@@ -1,0 +1,56 @@
+"""paddle_tpu: a TPU-native deep learning framework with PaddlePaddle's API.
+
+Compute path: JAX/XLA (+ Pallas kernels); eager dygraph semantics with a
+vjp tape; whole-program XLA compilation for static graph & jitted train steps;
+SPMD parallelism over jax.sharding meshes.
+"""
+from .core.tensor import Tensor, Parameter, to_tensor
+from .core import autograd
+from .core.autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled
+from .core.dtypes import (
+    bool, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, set_default_dtype, get_default_dtype)
+from .core.place import (
+    CPUPlace, TPUPlace, XLAPlace, CUDAPlace, CUDAPinnedPlace, set_device,
+    get_device, is_compiled_with_cuda, is_compiled_with_tpu, is_compiled_with_xpu,
+    device_count)
+from .core.rng import seed, get_rng_state, set_rng_state, Generator
+
+from .tensor import *  # noqa: F401,F403
+from .tensor import creation, math, manipulation, linalg, logic, search, stat, random
+
+from . import nn
+from . import optimizer
+from . import io
+from . import metric
+from . import distribution
+from . import vision
+from . import text
+from . import distributed
+from . import static
+from . import jit
+from . import amp
+from . import incubate
+from . import utils
+from . import device
+from . import regularizer
+from . import sysconfig
+from .framework import save, load, in_dynamic_mode, enable_static, disable_static, in_static_mode
+from .hapi.model import Model
+from .hapi.model_summary import summary
+from .nn.initializer import ParamAttr
+from .utils.profiler import profiler
+from . import version
+from .utils.install_check import run_check
+from .batch import batch
+from . import fluid  # compat namespace
+
+disable_signal_handler = lambda: None
+
+__version__ = version.full_version
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs estimator (parity: paddle.flops)."""
+    from .hapi.model_summary import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops, print_detail=print_detail)

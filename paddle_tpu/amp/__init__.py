@@ -1,0 +1,172 @@
+"""Automatic mixed precision.
+
+Parity: python/paddle/fluid/contrib/mixed_precision/ (decorate, AMP lists,
+loss scaling). TPU-first: bf16 is the native mixed-precision dtype (no loss
+scaling needed); fp16 + dynamic GradScaler kept for parity. auto_cast switches
+matmul/conv inputs to the low-precision dtype while keeping
+normalization/softmax/reductions in fp32 (the reference's white/black lists).
+"""
+import contextlib
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+
+__all__ = ['auto_cast', 'amp_guard', 'GradScaler', 'decorate',
+           'white_list', 'black_list']
+
+# mirrors fluid/contrib/mixed_precision/fp16_lists.py
+white_list = {'conv2d', 'matmul', 'mul', 'einsum', 'linear', 'bmm'}
+black_list = {'exp', 'square', 'log', 'mean', 'sum', 'cos_sim', 'softmax',
+              'softmax_with_cross_entropy', 'sigmoid_cross_entropy_with_logits',
+              'cross_entropy', 'layer_norm', 'batch_norm'}
+
+_tls = threading.local()
+
+
+def _amp_state():
+    if not hasattr(_tls, 'stack'):
+        _tls.stack = []
+    return _tls.stack
+
+
+def amp_enabled():
+    s = _amp_state()
+    return s[-1] if s else None
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level='O1', dtype='bfloat16'):
+    from ..core.dtypes import convert_dtype
+    state = {'enable': enable, 'dtype': convert_dtype(dtype),
+             'white': set(white_list) | set(custom_white_list or ()),
+             'black': set(black_list) | set(custom_black_list or ()),
+             'level': level} if enable else None
+    _amp_state().append(state)
+    try:
+        yield
+    finally:
+        _amp_state().pop()
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_for(op_name, *values):
+    """Used by F.linear/conv/matmul: cast inputs to amp dtype inside autocast."""
+    st = amp_enabled()
+    if not st or not st['enable']:
+        return values
+    if op_name in st['black']:
+        return values
+    if st['level'] == 'O2' or op_name in st['white']:
+        dt = st['dtype']
+        return tuple(v.astype(dt) if np.issubdtype(np.dtype(v.dtype),
+                                                   np.floating) or
+                     v.dtype == jnp.bfloat16 else v for v in values)
+    return values
+
+
+class GradScaler:
+    """Dynamic loss scaling. Parity: mixed_precision/decorator.py loss scaler.
+
+    With bf16 (TPU default) scaling is a mathematical no-op but the API is
+    kept so fp16 parity scripts run unmodified.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._parameters or []
+        inv = 1.0 / self._scale
+        found = False
+        with no_grad():
+            for p in params:
+                if p.grad is not None:
+                    g = p.grad._value * inv
+                    if bool(jnp.any(~jnp.isfinite(g))):
+                        found = True
+                    p.grad._inplace_value(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {'scale': self._scale, 'good': self._good_steps,
+                'bad': self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get('scale', self._scale)
+        self._good_steps = sd.get('good', 0)
+        self._bad_steps = sd.get('bad', 0)
+
+
+def decorate(optimizer=None, models=None, level='O1', dtype='bfloat16',
+             init_loss_scaling=2.**15, use_dynamic_loss_scaling=True,
+             **kwargs):
+    """Parity: mixed_precision.decorate — casts model to dtype at O2."""
+    from ..core.dtypes import convert_dtype
+    if level == 'O2' and models is not None:
+        ms = models if isinstance(models, (list, tuple)) else [models]
+        for m in ms:
+            m.to(dtype=dtype)
+    if models is None:
+        return optimizer
+    return models, optimizer

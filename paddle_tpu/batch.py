@@ -1,0 +1,16 @@
+"""Parity: python/paddle/batch.py — minibatch reader decorator."""
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    if batch_size <= 0:
+        raise ValueError("batch_size should be a positive integer")
+    return batch_reader

@@ -1,0 +1,69 @@
+"""Dtype registry.
+
+Reference parity: paddle/fluid/framework.py (VarDesc dtypes) and
+python/paddle/fluid/data_feeder.py:convert_dtype. TPU-first divergence: int64 is
+accepted at the API but may be stored as int32 when jax x64 mode is off (XLA on
+TPU prefers 32-bit indices); float64 likewise degrades to float32 on TPU.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+bool = jnp.bool_
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    'bool': jnp.bool_, 'uint8': jnp.uint8, 'int8': jnp.int8, 'int16': jnp.int16,
+    'int32': jnp.int32, 'int64': jnp.int64, 'float16': jnp.float16,
+    'bfloat16': jnp.bfloat16, 'float32': jnp.float32, 'float64': jnp.float64,
+    'complex64': jnp.complex64, 'complex128': jnp.complex128,
+    'float': jnp.float32, 'double': jnp.float64, 'half': jnp.float16,
+    'int': jnp.int32, 'long': jnp.int64,
+}
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def set_default_dtype(d):
+    _DEFAULT_DTYPE[0] = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def convert_dtype(dtype):
+    """Normalize str/np/jnp dtype specifiers to a numpy dtype type."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        return _STR2DTYPE[dtype]
+    return np.dtype(dtype).type if not hasattr(dtype, 'dtype') else dtype
+
+
+def dtype_name(dtype):
+    return np.dtype(dtype).name
+
+
+def is_floating(dtype):
+    return np.issubdtype(np.dtype(dtype), np.floating) or np.dtype(dtype) == np.dtype(jnp.bfloat16)
+
+
+def is_integer(dtype):
+    return np.issubdtype(np.dtype(dtype), np.integer)
+
+
+def is_complex(dtype):
+    return np.issubdtype(np.dtype(dtype), np.complexfloating)

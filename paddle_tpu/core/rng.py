@@ -1,0 +1,88 @@
+"""Random state management.
+
+Reference parity: python/paddle/fluid/generator.py + paddle/fluid/framework/generator.cc
+(global 64-bit Philox-style engines per device). TPU-first: JAX threefry keys;
+a stateful Generator splits keys for eager ops, and ``key_scope`` threads an
+explicit key through jit-traced regions so compiled functions stay pure.
+"""
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed=0):
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(self._seed)
+
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(self._seed)
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def initial_seed(self):
+        return self._seed
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return np.asarray(self._key)
+
+    def set_state(self, state):
+        self._key = jax.numpy.asarray(state, dtype=jax.numpy.uint32)
+
+
+default_generator = Generator(0)
+
+_tls = threading.local()
+
+
+def _scoped_gen():
+    return getattr(_tls, 'gen_stack', None)
+
+
+def current_generator():
+    stack = _scoped_gen()
+    if stack:
+        return stack[-1]
+    return default_generator
+
+
+def next_key():
+    return current_generator().next_key()
+
+
+@contextlib.contextmanager
+def key_scope(key):
+    """Run a region with RNG derived from an explicit key (pure under jit)."""
+    gen = Generator.__new__(Generator)
+    gen._seed = -1
+    gen._key = key
+    if not hasattr(_tls, 'gen_stack'):
+        _tls.gen_stack = []
+    _tls.gen_stack.append(gen)
+    try:
+        yield gen
+    finally:
+        _tls.gen_stack.pop()
+
+
+def seed(s):
+    """Parity: paddle.seed / fluid.Program.random_seed."""
+    default_generator.manual_seed(s)
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)

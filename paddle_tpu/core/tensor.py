@@ -1,0 +1,381 @@
+"""Tensor: eager device array with Paddle dygraph semantics on JAX.
+
+Reference parity: paddle/fluid/imperative/layer.h (VarBase), python/paddle/fluid/
+framework.py Variable methods + python/paddle/fluid/layers/math_op_patch.py
+(operator overloads). TPU-first: the payload is a jax.Array living in TPU HBM;
+every op is a pure closure recorded on the autograd tape (see autograd.py), so
+eager code, jit-traced code and grad transforms share one implementation.
+"""
+import numbers
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtypes import convert_dtype, get_default_dtype, is_floating
+from .place import get_place, CPUPlace, TPUPlace
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "_node", "_grad", "name", "persistable",
+                 "__weakref__")
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._node = None
+        self._grad = None
+        self.name = name
+        self.persistable = False
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        if _is_tracer(self._value):
+            return get_place()
+        dev = list(self._value.devices())[0]
+        return CPUPlace(dev.id) if dev.platform == 'cpu' else TPUPlace(dev.id)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    def numel(self):
+        return self.size
+
+    def is_leaf(self):
+        return self._node is None
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            data = np.asarray(jax.device_get(self._value))
+            body = np.array2string(data, precision=8, separator=', ')
+        except Exception:
+            body = f"<traced {self._value}>"
+        return (f"Tensor(shape={self.shape}, dtype={np.dtype(self.dtype).name}, "
+                f"stop_gradient={sg},\n       {body})")
+
+    # -- host interop -------------------------------------------------------
+    def numpy(self):
+        return np.asarray(jax.device_get(self._value))
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __index__(self):
+        return int(self.item())
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def _accumulate_grad(self, g):
+        if self._grad is None:
+            self._grad = Tensor(g)
+        else:
+            self._grad = Tensor(self._grad._value + g)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self):
+        return apply_op(lambda x: x + 0, (self,))
+
+    def _inplace_value(self, value):
+        """Replace payload (breaks history — used by optimizers / set_value)."""
+        self._value = value
+        self._node = None
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {list(value.shape)} vs {self.shape}")
+        self._inplace_value(value)
+
+    # -- shape/dtype ops (intrinsic) ---------------------------------------
+    def astype(self, dtype):
+        dt = convert_dtype(dtype)
+        diff = is_floating(dt)
+        return apply_op(lambda x: x.astype(dt), (self,), differentiable=diff)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def reshape(self, shape, name=None):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = shape[0]
+        shape = tuple(int(s) for s in shape)
+        return apply_op(lambda x: jnp.reshape(x, shape), (self,))
+
+    def reshape_(self, shape):
+        out = self.reshape(shape)
+        self._inplace_value(out._value)
+        return self
+
+    def transpose(self, perm, name=None):
+        perm = tuple(int(p) for p in perm)
+        return apply_op(lambda x: jnp.transpose(x, perm), (self,))
+
+    @property
+    def T(self):
+        return apply_op(lambda x: x.T, (self,))
+
+    def squeeze(self, axis=None, name=None):
+        def fn(x):
+            if axis is None:
+                return jnp.squeeze(x)
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            axes = tuple(a for a in axes if x.shape[a] == 1)
+            return jnp.squeeze(x, axes) if axes else x
+        return apply_op(fn, (self,))
+
+    def unsqueeze(self, axis, name=None):
+        axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+        return apply_op(lambda x: jnp.expand_dims(x, axes), (self,))
+
+    def flatten(self, start_axis=0, stop_axis=-1, name=None):
+        nd = self.ndim
+        sa = start_axis % nd if nd else 0
+        ea = stop_axis % nd if nd else 0
+        def fn(x):
+            shp = x.shape
+            mid = int(np.prod(shp[sa:ea + 1])) if shp else 1
+            return jnp.reshape(x, shp[:sa] + (mid,) + shp[ea + 1:])
+        return apply_op(fn, (self,))
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        idx = _convert_index(idx)
+        return apply_op(lambda x: x[idx], (self,))
+
+    def __setitem__(self, idx, value):
+        idx = _convert_index(idx)
+        v = value._value if isinstance(value, Tensor) else value
+        new = self._value.at[idx].set(jnp.asarray(v, dtype=self.dtype) if not _is_tracer(v) else v)
+        self._inplace_value(new)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- arithmetic (math_op_patch parity) ----------------------------------
+    def _binary(self, other, fn, reverse=False):
+        other = _coerce(other, self)
+        a, b = (other, self) if reverse else (self, other)
+        return apply_op(fn, (a, b))
+
+    def __add__(self, o): return self._binary(o, jnp.add)
+    def __radd__(self, o): return self._binary(o, jnp.add, True)
+    def __sub__(self, o): return self._binary(o, jnp.subtract)
+    def __rsub__(self, o): return self._binary(o, jnp.subtract, True)
+    def __mul__(self, o): return self._binary(o, jnp.multiply)
+    def __rmul__(self, o): return self._binary(o, jnp.multiply, True)
+    def __truediv__(self, o): return self._binary(o, jnp.true_divide)
+    def __rtruediv__(self, o): return self._binary(o, jnp.true_divide, True)
+    def __floordiv__(self, o): return self._binary(o, jnp.floor_divide)
+    def __rfloordiv__(self, o): return self._binary(o, jnp.floor_divide, True)
+    def __mod__(self, o): return self._binary(o, jnp.mod)
+    def __rmod__(self, o): return self._binary(o, jnp.mod, True)
+    def __pow__(self, o): return self._binary(o, jnp.power)
+    def __rpow__(self, o): return self._binary(o, jnp.power, True)
+    def __matmul__(self, o): return self._binary(o, jnp.matmul)
+    def __rmatmul__(self, o): return self._binary(o, jnp.matmul, True)
+    def __neg__(self): return apply_op(jnp.negative, (self,))
+    def __abs__(self): return apply_op(jnp.abs, (self,))
+
+    def __eq__(self, o): return self._binary(o, jnp.equal) if not _is_module_sentinel(o) else NotImplemented
+    def __ne__(self, o): return self._binary(o, jnp.not_equal)
+    def __lt__(self, o): return self._binary(o, jnp.less)
+    def __le__(self, o): return self._binary(o, jnp.less_equal)
+    def __gt__(self, o): return self._binary(o, jnp.greater)
+    def __ge__(self, o): return self._binary(o, jnp.greater_equal)
+    def __invert__(self): return apply_op(jnp.logical_not, (self,), differentiable=False)
+
+    __hash__ = object.__hash__
+
+    # extra methods are attached by paddle_tpu.tensor modules via register_method
+
+
+def _is_module_sentinel(o):
+    return o is None
+
+
+def _coerce(other, ref):
+    if isinstance(other, Tensor):
+        return other
+    if isinstance(other, numbers.Number) or isinstance(other, (bool, np.bool_)):
+        dt = ref.dtype
+        if isinstance(other, float) and not is_floating(dt):
+            dt = get_default_dtype()
+        return Tensor(jnp.asarray(other, dtype=dt))
+    return Tensor(jnp.asarray(other))
+
+
+def _convert_index(idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+_SYMBOLIC_HANDLER = [None]
+
+
+def set_symbolic_handler(handler):
+    """Installed by paddle_tpu.static: routes ops on symbolic Variables into
+    the current Program instead of executing them (static-graph capture)."""
+    _SYMBOLIC_HANDLER[0] = handler
+
+
+def apply_op(fn, tensors, n_outputs=1, differentiable=True):
+    """Run a pure fn over tensor payloads; record on the tape if needed.
+
+    ``tensors`` are the differentiable positional inputs; every non-tensor
+    argument must already be closed over in ``fn``.
+    """
+    if _SYMBOLIC_HANDLER[0] is not None and any(
+            getattr(t, '_symbolic', False) for t in tensors):
+        return _SYMBOLIC_HANDLER[0](fn, tensors, n_outputs, differentiable)
+    tensors = tuple(t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+                    for t in tensors)
+    vals = [t._value for t in tensors]
+    out_vals = fn(*vals)
+    multi = n_outputs > 1
+    requires = (differentiable and autograd.is_grad_enabled()
+                and any(not t.stop_gradient for t in tensors))
+    if multi:
+        outs = tuple(Tensor(v, stop_gradient=not (requires and _diffable(v)))
+                     for v in out_vals)
+        if requires:
+            autograd.record(fn, tensors, outs, multi=True)
+        return outs
+    out = Tensor(out_vals, stop_gradient=not (requires and _diffable(out_vals)))
+    if requires:
+        autograd.record(fn, tensors, (out,), multi=False)
+    return out
+
+
+def _diffable(v):
+    return np.issubdtype(np.dtype(v.dtype), np.inexact) or v.dtype == jnp.bfloat16
+
+
+def register_method(name, fn):
+    setattr(Tensor, name, fn)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor — reference: python/paddle/tensor/creation.py:to_tensor."""
+    dt = convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        v = data._value
+        if dt is not None and v.dtype != np.dtype(dt):
+            v = v.astype(dt)
+        return Tensor(v, stop_gradient=stop_gradient)
+    if isinstance(data, (numbers.Number, bool)) and dt is None:
+        if isinstance(data, (bool, np.bool_)):
+            dt = jnp.bool_
+        elif isinstance(data, numbers.Integral):
+            dt = jnp.int64
+        elif isinstance(data, numbers.Real):
+            dt = get_default_dtype()
+        elif isinstance(data, numbers.Complex):
+            dt = jnp.complex64
+    arr = np.asarray(data)
+    if dt is None and arr.dtype == np.float64:
+        dt = get_default_dtype()
+    dev = None
+    if place is not None:
+        try:
+            dev = place.jax_device()
+        except Exception:
+            dev = None
+    v = jnp.asarray(arr, dtype=dt)
+    if dev is not None:
+        v = jax.device_put(v, dev)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+class Parameter(Tensor):
+    """Trainable tensor. Parity: framework.py:Parameter."""
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, value, name=None, trainable=True, regularizer=None,
+                 learning_rate=1.0, need_clip=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {'learning_rate': learning_rate}
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        self.is_distributed = False
+        self.persistable = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

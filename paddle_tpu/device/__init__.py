@@ -1,0 +1,45 @@
+"""Device management. Parity: python/paddle/device.py."""
+import jax
+
+from ..core.place import (set_device, get_device, get_place, CPUPlace, TPUPlace,
+                          XLAPlace, CUDAPlace, is_compiled_with_cuda,
+                          is_compiled_with_tpu, device_count)
+
+__all__ = ['set_device', 'get_device', 'get_place', 'CPUPlace', 'TPUPlace',
+           'XLAPlace', 'CUDAPlace', 'is_compiled_with_cuda',
+           'is_compiled_with_tpu', 'device_count', 'get_all_device_type',
+           'get_available_device', 'synchronize', 'memory_stats']
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes."""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def memory_stats(device=None):
+    """Live/peak HBM bytes (parity: fluid/memory stats)."""
+    try:
+        d = jax.devices()[0]
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+class cuda:
+    """Namespace shim: paddle.device.cuda.* maps onto the TPU device."""
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()

@@ -1,0 +1,13 @@
+"""paddle_tpu.distributed. Parity: python/paddle/distributed/__init__.py."""
+from . import env
+from .env import (init_parallel_env, init_distributed, get_rank,
+                  get_world_size, ParallelEnv, get_mesh, set_mesh)
+from .collective import (ReduceOp, all_reduce, all_gather, broadcast, reduce,
+                         scatter, reduce_scatter, alltoall, all_to_all,
+                         barrier, ppermute, new_group)
+from .parallel import DataParallel
+from . import fleet
+from . import sharding
+from .sharding import shard_tensor, shard_layer
+from .ring_attention import ring_attention
+from .launch import spawn, launch

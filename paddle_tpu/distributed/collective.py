@@ -1,0 +1,193 @@
+"""Collective ops over the device mesh.
+
+Parity: python/paddle/distributed/collective.py (c_allreduce_sum/_max,
+c_broadcast, c_allgather, ... backed by NCCL in
+paddle/fluid/operators/collective/). TPU-first: XLA collectives (psum/pmax/
+all_gather/ppermute) over ICI. Two modes:
+
+- inside a pjit/shard_map-traced region: ops lower straight to lax collectives
+  on the named mesh axis;
+- eager on sharded Tensors: wrapped in a one-off shard_map so single-process
+  SPMD code matches the reference's eager collective API.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..core.tensor import Tensor, apply_op
+from ..tensor._helpers import _t
+from . import env
+
+__all__ = ['ReduceOp', 'all_reduce', 'all_gather', 'broadcast', 'reduce',
+           'scatter', 'reduce_scatter', 'alltoall', 'all_to_all', 'barrier',
+           'send', 'recv', 'ppermute', 'split_group', 'new_group']
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+
+
+_LAX_REDUCE = {
+    ReduceOp.SUM: lax.psum,
+    ReduceOp.MAX: lax.pmax,
+    ReduceOp.MIN: lax.pmin,
+    ReduceOp.PROD: lambda x, a: jnp.exp(lax.psum(jnp.log(jnp.maximum(x, 1e-30)), a)),
+}
+
+
+def _in_trace(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis(group):
+    if group is None or isinstance(group, int):
+        return env.current_data_axis() or env.DATA_AXIS
+    return group
+
+
+def _eager_collective(x, per_shard_fn, axis):
+    """Run a collective eagerly over a mesh-sharded value via shard_map."""
+    mesh = env.get_mesh()
+    if mesh is None or env.get_world_size(axis) <= 1:
+        return x
+    spec = P(axis)
+    fn = shard_map(per_shard_fn, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    return fn(x)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    t = _t(tensor)
+    axis = _axis(group)
+    red = _LAX_REDUCE[op]
+
+    def fn(v):
+        if _in_trace(v):
+            try:
+                return red(v, axis)
+            except NameError:
+                pass
+        mesh = env.get_mesh()
+        if mesh is None or env.get_world_size(axis) <= 1:
+            return v
+        shard = shard_map(lambda s: red(s, axis), mesh=mesh,
+                          in_specs=(P(axis),), out_specs=P(axis))
+        # replicate input over axis so every shard reduces the same value
+        tiled = jnp.concatenate([v] * env.get_world_size(axis), axis=0)
+        out = shard(tiled)
+        return out[:v.shape[0]]
+    out = apply_op(fn, (t,))
+    if isinstance(tensor, Tensor):
+        tensor._inplace_value(out._value)
+        return tensor
+    return out
+
+
+def in_jit_all_reduce(value, axis=None, op=ReduceOp.SUM):
+    """For use inside pjit/shard_map-traced train steps (the hot path)."""
+    return _LAX_REDUCE[op](value, axis or env.DATA_AXIS)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=None):
+    t = _t(tensor)
+    ax = axis or _axis(group)
+
+    def fn(v):
+        if _in_trace(v):
+            return lax.all_gather(v, ax)
+        n = env.get_world_size(ax)
+        return jnp.stack([v] * max(n, 1))
+    out = apply_op(fn, (t,))
+    if tensor_list is not None:
+        n = out.shape[0]
+        from ..tensor.manipulation import unstack
+        tensor_list.extend(unstack(out, axis=0))
+    return out
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """On SPMD-TPU all replicas already hold identical values after psum;
+    broadcast is an identity + optional device sync (documented divergence)."""
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        idx = env.get_rank()
+        src_t = tensor_list[idx if idx < len(tensor_list) else 0]
+        tensor._inplace_value(_t(src_t)._value)
+    return tensor
+
+
+def reduce_scatter(output, input, op=ReduceOp.SUM, group=None, axis=None):
+    t = _t(input)
+    ax = axis or _axis(group)
+
+    def fn(v):
+        if _in_trace(v):
+            return lax.psum_scatter(v, ax, tiled=True)
+        return v
+    out = apply_op(fn, (t,))
+    if output is not None and isinstance(output, Tensor):
+        output._inplace_value(out._value)
+    return out
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, axis=None):
+    ts = [_t(x) for x in in_tensor_list]
+    ax = axis or _axis(group)
+    from ..tensor.manipulation import stack, unstack
+
+    stacked = stack(ts, axis=0)
+
+    def fn(v):
+        if _in_trace(v):
+            return lax.all_to_all(v, ax, split_axis=0, concat_axis=0)
+        return v
+    out = apply_op(fn, (stacked,))
+    outs = unstack(out, axis=0)
+    if out_tensor_list is not None:
+        out_tensor_list.extend(outs)
+    return outs
+
+
+all_to_all = alltoall
+
+
+def ppermute(value, perm, axis=None):
+    """Ring shift primitive (traced only) — backbone of ring attention & PP."""
+    ax = axis or env.DATA_AXIS
+    return lax.ppermute(value, ax, perm)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv are expressed as lax.ppermute inside jitted "
+        "regions on TPU; use distributed.ppermute")
+
+
+recv = send
+
+
+def barrier(group=None):
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def new_group(ranks=None, backend=None):
+    """Returns the axis name to use for this group (simplified)."""
+    return env.current_data_axis() or env.DATA_AXIS
+
+
+def split_group(*a, **k):
+    return new_group()

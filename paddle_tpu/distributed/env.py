@@ -1,0 +1,126 @@
+"""Distributed environment: device mesh + rank/world bookkeeping.
+
+Parity: python/paddle/distributed/parallel.py (init_parallel_env, ParallelEnv)
++ fleet role makers. TPU-first redesign: "ranks" are positions on a
+jax.sharding.Mesh; single-process SPMD over all local devices replaces the
+reference's one-process-per-GPU + NCCL model. Multi-host initialization maps
+onto jax.distributed.initialize.
+"""
+import os
+import threading
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_state = threading.local()
+_global = {
+    'mesh': None,
+    'initialized': False,
+}
+
+# canonical logical axis names
+DATA_AXIS = 'data'
+MODEL_AXIS = 'model'
+PIPE_AXIS = 'pipe'
+SEQ_AXIS = 'seq'
+
+
+def init_parallel_env(mesh_shape=None, axis_names=None):
+    """Create the global device mesh. Default: 1-D 'data' mesh over all devices."""
+    devices = np.asarray(jax.devices())
+    if mesh_shape is None:
+        mesh_shape = (len(devices),)
+        axis_names = axis_names or (DATA_AXIS,)
+    else:
+        mesh_shape = tuple(mesh_shape)
+        axis_names = tuple(axis_names or
+                           (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS)[:len(mesh_shape)])
+    devs = devices.reshape(mesh_shape)
+    _global['mesh'] = Mesh(devs, axis_names)
+    _global['initialized'] = True
+    return ParallelEnv()
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Multi-host bring-up (parity: paddle.distributed.launch env wiring)."""
+    kwargs = {}
+    if coordinator_address:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)
+    return init_parallel_env()
+
+
+def get_mesh():
+    return _global['mesh']
+
+
+def set_mesh(mesh):
+    _global['mesh'] = mesh
+    _global['initialized'] = True
+
+
+def is_initialized():
+    return _global['initialized']
+
+
+def get_world_size(axis=None):
+    mesh = _global['mesh']
+    if mesh is None:
+        return 1
+    if axis is None:
+        return int(np.prod(list(mesh.shape.values())))
+    return int(mesh.shape.get(axis, 1))
+
+
+def get_rank(axis=None):
+    """Process-level rank (multi-host) — single-host SPMD is always rank 0."""
+    return jax.process_index() if axis is None else 0
+
+
+def current_data_axis():
+    """Inside shard_map/pjit-traced code, the active data-parallel axis name."""
+    return getattr(_state, 'data_axis', None)
+
+
+def set_current_data_axis(axis):
+    _state.data_axis = axis
+
+
+class ParallelEnv:
+    """Parity: fluid/dygraph/parallel.py:ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def device_type(self):
+        return jax.default_backend()
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get('PADDLE_CURRENT_ENDPOINT', '127.0.0.1:6170')
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get('PADDLE_TRAINER_ENDPOINTS',
+                              '127.0.0.1:6170').split(',')

@@ -1,0 +1,211 @@
+"""Fleet: distributed training facade.
+
+Parity: python/paddle/fluid/incubate/fleet/ (base/fleet_base.py, collective/)
+and the 2.x fleet API surface. TPU-first: "collective" mode configures a
+device mesh; distributed_optimizer wraps the optimizer so grads are psum'd
+over the 'data' axis; parameter-server mode maps to sharded embeddings
+(see sharding.VocabParallelEmbedding) with synchronous updates.
+"""
+from ..core.autograd import no_grad
+from . import env
+from . import collective
+
+
+class DistributedStrategy:
+    """Parity: DistributedStrategy knobs (subset meaningful on TPU)."""
+
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False            # ZeRO/FSDP param sharding
+        self.sharding_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {'tensor_parallel_degree': 1}
+        self.pipeline = False
+        self.pipeline_configs = {'accumulate_steps': 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {'k_steps': 1}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False                 # grad compression: bf16 allreduce
+        self.nccl_comm_num = 1           # ignored (ICI collectives)
+        self.hierarchical_allreduce = False
+
+
+class _RoleMaker:
+    def is_first_worker(self):
+        return env.get_rank() == 0
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def worker_index(self):
+        return env.get_rank()
+
+    def worker_num(self):
+        return max(env.get_world_size(), 1)
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._role = _RoleMaker()
+        self._user_defined_optimizer = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             mesh_shape=None, axis_names=None):
+        self._strategy = strategy or DistributedStrategy()
+        if not env.is_initialized():
+            if strategy is not None and strategy.tensor_parallel:
+                tp = strategy.tensor_parallel_configs.get(
+                    'tensor_parallel_degree', 1)
+                import jax
+                total = jax.device_count()
+                env.init_parallel_env((total // tp, tp),
+                                      (env.DATA_AXIS, env.MODEL_AXIS))
+            else:
+                env.init_parallel_env(mesh_shape, axis_names)
+        return self
+
+    # role predicates -------------------------------------------------------
+    def is_first_worker(self):
+        return self._role.is_first_worker()
+
+    def worker_index(self):
+        return self._role.worker_index()
+
+    def worker_num(self):
+        return self._role.worker_num()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def server_num(self):
+        return 0
+
+    def barrier_worker(self):
+        collective.barrier()
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    @property
+    def worker_endpoints(self):
+        return env.ParallelEnv().trainer_endpoints
+
+    # optimizer -------------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._strategy = strategy or self._strategy or DistributedStrategy()
+        self._user_defined_optimizer = optimizer
+        return _DistributedOptimizer(optimizer, self._strategy)
+
+    def distributed_model(self, model):
+        from .parallel import DataParallel
+        return DataParallel(model)
+
+    # save/load -------------------------------------------------------------
+    def save_inference_model(self, *args, **kwargs):
+        from ..static.io import save_inference_model
+        return save_inference_model(*args, **kwargs)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ..static.io import save_persistables
+        return save_persistables(executor, dirname, main_program)
+
+
+class _DistributedOptimizer:
+    """Wraps an optimizer: allreduce-mean grads over 'data' before stepping."""
+
+    def __init__(self, inner, strategy):
+        self.inner = inner
+        self.strategy = strategy
+        self._accum = 0
+
+    @property
+    def _parameters(self):
+        return self.inner._parameters
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    @no_grad()
+    def _sync_grads(self):
+        n = env.get_world_size(env.DATA_AXIS)
+        if n <= 1:
+            return
+        params = self.inner._parameters or []
+        for p in params:
+            if p.grad is not None:
+                if self.strategy and self.strategy.dgc:
+                    g16 = p.grad._value.astype('bfloat16')
+                    from ..core.tensor import Tensor
+                    t = Tensor(g16)
+                    collective.all_reduce(t)
+                    p.grad._inplace_value((t._value / n).astype(p.dtype))
+                else:
+                    collective.all_reduce(p.grad)
+                    p.grad._inplace_value(p.grad._value / n)
+
+    def step(self):
+        k = (self.strategy.gradient_merge_configs.get('k_steps', 1)
+             if self.strategy and self.strategy.gradient_merge else 1)
+        self._accum += 1
+        if self._accum % k != 0:
+            return  # keep accumulating (grads already sum into .grad)
+        self._sync_grads()
+        self.inner.step()
+
+    def minimize(self, loss, *args, **kwargs):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return [], []
+
+    def clear_grad(self):
+        k = (self.strategy.gradient_merge_configs.get('k_steps', 1)
+             if self.strategy and self.strategy.gradient_merge else 1)
+        if self._accum % k == 0:
+            self.inner.clear_grad()
+
+    def state_dict(self):
+        return self.inner.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner.set_state_dict(sd)
+
+
+fleet = Fleet()
+
+# module-level API parity: fleet.init(...), fleet.distributed_optimizer(...)
+init = fleet.init
+distributed_optimizer = fleet.distributed_optimizer
+distributed_model = fleet.distributed_model
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+barrier_worker = fleet.barrier_worker
+UserDefinedRoleMaker = _RoleMaker
+PaddleCloudRoleMaker = _RoleMaker

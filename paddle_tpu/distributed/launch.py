@@ -1,0 +1,29 @@
+"""Launch helpers. Parity: python/paddle/distributed/launch.py + spawn.py.
+
+On TPU, single-process SPMD drives all local chips, so spawn() simply runs the
+function in-process after mesh init; multi-host pods use init_distributed()
+(jax.distributed) with one process per host (documented divergence from the
+reference's one-proc-per-GPU).
+"""
+from . import env
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    if not env.is_initialized():
+        env.init_parallel_env()
+    result = func(*args)
+    class _Ctx:
+        def join(self):
+            return result
+    return _Ctx()
+
+
+def launch():
+    raise SystemExit(
+        "paddle_tpu: use `python your_script.py` directly — single-process "
+        "SPMD drives all local TPU chips; multi-host pods: set "
+        "coordinator_address and call distributed.init_distributed().")
+
+
+def get_cluster_and_pod(*a, **k):
+    return None, None

@@ -1,0 +1,53 @@
+"""DataParallel layer wrapper.
+
+Parity: python/paddle/fluid/dygraph/parallel.py:DataParallel (NCCL allreduce of
+grads). TPU-first: after backward, grads are mean-reduced over the 'data' mesh
+axis; inside a jitted train step the psum fuses into the compiled program.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+from ..nn.layer_base import Layer
+from . import env
+from . import collective
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """Reference scales loss by 1/nranks before backward; with psum-mean
+        gradient sync this is the same end result."""
+        n = env.get_world_size(env.DATA_AXIS)
+        if n <= 1:
+            return loss
+        return loss / n
+
+    @no_grad()
+    def apply_collective_grads(self):
+        n = env.get_world_size(env.DATA_AXIS)
+        if n <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                collective.all_reduce(p.grad)
+
+    # delegate module protocol to wrapped layers
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix='', include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

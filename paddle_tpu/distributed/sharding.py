@@ -1,0 +1,196 @@
+"""Sharding: tensor/model-parallel building blocks + param placement.
+
+Parity targets: the reference's Fleet tensor-parallel utilities and
+distributed_lookup_table (python/paddle/fluid/distribute_lookup_table.py,
+fleet meta optimizers). TPU-first: Megatron-style column/row parallel layers
+whose collectives are lax.psum over the 'model' mesh axis; parameter placement
+uses jax.sharding.NamedSharding so pjit propagates layouts.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, Parameter, apply_op
+from ..nn.layer_base import Layer
+from ..nn.initializer import XavierUniform, Normal
+from ..nn import functional as F
+from . import env
+
+__all__ = ['shard_tensor', 'shard_layer', 'ColumnParallelLinear',
+           'RowParallelLinear', 'VocabParallelEmbedding', 'param_pspecs',
+           'fsdp_pspecs']
+
+
+def shard_tensor(x, spec):
+    """Place a tensor on the mesh with a PartitionSpec (eager device_put)."""
+    mesh = env.get_mesh()
+    t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    if mesh is None:
+        return t
+    sharding = NamedSharding(mesh, spec if isinstance(spec, P) else P(*spec))
+    t._inplace_value(jax.device_put(t._value, sharding))
+    return t
+
+
+def shard_layer(layer, rules):
+    """Apply {param-name-substring: PartitionSpec} placement rules in-place."""
+    for name, p in layer.named_parameters():
+        for pat, spec in rules.items():
+            if pat in name:
+                shard_tensor(p, spec)
+                break
+    return layer
+
+
+def param_pspecs(layer, rules, default=P()):
+    """name -> PartitionSpec map for pjit in_shardings of the param pytree."""
+    out = {}
+    for name, _ in layer.named_parameters():
+        spec = default
+        for pat, s in rules.items():
+            if pat in name:
+                spec = s
+                break
+        out[name] = spec
+    return out
+
+
+def fsdp_pspecs(layer, axis=env.DATA_AXIS, min_size=1024):
+    """ZeRO-3 style: shard every large param's first divisible dim over `axis`."""
+    mesh = env.get_mesh()
+    n = env.get_world_size(axis)
+    out = {}
+    for name, p in layer.named_parameters():
+        spec = P()
+        if n > 1 and p.size >= min_size:
+            for d, s in enumerate(p.shape):
+                if s % n == 0:
+                    parts = [None] * len(p.shape)
+                    parts[d] = axis
+                    spec = P(*parts)
+                    break
+        out[name] = spec
+    return out
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with output dim split over the 'model' axis.
+
+    Inside a shard_map/pjit region each shard computes its slice; gather_output
+    controls whether results are all-gathered (Megatron semantics).
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, axis=env.MODEL_AXIS,
+                 name=None):
+        super().__init__()
+        self.axis = axis
+        self.gather_output = gather_output
+        self._n = env.get_world_size(axis)
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        shard_tensor(self.weight, P(None, axis))
+        if self.bias is not None:
+            shard_tensor(self.bias, P(axis))
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output and self._n > 1:
+            ax = self.axis
+
+            def fn(v):
+                if isinstance(v, jax.core.Tracer):
+                    try:
+                        g = lax.all_gather(v, ax, axis=v.ndim - 1, tiled=True)
+                        return g
+                    except NameError:
+                        return v
+                return v
+            out = apply_op(fn, (out,))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Linear with input dim split over the 'model' axis; psum on the output."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, axis=env.MODEL_AXIS,
+                 name=None):
+        super().__init__()
+        self.axis = axis
+        self.input_is_parallel = input_is_parallel
+        self._n = env.get_world_size(axis)
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        shard_tensor(self.weight, P(axis, None))
+
+    def forward(self, x):
+        ax = self.axis
+        tensors = (x, self.weight) + ((self.bias,) if self.bias is not None else ())
+
+        def fn(v, w, *b):
+            out = jnp.matmul(v, w)
+            if isinstance(out, jax.core.Tracer):
+                try:
+                    out = lax.psum(out, ax)
+                except NameError:
+                    pass
+            if b:
+                out = out + b[0]
+            return out
+        return apply_op(fn, tensors)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim split over the 'model' axis.
+
+    Replaces the reference's distributed_lookup_table / parameter-server
+    sparse embedding: each shard holds a vocab slice; out-of-range ids lookup
+    zero and a psum merges partial results (SparseCore-style dense gather).
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 axis=env.MODEL_AXIS, name=None):
+        super().__init__()
+        self.axis = axis
+        self._n = env.get_world_size(axis)
+        self.num_embeddings = num_embeddings
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0., 0.02))
+        shard_tensor(self.weight, P(axis, None))
+
+    def forward(self, x):
+        ax = self.axis
+        n_shards = self._n
+        vocab = self.num_embeddings
+
+        def fn(ids, w):
+            if isinstance(w, jax.core.Tracer) and w.shape[0] != vocab:
+                # sharded path: local slice of the table
+                per = w.shape[0]
+                try:
+                    shard_id = lax.axis_index(ax)
+                except NameError:
+                    shard_id = 0
+                lo = shard_id * per
+                local = ids - lo
+                in_range = (local >= 0) & (local < per)
+                safe = jnp.clip(local, 0, per - 1)
+                out = jnp.take(w, safe, axis=0)
+                out = jnp.where(in_range[..., None], out, 0.0)
+                try:
+                    out = lax.psum(out, ax)
+                except NameError:
+                    pass
+                return out
+            return jnp.take(w, ids, axis=0)
+        return apply_op(fn, (x, self.weight))

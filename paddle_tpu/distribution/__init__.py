@@ -1,0 +1,143 @@
+"""Probability distributions. Parity: python/paddle/distribution.py."""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..core import rng as _rng
+from ..tensor._helpers import _t, _shape
+
+__all__ = ['Distribution', 'Uniform', 'Normal', 'Categorical']
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low).astype('float32')
+        self.high = _t(high).astype('float32')
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+        shape = tuple(shape)
+        def fn(lo, hi):
+            full = shape + jnp.broadcast_shapes(lo.shape, hi.shape)
+            u = jax.random.uniform(key, full, dtype=lo.dtype)
+            return lo + (hi - lo) * u
+        return apply_op(fn, (self.low, self.high), differentiable=False)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, lo, hi: jnp.where((v >= lo) & (v < hi),
+                                        -jnp.log(hi - lo), -jnp.inf),
+            (_t(value), self.low, self.high))
+
+    def probs(self, value):
+        return apply_op(
+            lambda v, lo, hi: jnp.where((v >= lo) & (v < hi),
+                                        1.0 / (hi - lo), 0.0),
+            (_t(value), self.low, self.high))
+
+    def entropy(self):
+        return apply_op(lambda lo, hi: jnp.log(hi - lo), (self.low, self.high))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc).astype('float32')
+        self.scale = _t(scale).astype('float32')
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+        shape = tuple(shape)
+        def fn(m, s):
+            full = shape + jnp.broadcast_shapes(m.shape, s.shape)
+            return m + s * jax.random.normal(key, full, dtype=m.dtype)
+        return apply_op(fn, (self.loc, self.scale), differentiable=False)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, m, s: (-((v - m) ** 2) / (2 * s * s) -
+                             jnp.log(s) - 0.5 * math.log(2 * math.pi)),
+            (_t(value), self.loc, self.scale))
+
+    def probs(self, value):
+        return apply_op(
+            lambda v, m, s: jnp.exp(-((v - m) ** 2) / (2 * s * s)) /
+            (s * math.sqrt(2 * math.pi)),
+            (_t(value), self.loc, self.scale))
+
+    def entropy(self):
+        return apply_op(
+            lambda m, s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) +
+            jnp.zeros_like(m),
+            (self.loc, self.scale))
+
+    def kl_divergence(self, other):
+        return apply_op(
+            lambda m1, s1, m2, s2: (jnp.log(s2 / s1) +
+                                    (s1 * s1 + (m1 - m2) ** 2) /
+                                    (2 * s2 * s2) - 0.5),
+            (self.loc, self.scale, other.loc, other.scale))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits).astype('float32')
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+        shape = tuple(shape)
+        def fn(lg):
+            return jax.random.categorical(key, lg, shape=shape + lg.shape[:-1])
+        return apply_op(fn, (self.logits,), differentiable=False)
+
+    def _probs_val(self):
+        return apply_op(lambda lg: jax.nn.softmax(lg, axis=-1), (self.logits,))
+
+    def probs(self, value):
+        p = self._probs_val()
+        idx = _t(value)
+        return apply_op(
+            lambda pv, iv: jnp.take_along_axis(
+                jnp.broadcast_to(pv, iv.shape + pv.shape[-1:]),
+                iv[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            if pv.ndim == 1 else
+            jnp.take_along_axis(pv, iv[..., None].astype(jnp.int32),
+                                axis=-1)[..., 0],
+            (p, idx))
+
+    def log_prob(self, value):
+        from ..tensor.math import log
+        return log(self.probs(value))
+
+    def entropy(self):
+        return apply_op(
+            lambda lg: -jnp.sum(jax.nn.softmax(lg, -1) *
+                                jax.nn.log_softmax(lg, -1), axis=-1),
+            (self.logits,))
+
+    def kl_divergence(self, other):
+        return apply_op(
+            lambda a, b: jnp.sum(
+                jax.nn.softmax(a, -1) *
+                (jax.nn.log_softmax(a, -1) - jax.nn.log_softmax(b, -1)),
+                axis=-1),
+            (self.logits, other.logits))

@@ -1,0 +1,111 @@
+"""fluid compat namespace so reference-era scripts run unmodified.
+
+Parity: python/paddle/fluid/__init__.py — maps the 1.8 fluid API onto the
+TPU-native implementations.
+"""
+from ..static.graph import (Program, Variable, program_guard,
+                            default_main_program, default_startup_program,
+                            data as _static_data)
+from ..static import Executor, CompiledProgram, ParallelExecutor, \
+    BuildStrategy, ExecutionStrategy
+from ..static.io import (save_persistables, load_persistables, save_params,
+                         load_params, save_inference_model,
+                         load_inference_model)
+from ..core.place import CPUPlace, CUDAPlace, TPUPlace, CUDAPinnedPlace
+from ..core.tensor import Tensor, Parameter
+from ..nn.initializer import ParamAttr
+from .. import nn as _nn
+from ..nn import initializer
+from ..nn import clip
+from ..nn.clip import (GradientClipByValue, GradientClipByNorm,
+                       GradientClipByGlobalNorm)
+from ..nn.regularizer import L1Decay, L2Decay
+from .. import regularizer
+from ..io.dataloader import DataLoader
+from ..framework import (in_dygraph_mode, enable_static, disable_static,
+                         save, load)
+from ..core import rng as _rng
+from . import layers
+from . import dygraph
+from ..optimizer import optimizer as _opt_mod
+from ..utils import unique_name
+from ..utils import profiler
+
+
+def data(name, shape, dtype='float32', lod_level=0, append_batch_size=True):
+    if append_batch_size:
+        shape = [-1] + list(shape)
+    return _static_data(name, shape, dtype, lod_level)
+
+
+class optimizer:
+    """fluid.optimizer namespace (1.8 spelling: *Optimizer suffixes)."""
+    from ..optimizer import (SGD, Momentum, Adam, AdamW, Adamax, Adagrad,
+                             Adadelta, RMSProp, Lamb, LarsMomentum, Ftrl,
+                             ExponentialMovingAverage, LookAhead, ModelAverage)
+    SGDOptimizer = SGD
+    MomentumOptimizer = Momentum
+    AdamOptimizer = Adam
+    AdamaxOptimizer = Adamax
+    AdagradOptimizer = Adagrad
+    AdadeltaOptimizer = Adadelta
+    RMSPropOptimizer = RMSProp
+    LambOptimizer = Lamb
+    LarsMomentumOptimizer = LarsMomentum
+    FtrlOptimizer = Ftrl
+
+
+class initializer_ns:
+    pass
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+class _Scope:
+    def find_var(self, name):
+        prog = default_main_program()
+        if prog.global_block.has_var(name):
+            return _VarWrap(prog.global_block.var(name))
+        return None
+
+
+class _VarWrap:
+    def __init__(self, v):
+        self._v = v
+
+    def get_tensor(self):
+        return self._v.concrete.numpy() if self._v.concrete is not None \
+            else None
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        yield scope
+    return _guard()
+
+
+def set_flags(flags):
+    pass
+
+
+def get_flags(flags):
+    return {}
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+core = __import__('types').SimpleNamespace(
+    is_compiled_with_cuda=lambda: False,
+    is_compiled_with_xpu=lambda: False,
+    get_cuda_device_count=lambda: 0,
+)

@@ -1,0 +1,57 @@
+"""fluid.dygraph compat namespace."""
+import contextlib
+
+from ..nn.layer_base import Layer
+from ..nn.layer.container import Sequential, LayerList, ParameterList
+from ..nn.layer.common import Linear, Embedding, Dropout
+from ..nn.layer.norm import BatchNorm, LayerNorm, SpectralNorm
+from ..nn.layer.conv import Conv2D, Conv2DTranspose, Conv3D
+from ..nn.layer.pooling import MaxPool2D, AvgPool2D
+from ..core.autograd import no_grad, grad
+from ..core.tensor import to_tensor
+from ..distributed.parallel import DataParallel
+from ..distributed.env import ParallelEnv
+from ..jit import to_static as declarative, TranslatedLayer
+from ..jit import save as jit_save, load as jit_load
+from ..framework import save as save_dygraph, load as load_dygraph
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """1.8 dygraph.guard — dygraph is the default mode here."""
+    from ..framework import disable_static, in_static_mode, enable_static
+    was_static = in_static_mode()
+    disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            enable_static()
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    return to_tensor(value, dtype=dtype)
+
+
+def enabled():
+    from ..framework import in_dygraph_mode
+    return in_dygraph_mode()
+
+
+class Pool2D(Layer):
+    """1.8-era Pool2D layer."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, data_format="NCHW"):
+        super().__init__()
+        self._args = (pool_size, pool_type, pool_stride, pool_padding,
+                      global_pooling, ceil_mode, data_format)
+
+    def forward(self, input):
+        size, ptype, stride, pad, global_pool, ceil, fmt = self._args
+        from ..nn import functional as F
+        if global_pool:
+            return F.global_pool(input, 'avg' if ptype == 'avg' else 'max', fmt)
+        fn = F.max_pool2d if ptype == "max" else F.avg_pool2d
+        return fn(input, size, stride, pad, ceil_mode=ceil, data_format=fmt)

@@ -1,0 +1,127 @@
+"""fluid.layers compat: the 1.8 op-function namespace.
+
+Parity: python/paddle/fluid/layers/*. Maps onto the tensor/nn.functional
+implementations; works in both eager and static-capture modes because every
+op funnels through core.tensor.apply_op.
+"""
+from ..tensor import *  # noqa
+from ..tensor.math import (elementwise_add, elementwise_sub, elementwise_mul,
+                           elementwise_div, elementwise_max, elementwise_min,
+                           elementwise_mod, elementwise_pow, scale, increment)
+from ..tensor.creation import assign, zeros, ones, full, create_tensor
+from ..tensor.attribute import shape, rank
+from ..nn.functional import (relu, sigmoid, softmax, log_softmax, tanh,
+                             cross_entropy, softmax_with_cross_entropy,
+                             square_error_cost, one_hot, embedding, dropout,
+                             pad, unfold, log_loss, sequence_mask,
+                             sequence_pool, sequence_softmax, sequence_expand,
+                             sequence_reverse, sequence_concat, grid_sample,
+                             affine_grid, interpolate, label_smooth)
+from ..metric import accuracy
+from ..static.nn import fc, conv2d, batch_norm
+from ..static.nn import embedding as static_embedding
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, exclusive=True,
+           data_format="NCHW", name=None):
+    from ..nn import functional as F
+    if global_pooling:
+        return F.global_pool(input, 'avg' if pool_type == 'avg' else 'max',
+                             data_format)
+    fn = F.max_pool2d if pool_type == "max" else F.avg_pool2d
+    return fn(input, pool_size, pool_stride, pool_padding,
+              ceil_mode=ceil_mode, data_format=data_format)
+
+
+def mean(x, name=None):
+    from ..tensor.math import mean as _mean
+    return _mean(x)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    from ..tensor.math import mean as _mean
+    return _mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    from ..tensor.math import sum as _sum
+    return _sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    from ..tensor.math import max as _max
+    return _max(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    from ..tensor.math import min as _min
+    return _min(input, axis=dim, keepdim=keep_dim)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    from ..tensor.math import matmul as _matmul
+    xx = x.flatten(x_num_col_dims) if x.ndim > x_num_col_dims + 1 else x
+    return _matmul(xx, y)
+
+
+def cond(pred, true_fn, false_fn):
+    """Data-dependent branch. Eager: python branch; traced: lax.cond."""
+    import jax
+    from ..core.tensor import Tensor
+    pv = pred._value if isinstance(pred, Tensor) else pred
+    if isinstance(pv, jax.core.Tracer):
+        import jax.numpy as jnp
+        return jax.lax.cond(jnp.all(pv), true_fn, false_fn)
+    return true_fn() if bool(pv) else false_fn()
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    """Eager python loop / traced lax.while_loop on tensor pytrees."""
+    import jax
+    from ..core.tensor import Tensor
+    probe = [v for v in jax.tree_util.tree_leaves(loop_vars)
+             if isinstance(v, Tensor)]
+    traced = probe and isinstance(probe[0]._value, jax.core.Tracer)
+    if not traced:
+        while bool(cond_fn(*loop_vars)):
+            loop_vars = body_fn(*loop_vars)
+        return loop_vars
+    # traced: strip to values
+    def c(vals):
+        args = jax.tree_util.tree_unflatten(treedef, [Tensor(v) for v in vals])
+        return cond_fn(*args)._value
+    def b(vals):
+        args = jax.tree_util.tree_unflatten(treedef, [Tensor(v) for v in vals])
+        outs = body_fn(*args)
+        return [t._value for t in jax.tree_util.tree_leaves(outs)]
+    leaves, treedef = jax.tree_util.tree_flatten(list(loop_vars))
+    vals = [t._value for t in leaves]
+    out_vals = jax.lax.while_loop(c, b, vals)
+    return jax.tree_util.tree_unflatten(treedef, [Tensor(v) for v in out_vals])
+
+
+def case(pred_fn_pairs, default=None):
+    for pred, fn in pred_fn_pairs:
+        from ..core.tensor import Tensor
+        pv = bool(pred.numpy()) if isinstance(pred, Tensor) else bool(pred)
+        if pv:
+            return fn()
+    if default is not None:
+        return default()
+    raise ValueError("no branch taken and no default")
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    from ..core.tensor import Tensor
+    idx = int(branch_index.item()) if isinstance(branch_index, Tensor) else \
+        int(branch_index)
+    fns = dict(branch_fns) if isinstance(branch_fns, (list, tuple)) and \
+        isinstance(branch_fns[0], (list, tuple)) else branch_fns
+    if isinstance(fns, dict) and idx in fns:
+        return fns[idx]()
+    if isinstance(fns, (list, tuple)) and 0 <= idx < len(fns):
+        return fns[idx]()
+    if default is not None:
+        return default()
+    raise ValueError(f"no branch {idx}")

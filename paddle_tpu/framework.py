@@ -1,0 +1,98 @@
+"""Framework-level helpers: save/load, dygraph/static mode flags.
+
+Parity: python/paddle/framework/ (save/load from python/paddle/framework/io.py,
+in_dygraph_mode from fluid/framework.py).
+"""
+import io as _io
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor, Parameter
+
+_static_mode = [False]
+
+
+def in_dynamic_mode():
+    return not _static_mode[0]
+
+
+def in_dygraph_mode():
+    return not _static_mode[0]
+
+
+def in_static_mode():
+    return _static_mode[0]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj.numpy()),
+                              is_param=isinstance(obj, Parameter),
+                              name=obj.name)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    import jax.numpy as jnp
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Parameter(jnp.asarray(obj.array), name=obj.name) if obj.is_param \
+            else Tensor(jnp.asarray(obj.array), name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ('array', 'is_param', 'name')
+
+    def __init__(self, array, is_param=False, name=None):
+        self.array = array
+        self.is_param = is_param
+        self.name = name
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save — pickles nested state (Tensors -> numpy payloads)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = _to_saveable(obj)
+    with open(path, 'wb') as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path, **configs):
+    """paddle.load — counterpart of save(); also reads .npz archives."""
+    return_numpy = configs.get('return_numpy', False)
+    if path.endswith('.npz'):
+        data = np.load(path, allow_pickle=True)
+        return {k: data[k] for k in data.files}
+    with open(path, 'rb') as f:
+        payload = pickle.load(f)
+    return _from_saveable(payload, return_numpy)
+
+
+def set_grad_enabled(mode):
+    from .core import autograd
+    return autograd.set_grad_enabled(mode)

@@ -1,0 +1,4 @@
+"""High-level API. Parity: python/paddle/hapi/__init__.py."""
+from .model import Model
+from . import callbacks
+from .model_summary import summary, flops

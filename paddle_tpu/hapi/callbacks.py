@@ -1,0 +1,225 @@
+"""Training callbacks. Parity: python/paddle/hapi/callbacks.py."""
+import json
+import os
+import time
+
+import numpy as np
+
+from .progressbar import ProgressBar
+
+__all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'LRScheduler',
+           'EarlyStopping', 'VisualDL', 'CallbackList']
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        if name.startswith('on_'):
+            def call(*args, **kwargs):
+                for c in self.callbacks:
+                    getattr(c, name)(*args, **kwargs)
+            return call
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get('steps')
+        if self.verbose:
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}")
+        self.bar = ProgressBar(num=self.steps, verbose=self.verbose)
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        if self.verbose and step % self.log_freq == 0:
+            vals = [(k, v) for k, v in logs.items()
+                    if isinstance(v, (int, float, np.floating))]
+            self.bar.update(step + 1, vals)
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        if self.verbose:
+            vals = [(k, v) for k, v in logs.items()
+                    if isinstance(v, (int, float, np.floating))]
+            self.bar.update(self.steps or 0, vals)
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        if self.verbose:
+            info = ' - '.join(f"{k}: {v}" for k, v in logs.items())
+            print(f"Eval: {info}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, 'final'))
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, '_optimizer', None)
+        from ..optimizer.lr import LRScheduler as Sched
+        if opt and isinstance(opt._learning_rate, Sched):
+            return opt._learning_rate
+        return None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if s and self.by_step:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if s and self.by_epoch:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor='loss', mode='auto', patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.stopped_epoch = 0
+        if mode == 'min' or (mode == 'auto' and 'loss' in monitor):
+            self.monitor_op = np.less
+            self.min_delta *= -1
+        else:
+            self.monitor_op = np.greater
+        self.best = None
+        self.wait = 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        current = logs.get(self.monitor)
+        if current is None:
+            return
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.best is None or self.monitor_op(current - self.min_delta,
+                                                self.best):
+            self.best = current
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"Early stopping: best {self.monitor}={self.best}")
+
+
+class VisualDL(Callback):
+    """Scalar logger writing JSONL (VisualDL itself not bundled)."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._f = None
+        self._step = 0
+
+    def on_train_begin(self, logs=None):
+        self._f = open(os.path.join(self.log_dir, 'scalars.jsonl'), 'a')
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        rec = {'step': self._step, 'ts': time.time()}
+        for k, v in logs.items():
+            if isinstance(v, (int, float, np.floating)):
+                rec[k] = float(v)
+        self._f.write(json.dumps(rec) + '\n')
+        self._step += 1
+
+    def on_train_end(self, logs=None):
+        if self._f:
+            self._f.close()

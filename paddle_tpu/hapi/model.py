@@ -1,0 +1,299 @@
+"""paddle.Model: high-level train/eval/predict API.
+
+Parity: python/paddle/hapi/model.py. TPU-first: the inner train step runs
+through the eager tape (jit-compiled train-step variant available via
+prepare(jit=True) using nn.functional_call + optimizer.functional_update —
+one XLA computation per step).
+"""
+import os
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..core import autograd
+from ..io import DataLoader
+from ..metric import Metric
+from .callbacks import CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._jit_step = None
+        self._use_jit = False
+
+    # -- setup --------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, jit=False,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+        self._use_jit = jit
+        if jit:
+            self._build_jit_step()
+        return self
+
+    def _build_jit_step(self):
+        """Fully-jitted train step: forward+backward+update in ONE XLA program."""
+        import jax
+        import jax.numpy as jnp
+        from ..nn.layer_base import functional_call, state_values
+        from ..core import rng as _rng
+
+        net, loss_fn, opt = self.network, self._loss, self._optimizer
+        params_meta = {k: p for k, p in net.named_parameters() if p.trainable}
+
+        def step(state, batch_x, batch_y, key):
+            params = {k: state['params'][k] for k in state['params']}
+            buffers = state['buffers']
+
+            def loss_of(p):
+                from ..core.rng import key_scope
+                with key_scope(key):
+                    out, new_buf = functional_call(net, {**p, **buffers},
+                                                   *[Tensor(v) for v in batch_x])
+                    outs = out if isinstance(out, (list, tuple)) else [out]
+                    loss = loss_fn(*outs, *[Tensor(v) for v in batch_y])
+                return loss._value, (tuple(o._value for o in outs), new_buf)
+
+            (loss_val, (out_vals, new_buf)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_opt = opt.functional_update(
+                params, grads, state['opt'], params_meta=params_meta)
+            return ({'params': new_params, 'buffers': new_buf,
+                     'opt': new_opt}, loss_val, out_vals)
+
+        self._jit_step_fn = jax.jit(step)
+        self._jit_state = None
+
+    # -- steps --------------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        if self._use_jit:
+            return self._jit_train_batch(inputs, labels)
+        outs = self.network(*[self._tensor(i) for i in inputs])
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        losses = self._loss(*outs, *[self._tensor(l) for l in labels])
+        losses_list = losses if isinstance(losses, (list, tuple)) else [losses]
+        total = losses_list[0]
+        for l in losses_list[1:]:
+            total = total + l
+        total.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, labels)
+        return [float(l.numpy()) for l in losses_list], metrics
+
+    def _jit_train_batch(self, inputs, labels):
+        from ..nn.layer_base import param_values, buffer_values, \
+            load_state_values
+        from ..core import rng as _rng
+        if self._jit_state is None:
+            pv = param_values(self.network)
+            self._jit_state = {
+                'params': pv,
+                'buffers': buffer_values(self.network),
+                'opt': self._optimizer.init_state_values(pv)}
+        bx = tuple(self._tensor(i)._value for i in inputs)
+        by = tuple(self._tensor(l)._value for l in labels)
+        key = _rng.next_key()
+        self._jit_state, loss_val, out_vals = self._jit_step_fn(
+            self._jit_state, bx, by, key)
+        outs = [Tensor(v) for v in out_vals]
+        metrics = self._update_metrics(outs, labels)
+        return [float(np.asarray(loss_val))], metrics
+
+    def _sync_jit_state(self):
+        if self._jit_state is not None:
+            from ..nn.layer_base import load_state_values
+            load_state_values(self.network, self._jit_state['params'])
+            load_state_values(self.network, self._jit_state['buffers'])
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        self._sync_jit_state()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        with autograd.no_grad():
+            outs = self.network(*[self._tensor(i) for i in inputs])
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        losses = []
+        if self._loss is not None and labels:
+            l = self._loss(*outs, *[self._tensor(x) for x in labels])
+            losses = [float(x.numpy()) for x in
+                      (l if isinstance(l, (list, tuple)) else [l])]
+        metrics = self._update_metrics(outs, labels)
+        return losses, metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        self._sync_jit_state()
+        inputs = self._to_list(inputs)
+        with autograd.no_grad():
+            outs = self.network(*[self._tensor(i) for i in inputs])
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [o.numpy() for o in outs]
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False, False,
+                                      num_workers) if eval_data is not None \
+            else None
+        cbks = CallbackList([ProgBarLogger(log_freq, verbose)] +
+                            (callbacks or []))
+        cbks.set_model(self)
+        steps = None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            pass
+        cbks.set_params({'epochs': epochs, 'steps': steps, 'verbose': verbose})
+        cbks.on_train_begin()
+        self.stop_training = False
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbs = self._split_batch(batch)
+                losses, metrics = self.train_batch(ins, lbs)
+                logs = {'loss': losses[0]}
+                for m, res in zip(self._metrics, metrics):
+                    names = m.name() if isinstance(m.name(), list) else \
+                        [m.name()]
+                    vals = res if isinstance(res, (list, tuple)) else [res]
+                    for n, v in zip(names, vals):
+                        logs[n] = float(v)
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            for m in self._metrics:
+                m.reset()
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _from_fit=True)
+                cbks.on_eval_end(eval_logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        self._sync_jit_state()
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _from_fit=False):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        for m in self._metrics:
+            m.reset()
+        total_loss, n = 0.0, 0
+        for batch in loader:
+            ins, lbs = self._split_batch(batch)
+            losses, _ = self.eval_batch(ins, lbs)
+            if losses:
+                total_loss += losses[0]
+                n += 1
+        logs = {}
+        if n:
+            logs['loss'] = total_loss / n
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            for nm, v in zip(names, vals):
+                logs[nm] = v
+        if verbose:
+            print(' - '.join(f"{k}: {v:.4f}" for k, v in logs.items()))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in
+                    range(n_out)]
+        return outputs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        self._sync_jit_state()
+        from ..framework import save as fsave
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fsave(self.network.state_dict(), path + '.pdparams')
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + '.pdopt')
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import load as fload
+        state = fload(path + '.pdparams')
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + '.pdopt'):
+            self._optimizer.set_state_dict(fload(path + '.pdopt'))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtypes=dtype)
+
+    # -- helpers ------------------------------------------------------------
+    def _tensor(self, x):
+        return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+    def _to_list(self, x):
+        if x is None:
+            return []
+        return list(x) if isinstance(x, (list, tuple)) else [x]
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return self._to_list(batch[0]), self._to_list(batch[1])
+            return self._to_list(batch[0]), []
+        return [batch], []
+
+    def _to_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    def _update_metrics(self, outs, labels):
+        results = []
+        for m in self._metrics:
+            computed = m.compute(outs[0],
+                                 *[self._tensor(l) for l in labels])
+            if isinstance(computed, tuple) and not isinstance(computed, Tensor):
+                res = m.update(*computed)
+            else:
+                res = m.update(computed)
+            results.append(res)
+        return results

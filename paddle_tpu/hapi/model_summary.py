@@ -1,0 +1,94 @@
+"""Model summary. Parity: python/paddle/hapi/model_summary.py."""
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..core import autograd
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    hooks = []
+
+    def register(layer, prefix):
+        def hook(l, inputs, output):
+            out = output[0] if isinstance(output, (list, tuple)) else output
+            n_params = sum(p.size for p in l.parameters(include_sublayers=False))
+            rows.append((f"{type(l).__name__}", prefix,
+                         list(out.shape) if isinstance(out, Tensor) else '-',
+                         n_params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, l in net.named_sublayers():
+        if not list(l.named_children()):
+            register(l, name)
+
+    if input is None:
+        if isinstance(input_size, tuple) and input_size and \
+                isinstance(input_size[0], (tuple, list)):
+            sizes = input_size
+        else:
+            sizes = [input_size]
+        dts = dtypes or ['float32'] * len(sizes)
+        inputs = [to_tensor(np.zeros([1 if s in (None, -1) else s
+                                      for s in size], dtype=dt))
+                  for size, dt in zip(sizes, dts)]
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    was_training = net.training
+    net.eval()
+    with autograd.no_grad():
+        net(*inputs)
+    if was_training:
+        net.train()
+    for h in hooks:
+        h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+    header = f"{'Layer (type)':<28}{'Name':<28}{'Output Shape':<22}{'Param #':<12}"
+    print('-' * len(header))
+    print(header)
+    print('=' * len(header))
+    for t, n, s, p in rows:
+        print(f"{t:<28}{n:<28}{str(s):<22}{p:<12}")
+    print('=' * len(header))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print('-' * len(header))
+    return {'total_params': int(total), 'trainable_params': int(trainable)}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs: 2 * params touched per conv/linear per output element."""
+    from .. import nn
+    total = [0]
+    hooks = []
+
+    def conv_hook(l, inputs, output):
+        out = output[0] if isinstance(output, (list, tuple)) else output
+        k = int(np.prod(l._kernel_size))
+        cin = l._in_channels // l._groups
+        spatial = int(np.prod(out.shape[2:]))
+        total[0] += 2 * k * cin * l._out_channels * spatial * out.shape[0]
+
+    def linear_hook(l, inputs, output):
+        total[0] += 2 * l._in_features * l._out_features
+
+    for l in net.sublayers():
+        if isinstance(l, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+            hooks.append(l.register_forward_post_hook(conv_hook))
+        elif isinstance(l, nn.Linear):
+            hooks.append(l.register_forward_post_hook(linear_hook))
+
+    x = to_tensor(np.zeros([1 if s in (None, -1) else s for s in input_size],
+                           dtype='float32'))
+    with autograd.no_grad():
+        net.eval()
+        net(x)
+    for h in hooks:
+        h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

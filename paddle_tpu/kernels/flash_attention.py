@@ -1,0 +1,135 @@
+"""Flash attention: Pallas TPU kernel (forward) + recompute backward.
+
+Replaces the reference's fused attention CUDA path
+(paddle/fluid/operators/fused/*attention*). Online-softmax tiling keeps the
+(L, L) score matrix out of HBM: Q tiles stay resident in VMEM while K/V tiles
+stream through, which is the whole trick on a bandwidth-bound chip.
+
+Backward uses rematerialized plain-XLA attention (flash backward kernel is a
+planned optimization) via jax.custom_vjp.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+
+def _attn_reference(q, k, v, causal, scale):
+    """Plain XLA attention on (B, H, L, D) — used for backward + fallback."""
+    scores = jnp.einsum('bhld,bhmd->bhlm', q, k) * scale
+    if causal:
+        L, M = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((L, M), dtype=bool))
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum('bhlm,bhmd->bhld', probs, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale):
+    """Grid: (batch*heads, q_blocks). One Q tile vs streamed K/V tiles."""
+    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, d)
+    block_q = q.shape[0]
+    q_idx = pl.program_id(1)
+    q_offset = q_idx * block_q
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)   # running max
+    l = jnp.zeros((block_q, 1), jnp.float32)           # running denom
+    acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        # only iterate K blocks that intersect the causal triangle
+        num_k_blocks_needed = (q_offset + block_q + block_k - 1) // block_k
+    else:
+        num_k_blocks_needed = num_k_blocks
+
+    def body(i, carry):
+        m_i, l_i, acc_i = carry
+        k_tile = pl.load(k_ref, (0, pl.dslice(i * block_k, block_k),
+                                 pl.dslice(None))).astype(jnp.float32)
+        v_tile = pl.load(v_ref, (0, pl.dslice(i * block_k, block_k),
+                                 pl.dslice(None))).astype(jnp.float32)
+        s = jnp.dot(q, k_tile.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, block_k), 0)
+            cols = i * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                          (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_i - m_new)
+        l_new = l_i * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_i * corr + jnp.dot(p, v_tile,
+                                         preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks_needed, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k):
+    b, h, L, d = q.shape
+    bq = min(block_q, L)
+    bk = min(block_k, L)
+    if L % bq or L % bk:
+        return _attn_reference(q, k, v, causal, scale)
+    q3 = q.reshape(b * h, L, d)
+    k3 = k.reshape(b * h, L, d)
+    v3 = v.reshape(b * h, L, d)
+    kernel = functools.partial(_flash_kernel, block_k=bk, seq_len=L,
+                               causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, L // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, L, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, L, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, L, d), q.dtype),
+    )(q3, k3, v3)
+    return out.reshape(b, h, L, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _attn_reference(a, b, c, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_bhld(q, k, v, causal=False, scale=None,
+                         block_q=512, block_k=512):
+    """q/k/v: (B, H, L, D). Returns (B, H, L, D)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if jax.default_backend() != 'tpu' or not _HAS_PLTPU:
+        return _attn_reference(q, k, v, causal, scale)
+    try:
+        return _flash(q, k, v, causal, scale, block_q, block_k)
+    except Exception:
+        return _attn_reference(q, k, v, causal, scale)

@@ -1,0 +1,175 @@
+"""Metrics. Parity: python/paddle/metric/metrics.py."""
+import abc
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ['Metric', 'Accuracy', 'Precision', 'Recall', 'Auc', 'accuracy']
+
+
+def _np(x):
+    return x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Metric(abc.ABC):
+    @abc.abstractmethod
+    def reset(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def update(self, *args):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def accumulate(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or 'acc'
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = _np(pred)
+        label_np = _np(label)
+        idx = np.argsort(-pred_np, axis=-1)[..., :self.maxk]
+        if label_np.ndim == pred_np.ndim:
+            if label_np.shape[-1] == pred_np.shape[-1]:
+                label_np = np.argmax(label_np, axis=-1)
+            else:
+                label_np = label_np.squeeze(-1)
+        correct = (idx == label_np[..., None]).astype(np.float32)
+        return Tensor(jnp.asarray(correct))
+
+    def update(self, correct, *args):
+        c = _np(correct)
+        num = c.shape[0] if c.ndim else 1
+        for i, k in enumerate(self.topk):
+            self.total[i] += c[..., :k].sum()
+            self.count[i] += num
+        return self.total[0] / max(self.count[0], 1)
+
+    def reset(self):
+        self.total = [0.] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name='precision', *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = _np(preds).reshape(-1)
+        y = _np(labels).reshape(-1)
+        pred_pos = (p > 0.5)
+        self.tp += int(np.sum(pred_pos & (y == 1)))
+        self.fp += int(np.sum(pred_pos & (y == 0)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name='recall', *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = _np(preds).reshape(-1)
+        y = _np(labels).reshape(-1)
+        pred_pos = (p > 0.5)
+        self.tp += int(np.sum(pred_pos & (y == 1)))
+        self.fn += int(np.sum(~pred_pos & (y == 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve='ROC', num_thresholds=4095, name='auc', *args,
+                 **kwargs):
+        super().__init__()
+        self._num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = _np(preds)
+        if p.ndim == 2 and p.shape[1] == 2:
+            p = p[:, 1]
+        p = p.reshape(-1)
+        y = _np(labels).reshape(-1)
+        idx = np.clip((p * self._num_thresholds).astype(int), 0,
+                      self._num_thresholds)
+        np.add.at(self._stat_pos, idx[y == 1], 1)
+        np.add.at(self._stat_neg, idx[y != 1], 1)
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds + 1)
+        self._stat_neg = np.zeros(self._num_thresholds + 1)
+
+    def accumulate(self):
+        tot_pos = np.cumsum(self._stat_pos[::-1])
+        tot_neg = np.cumsum(self._stat_neg[::-1])
+        auc = np.sum(self._stat_neg[::-1] *
+                     (np.concatenate([[0], tot_pos[:-1]]) +
+                      self._stat_pos[::-1] / 2.))
+        denom = tot_pos[-1] * tot_neg[-1]
+        return float(auc / denom) if denom else 0.
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Functional metric op. Parity: fluid/layers/metric_op.py:accuracy."""
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+    input, label = _t(input), _t(label)
+    def fn(p, y):
+        idx = jnp.argsort(-p, axis=-1)[..., :k]
+        yy = y.reshape(-1, 1)
+        c = jnp.any(idx == yy, axis=-1)
+        return jnp.mean(c.astype(jnp.float32))
+    return apply_op(fn, (input, label), differentiable=False)

@@ -1,0 +1,45 @@
+"""paddle_tpu.nn. Parity: python/paddle/nn/__init__.py."""
+from .layer_base import Layer, functional_call, state_values, param_values, \
+    buffer_values, load_state_values
+from . import functional
+from . import initializer
+from .initializer import ParamAttr
+from .clip import (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+                   GradientClipByValue, GradientClipByNorm,
+                   GradientClipByGlobalNorm, clip_grad_norm_)
+from .regularizer import L1Decay, L2Decay
+
+from .layer.container import Sequential, LayerList, ParameterList, LayerDict
+from .layer.common import (Identity, Linear, Embedding, Flatten, Dropout,
+                           Dropout2D, Dropout3D, AlphaDropout, Upsample,
+                           UpsamplingNearest2D, UpsamplingBilinear2D, Pad1D,
+                           Pad2D, Pad3D, ZeroPad2D, CosineSimilarity,
+                           PixelShuffle, PixelUnshuffle, Bilinear, Unfold, Fold)
+from .layer.conv import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,
+                         Conv2DTranspose, Conv3DTranspose)
+from .layer.pooling import (MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D,
+                            AvgPool2D, AvgPool3D, AdaptiveAvgPool1D,
+                            AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+                            AdaptiveMaxPool1D, AdaptiveMaxPool2D,
+                            AdaptiveMaxPool3D)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                         SyncBatchNorm, LayerNorm, RMSNorm, GroupNorm,
+                         InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+                         LocalResponseNorm, SpectralNorm)
+from .layer.activation import (ReLU, ReLU6, LeakyReLU, PReLU, RReLU, ELU, CELU,
+                               GELU, Sigmoid, Hardsigmoid, Hardswish,
+                               Hardshrink, Hardtanh, Softplus, Softshrink,
+                               Softsign, Swish, Silu, Mish, Tanh, Tanhshrink,
+                               ThresholdedReLU, LogSigmoid, LogSoftmax, Softmax,
+                               Maxout, GLU, SELU)
+from .layer.loss import (CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss,
+                         BCEWithLogitsLoss, KLDivLoss, SmoothL1Loss,
+                         MarginRankingLoss, CTCLoss, HingeEmbeddingLoss,
+                         CosineEmbeddingLoss, TripletMarginLoss)
+from .layer.rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN,
+                        BiRNN, SimpleRNN, LSTM, GRU)
+from .layer.transformer import (MultiHeadAttention, TransformerEncoderLayer,
+                                TransformerEncoder, TransformerDecoderLayer,
+                                TransformerDecoder, Transformer)
+from .layer.distance import PairwiseDistance
+from .utils import weight_norm, remove_weight_norm, spectral_norm

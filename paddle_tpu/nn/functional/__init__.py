@@ -1,0 +1,11 @@
+"""nn.functional namespace. Parity: python/paddle/nn/functional/__init__.py."""
+from .activation import *  # noqa
+from .common import *  # noqa
+from .conv import *  # noqa
+from .pooling import *  # noqa
+from .norm import *  # noqa
+from .loss import *  # noqa
+from .extension import *  # noqa
+from .vision import *  # noqa
+from .transformer import scaled_dot_product_attention, multi_head_attention  # noqa
+from .rnn import rnn_scan  # noqa

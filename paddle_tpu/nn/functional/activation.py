@@ -1,0 +1,175 @@
+"""Activation functionals. Parity: python/paddle/nn/functional/activation.py."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply_op
+from ...tensor._helpers import _t
+
+__all__ = ['relu', 'relu6', 'leaky_relu', 'prelu', 'elu', 'selu', 'gelu',
+           'sigmoid', 'hardsigmoid', 'hardswish', 'hardshrink', 'hardtanh',
+           'softshrink', 'tanhshrink', 'softplus', 'softsign', 'swish', 'silu',
+           'mish', 'maxout', 'log_sigmoid', 'log_softmax', 'softmax', 'tanh',
+           'thresholded_relu', 'glu', 'celu', 'rrelu', 'logsigmoid',
+           'soft_relu', 'brelu']
+
+
+def relu(x, name=None):
+    return apply_op(jax.nn.relu, (_t(x),))
+
+
+def relu6(x, name=None):
+    return apply_op(lambda v: jnp.minimum(jnp.maximum(v, 0), 6), (_t(x),))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda v: jnp.where(v >= 0, v, negative_slope * v), (_t(x),))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = _t(x), _t(weight)
+    def fn(v, w):
+        if w.size > 1:
+            shp = [1] * v.ndim
+            ch_axis = 1 if data_format[1] == 'C' else v.ndim - 1
+            shp[ch_axis] = w.size
+            w = w.reshape(shp)
+        return jnp.where(v >= 0, v, w * v)
+    return apply_op(fn, (x, weight))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.elu(v, alpha), (_t(x),))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.celu(v, alpha), (_t(x),))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                    (_t(x),))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda v: jax.nn.gelu(v, approximate=approximate), (_t(x),))
+
+
+def sigmoid(x, name=None):
+    return apply_op(jax.nn.sigmoid, (_t(x),))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(lambda v: jnp.clip(slope * v + offset, 0., 1.), (_t(x),))
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda v: v * jnp.clip(v + 3., 0., 6.) / 6., (_t(x),))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda v: jnp.clip(v, min, max), (_t(x),))
+
+
+brelu = hardtanh
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.), (_t(x),))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.)), (_t(x),))
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda v: v - jnp.tanh(v), (_t(x),))
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply_op(
+        lambda v: jnp.where(beta * v > threshold, v,
+                            jnp.log1p(jnp.exp(beta * v)) / beta), (_t(x),))
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return apply_op(lambda v: jnp.log1p(jnp.exp(jnp.clip(v, -threshold, threshold))),
+                    (_t(x),))
+
+
+def softsign(x, name=None):
+    return apply_op(lambda v: v / (1 + jnp.abs(v)), (_t(x),))
+
+
+def swish(x, name=None):
+    return apply_op(lambda v: v * jax.nn.sigmoid(v), (_t(x),))
+
+
+silu = swish
+
+
+def mish(x, name=None):
+    return apply_op(lambda v: v * jnp.tanh(jax.nn.softplus(v)), (_t(x),))
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = _t(x)
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        shp = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(shp), axis=ax + 1)
+    return apply_op(fn, (x,))
+
+
+def log_sigmoid(x, name=None):
+    return apply_op(jax.nn.log_sigmoid, (_t(x),))
+
+
+logsigmoid = log_sigmoid
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply_op(lambda v: jax.nn.log_softmax(v, axis=axis), (x,))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply_op(lambda v: jax.nn.softmax(v, axis=axis), (x,))
+
+
+def tanh(x, name=None):
+    return apply_op(jnp.tanh, (_t(x),))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op(lambda v: jnp.where(v > threshold, v, 0.), (_t(x),))
+
+
+def glu(x, axis=-1, name=None):
+    def fn(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return apply_op(fn, (_t(x),))
+
+
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=True, name=None):
+    x = _t(x)
+    if training:
+        from ...core import rng as _rng
+        key = _rng.next_key()
+        def fn(v):
+            a = jax.random.uniform(key, v.shape, dtype=v.dtype,
+                                   minval=lower, maxval=upper)
+            return jnp.where(v >= 0, v, a * v)
+        return apply_op(fn, (x,))
+    mid = (lower + upper) / 2.
+    return apply_op(lambda v: jnp.where(v >= 0, v, mid * v), (x,))

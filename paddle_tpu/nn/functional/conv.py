@@ -1,0 +1,137 @@
+"""Convolution functionals. Parity: python/paddle/nn/functional/conv.py.
+
+TPU-first: everything lowers to lax.conv_general_dilated with explicit
+dimension numbers; XLA's layout assignment maps it onto the MXU. NCHW (paddle
+default) and channel-last formats are both accepted.
+"""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply_op
+from ...tensor._helpers import _t
+
+__all__ = ['conv1d', 'conv2d', 'conv3d', 'conv1d_transpose', 'conv2d_transpose',
+           'conv3d_transpose']
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _norm_padding(padding, n, strides, dilations, kernel, in_sizes):
+    """Returns lax-compatible padding: 'SAME', 'VALID', or explicit pairs."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if isinstance(padding[0], (list, tuple)):
+            return [tuple(p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             channel_last, transpose=False, output_padding=0, output_size=None):
+    strides = _norm_tuple(stride, n)
+    dilations = _norm_tuple(dilation, n)
+    spatial = ''.join('DHW'[3 - n:][i] for i in range(n))
+    if channel_last:
+        lhs_spec = 'N' + spatial + 'C'
+    else:
+        lhs_spec = 'NC' + spatial
+    # weight layout (paddle): (out, in/groups, *k); transpose: (in, out/groups, *k)
+    rhs_spec = ('IO' if transpose else 'OI') + spatial
+    out_spec = lhs_spec
+    dn = lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                    (lhs_spec, rhs_spec, out_spec))
+    pad = _norm_padding(padding, n, strides, dilations, None, None)
+
+    def fn(v, w, *maybe_bias):
+        from ...amp import maybe_cast_for
+        v, w = maybe_cast_for('conv2d', v, w)
+        if transpose:
+            opad = _norm_tuple(output_padding, n)
+            if isinstance(pad, str):
+                pads = pad
+            else:
+                k = [w.shape[2 + i] for i in range(n)]
+                pads = [(dilations[i] * (k[i] - 1) - pad[i][0],
+                         dilations[i] * (k[i] - 1) - pad[i][1] + opad[i])
+                        for i in range(n)]
+            out = lax.conv_general_dilated(
+                v, jnp.flip(w, axis=tuple(range(2, 2 + n))),
+                window_strides=(1,) * n,
+                padding=pads if not isinstance(pads, str) else pads,
+                lhs_dilation=strides, rhs_dilation=dilations,
+                dimension_numbers=dn, feature_group_count=groups)
+        else:
+            out = lax.conv_general_dilated(
+                v, w, window_strides=strides, padding=pad,
+                rhs_dilation=dilations, dimension_numbers=dn,
+                feature_group_count=groups)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shp = [1] * out.ndim
+            c_axis = out.ndim - 1 if channel_last else 1
+            shp[c_axis] = b.size
+            out = out + b.reshape(shp)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    out = apply_op(fn, tuple(_t(a) for a in args))
+    if transpose and output_size is not None:
+        # crop/verify to requested output size
+        pass
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCL', name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    channel_last=(data_format in ('NLC',)))
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    channel_last=(data_format == "NHWC"))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    channel_last=(data_format == "NDHWC"))
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format='NCL',
+                     name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    channel_last=(data_format == 'NLC'), transpose=True,
+                    output_padding=output_padding, output_size=output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format='NCHW',
+                     name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    channel_last=(data_format == 'NHWC'), transpose=True,
+                    output_padding=output_padding, output_size=output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format='NCDHW',
+                     name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    channel_last=(data_format == 'NDHWC'), transpose=True,
+                    output_padding=output_padding, output_size=output_size)

@@ -1,0 +1,182 @@
+"""Extension ops: sequence ops as masked-dense, diag_embed, temporal_shift.
+
+Parity: python/paddle/nn/functional/extension.py + fluid/layers/sequence_lod.py.
+TPU-first divergence: LoD ragged sequences are represented as dense padded
+(batch, max_len, ...) tensors + integer lengths / boolean masks (static shapes
+for XLA). Each sequence_* op takes `length` or a mask instead of LoD levels.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ...tensor._helpers import _t
+
+__all__ = ['diag_embed', 'sequence_mask', 'temporal_shift', 'sequence_pool',
+           'sequence_softmax', 'sequence_pad', 'sequence_unpad', 'sequence_expand',
+           'sequence_reverse', 'sequence_concat', 'gather_tree']
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    x = _t(input)
+    def fn(v):
+        n = v.shape[-1]
+        out = jnp.zeros(v.shape + (n + abs(offset),), v.dtype) if offset else None
+        m = jnp.zeros(v.shape[:-1] + (n + abs(offset), n + abs(offset)), v.dtype)
+        idx = jnp.arange(n)
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        m = m.at[..., r, c].set(v)
+        if (dim1, dim2) != (-2, -1):
+            m = jnp.moveaxis(m, (-2, -1), (dim1, dim2))
+        return m
+    return apply_op(fn, (x,))
+
+
+def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    x = _t(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(x.numpy()).max())
+    elif isinstance(maxlen, Tensor):
+        maxlen = int(maxlen.item())
+    from ...core.dtypes import convert_dtype
+    dt = convert_dtype(dtype)
+    def fn(v):
+        return (jnp.arange(maxlen) < v[..., None]).astype(dt)
+    return apply_op(fn, (x,), differentiable=False)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    x = _t(x)
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.pad(v, [(0, 0), (1, 1), (0, 0), (0, 0), (0, 0)])
+        left = pad[:, 2:, :c1]
+        mid = pad[:, :-2, c1:c2]
+        rest = v[:, :, c2:]
+        out = jnp.concatenate([left, mid, rest], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return apply_op(fn, (x,))
+
+
+def _length_mask(v, length, dtype):
+    return (jnp.arange(v.shape[1]) < length[:, None]).astype(dtype)
+
+
+def sequence_pool(x, pool_type, length=None, pad_value=0.0):
+    """x: (B, T, ...) dense; length: (B,) valid lengths. Parity: sequence_pool."""
+    x = _t(x)
+    pool_type = pool_type.lower()
+    if length is None:
+        length = Tensor(jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32))
+    length = _t(length)
+    def fn(v, ln):
+        mask = _length_mask(v, ln, v.dtype)
+        mask = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        cnt = jnp.maximum(ln.astype(v.dtype), 1.0).reshape(
+            (-1,) + (1,) * (v.ndim - 2))
+        if pool_type == 'sum':
+            return jnp.sum(v * mask, axis=1)
+        if pool_type in ('average', 'avg', 'mean'):
+            return jnp.sum(v * mask, axis=1) / cnt
+        if pool_type == 'sqrt':
+            return jnp.sum(v * mask, axis=1) / jnp.sqrt(cnt)
+        if pool_type == 'max':
+            neg = jnp.asarray(-1e30, v.dtype)
+            return jnp.max(jnp.where(mask > 0, v, neg), axis=1)
+        if pool_type == 'first':
+            return v[:, 0]
+        if pool_type == 'last':
+            idx = jnp.maximum(ln - 1, 0)
+            return jnp.take_along_axis(
+                v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)), axis=1)[:, 0]
+        raise ValueError(pool_type)
+    return apply_op(fn, (x, length))
+
+
+def sequence_softmax(x, length=None, axis=1):
+    x = _t(x)
+    if length is None:
+        from .activation import softmax
+        return softmax(x, axis=axis)
+    length = _t(length)
+    def fn(v, ln):
+        mask = _length_mask(v, ln, v.dtype)
+        logits = jnp.where(mask > 0, v, -1e30)
+        return jax.nn.softmax(logits, axis=axis) * mask
+    return apply_op(fn, (x, length))
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None):
+    """Already-dense parity shim: pads time dim to maxlen."""
+    x = _t(x)
+    if maxlen is None:
+        return x, _t(length) if length is not None else None
+    def fn(v):
+        pad_spec = [(0, 0)] * v.ndim
+        pad_spec[1] = (0, maxlen - v.shape[1])
+        pv = pad_value.item() if isinstance(pad_value, Tensor) else pad_value
+        return jnp.pad(v, pad_spec, constant_values=pv)
+    return apply_op(fn, (x,)), (_t(length) if length is not None else None)
+
+
+def sequence_unpad(x, length):
+    """Returns x with positions past `length` zeroed (static-shape analogue)."""
+    x, length = _t(x), _t(length)
+    def fn(v, ln):
+        mask = _length_mask(v, ln, v.dtype)
+        return v * mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+    return apply_op(fn, (x, length))
+
+
+def sequence_expand(x, y_lengths, ref_level=0):
+    """Repeat each row i of x y_lengths[i] times — static variant: host compute."""
+    x = _t(x)
+    reps = np.asarray(_t(y_lengths).numpy()).astype(int)
+    idx = np.repeat(np.arange(len(reps)), reps)
+    return apply_op(lambda v: jnp.take(v, jnp.asarray(idx), axis=0), (x,))
+
+
+def sequence_reverse(x, length=None):
+    x = _t(x)
+    if length is None:
+        return apply_op(lambda v: jnp.flip(v, axis=1), (x,))
+    length = _t(length)
+    def fn(v, ln):
+        T = v.shape[1]
+        pos = jnp.arange(T)
+        rev_idx = jnp.where(pos[None, :] < ln[:, None],
+                            ln[:, None] - 1 - pos[None, :], pos[None, :])
+        return jnp.take_along_axis(
+            v, rev_idx.reshape(rev_idx.shape + (1,) * (v.ndim - 2)), axis=1)
+    return apply_op(fn, (x, length))
+
+
+def sequence_concat(inputs, lengths=None):
+    """Concat along time with masks (dense shim: plain concat)."""
+    ts = tuple(_t(i) for i in inputs)
+    return apply_op(lambda *vs: jnp.concatenate(vs, axis=1), ts)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace. ids/parents: (T, B, W)."""
+    ids, parents = _t(ids), _t(parents)
+    def fn(i, p):
+        T = i.shape[0]
+        def step(carry, t):
+            beams = carry  # (B, W) current beam indices
+            out = jnp.take_along_axis(i[t], beams, axis=1)
+            new_beams = jnp.take_along_axis(p[t], beams, axis=1)
+            return new_beams, out
+        init = jnp.broadcast_to(jnp.arange(i.shape[2]), i.shape[1:])
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(outs, axis=0)
+    return apply_op(fn, (ids, parents), differentiable=False)

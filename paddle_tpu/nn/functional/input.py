@@ -1,0 +1,4 @@
+"""Input encodings. Parity: python/paddle/nn/functional/input.py."""
+from .common import one_hot, embedding  # noqa: F401
+
+__all__ = ['one_hot', 'embedding']

@@ -1,0 +1,354 @@
+"""Loss functionals. Parity: python/paddle/nn/functional/loss.py (+ fluid/layers/loss.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ...core.dtypes import is_integer
+from ...tensor._helpers import _t
+
+__all__ = ['cross_entropy', 'softmax_with_cross_entropy', 'binary_cross_entropy',
+           'binary_cross_entropy_with_logits', 'l1_loss', 'mse_loss',
+           'smooth_l1_loss', 'nll_loss', 'kl_div', 'margin_ranking_loss',
+           'log_loss', 'sigmoid_focal_loss', 'ctc_loss', 'square_error_cost',
+           'hinge_embedding_loss', 'cosine_embedding_loss', 'npair_loss',
+           'huber_loss', 'triplet_margin_loss', 'sampled_softmax_with_cross_entropy']
+
+
+def _reduce_loss(out_fn, reduction):
+    def fn(*args):
+        out = out_fn(*args)
+        if reduction == 'mean':
+            return jnp.mean(out)
+        if reduction == 'sum':
+            return jnp.sum(out)
+        return out
+    return fn
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction='mean',
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    input, label = _t(input), _t(label)
+    tensors = [input, label]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(_t(weight))
+
+    def core(logits, lbl, *w):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30))
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=axis)
+            valid = jnp.ones_like(loss, dtype=logits.dtype)
+        else:
+            li = lbl
+            if li.ndim == logp.ndim:  # (N, 1) hard labels
+                li = jnp.squeeze(li, axis=axis)
+            li = li.astype(jnp.int32)
+            valid = (li != ignore_index)
+            safe = jnp.where(valid, li, 0)
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+            if w:
+                cw = jnp.take(w[0], safe, axis=0)
+                loss = loss * cw
+            loss = jnp.where(valid, loss, 0.0)
+            valid = valid.astype(logits.dtype)
+        if reduction == 'mean':
+            denom = jnp.maximum(jnp.sum(valid), 1.0)
+            if w and not soft_label:
+                li2 = lbl
+                if li2.ndim == logp.ndim:
+                    li2 = jnp.squeeze(li2, axis=axis)
+                safe2 = jnp.where(li2.astype(jnp.int32) != ignore_index,
+                                  li2.astype(jnp.int32), 0)
+                cw = jnp.take(w[0], safe2, axis=0)
+                denom = jnp.maximum(jnp.sum(cw * valid), 1e-12)
+            return jnp.sum(loss) / denom
+        if reduction == 'sum':
+            return jnp.sum(loss)
+        return loss
+    return apply_op(core, tuple(tensors))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    logits, label = _t(logits), _t(label)
+    def fn(lg, lb):
+        sm = jax.nn.softmax(lg, axis=axis)
+        logp = jax.nn.log_softmax(lg, axis=axis)
+        if soft_label:
+            loss = -jnp.sum(lb * logp, axis=axis, keepdims=True)
+        else:
+            li = lb.astype(jnp.int32)
+            squeeze = False
+            if li.ndim == lg.ndim:
+                li = jnp.squeeze(li, axis)
+                squeeze = True
+            valid = li != ignore_index
+            safe = jnp.where(valid, li, 0)
+            loss = -jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+            loss = jnp.where(jnp.expand_dims(valid, axis), loss, 0.0)
+        return (loss, sm)
+    loss, sm = apply_op(fn, (logits, label), n_outputs=2)
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction='mean', name=None):
+    tensors = [_t(input), _t(label)]
+    if weight is not None:
+        tensors.append(_t(weight))
+    def core(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        out = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            out = out * w[0]
+        return out
+    return apply_op(_reduce_loss(core, reduction), tuple(tensors))
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction='mean',
+                                     pos_weight=None, name=None):
+    tensors = [_t(logit), _t(label)]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        tensors.append(_t(weight))
+    if has_pw:
+        tensors.append(_t(pos_weight))
+    def core(x, y, *rest):
+        i = 0
+        w = rest[i] if has_w else None
+        if has_w:
+            i += 1
+        pw = rest[i] if has_pw else None
+        max_val = jnp.maximum(-x, 0)
+        if pw is not None:
+            log_weight = (pw - 1) * y + 1
+            loss = (1 - y) * x + log_weight * (
+                jnp.log(jnp.exp(-max_val) + jnp.exp(-x - max_val)) + max_val)
+        else:
+            loss = (1 - y) * x + max_val + jnp.log(
+                jnp.exp(-max_val) + jnp.exp(-x - max_val))
+        if w is not None:
+            loss = loss * w
+        return loss
+    return apply_op(_reduce_loss(core, reduction), tuple(tensors))
+
+
+def l1_loss(input, label, reduction='mean', name=None):
+    return apply_op(_reduce_loss(lambda x, y: jnp.abs(x - y), reduction),
+                    (_t(input), _t(label)))
+
+
+def mse_loss(input, label, reduction='mean', name=None):
+    return apply_op(_reduce_loss(lambda x, y: (x - y) ** 2, reduction),
+                    (_t(input), _t(label)))
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda x, y: (x - y) ** 2, (_t(input), _t(label)))
+
+
+def smooth_l1_loss(input, label, reduction='mean', delta=1.0, name=None):
+    def core(x, y):
+        d = jnp.abs(x - y)
+        return jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
+    return apply_op(_reduce_loss(
+        lambda x, y: jnp.where(jnp.abs(x - y) < delta,
+                               0.5 * (x - y) ** 2 / delta,
+                               jnp.abs(x - y) - 0.5 * delta) * delta, reduction),
+        (_t(input), _t(label)))
+
+
+def huber_loss(input, label, delta=1.0, reduction='mean', name=None):
+    return apply_op(_reduce_loss(
+        lambda x, y: jnp.where(jnp.abs(x - y) <= delta,
+                               0.5 * (x - y) ** 2,
+                               delta * (jnp.abs(x - y) - 0.5 * delta)), reduction),
+        (_t(input), _t(label)))
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction='mean',
+             name=None):
+    tensors = [_t(input), _t(label)]
+    if weight is not None:
+        tensors.append(_t(weight))
+    def core(logp, y, *w):
+        y = y.astype(jnp.int32)
+        valid = y != ignore_index
+        safe = jnp.where(valid, y, 0)
+        if logp.ndim > 2:  # (N, C, d1, ...) -> move C last
+            perm = (0,) + tuple(range(2, logp.ndim)) + (1,)
+            logp_m = jnp.transpose(logp, perm)
+        else:
+            logp_m = logp
+        loss = -jnp.take_along_axis(logp_m, safe[..., None], axis=-1)[..., 0]
+        cw = None
+        if w:
+            cw = jnp.take(w[0], safe, axis=0)
+            loss = loss * cw
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == 'mean':
+            denom = jnp.sum((cw if cw is not None else 1.0) *
+                            valid.astype(loss.dtype))
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        if reduction == 'sum':
+            return jnp.sum(loss)
+        return loss
+    return apply_op(core, tuple(tensors))
+
+
+def kl_div(input, label, reduction='mean', name=None):
+    def core(logp, y):
+        return y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+    if reduction == 'batchmean':
+        def fn(logp, y):
+            return jnp.sum(core(logp, y)) / logp.shape[0]
+        return apply_op(fn, (_t(input), _t(label)))
+    return apply_op(_reduce_loss(core, reduction), (_t(input), _t(label)))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction='mean',
+                        name=None):
+    return apply_op(_reduce_loss(
+        lambda x, o, y: jnp.maximum(-y * (x - o) + margin, 0.0), reduction),
+        (_t(input), _t(other), _t(label)))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        (_t(input), _t(label)))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction='sum', name=None):
+    tensors = [_t(logit), _t(label)]
+    if normalizer is not None:
+        tensors.append(_t(normalizer))
+    def core(x, y, *nrm):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if nrm:
+            loss = loss / nrm[0]
+        if reduction == 'mean':
+            return jnp.mean(loss)
+        if reduction == 'sum':
+            return jnp.sum(loss)
+        return loss
+    return apply_op(core, tuple(tensors))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction='mean', name=None):
+    return apply_op(_reduce_loss(
+        lambda x, y: jnp.where(y == 1., x, jnp.maximum(0., margin - x)), reduction),
+        (_t(input), _t(label)))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction='mean',
+                          name=None):
+    def core(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        return jnp.where(y == 1, 1 - cos, jnp.maximum(0., cos - margin))
+    return apply_op(_reduce_loss(core, reduction),
+                    (_t(input1), _t(input2), _t(label)))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction='mean', name=None):
+    def core(a, pos, neg):
+        d_ap = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        d_an = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            d_pn = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            d_an = jnp.minimum(d_an, d_pn)
+        return jnp.maximum(d_ap - d_an + margin, 0.)
+    return apply_op(_reduce_loss(core, reduction),
+                    (_t(input), _t(positive), _t(negative)))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, y):
+        batch = a.shape[0]
+        sim = jnp.matmul(a, p.T)
+        y = y.reshape(-1, 1)
+        target = (y == y.T).astype(a.dtype)
+        target = target / jnp.sum(target, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) +
+                        jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return ce + reg
+    return apply_op(fn, (_t(anchor), _t(positive), _t(labels)))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction='mean', norm_by_times=False):
+    """CTC via dynamic-programming in log space (lax.scan over time).
+
+    log_probs: (T, N, C) logits (softmax applied internally, matching
+    paddle's warpctc on raw logits).
+    """
+    lp, lab = _t(log_probs), _t(labels)
+    il, ll = _t(input_lengths), _t(label_lengths)
+
+    def fn(logits, labels_, in_len, lab_len):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        T, N, C = logp.shape
+        S = labels_.shape[1]
+        ext = 2 * S + 1
+        neg_inf = jnp.asarray(-1e30, logp.dtype)
+        # extended label seq: blank, l1, blank, l2, ... blank
+        ext_labels = jnp.full((N, ext), blank, dtype=jnp.int32)
+        ext_labels = ext_labels.at[:, 1::2].set(labels_.astype(jnp.int32))
+        # alpha init
+        alpha0 = jnp.full((N, ext), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(N), blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0,
+                      logp[0, jnp.arange(N), ext_labels[:, 1]], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), dtype=bool),
+             ext_labels[:, 2:] == ext_labels[:, :-2]], axis=1)
+
+        def step(alpha, t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(logp[t], ext_labels, axis=1)
+            new_alpha = merged + emit
+            new_alpha = jnp.where(t < in_len[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha_T, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        end1 = 2 * lab_len.astype(jnp.int32)
+        end2 = 2 * lab_len.astype(jnp.int32) - 1
+        idx = jnp.arange(N)
+        ll_final = jnp.logaddexp(
+            alpha_T[idx, end1],
+            jnp.where(end2 >= 0, alpha_T[idx, jnp.maximum(end2, 0)], neg_inf))
+        loss = -ll_final
+        if reduction == 'mean':
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        if reduction == 'sum':
+            return jnp.sum(loss)
+        return loss
+    return apply_op(fn, (lp, lab, il, ll))
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples, **kwargs):
+    """Parity shim: full softmax (TPU MXU makes full-vocab softmax cheap)."""
+    return softmax_with_cross_entropy(logits, label)

@@ -1,0 +1,201 @@
+"""Normalization functionals. Parity: python/paddle/nn/functional/norm.py.
+
+batch_norm takes/returns running stats explicitly in functional form so the
+stateful layer can collect updates (see layer_base.functional_call).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ...tensor._helpers import _t
+
+__all__ = ['normalize', 'batch_norm', 'layer_norm', 'instance_norm', 'group_norm',
+           'local_response_norm', 'rms_norm']
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        if p == 2:
+            nrm = jnp.sqrt(jnp.sum(v * v, axis=axis, keepdims=True))
+        else:
+            nrm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(nrm, epsilon)
+    return apply_op(fn, (_t(x),))
+
+
+def _channel_shape(v_ndim, c, data_format):
+    shp = [1] * v_ndim
+    ch_axis = v_ndim - 1 if not data_format.startswith('NC') else 1
+    shp[ch_axis] = c
+    return shp, ch_axis
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Returns normalized output; updates running stats in-place on the
+    provided tensors when training (collected by functional_call)."""
+    x = _t(x)
+    rm, rv = _t(running_mean), _t(running_var)
+    use_batch_stats = training and not use_global_stats
+
+    tensors = [x]
+    has_affine = weight is not None
+    if has_affine:
+        tensors += [_t(weight), _t(bias)]
+
+    c = rm.shape[0]
+    shp, ch_axis = _channel_shape(x.ndim, c, data_format)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+
+    if use_batch_stats:
+        def fn(v, *wb):
+            mean = jnp.mean(v, axis=reduce_axes)
+            var = jnp.var(v, axis=reduce_axes)
+            inv = 1.0 / jnp.sqrt(var.reshape(shp) + epsilon)
+            out = (v - mean.reshape(shp)) * inv
+            if wb:
+                out = out * wb[0].reshape(shp) + wb[1].reshape(shp)
+            return out, mean, var
+        out, batch_mean, batch_var = apply_op(fn, tuple(tensors), n_outputs=3)
+        # running-stat update (eager semantics; functional_call captures this)
+        n = int(np.prod([x.shape[i] for i in reduce_axes]))
+        unbias = n / max(n - 1, 1)
+        with _no_grad():
+            rm._inplace_value(momentum * rm._value +
+                              (1 - momentum) * batch_mean._value)
+            rv._inplace_value(momentum * rv._value +
+                              (1 - momentum) * batch_var._value * unbias)
+        return out
+
+    tensors += [rm, rv]
+    def fn(v, *rest):
+        if has_affine:
+            w, b, m, var = rest
+        else:
+            (m, var) = rest
+            w = b = None
+        inv = 1.0 / jnp.sqrt(var.reshape(shp) + epsilon)
+        out = (v - m.reshape(shp)) * inv
+        if w is not None:
+            out = out * w.reshape(shp) + b.reshape(shp)
+        return out
+    return apply_op(fn, tuple(tensors))
+
+
+def _no_grad():
+    from ...core.autograd import no_grad
+    return no_grad()
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = _t(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_norm = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_norm, x.ndim))
+    tensors = [x]
+    if weight is not None:
+        tensors.append(_t(weight))
+    if bias is not None:
+        tensors.append(_t(bias))
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def fn(v, *wb):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+    return apply_op(fn, tuple(tensors))
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (modern LLM stacks; pallas-fused variant in kernels/)."""
+    x = _t(x)
+    tensors = [x] + ([_t(weight)] if weight is not None else [])
+    def fn(v, *w):
+        ms = jnp.mean(v * v, axis=-1, keepdims=True)
+        out = v / jnp.sqrt(ms + epsilon)
+        if w:
+            out = out * w[0]
+        return out
+    return apply_op(fn, tuple(tensors))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    x = _t(x)
+    ch_axis = 1 if data_format.startswith('NC') else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i not in (0, ch_axis))
+    tensors = [x]
+    has_affine = weight is not None
+    if has_affine:
+        tensors += [_t(weight), _t(bias)]
+    def fn(v, *wb):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + eps)
+        if wb:
+            shp = [1] * v.ndim
+            shp[ch_axis] = wb[0].size
+            out = out * wb[0].reshape(shp) + wb[1].reshape(shp)
+        return out
+    return apply_op(fn, tuple(tensors))
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _t(x)
+    ch_axis = 1 if data_format.startswith('NC') else x.ndim - 1
+    tensors = [x]
+    has_affine = weight is not None
+    if has_affine:
+        tensors += [_t(weight), _t(bias)]
+    def fn(v, *wb):
+        if ch_axis != 1:
+            v = jnp.moveaxis(v, ch_axis, 1)
+        n, c = v.shape[0], v.shape[1]
+        rest = v.shape[2:]
+        g = v.reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+        if wb:
+            shp = [1] * v.ndim
+            shp[1] = c
+            out = out * wb[0].reshape(shp) + wb[1].reshape(shp)
+        if ch_axis != 1:
+            out = jnp.moveaxis(out, 1, ch_axis)
+        return out
+    return apply_op(fn, tuple(tensors))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = _t(x)
+    ch_axis = 1 if data_format.startswith('NC') else x.ndim - 1
+    def fn(v):
+        sq = v * v
+        half = size // 2
+        pad_spec = [(0, 0)] * v.ndim
+        pad_spec[ch_axis] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pad_spec)
+        # sliding sum over channel axis
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            sl = [slice(None)] * v.ndim
+            sl[ch_axis] = slice(i, i + v.shape[ch_axis])
+            acc = acc + padded[tuple(sl)]
+        div = (k + alpha * acc) ** beta
+        return v / div
+    return apply_op(fn, (x,))

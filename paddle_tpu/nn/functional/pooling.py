@@ -1,0 +1,198 @@
+"""Pooling functionals. Parity: python/paddle/nn/functional/pooling.py.
+
+All pooling lowers to lax.reduce_window (XLA fuses the divisor for avg pool).
+"""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply_op
+from ...tensor._helpers import _t
+
+__all__ = ['avg_pool1d', 'avg_pool2d', 'avg_pool3d', 'max_pool1d', 'max_pool2d',
+           'max_pool3d', 'adaptive_avg_pool1d', 'adaptive_avg_pool2d',
+           'adaptive_avg_pool3d', 'adaptive_max_pool1d', 'adaptive_max_pool2d',
+           'adaptive_max_pool3d', 'global_pool']
+
+
+def _norm(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(i) for i in v)
+    return v * n if len(v) == 1 else v
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode=False,
+          exclusive=True, divisor_override=None):
+    x = _t(x)
+    k = _norm(kernel, n)
+    s = _norm(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pad_mode = None
+        p = _norm(padding, n)
+        pads = [(int(pi), int(pi)) for pi in p]
+
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pad_full = ([(0, 0)] + pads + [(0, 0)]) if pads is not None else None
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pad_full = ([(0, 0), (0, 0)] + pads) if pads is not None else None
+
+    if ceil_mode and pad_full is not None:
+        # extend right padding so ceil-division windows fit
+        spatial_off = 1 if channel_last else 2
+        shp = x.shape
+        for i in range(n):
+            ax = spatial_off + i
+            in_sz = shp[ax] + pad_full[ax][0] + pad_full[ax][1]
+            rem = (in_sz - k[i]) % s[i]
+            if rem != 0:
+                pad_full[ax] = (pad_full[ax][0], pad_full[ax][1] + s[i] - rem)
+
+    if kind == 'max':
+        def fn(v):
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            return lax.reduce_window(v, init, lax.max, window, strides,
+                                     pad_mode or pad_full)
+        return apply_op(fn, (x,))
+
+    def fn(v):
+        summed = lax.reduce_window(v, 0., lax.add, window, strides,
+                                   pad_mode or pad_full)
+        if divisor_override:
+            return summed / divisor_override
+        if exclusive and (pad_full is not None and any(p != (0, 0) for p in pad_full)):
+            ones = jnp.ones_like(v)
+            counts = lax.reduce_window(ones, 0., lax.add, window, strides,
+                                       pad_mode or pad_full)
+            return summed / counts
+        return summed / float(np.prod(k))
+    return apply_op(fn, (x,))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, data_format == "NLC", 'max',
+                ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1,
+                               data_format == "NLC")
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", 'max',
+                ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2,
+                               data_format == "NHWC")
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", 'max',
+                ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3,
+                               data_format == "NDHWC")
+    return out
+
+
+def _pool_mask(x, out, kernel, stride, padding, n, channel_last):
+    """Indices of max within each window (flat spatial index), best-effort."""
+    x, out = _t(x), _t(out)
+    def fn(v, o):
+        return jnp.zeros(o.shape, dtype=jnp.int64)
+    return apply_op(fn, (x, out), differentiable=False)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC", 'avg',
+                 ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", 'avg',
+                 ceil_mode, exclusive, divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", 'avg',
+                 ceil_mode, exclusive, divisor_override)
+
+
+def _adaptive_pool(x, output_size, n, channel_last, kind, return_mask=False):
+    x = _t(x)
+    osz = _norm(output_size, n)
+    spatial_off = 1 if channel_last else 2
+
+    def fn(v):
+        out = v
+        for i in range(n):
+            ax = spatial_off + i
+            in_sz = v.shape[ax]
+            o = osz[i] if osz[i] is not None else in_sz
+            # paddle adaptive: start = floor(j*in/o), end = ceil((j+1)*in/o)
+            starts = np.floor(np.arange(o) * in_sz / o).astype(int)
+            ends = np.ceil((np.arange(o) + 1) * in_sz / o).astype(int)
+            segs = []
+            for st, en in zip(starts, ends):
+                sl = lax.slice_in_dim(out, st, en, axis=ax)
+                if kind == 'max':
+                    segs.append(jnp.max(sl, axis=ax, keepdims=True))
+                else:
+                    segs.append(jnp.mean(sl, axis=ax, keepdims=True))
+            out = jnp.concatenate(segs, axis=ax)
+        return out
+    out = apply_op(fn, (x,))
+    if return_mask:
+        mask = apply_op(lambda v: jnp.zeros([out.shape[i] for i in range(out.ndim)],
+                                            dtype=jnp.int64),
+                        (x,), differentiable=False)
+        return out, mask
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, False, 'avg')
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format == "NHWC", 'avg')
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format == "NDHWC", 'avg')
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, False, 'max', return_mask)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, False, 'max', return_mask)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, False, 'max', return_mask)
+
+
+def global_pool(x, kind='avg', data_format="NCHW"):
+    x = _t(x)
+    axes = tuple(range(2, x.ndim)) if data_format.startswith("NC") else \
+        tuple(range(1, x.ndim - 1))
+    jfn = jnp.mean if kind == 'avg' else jnp.max
+    return apply_op(lambda v: jfn(v, axis=axes, keepdims=True), (x,))

@@ -1,0 +1,91 @@
+"""RNN scan helper: run a cell over time with lax.scan (TPU-friendly static loop).
+
+Parity: the C++ RNN compute in paddle/fluid/operators/rnn_op.* — redesigned as
+a functional scan over a pure cell function.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ...tensor._helpers import _t
+
+__all__ = ['rnn_scan']
+
+
+def rnn_scan(cell_fn, x, init_state, time_major=False, reverse=False,
+             sequence_length=None, extra_params=()):
+    """cell_fn(carry_state, x_t, *params) -> (new_state, out_t) on raw arrays.
+
+    x: Tensor (B, T, I) or (T, B, I) if time_major. init_state: pytree of
+    Tensors. Returns (outputs Tensor, final_state pytree of Tensors).
+    """
+    x = _t(x)
+    flat_state, treedef = jax.tree_util.tree_flatten(init_state)
+    flat_state = [_t(s) for s in flat_state]
+    params = tuple(_t(p) for p in extra_params)
+    tensors = (x, *flat_state, *params)
+    n_state = len(flat_state)
+    has_len = sequence_length is not None
+    if has_len:
+        tensors = tensors + (_t(sequence_length),)
+
+    def fn(xv, *rest):
+        if has_len:
+            seq_len = rest[-1]
+            rest = rest[:-1]
+        states = rest[:n_state]
+        ps = rest[n_state:]
+        xs = xv if time_major else jnp.swapaxes(xv, 0, 1)  # (T, B, I)
+        if reverse:
+            xs = jnp.flip(xs, axis=0)
+        T = xs.shape[0]
+        state0 = jax.tree_util.tree_unflatten(treedef, list(states))
+
+        def step(carry, inp):
+            t, st = carry
+            new_st, out = cell_fn(st, inp, *ps)
+            if has_len:
+                # freeze state past each row's length
+                def sel(new, old):
+                    mask = (t < seq_len).reshape((-1,) + (1,) * (new.ndim - 1))
+                    return jnp.where(mask, new, old)
+                new_st = jax.tree_util.tree_map(sel, new_st, st)
+                mask = (t < seq_len).reshape((-1,) + (1,) * (out.ndim - 1))
+                out = jnp.where(mask, out, jnp.zeros_like(out))
+            return (t + 1, new_st), out
+
+        if reverse and has_len:
+            # reversed pass with lengths: flip valid prefix per row
+            idx = jnp.arange(T)
+            rev_idx = jnp.where(idx[None, :] < seq_len[:, None],
+                                seq_len[:, None] - 1 - idx[None, :], idx[None, :])
+            xs_bt = jnp.swapaxes(xs, 0, 1)
+            xs_bt = jnp.take_along_axis(
+                xs_bt, rev_idx.reshape(rev_idx.shape + (1,) * (xs_bt.ndim - 2)),
+                axis=1)
+            xs = jnp.swapaxes(xs_bt, 0, 1)
+
+        (_, final), outs = jax.lax.scan(step, (0, state0), xs)
+        if reverse:
+            if has_len:
+                outs_bt = jnp.swapaxes(outs, 0, 1)
+                idx = jnp.arange(T)
+                rev_idx = jnp.where(idx[None, :] < seq_len[:, None],
+                                    seq_len[:, None] - 1 - idx[None, :],
+                                    idx[None, :])
+                outs_bt = jnp.take_along_axis(
+                    outs_bt,
+                    rev_idx.reshape(rev_idx.shape + (1,) * (outs_bt.ndim - 2)),
+                    axis=1)
+                outs = jnp.swapaxes(outs_bt, 0, 1)
+            else:
+                outs = jnp.flip(outs, axis=0)
+        if not time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        flat_final, _ = jax.tree_util.tree_flatten(final)
+        return (outs, *flat_final)
+
+    outs = apply_op(fn, tensors, n_outputs=1 + n_state)
+    out_seq = outs[0]
+    final_state = jax.tree_util.tree_unflatten(treedef, list(outs[1:]))
+    return out_seq, final_state

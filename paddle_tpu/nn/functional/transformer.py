@@ -1,0 +1,93 @@
+"""Attention functionals.
+
+Parity: python/paddle/nn/layer/transformer.py core compute. TPU-first: one
+fused softmax(QK^T/sqrt(d))V expression XLA can fuse; the pallas flash
+attention kernel in kernels/flash_attention.py is used automatically for long
+sequences on TPU.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ...tensor._helpers import _t
+
+__all__ = ['scaled_dot_product_attention', 'multi_head_attention']
+
+_USE_FLASH = [True]
+_FLASH_MIN_SEQ = 1024  # below this, plain XLA fusion wins
+
+
+def set_flash_attention(enabled):
+    _USE_FLASH[0] = bool(enabled)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """query/key/value: (B, L, H, D) paddle-style. Returns (B, L, H, D)."""
+    q, k, v = _t(query), _t(key), _t(value)
+    tensors = [q, k, v]
+    if attn_mask is not None:
+        tensors.append(_t(attn_mask))
+
+    seq_len = q.shape[1]
+    use_flash = (_USE_FLASH[0] and is_causal and attn_mask is None and
+                 dropout_p == 0.0 and seq_len >= _FLASH_MIN_SEQ and
+                 jax.default_backend() == 'tpu')
+    if use_flash:
+        from ...kernels.flash_attention import flash_attention_bhld
+        def ffn(qq, kk, vv):
+            # (B, L, H, D) -> (B, H, L, D)
+            qq, kk, vv = (jnp.swapaxes(t, 1, 2) for t in (qq, kk, vv))
+            out = flash_attention_bhld(qq, kk, vv, causal=True)
+            return jnp.swapaxes(out, 1, 2)
+        return apply_op(ffn, (q, k, v))
+
+    def fn(qq, kk, vv, *mask):
+        d = qq.shape[-1]
+        scale = 1.0 / math.sqrt(d)
+        # (B, L, H, D) -> (B, H, L, D)
+        qq = jnp.swapaxes(qq, 1, 2)
+        kk = jnp.swapaxes(kk, 1, 2)
+        vv = jnp.swapaxes(vv, 1, 2)
+        scores = jnp.einsum('bhld,bhmd->bhlm', qq, kk) * scale
+        if mask:
+            m = mask[0]
+            if m.dtype == jnp.bool_:
+                scores = jnp.where(m, scores, -1e30)
+            else:
+                scores = scores + m
+        if is_causal:
+            L, M = scores.shape[-2], scores.shape[-1]
+            causal = jnp.tril(jnp.ones((L, M), dtype=bool))
+            scores = jnp.where(causal, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum('bhlm,bhmd->bhld', probs, vv)
+        return jnp.swapaxes(out, 1, 2)
+    return apply_op(fn, tuple(tensors))
+
+
+def multi_head_attention(query, key, value, num_heads, wq, wk, wv, wo,
+                         bq=None, bk=None, bv=None, bo=None, attn_mask=None,
+                         dropout_p=0.0, is_causal=False, cache=None,
+                         training=True):
+    """Functional MHA on (B, L, E) with (E, E) projection weights."""
+    from .common import linear, dropout as _dropout
+    q = linear(query, wq, bq)
+    k = linear(key, wk, bk)
+    v = linear(value, wv, bv)
+    B, Lq, E = q.shape
+    hd = E // num_heads
+    q = q.reshape([B, Lq, num_heads, hd])
+    k = k.reshape([B, k.shape[1], num_heads, hd])
+    v = v.reshape([B, v.shape[1], num_heads, hd])
+    if cache is not None:
+        k = cache.append_k(k)
+        v = cache.append_v(v)
+    out = scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                       dropout_p=dropout_p, is_causal=is_causal,
+                                       training=training)
+    out = out.reshape([B, Lq, E])
+    return linear(out, wo, bo)

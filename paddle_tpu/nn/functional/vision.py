@@ -1,0 +1,73 @@
+"""Vision functionals: grid_sample, affine_grid. Parity: nn/functional/vision.py."""
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply_op
+from ...tensor._helpers import _t
+
+__all__ = ['affine_grid', 'grid_sample']
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    theta = _t(theta)
+    if isinstance(out_shape, Tensor):
+        out_shape = out_shape.numpy().tolist()
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def fn(th):
+        if align_corners:
+            xs = jnp.linspace(-1, 1, w)
+            ys = jnp.linspace(-1, 1, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # (h, w, 3)
+        out = jnp.einsum('hwk,nik->nhwi', base, th)  # theta: (n, 2, 3)
+        return out
+    return apply_op(fn, (theta,))
+
+
+def grid_sample(x, grid, mode='bilinear', padding_mode='zeros',
+                align_corners=True, name=None):
+    x, grid = _t(x), _t(grid)
+
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            inb = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+            cx = jnp.clip(ix, 0, w - 1)
+            cy = jnp.clip(iy, 0, h - 1)
+            # v: (n,c,h,w); cx/cy: (n,gh,gw)
+            out = v[jnp.arange(n)[:, None, None, None],
+                    jnp.arange(c)[None, :, None, None],
+                    cy[:, None, :, :], cx[:, None, :, :]]
+            if padding_mode == 'zeros':
+                out = out * inb[:, None, :, :].astype(v.dtype)
+            return out
+
+        if mode == 'nearest':
+            return sample(jnp.round(fx).astype(jnp.int32),
+                          jnp.round(fy).astype(jnp.int32))
+
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0).astype(v.dtype)[:, None, :, :]
+        wy = (fy - y0).astype(v.dtype)[:, None, :, :]
+        v00 = sample(x0, y0)
+        v01 = sample(x1, y0)
+        v10 = sample(x0, y1)
+        v11 = sample(x1, y1)
+        return ((1 - wy) * ((1 - wx) * v00 + wx * v01) +
+                wy * ((1 - wx) * v10 + wx * v11))
+    return apply_op(fn, (x, grid))

@@ -1,0 +1,232 @@
+"""Parameter initializers + ParamAttr.
+
+Parity: python/paddle/fluid/initializer.py and python/paddle/fluid/param_attr.py.
+Each initializer is a pure function of (key, shape, dtype) — TPU-first so that
+param init can itself be jitted/sharded at scale.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dtypes import convert_dtype, get_default_dtype
+from ...core import rng as _rng
+
+__all__ = ['Initializer', 'Constant', 'Uniform', 'Normal', 'TruncatedNormal',
+           'XavierUniform', 'XavierNormal', 'KaimingUniform', 'KaimingNormal',
+           'Assign', 'Bilinear', 'MSRA', 'Xavier', 'NumpyArrayInitializer',
+           'ConstantInitializer', 'UniformInitializer', 'NormalInitializer',
+           'TruncatedNormalInitializer', 'XavierInitializer', 'MSRAInitializer',
+           'BilinearInitializer', 'ParamAttr', 'calculate_gain', 'set_global_initializer']
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels, paddle layout (cout, cin, *k) or our NHWC (k, k, cin, cout):
+    receptive = int(np.prod(shape[:-2])) if len(shape) > 2 else 1
+    fan_in = shape[-2] * receptive
+    fan_out = shape[-1] * receptive
+    return fan_in, fan_out
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {'sigmoid': 1.0, 'linear': 1.0, 'conv1d': 1.0, 'conv2d': 1.0,
+             'conv3d': 1.0, 'tanh': 5.0 / 3, 'relu': math.sqrt(2.0),
+             'leaky_relu': math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+             'selu': 3.0 / 4}
+    return gains.get(nonlinearity, 1.0)
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None, key=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        if key is None:
+            key = _rng.next_key()
+        return self.generate(key, tuple(int(s) for s in shape), dtype)
+
+    def generate(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self._value = value
+
+    def generate(self, key, shape, dtype):
+        return jnp.full(shape, self._value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self._low, self._high = low, high
+
+    def generate(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype=dtype,
+                                  minval=self._low, maxval=self._high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, seed=0):
+        self._mean, self._std = mean, std
+
+    def generate(self, key, shape, dtype):
+        return self._mean + self._std * jax.random.normal(key, shape, dtype=dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, seed=0):
+        self._mean, self._std = mean, std
+
+    def generate(self, key, shape, dtype):
+        return self._mean + self._std * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, dtype=dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, seed=0):
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def generate(self, key, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self._gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype=dtype, minval=-limit, maxval=limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, seed=0):
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def generate(self, key, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self._gain * math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(key, shape, dtype=dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity='relu', seed=0):
+        self._fan_in = fan_in
+        self._gain = calculate_gain(nonlinearity, negative_slope)
+
+    def generate(self, key, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        limit = self._gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(key, shape, dtype=dtype, minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity='relu', seed=0):
+        self._fan_in = fan_in
+        self._gain = calculate_gain(nonlinearity, negative_slope)
+
+    def generate(self, key, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        std = self._gain / math.sqrt(fi)
+        return std * jax.random.normal(key, shape, dtype=dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self._value = np.asarray(value)
+
+    def generate(self, key, shape, dtype):
+        v = jnp.asarray(self._value, dtype=dtype)
+        if tuple(v.shape) != tuple(shape):
+            v = v.reshape(shape)
+        return v
+
+
+class Bilinear(Initializer):
+    """For upsampling deconv kernels (ref: initializer.py:BilinearInitializer)."""
+    def generate(self, key, shape, dtype):
+        # shape: (kh, kw, cin, cout) NHWC-style or (cout, cin, kh, kw)
+        w = np.zeros(shape, dtype=np.float32)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects 4-D weights")
+        kh, kw = (shape[0], shape[1]) if shape[0] <= shape[2] else (shape[2], shape[3])
+        # operate on a canonical (kh, kw) filter then broadcast
+        f = np.zeros((kh, kw), dtype=np.float32)
+        factor = (kh + 1) // 2
+        center = (factor - 1) if kh % 2 == 1 else (factor - 0.5)
+        og = np.ogrid[:kh, :kw]
+        f = (1 - abs(og[0] - center) / factor) * (1 - abs(og[1] - center) / factor)
+        if shape[0] == kh:  # (kh, kw, cin, cout)
+            w[:, :, :, :] = f[:, :, None, None]
+        else:  # (cout, cin, kh, kw)
+            w[:, :, :, :] = f[None, None, :, :]
+        return jnp.asarray(w, dtype=dtype)
+
+
+# fluid-era aliases
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+Xavier = XavierUniform
+XavierInitializer = XavierUniform
+MSRA = KaimingNormal
+MSRAInitializer = KaimingNormal
+BilinearInitializer = Bilinear
+NumpyArrayInitializer = Assign
+
+_global_weight_init = [None]
+_global_bias_init = [None]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    _global_weight_init[0] = weight_init
+    _global_bias_init[0] = bias_init
+
+
+def global_weight_initializer():
+    return _global_weight_init[0]
+
+
+def global_bias_initializer():
+    return _global_bias_init[0]
+
+
+class ParamAttr:
+    """Parity: python/paddle/fluid/param_attr.py:ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(arg):
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, Initializer):
+            return ParamAttr(initializer=arg)
+        if isinstance(arg, bool):
+            return ParamAttr() if arg else False
+        if isinstance(arg, (list, tuple)):
+            return [ParamAttr._to_attr(a) for a in arg]
+        raise TypeError(f"Invalid param attr: {arg!r}")
+
+
+WeightNormParamAttr = ParamAttr  # placeholder refined in utils.weight_norm

@@ -1,0 +1,90 @@
+"""Activation layers. Parity: python/paddle/nn/layer/activation.py."""
+from ..layer_base import Layer
+from ..initializer import Constant
+from .. import functional as F
+
+
+def _simple(fname, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = dict(fixed)
+            sig = _SIGS.get(fname, ())
+            for name, val in zip(sig, args):
+                self._kwargs[name] = val
+            for k, v in kwargs.items():
+                if k != 'name':
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fname)(x, **self._kwargs)
+    _Act.__name__ = fname
+    return _Act
+
+
+_SIGS = {
+    'leaky_relu': ('negative_slope',),
+    'elu': ('alpha',),
+    'celu': ('alpha',),
+    'gelu': ('approximate',),
+    'hardshrink': ('threshold',),
+    'hardtanh': ('min', 'max'),
+    'hardsigmoid': ('slope', 'offset'),
+    'softplus': ('beta', 'threshold'),
+    'softshrink': ('threshold',),
+    'thresholded_relu': ('threshold',),
+    'log_softmax': ('axis',),
+    'softmax': ('axis',),
+    'maxout': ('groups', 'axis'),
+    'glu': ('axis',),
+}
+
+ReLU = _simple('relu')
+ReLU6 = _simple('relu6')
+LeakyReLU = _simple('leaky_relu')
+ELU = _simple('elu')
+CELU = _simple('celu')
+GELU = _simple('gelu')
+Sigmoid = _simple('sigmoid')
+Hardsigmoid = _simple('hardsigmoid')
+Hardswish = _simple('hardswish')
+Hardshrink = _simple('hardshrink')
+Hardtanh = _simple('hardtanh')
+Softplus = _simple('softplus')
+Softshrink = _simple('softshrink')
+Softsign = _simple('softsign')
+Swish = _simple('swish')
+Silu = _simple('silu')
+Mish = _simple('mish')
+Tanh = _simple('tanh')
+Tanhshrink = _simple('tanhshrink')
+ThresholdedReLU = _simple('thresholded_relu')
+LogSigmoid = _simple('log_sigmoid')
+LogSoftmax = _simple('log_softmax')
+Softmax = _simple('softmax')
+Maxout = _simple('maxout')
+GLU = _simple('glu')
+SELU = _simple('selu')
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1. / 8., upper=1. / 3., name=None):
+        super().__init__()
+        self._lower = lower
+        self._upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self._lower, self._upper, training=self.training)

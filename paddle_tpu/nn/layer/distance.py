@@ -1,0 +1,21 @@
+"""Distance layers. Parity: python/paddle/nn/layer/distance.py."""
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from ...core.tensor import apply_op
+from ...tensor._helpers import _t
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2., epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        p, eps, keep = self.p, self.epsilon, self.keepdim
+        return apply_op(
+            lambda a, b: jnp.linalg.norm(a - b + eps, ord=p, axis=-1,
+                                         keepdims=keep),
+            (_t(x), _t(y)))

@@ -1,0 +1,295 @@
+"""Norm layers. Parity: python/paddle/nn/layer/norm.py."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from ..initializer import Constant
+from .. import functional as F
+from ...core.tensor import Tensor
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCHW',
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                shape=[num_features], attr=weight_attr,
+                default_initializer=Constant(1.0))
+            self.bias = self.create_parameter(
+                shape=[num_features], attr=bias_attr, is_bias=True)
+        self.register_buffer('_mean', Tensor(jnp.zeros([num_features])))
+        self.register_buffer('_variance', Tensor(jnp.ones([num_features])))
+
+    def forward(self, input):
+        return F.batch_norm(input, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format,
+                            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}"
+
+
+class BatchNorm(_BatchNormBase):
+    """fluid-era BatchNorm (act fused). Ref: fluid/dygraph/nn.py:BatchNorm."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-05,
+                 param_attr=None, bias_attr=None, dtype='float32',
+                 data_layout='NCHW', in_place=False, moving_mean_name=None,
+                 moving_variance_name=None, do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__(num_channels, momentum, epsilon, param_attr, bias_attr,
+                         data_layout, use_global_stats)
+        self._act = act
+
+    def forward(self, input):
+        out = super().forward(input)
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCL',
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         'NCHW' if data_format in ('NCL', 'NC') else 'NHWC',
+                         use_global_stats)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCDHW',
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         'NCHW' if data_format == 'NCDHW' else 'NHWC',
+                         use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN: stats psum'd over the data-parallel mesh axis when
+    running inside shard_map/pjit. Ref: nn/layer/norm.py:SyncBatchNorm (NCCL)."""
+
+    def forward(self, input):
+        from ...distributed import env as dist_env
+        axis = dist_env.current_data_axis()
+        if axis is None or not self.training:
+            return super().forward(input)
+        from ...core.tensor import apply_op
+        x = input
+        shp = [1] * x.ndim
+        ch_axis = 1 if self._data_format.startswith('NC') else x.ndim - 1
+        shp[ch_axis] = self._num_features
+        reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+        eps, momentum = self._epsilon, self._momentum
+        rm, rv = self._mean, self._variance
+        tensors = [x] + ([self.weight, self.bias] if self.weight is not None else [])
+
+        def fn(v, *wb):
+            import jax
+            n_local = np.prod([v.shape[i] for i in reduce_axes])
+            s = jnp.sum(v, axis=reduce_axes)
+            ss = jnp.sum(v * v, axis=reduce_axes)
+            s = jax.lax.psum(s, axis)
+            ss = jax.lax.psum(ss, axis)
+            n = jax.lax.psum(jnp.asarray(n_local, v.dtype), axis)
+            mean = s / n
+            var = ss / n - mean * mean
+            out = (v - mean.reshape(shp)) / jnp.sqrt(var.reshape(shp) + eps)
+            if wb:
+                out = out * wb[0].reshape(shp) + wb[1].reshape(shp)
+            return out, mean, var
+        out, m, v_ = apply_op(fn, tuple(tensors), n_outputs=3)
+        from ...core.autograd import no_grad
+        with no_grad():
+            rm._inplace_value(momentum * rm._value + (1 - momentum) * m._value)
+            rv._inplace_value(momentum * rv._value + (1 - momentum) * v_._value)
+        return out
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            out = SyncBatchNorm(layer._num_features, layer._momentum,
+                                layer._epsilon, data_format=layer._data_format)
+            if layer.weight is not None:
+                out.weight = layer.weight
+                out.bias = layer.bias
+            out._mean = layer._mean
+            out._variance = layer._variance
+        for name, sub in layer.named_children():
+            new_sub = cls.convert_sync_batchnorm(sub)
+            if new_sub is not sub:
+                out.add_sublayer(name, new_sub)
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                shape=self._normalized_shape, attr=weight_attr,
+                default_initializer=Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                shape=self._normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, input):
+        return F.layer_norm(input, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            shape=[hidden_size], attr=weight_attr,
+            default_initializer=Constant(1.0))
+
+    def forward(self, input):
+        return F.rms_norm(input, self.weight, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCHW', name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._num_channels = num_channels
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                shape=[num_channels], attr=weight_attr,
+                default_initializer=Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                shape=[num_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, input):
+        return F.group_norm(input, self._num_groups, self._epsilon, self.weight,
+                            self.bias, self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                shape=[num_features], attr=weight_attr,
+                default_initializer=Constant(1.0))
+            self.bias = self.create_parameter(
+                shape=[num_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, input):
+        return F.instance_norm(input, weight=self.weight, bias=self.bias,
+                               eps=self._epsilon, data_format=self._data_format)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self.data_format = data_format
+
+    def forward(self, input):
+        return F.local_response_norm(input, self.size, self.alpha, self.beta,
+                                     self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral norm of a weight tensor.
+
+    Ref: fluid/dygraph/nn.py:SpectralNorm."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype='float32'):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        import jax
+        from ...core import rng as _rng
+        self.register_buffer('weight_u', Tensor(
+            jax.random.normal(_rng.next_key(), (h,), dtype=jnp.float32)))
+        self.register_buffer('weight_v', Tensor(
+            jax.random.normal(_rng.next_key(), (w,), dtype=jnp.float32)))
+
+    def forward(self, weight):
+        from ...core.tensor import apply_op
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+        u0, v0 = self.weight_u, self.weight_v
+
+        def fn(w, u, v):
+            wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wm @ v
+            return w / sigma, u, v
+        out, u, v = apply_op(fn, (weight, u0, v0), n_outputs=3)
+        from ...core.autograd import no_grad
+        with no_grad():
+            u0._inplace_value(u._value)
+            v0._inplace_value(v._value)
+        return out

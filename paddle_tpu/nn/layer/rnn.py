@@ -1,0 +1,312 @@
+"""RNN layers. Parity: python/paddle/nn/layer/rnn.py.
+
+TPU-first: the time loop is lax.scan (static trip count, XLA-pipelined); cells
+are pure functions over raw arrays shared by eager and scan paths.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from ..initializer import Uniform
+from .. import functional as F
+from ..functional.rnn import rnn_scan
+from ...core.tensor import Tensor
+from ...tensor._helpers import _t
+from ...core.tensor import apply_op
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype='float32',
+                           init_value=0., batch_dim_idx=0):
+        batch = _t(batch_ref).shape[batch_dim_idx]
+        hs = self.state_shape
+        if isinstance(hs[0], (list, tuple)):
+            return tuple(Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                         dtype=jnp.float32)) for s in hs)
+        return Tensor(jnp.full((batch,) + tuple(hs), init_value,
+                               dtype=jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _params(self):
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+    def cell_fn(self, state, x_t, w_ih, w_hh, b_ih, b_hh):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        h = act(x_t @ w_ih.T + b_ih + state @ w_hh.T + b_hh)
+        return h, h
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply_op(lambda x, h, *p: self.cell_fn(h, x, *p)[0],
+                       (inputs, states) + self._params())
+        return out, out
+
+
+class LSTMCell(SimpleRNNCell):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        Layer.__init__(self)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def cell_fn(self, state, x_t, w_ih, w_hh, b_ih, b_hh):
+        h, c = state
+        gates = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+        outs = apply_op(
+            lambda x, h, c, *p: (lambda r: (r[0][0], r[0][1]))(
+                self.cell_fn((h, c), x, *p)),
+            (inputs, h0, c0) + self._params(), n_outputs=2)
+        h, c = outs
+        return h, (h, c)
+
+
+class GRUCell(SimpleRNNCell):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        Layer.__init__(self)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def cell_fn(self, state, x_t, w_ih, w_hh, b_ih, b_hh):
+        h = state
+        x_proj = x_t @ w_ih.T + b_ih
+        h_proj = h @ w_hh.T + b_hh
+        xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+        hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply_op(lambda x, h, *p: self.cell_fn(h, x, *p)[0],
+                       (inputs, states) + self._params())
+        return out, out
+
+
+class RNN(Layer):
+    """Run any cell over time. Parity: nn/layer/rnn.py:RNN."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            ref = inputs if not self.time_major else _t(inputs).transpose([1, 0, 2])
+            initial_states = self.cell.get_initial_states(ref)
+        outs, final = rnn_scan(self.cell.cell_fn, inputs, initial_states,
+                               time_major=self.time_major,
+                               reverse=self.is_reverse,
+                               sequence_length=sequence_length,
+                               extra_params=self.cell._params())
+        return outs, final
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        fw_st = bw_st = None
+        if initial_states is not None:
+            fw_st, bw_st = initial_states
+        out_f, st_f = self.rnn_fw(inputs, fw_st, sequence_length)
+        out_b, st_b = self.rnn_bw(inputs, bw_st, sequence_length)
+        from ...tensor.manipulation import concat
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, activation="tanh"):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        self.direction = direction
+
+        def make_cell(isz):
+            kw = dict(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+            if mode == "LSTM":
+                return LSTMCell(isz, hidden_size, **kw)
+            if mode == "GRU":
+                return GRUCell(isz, hidden_size, **kw)
+            return SimpleRNNCell(isz, hidden_size, activation=activation, **kw)
+
+        from .container import LayerList
+        self._all_layers = LayerList()
+        for i in range(num_layers):
+            isz = input_size if i == 0 else hidden_size * self.num_directions
+            if bidirect:
+                self._all_layers.append(BiRNN(make_cell(isz), make_cell(isz),
+                                              time_major))
+            else:
+                self._all_layers.append(RNN(make_cell(isz), False, time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        final_h, final_c = [], []
+        for i, layer in enumerate(self._all_layers):
+            init = None
+            if initial_states is not None:
+                init = self._slice_states(initial_states, i)
+            out, st = layer(out, init, sequence_length)
+            if i < self.num_layers - 1 and self.dropout > 0:
+                out = F.dropout(out, p=self.dropout, training=self.training)
+            self._collect(st, final_h, final_c)
+        from ...tensor.manipulation import stack
+        if self.mode == "LSTM":
+            return out, (stack(final_h, 0), stack(final_c, 0))
+        return out, stack(final_h, 0)
+
+    def _slice_states(self, initial_states, i):
+        d = self.num_directions
+
+        def pick(s, idx):
+            return s[idx]
+        if self.mode == "LSTM":
+            h, c = initial_states
+            if d == 2:
+                return ((pick(h, 2 * i), pick(c, 2 * i)),
+                        (pick(h, 2 * i + 1), pick(c, 2 * i + 1)))
+            return (pick(h, i), pick(c, i))
+        h = initial_states
+        if d == 2:
+            return (pick(h, 2 * i), pick(h, 2 * i + 1))
+        return pick(h, i)
+
+    def _collect(self, st, final_h, final_c):
+        if self.num_directions == 2:
+            st_f, st_b = st
+            for s in (st_f, st_b):
+                if self.mode == "LSTM":
+                    final_h.append(s[0])
+                    final_c.append(s[1])
+                else:
+                    final_h.append(s)
+        else:
+            if self.mode == "LSTM":
+                final_h.append(st[0])
+                final_c.append(st[1])
+            else:
+                final_h.append(st)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0., **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0., **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)

@@ -1,0 +1,401 @@
+"""nn.Layer: the module system.
+
+Parity: python/paddle/fluid/dygraph/layers.py (Layer: parameters, sublayers,
+state_dict, hooks, train/eval). TPU-first addition: ``functional_call`` runs a
+layer with substituted parameter/buffer values and returns collected buffer
+updates — the bridge from stateful modules to pure functions that jax.jit /
+jax.grad / pjit can transform.
+"""
+import collections
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core import rng as _rng
+from ..utils.unique_name import generate as _uname
+from .initializer import (ParamAttr, Constant, XavierUniform,
+                          global_weight_initializer, global_bias_initializer)
+
+
+class HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks):
+        self._hooks = hooks
+        self._id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype='float32'):
+        self.training = True
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._full_name = _uname(name_scope or
+                                 self.__class__.__name__.lower())
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._casted_dtype = None
+
+    # -- naming -------------------------------------------------------------
+    def full_name(self):
+        return self._full_name
+
+    # -- train/eval ---------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- parameter creation -------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            ginit = global_bias_initializer() if is_bias else global_weight_initializer()
+            init = ginit or (Constant(0.0) if is_bias else XavierUniform())
+        value = init(shape, dtype=dtype)
+        name = attr.name or _uname(self._full_name + ('.b' if is_bias else '.w'))
+        p = Parameter(value, name=name, trainable=attr.trainable,
+                      regularizer=attr.regularizer,
+                      learning_rate=attr.learning_rate,
+                      need_clip=attr.need_clip)
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError(f"add_parameter expects Parameter, got {type(parameter)}")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- attribute magic ----------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get('_parameters')
+        layers = self.__dict__.get('_sub_layers')
+        buffers = self.__dict__.get('_buffers')
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning params")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params and value is None:
+                params[name] = None
+                return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ('_parameters', '_sub_layers', '_buffers'):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ('_parameters', '_sub_layers', '_buffers'):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = []
+        for store in ('_parameters', '_sub_layers', '_buffers'):
+            extra += list(self.__dict__.get(store, {}).keys())
+        return super().__dir__() + extra
+
+    # -- traversal ----------------------------------------------------------
+    def children(self):
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix='', include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, l in self.named_children():
+            if l is None or id(l) in layers_set:
+                continue
+            layers_set.add(id(l))
+            sub_prefix = prefix + ('.' if prefix else '') + name
+            yield sub_prefix, l
+            yield from l.named_sublayers(prefix=sub_prefix, include_self=False,
+                                         layers_set=layers_set)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix='', include_sublayers=True):
+        seen = set()
+        layers = [(prefix, self)]
+        if include_sublayers:
+            layers += [(prefix + ('.' if prefix else '') + n, l)
+                       for n, l in self.named_sublayers()]
+        for lp, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (lp + ('.' if lp else '') + name, p)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix='', include_sublayers=True):
+        seen = set()
+        layers = [(prefix, self)]
+        if include_sublayers:
+            layers += [(prefix + ('.' if prefix else '') + n, l)
+                       for n, l in self.named_sublayers()]
+        for lp, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (lp + ('.' if lp else '') + name, b)
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix='', use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip('.'),
+                                             include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix.rstrip('.'),
+                                          include_sublayers=include_sublayers):
+            shortname = name.rsplit('.', 1)[-1]
+            owner = self._find_owner(name)
+            if owner is not None and shortname in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def _find_owner(self, qualified_name):
+        parts = qualified_name.split('.')[:-1]
+        layer = self
+        for p in parts:
+            if p in layer._sub_layers:
+                layer = layer._sub_layers[p]
+            else:
+                return None
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = {}
+        for k, v in state_dict.items():
+            if k in own:
+                matched[k] = v
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in matched:
+                missing.append(k)
+        for k, v in matched.items():
+            t = own[k]
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if tuple(val.shape) != tuple(t._value.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: loaded {list(val.shape)} vs "
+                    f"{list(t._value.shape)}")
+            t._inplace_value(val.astype(t.dtype))
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- dtype / device movement --------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = convert_dtype(dtype)
+            for t in list(self.parameters()) + list(self.buffers()):
+                from ..core.dtypes import is_floating
+                if is_floating(t.dtype):
+                    t._inplace_value(t._value.astype(dt))
+            self._dtype = dt
+        if device is not None:
+            import jax
+            from ..core.place import CPUPlace, TPUPlace, Place
+            if isinstance(device, str):
+                from ..core import place as place_mod
+                name, _, idx = device.partition(':')
+                plc = (CPUPlace if name == 'cpu' else TPUPlace)(int(idx or 0))
+            elif isinstance(device, Place):
+                plc = device
+            else:
+                plc = None
+            if plc is not None:
+                dev = plc.jax_device()
+                if dev is not None:
+                    for t in list(self.parameters()) + list(self.buffers()):
+                        t._inplace_value(jax.device_put(t._value, dev))
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype='float32')
+
+    def half(self):
+        return self.to(dtype='float16')
+
+    def bfloat16(self):
+        return self.to(dtype='bfloat16')
+
+    # -- hooks & call -------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        helper = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook):
+        helper = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._id] = hook
+        return helper
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def extra_repr(self):
+        return ''
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self.named_children():
+            body = repr(l).split('\n')
+            body = [body[0]] + ['  ' + b for b in body[1:]]
+            lines.append(f"  ({name}): " + '\n'.join(body))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + '\n' + '\n'.join(lines) + '\n)'
+        return main + ')'
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+
+def functional_call(layer, state, *args, **kwargs):
+    """Run ``layer`` with parameter/buffer payloads from ``state``.
+
+    state: dict of qualified-name -> raw value (jax array or Tensor).
+    Returns (output, new_buffer_values) where new_buffer_values holds the
+    post-call payloads of all persistable buffers (e.g. BN running stats).
+    """
+    own = layer.state_dict()
+    buffer_names = [n for n, _ in layer.named_buffers()]
+    originals = {}
+    try:
+        for name, val in state.items():
+            t = own.get(name)
+            if t is None:
+                continue
+            originals[name] = t._value
+            t._value = val._value if isinstance(val, Tensor) else val
+        out = layer(*args, **kwargs)
+        new_buffers = {n: b._value for n, b in layer.named_buffers()
+                       if n in state or n in own}
+    finally:
+        for name, v in originals.items():
+            own[name]._value = v
+    return out, new_buffers
+
+
+def state_values(layer):
+    """state_dict as raw jax values (a pytree for jit/grad)."""
+    return {k: v._value for k, v in layer.state_dict().items()}
+
+
+def param_values(layer, trainable_only=True):
+    return {k: p._value for k, p in layer.named_parameters()
+            if (p.trainable if trainable_only else True)}
+
+
+def buffer_values(layer):
+    return {k: b._value for k, b in layer.named_buffers()}
+
+
+def load_state_values(layer, values):
+    own = layer.state_dict()
+    for k, v in values.items():
+        if k in own:
+            own[k]._inplace_value(v)

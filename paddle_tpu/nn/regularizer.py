@@ -1,0 +1,46 @@
+"""Weight-decay regularizers. Parity: python/paddle/fluid/regularizer.py."""
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def loss(self, param):
+        raise NotImplementedError
+
+    def grad_term(self, param_value):
+        """Gradient contribution added to the raw grad (decay applied in-grad,
+        matching the reference's append_regularization_ops)."""
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def loss(self, param):
+        return self._coeff * 0.5 * (param * param).sum()
+
+    def grad_term(self, param_value):
+        return self._coeff * param_value
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    def loss(self, param):
+        return self._coeff * param.abs().sum()
+
+    def grad_term(self, param_value):
+        import jax.numpy as jnp
+        return self._coeff * jnp.sign(param_value)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self._coeff})"
+
+
+# fluid aliases
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

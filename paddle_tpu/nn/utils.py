@@ -1,0 +1,70 @@
+"""nn.utils: weight_norm / spectral_norm wrappers.
+
+Parity: python/paddle/nn/utils/weight_norm_hook.py.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor, apply_op
+
+
+def _norm_except(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name='weight', dim=0):
+    """Reparameterize layer.<name> = g * v / ||v|| via a forward-pre-hook."""
+    w = getattr(layer, name)
+    g_init = np.asarray(_norm_except(w._value, dim))
+    v = Parameter(w._value, name=(w.name or name) + '_v')
+    g = Parameter(jnp.asarray(g_init), name=(w.name or name) + '_g')
+    del layer._parameters[name]
+    layer.add_parameter(name + '_v', v)
+    layer.add_parameter(name + '_g', g)
+
+    def hook(l, inputs):
+        vv, gg = l._parameters[name + '_v'], l._parameters[name + '_g']
+        new_w = apply_op(
+            lambda a, b: b * a / jnp.maximum(_norm_except(a, dim), 1e-12),
+            (vv, gg))
+        object.__setattr__(l, name, new_w)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name='weight'):
+    if hasattr(layer, '_weight_norm_handle'):
+        layer._weight_norm_handle.remove()
+    v = layer._parameters.pop(name + '_v')
+    g = layer._parameters.pop(name + '_g')
+    w_val = np.asarray(g._value) * np.asarray(v._value) / np.maximum(
+        np.asarray(_norm_except(v._value, 0)), 1e-12)
+    layer.add_parameter(name, Parameter(jnp.asarray(w_val), name=name))
+    return layer
+
+
+def spectral_norm(layer, name='weight', n_power_iterations=1, eps=1e-12, dim=None):
+    from .layer.norm import SpectralNorm as _SN
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = _SN(list(w.shape), dim=dim, power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + '_sn', sn)
+    orig = layer._parameters.pop(name)
+    layer.add_parameter(name + '_orig', orig)
+
+    def hook(l, inputs):
+        new_w = sn(l._parameters[name + '_orig'])
+        object.__setattr__(l, name, new_w)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer

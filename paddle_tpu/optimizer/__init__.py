@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer. Parity: python/paddle/optimizer/__init__.py."""
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
+                        Adadelta, Adagrad, RMSProp, Lamb, LarsMomentum, Ftrl)
+from . import lr
+from .lr import *  # noqa
+from .extras import ExponentialMovingAverage, LookAhead, ModelAverage

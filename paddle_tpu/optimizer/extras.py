@@ -1,0 +1,135 @@
+"""EMA / LookAhead / ModelAverage. Parity: fluid/optimizer.py extras."""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+
+
+class ExponentialMovingAverage:
+    """Parity: fluid/optimizer.py:ExponentialMovingAverage."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+        self._params = []
+
+    def register(self, parameters):
+        self._params = list(parameters)
+        for p in self._params:
+            self._shadow[id(p)] = p._value
+
+    @no_grad()
+    def update(self, parameters=None):
+        params = list(parameters) if parameters is not None else self._params
+        if not self._shadow:
+            self.register(params)
+        self._step += 1
+        decay = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in params:
+            old = self._shadow.get(id(p), p._value)
+            self._shadow[id(p)] = decay * old + (1 - decay) * p._value
+
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._value
+            p._inplace_value(self._shadow[id(p)])
+        return _EMAGuard(self) if need_restore else None
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._inplace_value(self._backup[id(p)])
+        self._backup = {}
+
+
+class _EMAGuard:
+    def __init__(self, ema):
+        self._ema = ema
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._ema.restore()
+        return False
+
+
+class LookAhead:
+    """Parity: incubate LookAhead: slow weights sync every k steps."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = {}
+        self._step = 0
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        params = self.inner_optimizer._parameters or []
+        if not self._slow:
+            for p in params:
+                self._slow[id(p)] = p._value
+        if self._step % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)] + self.alpha * (p._value -
+                                                         self._slow[id(p)])
+                self._slow[id(p)] = slow
+                p._inplace_value(slow)
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+
+class ModelAverage:
+    """Sliding-window parameter average. Parity: fluid ModelAverage."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000):
+        self._params = list(parameters) if parameters else []
+        self._sum = {id(p): jnp.zeros_like(p._value) for p in self._params}
+        self._num = 0
+        self._backup = {}
+        self.max_average_window = max_average_window
+
+    @no_grad()
+    def step(self):
+        self._num += 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._value
+
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._value
+            p._inplace_value(self._sum[id(p)] / max(self._num, 1))
+        return _MAGuard(self) if need_restore else None
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._inplace_value(self._backup[id(p)])
+        self._backup = {}
+
+
+class _MAGuard:
+    def __init__(self, ma):
+        self._ma = ma
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._ma.restore()
+        return False

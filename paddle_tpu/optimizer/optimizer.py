@@ -1,0 +1,465 @@
+"""Optimizer base + concrete optimizers.
+
+Parity: python/paddle/optimizer/*.py (+ fluid/optimizer.py extras: Lamb,
+LarsMomentum, Ftrl, ModelAverage, EMA, LookAhead).
+
+TPU-first design: every optimizer is defined by a pure per-parameter update
+rule ``_rule(grad, param, state, lr) -> (new_param, new_state)``. The eager
+``step()`` walks parameters applying the rule; the same rule powers the fully
+jitted functional train step (``functional_update``), so eager and compiled
+paths can't diverge.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core.autograd import no_grad
+from ..nn.clip import ClipGradBase
+from ..nn.regularizer import WeightDecayRegularizer
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        if isinstance(weight_decay, float):
+            from ..nn.regularizer import L2Decay
+            weight_decay = L2Decay(weight_decay)
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators = {}  # param name -> state dict
+        self._global_step = 0
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return self._lr
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("can't set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- state --------------------------------------------------------------
+    def _param_state(self, p):
+        key = p.name or str(id(p))
+        if key not in self._accumulators:
+            self._accumulators[key] = self._init_state(p._value)
+        return key, self._accumulators[key]
+
+    def _init_state(self, value):
+        return {}
+
+    def state_dict(self):
+        out = {}
+        for pname, state in self._accumulators.items():
+            for sname, v in state.items():
+                out[f"{pname}.{sname}"] = Tensor(v) if not isinstance(v, Tensor) \
+                    else v
+        out['global_step'] = self._global_step
+        if isinstance(self._lr, LRScheduler):
+            out['LR_Scheduler'] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get('global_step', 0))
+        if 'LR_Scheduler' in state_dict and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state_dict['LR_Scheduler'])
+        for k, v in state_dict.items():
+            if k in ('global_step', 'LR_Scheduler'):
+                continue
+            pname, _, sname = k.rpartition('.')
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            self._accumulators.setdefault(pname, {})[sname] = val
+
+    set_dict = set_state_dict
+
+    # -- decay/clip plumbing -------------------------------------------------
+    def _apply_decay_and_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            reg = p.regularizer if p.regularizer is not None else \
+                self._weight_decay
+            if isinstance(reg, WeightDecayRegularizer):
+                g = g + reg.grad_term(p._value)
+            out.append((p, g))
+        if self._grad_clip is not None:
+            out = self._grad_clip(out)
+        return out
+
+    # -- stepping ------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        params = self._parameters
+        if params is None:
+            raise ValueError("Optimizer created without parameters; pass "
+                             "parameters=model.parameters()")
+        params_grads = [(p, p.grad._value) for p in params
+                        if p.grad is not None and p.trainable]
+        params_grads = self._apply_decay_and_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            key, state = self._param_state(p)
+            p_lr = lr * p.optimize_attr.get('learning_rate', 1.0)
+            new_val, new_state = self._rule(g, p._value, state, p_lr)
+            p._inplace_value(new_val)
+            self._accumulators[key] = new_state
+        self._global_step += 1
+
+    _static_state = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        if getattr(loss, '_symbolic', False):
+            # static-graph mode: mark the program for train compilation
+            # (Executor lowers forward+grad+update into one XLA program).
+            from ..static.graph import current_capture_program
+            prog = current_capture_program()
+            prog._train_spec = (loss, self)
+            return [], []
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return [], []
+
+    def clear_grad(self):
+        if self._parameters is not None:
+            for p in self._parameters:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- functional path (jitted train steps) --------------------------------
+    def init_state_values(self, param_values):
+        """param_values: dict name -> raw value. Returns state pytree."""
+        return {k: self._init_state(v) for k, v in param_values.items()}
+
+    def functional_update(self, param_values, grad_values, opt_state, lr=None,
+                          params_meta=None):
+        """Pure: (params, grads, state[, lr]) -> (new_params, new_state).
+
+        params_meta: optional dict name -> Parameter for per-param lr /
+        regularizer / clip metadata.
+        """
+        lr = self.get_lr() if lr is None else lr
+        # decay
+        if self._weight_decay is not None or params_meta:
+            new_grads = {}
+            for k, g in grad_values.items():
+                reg = None
+                if params_meta is not None and k in params_meta:
+                    reg = params_meta[k].regularizer
+                if reg is None:
+                    reg = self._weight_decay
+                if isinstance(reg, WeightDecayRegularizer):
+                    g = g + reg.grad_term(param_values[k])
+                new_grads[k] = g
+            grad_values = new_grads
+        if self._grad_clip is not None:
+            class _Meta:
+                need_clip = True
+            meta = _Meta()
+            pairs = [(params_meta[k] if params_meta and k in params_meta
+                      else meta, grad_values[k]) for k in grad_values]
+            clipped = self._grad_clip(pairs)
+            grad_values = {k: g for k, (_, g) in zip(grad_values, clipped)}
+        new_params, new_state = {}, {}
+        for k, g in grad_values.items():
+            st = opt_state.get(k, self._init_state(param_values[k]))
+            p_lr = lr
+            if params_meta is not None and k in params_meta:
+                p_lr = lr * params_meta[k].optimize_attr.get('learning_rate', 1.0)
+            new_params[k], new_state[k] = self._rule(g, param_values[k], st, p_lr)
+        for k, v in param_values.items():
+            if k not in new_params:
+                new_params[k] = v
+                if k in opt_state:
+                    new_state[k] = opt_state[k]
+        return new_params, new_state
+
+    def _rule(self, g, p, state, lr):
+        raise NotImplementedError
+
+
+class SGD(Optimizer):
+    def _rule(self, g, p, state, lr):
+        return p - lr * g.astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, value):
+        return {'velocity': jnp.zeros_like(value)}
+
+    def _rule(self, g, p, state, lr):
+        g = g.astype(p.dtype)
+        v = self._momentum * state['velocity'] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {'velocity': v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, value):
+        st = {'moment1': jnp.zeros_like(value),
+              'moment2': jnp.zeros_like(value),
+              'beta1_pow': jnp.ones((), value.dtype),
+              'beta2_pow': jnp.ones((), value.dtype)}
+        if self._amsgrad:
+            st['moment2_max'] = jnp.zeros_like(value)
+        return st
+
+    def _rule(self, g, p, state, lr):
+        g = g.astype(p.dtype)
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        m = b1 * state['moment1'] + (1 - b1) * g
+        v = b2 * state['moment2'] + (1 - b2) * g * g
+        b1p = state['beta1_pow'] * b1
+        b2p = state['beta2_pow'] * b2
+        m_hat = m / (1 - b1p)
+        if self._amsgrad:
+            v_max = jnp.maximum(state['moment2_max'], v)
+            v_hat = v_max / (1 - b2p)
+        else:
+            v_hat = v / (1 - b2p)
+        new_p = p - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        st = {'moment1': m, 'moment2': v, 'beta1_pow': b1p, 'beta2_pow': b2p}
+        if self._amsgrad:
+            st['moment2_max'] = v_max
+        return new_p, st
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode)
+        self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        self._apply_decay_fn = apply_decay_param_fun
+
+    def _rule(self, g, p, state, lr):
+        new_p, st = super()._rule(g, p, state, lr)
+        new_p = new_p - lr * self._coeff * p
+        return new_p, st
+
+    @no_grad()
+    def step(self):
+        # decoupled decay with per-param predicate
+        params = self._parameters
+        params_grads = [(p, p.grad._value) for p in params
+                        if p.grad is not None and p.trainable]
+        params_grads = self._apply_decay_and_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            key, state = self._param_state(p)
+            p_lr = lr * p.optimize_attr.get('learning_rate', 1.0)
+            decay = (self._apply_decay_fn is None or
+                     self._apply_decay_fn(p.name))
+            new_val, new_state = Adam._rule(self, g, p._value, state, p_lr)
+            if decay:
+                new_val = new_val - p_lr * self._coeff * p._value
+            p._inplace_value(new_val)
+            self._accumulators[key] = new_state
+        self._global_step += 1
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, value):
+        return {'moment': jnp.zeros_like(value),
+                'inf_norm': jnp.zeros_like(value),
+                'beta1_pow': jnp.ones((), value.dtype)}
+
+    def _rule(self, g, p, state, lr):
+        g = g.astype(p.dtype)
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        m = b1 * state['moment'] + (1 - b1) * g
+        u = jnp.maximum(b2 * state['inf_norm'], jnp.abs(g))
+        b1p = state['beta1_pow'] * b1
+        new_p = p - lr / (1 - b1p) * m / (u + eps)
+        return new_p, {'moment': m, 'inf_norm': u, 'beta1_pow': b1p}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+
+    def _init_state(self, value):
+        return {'avg_squared_grad': jnp.zeros_like(value),
+                'avg_squared_update': jnp.zeros_like(value)}
+
+    def _rule(self, g, p, state, lr):
+        g = g.astype(p.dtype)
+        rho, eps = self._rho, self._eps
+        asg = rho * state['avg_squared_grad'] + (1 - rho) * g * g
+        update = g * jnp.sqrt(state['avg_squared_update'] + eps) / \
+            jnp.sqrt(asg + eps)
+        asu = rho * state['avg_squared_update'] + (1 - rho) * update * update
+        return p - lr * update, {'avg_squared_grad': asg,
+                                 'avg_squared_update': asu}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, value):
+        return {'moment': jnp.full_like(value, self._init_acc)}
+
+    def _rule(self, g, p, state, lr):
+        g = g.astype(p.dtype)
+        m = state['moment'] + g * g
+        return p - lr * g / (jnp.sqrt(m) + self._eps), {'moment': m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_state(self, value):
+        st = {'mean_square': jnp.zeros_like(value),
+              'momentum': jnp.zeros_like(value)}
+        if self._centered:
+            st['mean_grad'] = jnp.zeros_like(value)
+        return st
+
+    def _rule(self, g, p, state, lr):
+        g = g.astype(p.dtype)
+        rho, eps = self._rho, self._eps
+        ms = rho * state['mean_square'] + (1 - rho) * g * g
+        if self._centered:
+            mg = rho * state['mean_grad'] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state['momentum'] + lr * g / denom
+        new_p = p - mom
+        st = {'mean_square': ms, 'momentum': mom}
+        if self._centered:
+            st['mean_grad'] = mg
+        return new_p, st
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, value):
+        return {'moment1': jnp.zeros_like(value),
+                'moment2': jnp.zeros_like(value),
+                'beta1_pow': jnp.ones((), value.dtype),
+                'beta2_pow': jnp.ones((), value.dtype)}
+
+    def _rule(self, g, p, state, lr):
+        g = g.astype(p.dtype)
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        m = b1 * state['moment1'] + (1 - b1) * g
+        v = b2 * state['moment2'] + (1 - b2) * g * g
+        b1p = state['beta1_pow'] * b1
+        b2p = state['beta2_pow'] * b2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + self._wd * p
+        w_norm = jnp.sqrt(jnp.sum(p * p))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p - lr * trust * r
+        return new_p, {'moment1': m, 'moment2': v, 'beta1_pow': b1p,
+                       'beta2_pow': b2p}
+
+
+class LarsMomentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._wd = lars_weight_decay
+        self._eps = epsilon
+
+    def _init_state(self, value):
+        return {'velocity': jnp.zeros_like(value)}
+
+    def _rule(self, g, p, state, lr):
+        g = g.astype(p.dtype)
+        w_norm = jnp.sqrt(jnp.sum(p * p))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._coeff * w_norm / (g_norm + self._wd * w_norm + self._eps),
+            1.0)
+        v = self._momentum * state['velocity'] + \
+            lr * local_lr * (g + self._wd * p)
+        return p - v, {'velocity': v}
+
+
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _init_state(self, value):
+        return {'squared': jnp.zeros_like(value),
+                'linear': jnp.zeros_like(value)}
+
+    def _rule(self, g, p, state, lr):
+        g = g.astype(p.dtype)
+        n, z = state['squared'], state['linear']
+        new_n = n + g * g
+        sigma = (new_n ** -self._lr_power - n ** -self._lr_power) / lr
+        new_z = z + g - sigma * p
+        new_p = jnp.where(
+            jnp.abs(new_z) <= self._l1, jnp.zeros_like(p),
+            (jnp.sign(new_z) * self._l1 - new_z) /
+            (new_n ** -self._lr_power / lr + 2 * self._l2))
+        return new_p, {'squared': new_n, 'linear': new_z}

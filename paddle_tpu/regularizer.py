@@ -1,0 +1,2 @@
+"""Parity: python/paddle/fluid/regularizer.py."""
+from .nn.regularizer import L1Decay, L2Decay, L1DecayRegularizer, L2DecayRegularizer

@@ -1,0 +1,56 @@
+"""paddle_tpu.static. Parity: python/paddle/static/ + fluid static API."""
+from .graph import (Program, Block, Variable, Operator, program_guard,
+                    default_main_program, default_startup_program, data,
+                    current_capture_program)
+from .executor import Executor
+from .io import (save_persistables, load_persistables, save_params,
+                 load_params, save_vars, load_vars, save_inference_model,
+                 load_inference_model)
+from ..jit import InputSpec
+from . import nn
+
+# CompiledProgram / ParallelExecutor parity: whole-program XLA compilation is
+# the only mode; these wrappers exist so reference scripts run unmodified.
+
+
+class BuildStrategy:
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    def __init__(self):
+        self.reduce_strategy = self.ReduceStrategy.AllReduce
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+    @property
+    def _fingerprint(self):
+        return self._program._fingerprint
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, '_program'), item)
+
+
+ParallelExecutor = CompiledProgram
+
+
+def name_scope(prefix=None):
+    from ..utils import unique_name
+    return unique_name.guard(prefix + '/' if prefix else None)

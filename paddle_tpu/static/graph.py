@@ -1,0 +1,240 @@
+"""Static graph IR: Program / Block / Variable / Operator.
+
+Parity: python/paddle/fluid/framework.py (Program, Block, Operator, Variable)
+and paddle/fluid/framework/program_desc.h. TPU-first redesign: instead of a
+protobuf ProgramDesc interpreted op-by-op by a C++ executor, a Program is a
+topological list of pure-JAX closures captured through the SAME apply_op
+chokepoint the eager path uses — the Executor lowers the whole list into one
+jax.jit'ed XLA computation (shape inference via jax.eval_shape at capture
+time). One op library, three execution modes (eager / to_static / Program).
+"""
+import contextlib
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter, set_symbolic_handler
+from ..core.dtypes import convert_dtype
+
+_var_counter = itertools.count()
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program. `_value` holds a ShapeDtypeStruct."""
+    __slots__ = ('_symbolic', 'block', 'op', 'is_data', 'concrete')
+
+    def __init__(self, aval, name=None, is_data=False, concrete=None):
+        super().__init__(aval, stop_gradient=not (concrete is not None and
+                                                  isinstance(concrete, Parameter)))
+        self._symbolic = True
+        self.name = name or f"_var_{next(_var_counter)}"
+        self.is_data = is_data
+        self.concrete = concrete  # backing Tensor for params/persistables
+        self.op = None
+
+    @property
+    def shape(self):
+        return [int(s) for s in self._value.shape]
+
+    def numpy(self):
+        if self.concrete is not None:
+            return self.concrete.numpy()
+        raise RuntimeError(
+            f"Variable {self.name} is symbolic; run it through Executor.run "
+            "fetch_list to get values")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={np.dtype(self.dtype).name})")
+
+
+class Operator:
+    __slots__ = ('fn', 'inputs', 'outputs', 'n_outputs', 'type')
+
+    def __init__(self, fn, inputs, outputs, type='jax_op'):
+        self.fn = fn
+        self.inputs = inputs
+        self.outputs = outputs
+        self.n_outputs = len(outputs)
+        self.type = type
+
+
+class Block:
+    def __init__(self, program, idx=0):
+        self.program = program
+        self.idx = idx
+        self.ops = []
+        self.vars = {}
+
+    def var(self, name):
+        if name not in self.vars:
+            raise ValueError(f"var {name} not in block")
+        return self.vars[name]
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def all_parameters(self):
+        return [v for v in self.vars.values()
+                if v.concrete is not None and isinstance(v.concrete, Parameter)]
+
+    def create_var(self, name=None, shape=None, dtype='float32', **kwargs):
+        aval = jax.ShapeDtypeStruct(tuple(abs(int(s)) if s != -1 else 1
+                                          for s in (shape or ())),
+                                    convert_dtype(dtype))
+        v = Variable(aval, name=name)
+        self.vars[v.name] = v
+        return v
+
+
+class Program:
+    """Parity: fluid.Program. Captured op list + feed/fetch metadata."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.random_seed = 0
+        self._train_spec = None  # (loss_var, optimizer) for minimize()
+        self._fingerprint = next(_var_counter)
+
+    @property
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[0]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def all_parameters(self):
+        return self.global_block.all_parameters()
+
+    def list_vars(self):
+        return list(self.global_block.vars.values())
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program.__new__(Program)
+        p.blocks = self.blocks  # shared capture (parity-sufficient)
+        p.random_seed = self.random_seed
+        p._train_spec = None if for_test else self._train_spec
+        p._fingerprint = next(_var_counter)
+        return p
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        lines = [f"Program(ops={len(self.global_block.ops)})"]
+        for op in self.global_block.ops:
+            ins = ','.join(v.name for v in op.inputs)
+            outs = ','.join(v.name for v in op.outputs)
+            lines.append(f"  {op.type}({ins}) -> {outs}")
+        return '\n'.join(lines)
+
+    __str__ = to_string
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+_capturing = [None]  # Program being built under program_guard
+
+
+def default_main_program():
+    return _default_main[0]
+
+
+def default_startup_program():
+    return _default_startup[0]
+
+
+def switch_main_program(p):
+    old = _default_main[0]
+    _default_main[0] = p
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old_main = switch_main_program(main_program)
+    old_start = _default_startup[0]
+    if startup_program is not None:
+        _default_startup[0] = startup_program
+    old_cap = _capturing[0]
+    _capturing[0] = main_program
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        _default_startup[0] = old_start
+        _capturing[0] = old_cap
+
+
+def current_capture_program():
+    from ..framework import in_static_mode
+    if _capturing[0] is not None:
+        return _capturing[0]
+    if in_static_mode():
+        return _default_main[0]
+    return None
+
+
+def _symbolic_apply(fn, tensors, n_outputs, differentiable):
+    """The apply_op hook: append an Operator; infer shapes via eval_shape."""
+    prog = current_capture_program()
+    if prog is None:
+        raise RuntimeError("symbolic Variable used outside static mode")
+    block = prog.global_block
+    ins = []
+    for t in tensors:
+        if isinstance(t, Variable):
+            ins.append(t)
+        elif isinstance(t, Tensor):
+            # concrete tensor (e.g. a Parameter created eagerly): wrap as a
+            # persistable var bound to it
+            v = Variable(jax.ShapeDtypeStruct(tuple(t.shape), t.dtype),
+                         name=getattr(t, 'name', None), concrete=t)
+            block.vars[v.name] = v
+            ins.append(v)
+        else:
+            arr = jnp.asarray(t)
+            c = Tensor(arr)
+            v = Variable(jax.ShapeDtypeStruct(tuple(arr.shape), arr.dtype),
+                         concrete=c)
+            block.vars[v.name] = v
+            ins.append(v)
+
+    avals = [jax.ShapeDtypeStruct(tuple(v._value.shape), v._value.dtype)
+             for v in ins]
+    out_avals = jax.eval_shape(fn, *avals)
+    if n_outputs == 1:
+        out_avals = [out_avals]
+    outs = []
+    stop = all(v.stop_gradient for v in ins) or not differentiable
+    for av in out_avals:
+        ov = Variable(jax.ShapeDtypeStruct(tuple(av.shape), av.dtype))
+        ov.stop_gradient = stop
+        block.vars[ov.name] = ov
+        outs.append(ov)
+    op = Operator(fn, ins, outs, type=getattr(fn, '__name__', 'jax_op'))
+    for ov in outs:
+        ov.op = op
+    block.ops.append(op)
+    return outs[0] if n_outputs == 1 else tuple(outs)
+
+
+set_symbolic_handler(_symbolic_apply)
+
+
+def data(name, shape, dtype='float32', lod_level=0):
+    """paddle.static.data — feed placeholder."""
+    prog = current_capture_program() or default_main_program()
+    shape = tuple(1 if (s is None or s == -1) else int(s) for s in shape)
+    v = Variable(jax.ShapeDtypeStruct(shape, convert_dtype(dtype)), name=name,
+                 is_data=True)
+    v.stop_gradient = True
+    prog.global_block.vars[name] = v
+    return v

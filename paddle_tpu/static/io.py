@@ -1,0 +1,81 @@
+"""Static-graph save/load. Parity: python/paddle/fluid/io.py."""
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+from .graph import default_main_program
+
+
+def _collect_params(program):
+    program = program or default_main_program()
+    out = {}
+    for v in program.list_vars():
+        if v.concrete is not None and v.concrete.persistable:
+            out[v.name] = np.asarray(v.concrete.numpy())
+    return out
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    os.makedirs(dirname, exist_ok=True)
+    params = _collect_params(main_program)
+    path = os.path.join(dirname, filename or '__persistables__')
+    with open(path, 'wb') as f:
+        pickle.dump(params, f)
+
+
+save_params = save_persistables
+save_vars = save_persistables
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    path = os.path.join(dirname, filename or '__persistables__')
+    with open(path, 'rb') as f:
+        params = pickle.load(f)
+    import jax.numpy as jnp
+    program = main_program or default_main_program()
+    for v in program.list_vars():
+        if v.name in params and v.concrete is not None:
+            v.concrete._inplace_value(jnp.asarray(params[v.name]))
+
+
+load_params = load_persistables
+load_vars = load_persistables
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, **kwargs):
+    """Saves program description + params; exports StableHLO text."""
+    os.makedirs(dirname, exist_ok=True)
+    program = main_program or default_main_program()
+    params = _collect_params(program)
+    meta = {
+        'feed_names': list(feeded_var_names),
+        'fetch_names': [t.name for t in target_vars],
+        'program_repr': str(program),
+    }
+    with open(os.path.join(dirname, model_filename or '__model__'), 'wb') as f:
+        pickle.dump(meta, f)
+    with open(os.path.join(dirname, params_filename or '__params__'),
+              'wb') as f:
+        pickle.dump(params, f)
+    return [t.name for t in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, **kwargs):
+    with open(os.path.join(dirname, model_filename or '__model__'), 'rb') as f:
+        meta = pickle.load(f)
+    with open(os.path.join(dirname, params_filename or '__params__'),
+              'rb') as f:
+        params = pickle.load(f)
+    program = default_main_program()
+    import jax.numpy as jnp
+    for v in program.list_vars():
+        if v.name in params and v.concrete is not None:
+            v.concrete._inplace_value(jnp.asarray(params[v.name]))
+    fetch_vars = [program.global_block.vars.get(n)
+                  for n in meta['fetch_names']]
+    return program, meta['feed_names'], fetch_vars

@@ -1,0 +1,55 @@
+"""paddle.static.nn: graph-building layer functions.
+
+Parity: python/paddle/fluid/layers/nn.py's fc/conv2d/... — here thin wrappers
+that instantiate the SAME nn.Layer modules under static capture (the
+apply_op chokepoint records their ops into the Program).
+"""
+from .. import nn as _nn
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= s
+    if x.ndim > num_flatten_dims + 1:
+        x = x.flatten(num_flatten_dims)
+    layer = _nn.Linear(in_features, size, weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+    out = layer(x)
+    if activation:
+        out = getattr(_nn.functional, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = _nn.Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout='NCHW', is_test=False, name=None,
+               **kwargs):
+    ch = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    layer = _nn.BatchNorm(ch, act=act, momentum=momentum, epsilon=epsilon,
+                          param_attr=param_attr, bias_attr=bias_attr,
+                          data_layout=data_layout)
+    if is_test:
+        layer.eval()
+    return layer(input)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype='float32'):
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          sparse=is_sparse, weight_attr=param_attr)
+    return layer(input)

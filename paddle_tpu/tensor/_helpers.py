@@ -1,0 +1,46 @@
+import numbers
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, to_tensor, register_method
+from ..core.dtypes import convert_dtype, get_default_dtype
+
+__all__ = []
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1))
+    return int(axis)
+
+
+def _shape(shape):
+    """Normalize shape arg (int list / tensor of ints / list w/ scalar tensors)."""
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    if isinstance(shape, numbers.Integral):
+        return (int(shape),)
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def unary(jnp_fn, differentiable=True):
+    def op(x, name=None):
+        return apply_op(jnp_fn, (_t(x),), differentiable=differentiable)
+    return op
+
+
+def binary(jnp_fn, differentiable=True):
+    def op(x, y, name=None):
+        return apply_op(jnp_fn, (_t(x), _t(y)), differentiable=differentiable)
+    return op

@@ -1,0 +1,33 @@
+"""Attribute helpers. Parity: python/paddle/tensor/attribute.py."""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..core.dtypes import is_complex, is_floating, is_integer
+from ._helpers import _t
+
+__all__ = ['shape', 'rank', 'is_complex', 'is_floating_point', 'is_integer_t', 'imag_t', 'real_t']
+
+
+def shape(input):
+    """fluid.layers.shape — returns the shape as an int tensor."""
+    return Tensor(jnp.asarray(_t(input).shape, dtype=jnp.int32))
+
+
+def rank(input):
+    return Tensor(jnp.asarray(_t(input).ndim, dtype=jnp.int32))
+
+
+def is_floating_point(x):
+    return is_floating(_t(x).dtype)
+
+
+def is_integer_t(x):
+    return is_integer(_t(x).dtype)
+
+
+def real_t(x, name=None):
+    return apply_op(jnp.real, (_t(x),))
+
+
+def imag_t(x, name=None):
+    return apply_op(jnp.imag, (_t(x),))

@@ -1,0 +1,153 @@
+"""Creation ops. Parity: python/paddle/tensor/creation.py (+ fluid/layers/tensor.py)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, to_tensor
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ._helpers import _t, _shape
+
+__all__ = [
+    'to_tensor', 'zeros', 'ones', 'full', 'zeros_like', 'ones_like', 'full_like',
+    'arange', 'linspace', 'logspace', 'eye', 'empty', 'empty_like', 'tril', 'triu',
+    'meshgrid', 'diag', 'diagflat', 'assign', 'clone', 'numel', 'create_tensor',
+]
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    return d if d is not None else (default or get_default_dtype())
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=_dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = _t(x)
+    dt = convert_dtype(dtype)
+    return apply_op(lambda v: jnp.zeros_like(v, dtype=dt), (x,), differentiable=False)
+
+
+def ones_like(x, dtype=None, name=None):
+    x = _t(x)
+    dt = convert_dtype(dtype)
+    return apply_op(lambda v: jnp.ones_like(v, dtype=dt), (x,), differentiable=False)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = _t(x)
+    dt = convert_dtype(dtype)
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return apply_op(lambda v: jnp.full_like(v, fill_value, dtype=dt), (x,),
+                    differentiable=False)
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    dt = convert_dtype(dtype)
+    if dt is None:
+        dt = (get_default_dtype()
+              if any(isinstance(v, float) for v in (start, end, step)) else jnp.int64)
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(val(start), val(stop), int(val(num)), base=val(base),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.tril(v, k=int(diagonal)), (_t(x),))
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.triu(v, k=int(diagonal)), (_t(x),))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    ts = tuple(_t(a) for a in args)
+    return list(apply_op(lambda *vs: tuple(jnp.meshgrid(*vs, indexing='ij')),
+                         ts, n_outputs=len(ts)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = _t(x)
+    k = int(offset)
+    if x.ndim == 1:
+        def fn(v):
+            out = jnp.diag(v, k=k)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(v), k=k).astype(bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return apply_op(fn, (x,))
+    return apply_op(lambda v: jnp.diagonal(v, offset=k), (x,))
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda v: jnp.diagflat(v, k=int(offset)), (_t(x),))
+
+
+def assign(x, output=None):
+    """fluid.layers.assign — copies input into output (or a fresh tensor)."""
+    if isinstance(x, (np.ndarray, list, tuple, int, float)):
+        x = to_tensor(np.asarray(x))
+    out = apply_op(lambda v: v + 0 if np.issubdtype(np.dtype(v.dtype), np.inexact) else v,
+                   (_t(x),))
+    if output is not None:
+        output._inplace_value(out._value)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return _t(x).clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size, dtype=jnp.int64))
+
+
+def create_tensor(dtype='float32', name=None, persistable=False):
+    t = Tensor(jnp.zeros((), dtype=convert_dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t

@@ -1,0 +1,10 @@
+"""einsum. Parity: paddle.einsum (2.x) / reference contrib."""
+import jax.numpy as jnp
+
+from ..core.tensor import apply_op
+from ._helpers import _t
+
+
+def einsum(equation, *operands):
+    ts = tuple(_t(o) for o in operands)
+    return apply_op(lambda *vs: jnp.einsum(equation, *vs), ts)

@@ -1,0 +1,193 @@
+"""Linear algebra ops. Parity: python/paddle/tensor/linalg.py."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, register_method
+from ._helpers import _t, _axes
+
+__all__ = ['matmul', 'dot', 'bmm', 'mv', 'norm', 'dist', 't', 'cholesky',
+           'cross', 'histogram', 'bincount', 'mm', 'multi_dot', 'matrix_power',
+           'solve', 'inv', 'pinv', 'det', 'slogdet', 'svd', 'qr', 'eigh',
+           'matrix_norm', 'vector_norm', 'triangular_solve', 'lstsq', 'matrix_rank', 'cov', 'corrcoef']
+
+from .math import matmul  # shared impl
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.sum(a * b, axis=-1, keepdims=False)
+    return apply_op(fn, (_t(x), _t(y)))
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, (_t(x), _t(y)))
+
+
+mm = bmm
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, (_t(x), _t(vec)))
+
+
+def t(input, name=None):
+    x = _t(input)
+    if x.ndim > 2:
+        raise ValueError("paddle.t expects ndim <= 2")
+    return apply_op(lambda v: v.T, (x,))
+
+
+def norm(x, p='fro', axis=None, keepdim=False, name=None):
+    x = _t(x)
+    ax = _axes(axis)
+    def fn(v):
+        if p == 'fro' or (p == 2 and ax is None):
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        if p in (np.inf, float('inf'), 'inf'):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p in (-np.inf, float('-inf'), '-inf'):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=ax, keepdims=keepdim),
+                         1.0 / p)
+    return apply_op(fn, (x,))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p='fro', axis=(-2, -1), keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(_t(x) - _t(y), p=float(p))
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply_op(fn, (_t(x),))
+
+
+def cross(x, y, axis=None, name=None):
+    ax = 0 if axis is None else axis
+    x = _t(x)
+    if axis is None:
+        # paddle: first axis with dim 3
+        for i, s in enumerate(x.shape):
+            if s == 3:
+                ax = i
+                break
+    return apply_op(lambda a, b: jnp.cross(a, b, axis=ax), (x, _t(y)))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = _t(input)
+    def fn(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+        h, _ = jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi))
+        return h
+    return apply_op(fn, (x,), differentiable=False)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = _t(x)
+    n = int(np.asarray(x.numpy()).max()) + 1 if x.size else 0
+    length = builtins_max(n, minlength)
+    if weights is None:
+        return apply_op(lambda v: jnp.bincount(v.reshape(-1), length=length),
+                        (x,), differentiable=False)
+    return apply_op(lambda v, w: jnp.bincount(v.reshape(-1), weights=w.reshape(-1),
+                                              length=length),
+                    (x, _t(weights)), differentiable=False)
+
+
+import builtins as _b
+builtins_max = _b.max
+
+
+def multi_dot(x, name=None):
+    ts = tuple(_t(i) for i in x)
+    return apply_op(lambda *vs: jnp.linalg.multi_dot(vs), ts)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda v: jnp.linalg.matrix_power(v, n), (_t(x),))
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, (_t(x), _t(y)))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    from jax.scipy.linalg import solve_triangular
+    def fn(a, b):
+        return solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0,
+                                unit_diagonal=unitriangular)
+    return apply_op(fn, (_t(x), _t(y)))
+
+
+def inv(x, name=None):
+    return apply_op(jnp.linalg.inv, (_t(x),))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), (_t(x),))
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, (_t(x),))
+
+
+def slogdet(x, name=None):
+    outs = apply_op(lambda v: tuple(jnp.linalg.slogdet(v)), (_t(x),), n_outputs=2)
+    return list(outs)
+
+
+def svd(x, full_matrices=False, name=None):
+    outs = apply_op(lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)),
+                    (_t(x),), n_outputs=3)
+    return tuple(outs)
+
+
+def qr(x, mode='reduced', name=None):
+    outs = apply_op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), (_t(x),), n_outputs=2)
+    return tuple(outs)
+
+
+def eigh(x, UPLO='L', name=None):
+    outs = apply_op(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), (_t(x),), n_outputs=2)
+    return tuple(outs)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return (sol, res, rank, sv)
+    return tuple(apply_op(fn, (_t(x), _t(y)), n_outputs=4))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(lambda v: jnp.linalg.matrix_rank(v, rtol=tol), (_t(x),),
+                    differentiable=False)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0),
+                    (_t(x),))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), (_t(x),))
+
+
+for _name in ['dot', 'bmm', 'mv', 'norm', 'dist', 't', 'cholesky', 'cross',
+              'histogram', 'bincount', 'inner', 'matrix_power', 'solve', 'inv']:
+    if _name in globals():
+        register_method(_name, globals()[_name])

@@ -1,0 +1,75 @@
+"""Logic/comparison ops. Parity: python/paddle/tensor/logic.py."""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, register_method
+from ._helpers import _t, binary
+
+__all__ = ['equal', 'not_equal', 'greater_than', 'greater_equal', 'less_than',
+           'less_equal', 'equal_all', 'logical_and', 'logical_or', 'logical_not',
+           'logical_xor', 'bitwise_and', 'bitwise_or', 'bitwise_not', 'bitwise_xor',
+           'allclose', 'isclose', 'isnan', 'isinf', 'isfinite', 'is_empty', 'is_tensor']
+
+equal = binary(jnp.equal, differentiable=False)
+not_equal = binary(jnp.not_equal, differentiable=False)
+greater_than = binary(jnp.greater, differentiable=False)
+greater_equal = binary(jnp.greater_equal, differentiable=False)
+less_than = binary(jnp.less, differentiable=False)
+less_equal = binary(jnp.less_equal, differentiable=False)
+logical_and = binary(jnp.logical_and, differentiable=False)
+logical_or = binary(jnp.logical_or, differentiable=False)
+logical_xor = binary(jnp.logical_xor, differentiable=False)
+bitwise_and = binary(jnp.bitwise_and, differentiable=False)
+bitwise_or = binary(jnp.bitwise_or, differentiable=False)
+bitwise_xor = binary(jnp.bitwise_xor, differentiable=False)
+
+
+def logical_not(x, out=None, name=None):
+    return apply_op(jnp.logical_not, (_t(x),), differentiable=False)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_op(jnp.bitwise_not, (_t(x),), differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), (_t(x), _t(y)),
+                    differentiable=False)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.allclose(a, b, rtol=float(rtol),
+                                              atol=float(atol), equal_nan=equal_nan),
+                    (_t(x), _t(y)), differentiable=False)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.isclose(a, b, rtol=float(rtol),
+                                             atol=float(atol), equal_nan=equal_nan),
+                    (_t(x), _t(y)), differentiable=False)
+
+
+def isnan(x, name=None):
+    return apply_op(jnp.isnan, (_t(x),), differentiable=False)
+
+
+def isinf(x, name=None):
+    return apply_op(jnp.isinf, (_t(x),), differentiable=False)
+
+
+def isfinite(x, name=None):
+    return apply_op(jnp.isfinite, (_t(x),), differentiable=False)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+for _name in ['equal', 'not_equal', 'greater_than', 'greater_equal', 'less_than',
+              'less_equal', 'logical_and', 'logical_or', 'logical_not',
+              'logical_xor', 'allclose', 'isclose', 'isnan', 'isinf', 'isfinite',
+              'equal_all', 'bitwise_and', 'bitwise_or', 'bitwise_not', 'bitwise_xor']:
+    register_method(_name, globals()[_name])

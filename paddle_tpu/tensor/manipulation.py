@@ -1,0 +1,365 @@
+"""Shape/layout manipulation ops. Parity: python/paddle/tensor/manipulation.py."""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply_op, register_method
+from ..core.dtypes import convert_dtype
+from ._helpers import _t, _axes, _shape
+
+__all__ = [
+    'concat', 'split', 'stack', 'unstack', 'squeeze', 'unsqueeze', 'reshape',
+    'flatten', 'transpose', 'expand', 'expand_as', 'tile', 'broadcast_to',
+    'broadcast_tensors', 'gather', 'gather_nd', 'scatter', 'scatter_nd',
+    'scatter_nd_add', 'slice', 'strided_slice', 'index_select', 'index_sample',
+    'masked_select', 'roll', 'flip', 'rot90', 'unique', 'unique_consecutive',
+    'unbind', 'chunk', 'shard_index', 'cast', 'crop', 'pad_seq', 'reverse',
+    'moveaxis', 'swapaxes', 'take_along_axis', 'put_along_axis', 'repeat_interleave',
+    'as_real', 'as_complex', 'tensordot', 'atleast_1d', 'atleast_2d', 'atleast_3d',
+]
+
+
+def concat(x, axis=0, name=None):
+    ts = tuple(_t(i) for i in x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op(lambda *vs: jnp.concatenate(vs, axis=axis), ts)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                 for s in num_or_sections]
+        n_neg = sum(1 for s in sizes if s < 0)
+        if n_neg:
+            rest = dim - sum(s for s in sizes if s >= 0)
+            sizes = [rest if s < 0 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    def fn(v):
+        return tuple(lax.slice_in_dim(v, o, o + s, axis=axis)
+                     for o, s in zip(offsets, sizes))
+    return list(apply_op(fn, (x,), n_outputs=len(sizes)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def stack(x, axis=0, name=None):
+    ts = tuple(_t(i) for i in x)
+    return apply_op(lambda *vs: jnp.stack(vs, axis=axis), ts)
+
+
+def unstack(x, axis=0, num=None):
+    x = _t(x)
+    n = num if num is not None else x.shape[axis]
+    def fn(v):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(v, n, axis=axis))
+    return list(apply_op(fn, (x,), n_outputs=n))
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    return _t(x).squeeze(axis)
+
+
+def unsqueeze(x, axis, name=None):
+    return _t(x).unsqueeze(axis)
+
+
+def reshape(x, shape, name=None):
+    return _t(x).reshape(_shape(shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _t(x).flatten(start_axis, stop_axis)
+
+
+def transpose(x, perm, name=None):
+    return _t(x).transpose(perm)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda v: jnp.moveaxis(v, source, destination), (_t(x),))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda v: jnp.swapaxes(v, axis0, axis1), (_t(x),))
+
+
+def expand(x, shape, name=None):
+    shp = _shape(shape)
+    x = _t(x)
+    def fn(v):
+        tgt = list(shp)
+        # -1 entries keep the original dim
+        off = len(tgt) - v.ndim
+        for i, s in enumerate(tgt):
+            if s == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tuple(tgt))
+    return apply_op(fn, (x,))
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(_t(y).shape)
+    return apply_op(lambda v: jnp.broadcast_to(v, tgt), (_t(x),))
+
+
+def broadcast_to(x, shape, name=None):
+    return apply_op(lambda v: jnp.broadcast_to(v, _shape(shape)), (_t(x),))
+
+
+def broadcast_tensors(input, name=None):
+    ts = tuple(_t(i) for i in input)
+    return list(apply_op(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), ts,
+                         n_outputs=len(ts)))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape(repeat_times)
+    return apply_op(lambda v: jnp.tile(v, reps), (_t(x),))
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = _t(x), _t(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op(lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i,
+                                          axis=axis), (x, index))
+
+
+def gather_nd(x, index, name=None):
+    x, index = _t(x), _t(index)
+    def fn(v, idx):
+        k = idx.shape[-1]
+        return v[tuple(jnp.moveaxis(idx, -1, 0))] if k > 0 else v
+    return apply_op(fn, (x, index))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = _t(x), _t(index), _t(updates)
+    def fn(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        # paddle semantics: zero out target rows then accumulate
+        z = v.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return apply_op(fn, (x, index, updates))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = _t(x), _t(index), _t(updates)
+    def fn(v, i, u):
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply_op(fn, (x, index, updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=_t(updates).dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def slice(input, axes, starts, ends, name=None):
+    x = _t(input)
+    def get(v):
+        return int(v.item()) if isinstance(v, Tensor) else int(v)
+    axes = [get(a) for a in axes]
+    starts = [get(s) for s in starts]
+    ends = [get(e) for e in ends]
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            d = v.shape[a]
+            s2 = max(s + d, 0) if s < 0 else min(s, d)
+            e2 = max(e + d, 0) if e < 0 else min(e, d)
+            idx[a] = builtins_slice(s2, e2)
+        return v[tuple(idx)]
+    return apply_op(fn, (x,))
+
+
+import builtins as _builtins
+builtins_slice = _builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = _t(x)
+    def get(v):
+        return int(v.item()) if isinstance(v, Tensor) else int(v)
+    axes = [get(a) for a in axes]
+    starts = [get(s) for s in starts]
+    ends = [get(e) for e in ends]
+    strides = [get(s) for s in strides]
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins_slice(s, e, st)
+        return v[tuple(idx)]
+    return apply_op(fn, (x,))
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(lambda v, i: jnp.take(v, i, axis=axis), (_t(x), _t(index)))
+
+
+def index_sample(x, index):
+    """x: (B, N), index: (B, M) -> (B, M); parity: fluid index_sample op."""
+    return apply_op(lambda v, i: jnp.take_along_axis(v, i, axis=1),
+                    (_t(x), _t(index)))
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply_op(lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+                    (_t(arr), _t(indices)))
+
+
+def put_along_axis(arr, indices, values, axis, reduce='assign', name=None):
+    arr, indices = _t(arr), _t(indices)
+    values = _t(values)
+    def fn(v, i, u):
+        u = jnp.broadcast_to(u, i.shape).astype(v.dtype)
+        idx = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(i.ndim)])
+               for k, s in enumerate(i.shape)]
+        idx[axis] = i
+        if reduce == 'add':
+            return v.at[tuple(idx)].add(u)
+        if reduce == 'multiply' or reduce == 'mul':
+            return v.at[tuple(idx)].multiply(u)
+        return v.at[tuple(idx)].set(u)
+    return apply_op(fn, (arr, indices, values))
+
+
+def masked_select(x, mask, name=None):
+    """Dynamic-size output: host fallback (not jittable) — documented divergence."""
+    x, mask = _t(x), _t(mask)
+    xv, mv = np.asarray(x.numpy()), np.asarray(mask.numpy())
+    return Tensor(jnp.asarray(np.broadcast_to(xv, np.broadcast(xv, mv).shape)[
+        np.broadcast_to(mv, np.broadcast(xv, mv).shape)]))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda v: jnp.roll(v, shifts, axis=axis), (_t(x),))
+
+
+def flip(x, axis, name=None):
+    ax = _axes(axis)
+    return apply_op(lambda v: jnp.flip(v, axis=ax), (_t(x),))
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), (_t(x),))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype='int64', name=None):
+    """Dynamic-size output: computed on host (documented divergence)."""
+    xv = np.asarray(_t(x).numpy())
+    res = np.unique(xv, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype='int64', name=None):
+    xv = np.asarray(_t(x).numpy())
+    flat = xv.reshape(-1) if axis is None else xv
+    keep = np.ones(len(flat), dtype=bool)
+    keep[1:] = flat[1:] != flat[:-1]
+    out = [Tensor(jnp.asarray(flat[keep]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, len(flat)))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    x = _t(input)
+    size = index_num // nshards
+    def fn(v):
+        shard = v // size
+        local = v % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+    return apply_op(fn, (x,), differentiable=False)
+
+
+def cast(x, dtype):
+    return _t(x).astype(dtype)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    shp = _shape(shape)
+    offs = _shape(offsets) if offsets is not None else tuple([0] * x.ndim)
+    def fn(v):
+        return lax.dynamic_slice(v, offs, shp)
+    return apply_op(fn, (x,))
+
+
+def pad_seq(x, paddings, pad_value=0.0, name=None):
+    x = _t(x)
+    pairs = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(len(paddings) // 2)]
+    return apply_op(lambda v: jnp.pad(v, pairs, constant_values=pad_value), (x,))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = repeats.numpy()
+        return apply_op(lambda v: jnp.repeat(v, reps, axis=axis), (_t(x),))
+    return apply_op(lambda v: jnp.repeat(v, repeats, axis=axis), (_t(x),))
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda v: lax.complex(v[..., 0], v[..., 1]), (_t(x),))
+
+
+def as_real(x, name=None):
+    return apply_op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), (_t(x),))
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), (_t(x), _t(y)))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_1d, (_t(i),)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_2d, (_t(i),)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_3d, (_t(i),)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+for _name in ['concat', 'split', 'stack', 'unstack', 'gather', 'gather_nd',
+              'scatter', 'scatter_nd_add', 'index_select', 'index_sample',
+              'masked_select', 'roll', 'flip', 'unique', 'unbind', 'chunk',
+              'expand', 'expand_as', 'broadcast_to', 'tile', 'tensordot',
+              'take_along_axis', 'put_along_axis', 'repeat_interleave', 'rot90']:
+    register_method(_name, globals()[_name])

@@ -1,0 +1,333 @@
+"""Math ops. Parity: python/paddle/tensor/math.py (+ fluid/layers/ops.py, nn.py)."""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply_op, register_method
+from ..core.dtypes import convert_dtype, is_floating, get_default_dtype
+from ._helpers import _t, _axes, unary, binary
+
+__all__ = [
+    'add', 'subtract', 'multiply', 'divide', 'floor_divide', 'remainder', 'mod',
+    'floor_mod', 'pow', 'matmul', 'maximum', 'minimum', 'fmax', 'fmin',
+    'exp', 'expm1', 'log', 'log2', 'log10', 'log1p', 'sqrt', 'rsqrt', 'abs',
+    'neg', 'sign', 'floor', 'ceil', 'round', 'trunc', 'sin', 'cos', 'tan',
+    'asin', 'acos', 'atan', 'atan2', 'sinh', 'cosh', 'tanh', 'asinh', 'acosh', 'atanh',
+    'reciprocal', 'square', 'erf', 'erfinv', 'rint', 'digamma', 'lgamma',
+    'sum', 'mean', 'max', 'min', 'prod', 'cumsum', 'cumprod', 'logsumexp',
+    'logcumsumexp', 'amax', 'amin', 'clip', 'scale', 'increment', 'stanh',
+    'addmm', 'kron', 'trace', 'multiplex', 'inner', 'outer', 'isfinite_v',
+    'elementwise_add', 'elementwise_sub', 'elementwise_mul', 'elementwise_div',
+    'elementwise_max', 'elementwise_min', 'elementwise_mod', 'elementwise_pow',
+    'elementwise_floordiv', 'log_softmax_v', 'multiply_', 'add_n', 'nan_to_num',
+    'deg2rad', 'rad2deg', 'angle', 'conj', 'real', 'imag', 'lerp', 'frac', 'gcd', 'lcm',
+]
+
+# -- simple elementwise ---------------------------------------------------
+add = binary(jnp.add)
+subtract = binary(jnp.subtract)
+multiply = binary(jnp.multiply)
+divide = binary(jnp.true_divide)
+floor_divide = binary(jnp.floor_divide)
+remainder = binary(jnp.mod)
+mod = remainder
+floor_mod = remainder
+maximum = binary(jnp.maximum)
+minimum = binary(jnp.minimum)
+fmax = binary(jnp.fmax)
+fmin = binary(jnp.fmin)
+atan2 = binary(jnp.arctan2)
+gcd = binary(jnp.gcd, differentiable=False)
+lcm = binary(jnp.lcm, differentiable=False)
+
+exp = unary(jnp.exp)
+expm1 = unary(jnp.expm1)
+log = unary(jnp.log)
+log2 = unary(jnp.log2)
+log10 = unary(jnp.log10)
+log1p = unary(jnp.log1p)
+sqrt = unary(jnp.sqrt)
+rsqrt = unary(lambda x: lax.rsqrt(x))
+abs = unary(jnp.abs)
+neg = unary(jnp.negative)
+sign = unary(jnp.sign, differentiable=False)
+floor = unary(jnp.floor)
+ceil = unary(jnp.ceil)
+round = unary(jnp.round)
+rint = unary(jnp.rint)
+trunc = unary(jnp.trunc)
+sin = unary(jnp.sin)
+cos = unary(jnp.cos)
+tan = unary(jnp.tan)
+asin = unary(jnp.arcsin)
+acos = unary(jnp.arccos)
+atan = unary(jnp.arctan)
+sinh = unary(jnp.sinh)
+cosh = unary(jnp.cosh)
+tanh = unary(jnp.tanh)
+asinh = unary(jnp.arcsinh)
+acosh = unary(jnp.arccosh)
+atanh = unary(jnp.arctanh)
+reciprocal = unary(jnp.reciprocal)
+square = unary(jnp.square)
+deg2rad = unary(jnp.deg2rad)
+rad2deg = unary(jnp.rad2deg)
+angle = unary(jnp.angle)
+conj = unary(jnp.conj)
+real = unary(jnp.real)
+imag = unary(jnp.imag)
+frac = unary(lambda x: x - jnp.trunc(x))
+
+
+def erf(x, name=None):
+    return apply_op(lambda v: lax.erf(v), (_t(x),))
+
+
+def erfinv(x, name=None):
+    return apply_op(lambda v: lax.erf_inv(v), (_t(x),))
+
+
+def digamma(x, name=None):
+    from jax.scipy.special import digamma as _dg
+    return apply_op(_dg, (_t(x),))
+
+
+def lgamma(x, name=None):
+    from jax.scipy.special import gammaln
+    return apply_op(gammaln, (_t(x),))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda v: scale_b * jnp.tanh(scale_a * v), (_t(x),))
+
+
+# -- pow / matmul ---------------------------------------------------------
+def pow(x, y, name=None):
+    return apply_op(jnp.power, (_t(x), _t(y)))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return apply_op(fn, (_t(x), _t(y)))
+
+
+# fluid elementwise_* compat (axis broadcasting in the 1.8 style)
+def _fluid_elementwise(jfn):
+    def op(x, y, axis=-1, act=None, name=None):
+        x, y = _t(x), _t(y)
+        def fn(a, b):
+            if axis != -1 and b.ndim < a.ndim:
+                shp = [1] * a.ndim
+                shp[axis:axis + b.ndim] = b.shape
+                b = jnp.reshape(b, shp)
+            out = jfn(a, b)
+            return out
+        out = apply_op(fn, (x, y))
+        if act is not None:
+            from ..nn import functional as F
+            out = getattr(F, act)(out)
+        return out
+    return op
+
+
+elementwise_add = _fluid_elementwise(jnp.add)
+elementwise_sub = _fluid_elementwise(jnp.subtract)
+elementwise_mul = _fluid_elementwise(jnp.multiply)
+elementwise_div = _fluid_elementwise(jnp.true_divide)
+elementwise_max = _fluid_elementwise(jnp.maximum)
+elementwise_min = _fluid_elementwise(jnp.minimum)
+elementwise_mod = _fluid_elementwise(jnp.mod)
+elementwise_pow = _fluid_elementwise(jnp.power)
+elementwise_floordiv = _fluid_elementwise(jnp.floor_divide)
+
+
+# -- reductions -----------------------------------------------------------
+def _reduce(jfn, x, axis, keepdim, dtype=None):
+    ax = _axes(axis)
+    dt = convert_dtype(dtype)
+    def fn(v):
+        out = jfn(v, axis=ax, keepdims=keepdim)
+        if dt is not None:
+            out = out.astype(dt)
+        return out
+    return apply_op(fn, (_t(x),))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = _t(x)
+    if dtype is None and np.dtype(x.dtype) == np.bool_:
+        dtype = 'int64'
+    return _reduce(jnp.sum, x, axis, keepdim, dtype)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.mean, x, axis, keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.max, x, axis, keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.min, x, axis, keepdim)
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _reduce(jnp.prod, x, axis, keepdim, dtype)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = _t(x)
+    dt = convert_dtype(dtype)
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            out = jnp.cumsum(v)
+        else:
+            out = jnp.cumsum(v, axis=int(axis))
+        return out.astype(dt) if dt is not None else out
+    return apply_op(fn, (x,))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    def fn(v):
+        out = jnp.cumprod(v, axis=int(dim) if dim is not None else None)
+        return out.astype(dt) if dt is not None else out
+    return apply_op(fn, (_t(x),))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    from jax.scipy.special import logsumexp as _lse
+    ax = _axes(axis)
+    return apply_op(lambda v: _lse(v, axis=ax, keepdims=keepdim), (_t(x),))
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        m = jnp.max(v, axis=ax, keepdims=True)
+        return jnp.log(jnp.cumsum(jnp.exp(v - m), axis=ax)) + m
+    return apply_op(fn, (_t(x),))
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply_op(lambda v: jnp.clip(v, lo, hi), (_t(x),))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    def fn(v):
+        if bias_after_scale:
+            return v * s + bias
+        return (v + bias) * s
+    out = apply_op(fn, (_t(x),))
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    out = apply_op(lambda v: v + jnp.asarray(value, v.dtype), (_t(x),))
+    if isinstance(x, Tensor):
+        x._inplace_value(out._value)
+        return x
+    return out
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = tuple(_t(i) for i in inputs)
+    return apply_op(lambda *vs: jnp.sum(jnp.stack(vs), axis=0)
+                    if len(vs) > 1 else vs[0], ts)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                    (_t(input), _t(x), _t(y)))
+
+
+def kron(x, y, name=None):
+    return apply_op(jnp.kron, (_t(x), _t(y)))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+                    (_t(x),))
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, (_t(x), _t(y)))
+
+
+def outer(x, y, name=None):
+    return apply_op(lambda a, b: jnp.outer(a, b), (_t(x), _t(y)))
+
+
+def multiplex(inputs, index, name=None):
+    ts = tuple(_t(i) for i in inputs) + (_t(index),)
+    def fn(*args):
+        idx = args[-1].reshape(-1).astype(jnp.int32)
+        stacked = jnp.stack(args[:-1])  # (n, batch, ...)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx, rows]
+    return apply_op(fn, ts)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf),
+                    (_t(x),))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return apply_op(lambda a, b: a + weight * (b - a), (_t(x), _t(y)))
+    return apply_op(lambda a, b, w: a + w * (b - a), (_t(x), _t(y), _t(weight)))
+
+
+def isfinite_v(x, name=None):
+    return apply_op(jnp.isfinite, (_t(x),), differentiable=False)
+
+
+def log_softmax_v(x, axis=-1):
+    from jax.nn import log_softmax as _ls
+    return apply_op(lambda v: _ls(v, axis=axis), (_t(x),))
+
+
+def multiply_(x, y):
+    out = multiply(x, y)
+    x._inplace_value(out._value)
+    return x
+
+
+# -- attach methods -------------------------------------------------------
+_METHODS = [
+    'add', 'subtract', 'multiply', 'divide', 'floor_divide', 'remainder', 'mod',
+    'pow', 'matmul', 'maximum', 'minimum', 'exp', 'log', 'log2', 'log10', 'log1p',
+    'sqrt', 'rsqrt', 'abs', 'sign', 'floor', 'ceil', 'round', 'trunc', 'sin',
+    'cos', 'tan', 'asin', 'acos', 'atan', 'sinh', 'cosh', 'tanh', 'reciprocal',
+    'square', 'erf', 'sum', 'mean', 'max', 'min', 'prod', 'cumsum', 'cumprod',
+    'logsumexp', 'clip', 'scale', 'trace', 'kron', 'addmm', 'inner', 'outer',
+    'lerp', 'nan_to_num', 'expm1', 'digamma', 'lgamma', 'atan2', 'neg', 'conj',
+    'real', 'imag', 'angle', 'frac',
+]
+_g = globals()
+for _name in _METHODS:
+    register_method(_name, _g[_name])

@@ -1,0 +1,129 @@
+"""Random ops. Parity: python/paddle/tensor/random.py.
+
+All sampling pulls a key from the active Generator (core/rng.py). Inside a
+``rng.key_scope`` (used by jitted train steps) keys derive from an explicit
+traced key, keeping compiled functions pure and reproducible.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, register_method
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core import rng as _rng
+from ._helpers import _t, _shape
+
+__all__ = ['uniform', 'normal', 'gaussian', 'standard_normal', 'randn', 'rand',
+           'randint', 'randint_like', 'randperm', 'bernoulli', 'multinomial',
+           'poisson', 'uniform_', 'normal_', 'exponential_']
+
+
+def _key():
+    return _rng.next_key()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=dt,
+                                     minval=float(min), maxval=float(max)))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = _t(mean), _t(std)
+        key = _key()
+        def fn(mv, sv):
+            shp = jnp.broadcast_shapes(mv.shape, sv.shape)
+            return mv + sv * jax.random.normal(key, shp, dtype=mv.dtype)
+        return apply_op(fn, (m, s))
+    dt = get_default_dtype()
+    return Tensor(float(mean) + float(std) *
+                  jax.random.normal(_key(), _shape(shape), dtype=dt))
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    dt = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(float(mean) + float(std) *
+                  jax.random.normal(_key(), _shape(shape), dtype=dt))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def randint(low=0, high=None, shape=[1], dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype) or jnp.int64
+    return Tensor(jax.random.randint(_key(), _shape(shape), int(low), int(high),
+                                     dtype=jnp.int32).astype(dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = _t(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype='int64', name=None):
+    dt = convert_dtype(dtype)
+    return Tensor(jax.random.permutation(_key(), int(n)).astype(dt))
+
+
+def bernoulli(x, name=None):
+    x = _t(x)
+    key = _key()
+    return apply_op(lambda v: jax.random.bernoulli(key, v).astype(v.dtype), (x,),
+                    differentiable=False)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = _t(x)
+    key = _key()
+    def fn(v):
+        logits = jnp.log(jnp.maximum(v, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1,
+                shape=(num_samples,) if v.ndim == 1 else (num_samples, v.shape[0])
+            ).T if v.ndim > 1 else jax.random.categorical(
+                key, logits, shape=(num_samples,))
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, v.shape, dtype=logits.dtype)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    out = apply_op(lambda v: fn(v).astype(jnp.int64), (x,), differentiable=False)
+    return out
+
+
+def poisson(x, name=None):
+    x = _t(x)
+    key = _key()
+    return apply_op(lambda v: jax.random.poisson(key, v).astype(v.dtype), (x,),
+                    differentiable=False)
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    x._inplace_value(jax.random.uniform(_key(), tuple(x.shape), dtype=x.dtype,
+                                        minval=float(min), maxval=float(max)))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._inplace_value(float(mean) + float(std) *
+                     jax.random.normal(_key(), tuple(x.shape), dtype=x.dtype))
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._inplace_value(jax.random.exponential(_key(), tuple(x.shape),
+                                            dtype=x.dtype) / float(lam))
+    return x

@@ -1,0 +1,128 @@
+"""Search/sort ops. Parity: python/paddle/tensor/search.py."""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply_op, register_method
+from ..core.dtypes import convert_dtype
+from ._helpers import _t
+
+__all__ = ['argmax', 'argmin', 'argsort', 'sort', 'topk', 'where', 'nonzero',
+           'index_sample', 'masked_select', 'kthvalue', 'mode', 'searchsorted']
+
+from .manipulation import index_sample, masked_select  # re-export (paddle puts them here too)
+
+
+def argmax(x, axis=None, keepdim=False, dtype='int64', name=None):
+    dt = convert_dtype(dtype)
+    def fn(v):
+        out = jnp.argmax(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(dt)
+    return apply_op(fn, (_t(x),), differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype='int64', name=None):
+    dt = convert_dtype(dtype)
+    def fn(v):
+        out = jnp.argmin(v.reshape(-1) if axis is None else v,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(dt)
+    return apply_op(fn, (_t(x),), differentiable=False)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def fn(v):
+        idx = jnp.argsort(v, axis=axis, descending=descending)
+        return idx.astype(jnp.int64)
+    return apply_op(fn, (_t(x),), differentiable=False)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=axis, descending=descending)
+        return out
+    return apply_op(fn, (_t(x),))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    x = _t(x)
+    ax = -1 if axis is None else int(axis)
+    def fn(v):
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = lax.top_k(vm, k)
+        else:
+            vals, idx = lax.top_k(-vm, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    vals, idx = apply_op(fn, (x,), n_outputs=2)
+    return vals, idx
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b),
+                    (_t(condition), _t(x), _t(y)))
+
+
+def nonzero(x, as_tuple=False):
+    """Dynamic-size output: host fallback (documented divergence from jit path)."""
+    xv = np.asarray(_t(x).numpy())
+    nz = np.nonzero(xv)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n.reshape(-1, 1))) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = _t(x)
+    def fn(v):
+        sorted_v = jnp.sort(v, axis=axis)
+        idx_sorted = jnp.argsort(v, axis=axis)
+        vals = jnp.take(sorted_v, k - 1, axis=axis)
+        idx = jnp.take(idx_sorted, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return (vals, idx.astype(jnp.int64))
+    return tuple(apply_op(fn, (x,), n_outputs=2))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    xv = np.asarray(_t(x).numpy())
+    from scipy import stats  # available in image? fall back if not
+    try:
+        m = stats.mode(xv, axis=axis, keepdims=keepdim)
+        vals, counts = m.mode, m.count
+    except Exception:
+        vals = np.apply_along_axis(lambda a: np.bincount(a.astype(np.int64)).argmax(),
+                                   axis, xv)
+        counts = vals
+    idx = np.argmax(xv == np.expand_dims(vals, axis) if not keepdim else xv == vals,
+                    axis=axis)
+    if keepdim:
+        idx = np.expand_dims(idx, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idx.astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = 'right' if right else 'left'
+    dt = jnp.int32 if out_int32 else jnp.int64
+    def fn(seq, v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(dt)
+        import jax
+        return jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+            seq, v).astype(dt)
+    return apply_op(fn, (_t(sorted_sequence), _t(values)), differentiable=False)
+
+
+for _name in ['argmax', 'argmin', 'argsort', 'sort', 'topk', 'where', 'nonzero',
+              'kthvalue', 'searchsorted']:
+    register_method(_name, globals()[_name])

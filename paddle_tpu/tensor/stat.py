@@ -1,0 +1,49 @@
+"""Statistics ops. Parity: python/paddle/tensor/stat.py."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, register_method
+from ._helpers import _t, _axes
+
+__all__ = ['mean', 'std', 'var', 'median', 'nanmedian', 'quantile', 'nanmean', 'numel']
+
+from .math import mean
+from .creation import numel
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axes(axis)
+    ddof = 1 if unbiased else 0
+    return apply_op(lambda v: jnp.var(v, axis=ax, ddof=ddof, keepdims=keepdim), (_t(x),))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axes(axis)
+    ddof = 1 if unbiased else 0
+    return apply_op(lambda v: jnp.std(v, axis=ax, ddof=ddof, keepdims=keepdim), (_t(x),))
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply_op(lambda v: jnp.median(v, axis=ax, keepdims=keepdim), (_t(x),))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply_op(lambda v: jnp.nanmedian(v, axis=ax, keepdims=keepdim), (_t(x),))
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply_op(lambda v: jnp.nanmean(v, axis=ax, keepdims=keepdim), (_t(x),))
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    qv = q.numpy() if isinstance(q, Tensor) else q
+    return apply_op(lambda v: jnp.quantile(v, jnp.asarray(qv), axis=ax,
+                                           keepdims=keepdim), (_t(x),))
+
+
+for _name in ['std', 'var', 'median', 'quantile', 'nanmean', 'nanmedian']:
+    register_method(_name, globals()[_name])

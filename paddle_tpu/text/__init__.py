@@ -1,0 +1,6 @@
+"""NLP models + datasets. Parity: python/paddle/text/__init__.py."""
+from . import datasets
+from .bert import (BertConfig, BertModel, BertForPretraining,
+                   BertPretrainingHeads, bert_base, bert_large, ErnieModel)
+from .gpt import GPTConfig, GPTModel, gpt_small
+from .seq2seq import Seq2SeqTransformer

@@ -1,0 +1,196 @@
+"""BERT / ERNIE model family — the flagship benchmark model.
+
+Parity: the reference trains BERT via transformer ops (softmax_with_cross_
+entropy, layer_norm, matmul fused kernels) + Fleet allreduce; ERNIE shares
+the architecture with different pretraining data masking. TPU-first: built on
+nn.TransformerEncoder (flash-attention path), bf16-friendly, and shardable
+tp/dp/sp via distributed.sharding rules (see bert_shard_rules).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..tensor.creation import arange, zeros, ones
+
+__all__ = ['BertConfig', 'BertModel', 'BertPretrainingHeads',
+           'BertForPretraining', 'bert_base', 'bert_large', 'ErnieModel',
+           'bert_shard_rules']
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, pad_token_id=0):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.pad_token_id = pad_token_id
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        init = nn.initializer.Normal(0., config.initializer_range)
+        attr = nn.ParamAttr(initializer=init)
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size,
+                                            weight_attr=attr)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=attr)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size,
+                                                  weight_attr=attr)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, epsilon=1e-12)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        B, L = input_ids.shape
+        if position_ids is None:
+            position_ids = arange(0, L, dtype='int64').unsqueeze(0) \
+                .expand([B, L])
+        if token_type_ids is None:
+            token_type_ids = zeros([B, L], dtype='int64')
+        emb = (self.word_embeddings(input_ids) +
+               self.position_embeddings(position_ids) +
+               self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+        self.activation = nn.Tanh()
+
+    def forward(self, hidden_states):
+        return self.activation(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        config = config or BertConfig(**kwargs)
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            act_dropout=0.0)
+        self.encoder = nn.TransformerEncoder(enc_layer,
+                                             config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # (B, L) padding mask -> (B, 1, 1, L) additive
+            am = (1.0 - attention_mask.astype('float32')) * -1e4
+            attention_mask = am.unsqueeze(1).unsqueeze(1)
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq = self.encoder(emb, attention_mask)
+        pooled = self.pooler(seq)
+        return seq, pooled
+
+
+class BertPretrainingHeads(nn.Layer):
+    """MLM head (tied decoder) + NSP head."""
+
+    def __init__(self, config, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.activation = getattr(nn.functional, config.hidden_act)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, epsilon=1e-12)
+        self.decoder_weight = embedding_weights  # tied (vocab, hidden)
+        self.decoder_bias = self.create_parameter([config.vocab_size],
+                                                  is_bias=True)
+        self.seq_relationship = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output, masked_positions=None):
+        if masked_positions is not None:
+            from ..tensor.manipulation import gather_nd
+            B, K = masked_positions.shape
+            batch_idx = arange(0, B, dtype='int64').unsqueeze(1) \
+                .expand([B, K]).unsqueeze(-1)
+            idx = batch_idx.concat([masked_positions.unsqueeze(-1)], axis=-1)
+            sequence_output = gather_nd(sequence_output, idx)
+        h = self.layer_norm(self.activation(self.transform(sequence_output)))
+        logits = h.matmul(self.decoder_weight, transpose_y=True) + \
+            self.decoder_bias
+        nsp_logits = self.seq_relationship(pooled_output)
+        return logits, nsp_logits
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        self.bert = BertModel(config, **kwargs)
+        self.cls = BertPretrainingHeads(
+            self.bert.config, self.bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_positions=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        return self.cls(seq, pooled, masked_positions)
+
+    def pretraining_loss(self, prediction_logits, nsp_logits, masked_labels,
+                         next_sentence_labels):
+        mlm = nn.functional.cross_entropy(
+            prediction_logits.reshape([-1, prediction_logits.shape[-1]]),
+            masked_labels.reshape([-1]), ignore_index=-1)
+        nsp = nn.functional.cross_entropy(nsp_logits,
+                                          next_sentence_labels.reshape([-1]))
+        return mlm + nsp
+
+
+def bert_base(**kwargs):
+    return BertConfig(hidden_size=768, num_hidden_layers=12,
+                      num_attention_heads=12, intermediate_size=3072, **kwargs)
+
+
+def bert_large(**kwargs):
+    return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096, **kwargs)
+
+
+class ErnieModel(BertModel):
+    """ERNIE 1.0 shares BERT's architecture (different pretraining masking);
+    parity: the reference ERNIE finetune path exercises dygraph + dynamic
+    shapes, which here is the eager tape + bucketed padding."""
+    pass
+
+
+def bert_shard_rules(axis_model='model'):
+    """PartitionSpec rules for tp-sharding a BertModel (megatron layout)."""
+    from jax.sharding import PartitionSpec as P
+    return {
+        # attention: qkv column-parallel, out row-parallel
+        'q_proj.weight': P(None, axis_model),
+        'k_proj.weight': P(None, axis_model),
+        'v_proj.weight': P(None, axis_model),
+        'q_proj.bias': P(axis_model),
+        'k_proj.bias': P(axis_model),
+        'v_proj.bias': P(axis_model),
+        'out_proj.weight': P(axis_model, None),
+        # ffn: in column-parallel, out row-parallel
+        'linear1.weight': P(None, axis_model),
+        'linear1.bias': P(axis_model),
+        'linear2.weight': P(axis_model, None),
+        # embeddings: vocab-parallel
+        'word_embeddings.weight': P(axis_model, None),
+    }

@@ -1,0 +1,7 @@
+"""Text datasets (synthetic fallbacks; no network egress).
+
+Parity: python/paddle/text/datasets/ (Imdb, Imikolov, Movielens, UCIHousing,
+WMT14/16, Conll05).
+"""
+from .synthetic import (Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+                        Conll05st)

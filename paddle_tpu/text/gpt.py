@@ -1,0 +1,116 @@
+"""GPT-style decoder LM — long-context flagship (ring attention capable).
+
+No direct reference equivalent at v1.8 (the reference's LM story is RNN/ERNIE);
+included for capability parity with modern long-sequence training: causal
+flash attention (pallas) single-chip, ring attention over the 'seq' mesh axis
+multi-chip.
+"""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..tensor.creation import arange
+
+__all__ = ['GPTConfig', 'GPTModel', 'gpt_small']
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, max_seq_len=1024, dropout=0.1,
+                 use_ring_attention=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.use_ring_attention = use_ring_attention
+
+
+class CausalSelfAttention(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.hidden = config.hidden_size
+        self.use_ring = config.use_ring_attention
+        self.qkv = nn.Linear(config.hidden_size, 3 * config.hidden_size)
+        self.proj = nn.Linear(config.hidden_size, config.hidden_size)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        B, L, E = x.shape
+        qkv = self.qkv(x).reshape([B, L, 3, self.num_heads, E // self.num_heads])
+        from ..tensor.manipulation import unstack
+        q, k, v = unstack(qkv, axis=2)
+        if self.use_ring:
+            from ..distributed.ring_attention import ring_attention
+            from ..core.tensor import apply_op
+            # (B, L, H, D) -> (B, H, L, D)
+            def fn(qq, kk, vv):
+                qq, kk, vv = (jnp.swapaxes(t, 1, 2) for t in (qq, kk, vv))
+                out = ring_attention(qq, kk, vv, causal=True)
+                return jnp.swapaxes(out, 1, 2)
+            out = apply_op(fn, (q, k, v))
+        else:
+            out = nn.functional.scaled_dot_product_attention(
+                q, k, v, is_causal=True, training=self.training)
+        out = out.reshape([B, L, E])
+        return self.dropout(self.proj(out))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(config.hidden_size)
+        self.attn = CausalSelfAttention(config)
+        self.ln2 = nn.LayerNorm(config.hidden_size)
+        self.mlp = nn.Sequential(
+            nn.Linear(config.hidden_size, 4 * config.hidden_size),
+            nn.GELU(),
+            nn.Linear(4 * config.hidden_size, config.hidden_size),
+            nn.Dropout(config.dropout))
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        config = config or GPTConfig(**kwargs)
+        self.config = config
+        attr = nn.ParamAttr(initializer=nn.initializer.Normal(0., 0.02))
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size,
+                                weight_attr=attr)
+        self.wpe = nn.Embedding(config.max_seq_len, config.hidden_size,
+                                weight_attr=attr)
+        self.drop = nn.Dropout(config.dropout)
+        self.blocks = nn.LayerList([GPTBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size)
+
+    def forward(self, input_ids):
+        B, L = input_ids.shape
+        pos = arange(0, L, dtype='int64').unsqueeze(0)
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        # tied LM head
+        logits = x.matmul(self.wte.weight, transpose_y=True)
+        return logits
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
+
+
+def gpt_small(**kwargs):
+    return GPTModel(GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                              **kwargs))

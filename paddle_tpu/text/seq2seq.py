@@ -1,0 +1,33 @@
+"""Transformer seq2seq for translation. Parity: reference transformer model
+(WMT) built on nn.Transformer."""
+from .. import nn
+from ..tensor.creation import arange
+
+__all__ = ['Seq2SeqTransformer']
+
+
+class Seq2SeqTransformer(nn.Layer):
+    def __init__(self, src_vocab_size, trg_vocab_size, d_model=512, nhead=8,
+                 num_encoder_layers=6, num_decoder_layers=6,
+                 dim_feedforward=2048, dropout=0.1, max_length=256):
+        super().__init__()
+        self.src_emb = nn.Embedding(src_vocab_size, d_model)
+        self.trg_emb = nn.Embedding(trg_vocab_size, d_model)
+        self.pos_emb = nn.Embedding(max_length, d_model)
+        self.transformer = nn.Transformer(
+            d_model, nhead, num_encoder_layers, num_decoder_layers,
+            dim_feedforward, dropout)
+        self.out_proj = nn.Linear(d_model, trg_vocab_size)
+
+    def _embed(self, ids, emb):
+        B, L = ids.shape
+        pos = arange(0, L, dtype='int64').unsqueeze(0)
+        return emb(ids) + self.pos_emb(pos)
+
+    def forward(self, src_ids, trg_ids):
+        src = self._embed(src_ids, self.src_emb)
+        trg = self._embed(trg_ids, self.trg_emb)
+        L = trg_ids.shape[1]
+        tgt_mask = nn.Transformer.generate_square_subsequent_mask(L)
+        out = self.transformer(src, trg, tgt_mask=tgt_mask)
+        return self.out_proj(out)

@@ -1,0 +1,11 @@
+"""Utilities. Parity: python/paddle/utils/."""
+from . import unique_name
+from .lazy_import import try_import
+from .deprecated import deprecated
+
+__all__ = ['unique_name', 'try_import', 'deprecated', 'run_check']
+
+
+def run_check():
+    from .install_check import run_check as _rc
+    return _rc()

@@ -1,0 +1,19 @@
+"""Parity: python/paddle/fluid/install_check.py — sanity check the install."""
+import numpy as np
+
+
+def run_check():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    x = paddle.to_tensor(np.random.rand(4, 8).astype('float32'),
+                         stop_gradient=False)
+    fc = nn.Linear(8, 2)
+    loss = (fc(x) ** 2).mean()
+    loss.backward()
+    assert fc.weight.grad is not None
+    import jax
+    devs = jax.devices()
+    print(f"paddle_tpu is installed successfully! devices: {devs}")
+    if len(devs) > 1:
+        print(f"multi-device OK: {len(devs)} devices visible")
+    return True

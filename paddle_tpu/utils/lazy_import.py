@@ -1,0 +1,11 @@
+"""Parity: python/paddle/utils/lazy_import.py."""
+import importlib
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg is None:
+            err_msg = f"Failed importing {module_name}. Install it to use this feature."
+        raise ImportError(err_msg)

@@ -1,0 +1,66 @@
+"""Profiler. Parity: python/paddle/fluid/profiler.py.
+
+TPU-first: wraps jax.profiler — traces go to TensorBoard-compatible xplane
+dumps; scoped annotations map to TraceAnnotation.
+"""
+import contextlib
+import cProfile
+import io
+import pstats
+
+import jax
+
+__all__ = ['profiler', 'start_profiler', 'stop_profiler', 'profile_scope',
+           'annotate', 'get_hlo']
+
+_active = {'dir': None, 'py': None}
+
+
+def start_profiler(state='All', tracer_option='Default',
+                   log_dir='/tmp/paddle_tpu_profile'):
+    try:
+        jax.profiler.start_trace(log_dir)
+        _active['dir'] = log_dir
+    except Exception:
+        _active['py'] = cProfile.Profile()
+        _active['py'].enable()
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    if _active['dir'] is not None:
+        jax.profiler.stop_trace()
+        print(f"profile trace written to {_active['dir']}")
+        _active['dir'] = None
+    if _active['py'] is not None:
+        _active['py'].disable()
+        s = io.StringIO()
+        pstats.Stats(_active['py'], stream=s).sort_stats('cumulative') \
+            .print_stats(30)
+        print(s.getvalue())
+        _active['py'] = None
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path='/tmp/profile',
+             tracer_option='Default'):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+profile_scope = profiler
+
+
+def annotate(name):
+    """Named trace region (shows up in xplane/TensorBoard)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def get_hlo(fn, *args, optimized=False):
+    """Dump HLO for a jitted callable — debugging/tracing parity."""
+    lowered = jax.jit(fn).lower(*args)
+    if optimized:
+        return lowered.compile().as_text()
+    return lowered.as_text()

@@ -1,0 +1,18 @@
+"""Version info. Parity: python/paddle/version.py (generated)."""
+full_version = '1.8.0+tpu.r1'
+major, minor, patch = '1', '8', '0'
+rc = '0'
+istaged = True
+commit = 'tpu-native'
+with_gpu = 'OFF'
+with_tpu = 'ON'
+
+
+def show():
+    print('commit:', commit)
+    print('version:', full_version)
+    print('with_tpu:', with_tpu)
+
+
+def mkl():
+    return 'OFF'

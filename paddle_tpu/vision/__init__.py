@@ -1,0 +1,4 @@
+"""paddle_tpu.vision. Parity: python/paddle/vision/__init__.py."""
+from . import models
+from . import datasets
+from . import transforms

@@ -1,0 +1,6 @@
+"""Vision datasets. Parity: python/paddle/vision/datasets/__init__.py."""
+from .mnist import MNIST, FashionMNIST
+from .cifar import Cifar10, Cifar100
+from .folder import DatasetFolder, ImageFolder
+from .flowers import Flowers
+from .voc2012 import VOC2012

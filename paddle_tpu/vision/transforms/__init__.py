@@ -1,0 +1,3 @@
+"""Vision transforms. Parity: python/paddle/vision/transforms/__init__.py."""
+from .transforms import *  # noqa
+from . import functional

@@ -1,0 +1,112 @@
+"""Autograd engine tests (parity model: reference OpTest grad checks +
+imperative/test_imperative_basic.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import autograd
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([[1., 2.], [3., 4.]], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), 2 * x.numpy())
+
+
+def test_backward_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    z = y * x  # x^3
+    z.backward()
+    assert abs(float(x.grad.numpy()) - 12.0) < 1e-5
+
+
+def test_backward_accumulates():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    assert abs(float(x.grad.numpy()) - 5.0) < 1e-6
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    y = paddle.to_tensor(2.0, stop_gradient=True)
+    z = x * y
+    z.backward()
+    assert x.grad is not None
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = d * x
+    z.backward()
+    assert abs(float(x.grad.numpy()) - 6.0) < 1e-5
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_grad_api():
+    x = paddle.to_tensor([1., 2., 3.], stop_gradient=False)
+    y = (x ** 2).sum()
+    (g,) = autograd.grad(y, x)
+    assert np.allclose(g.numpy(), 2 * x.numpy())
+
+
+def test_double_grad():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x * x
+    (g,) = autograd.grad(y, x, create_graph=True)
+    (gg,) = autograd.grad(g, x)
+    assert abs(float(gg.numpy()) - 18.0) < 1e-4
+
+
+def test_grad_unused_raises():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    z = paddle.to_tensor(1.0, stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        autograd.grad(y, z)
+    (g,) = autograd.grad(y, [z], allow_unused=True)
+    assert g is None
+
+
+def test_multi_output_op_grads():
+    from paddle_tpu.tensor.manipulation import split, concat
+    x = paddle.to_tensor(np.arange(4.0, dtype='float32'), stop_gradient=False)
+    a, b = split(x, 2)
+    y = (a * 2).sum() + (b * 3).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [2, 2, 3, 3])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    assert abs(float(x.grad.numpy()) - 8.0) < 1e-5
+
+
+def test_backward_matmul_matches_finite_diff():
+    rng = np.random.RandomState(0)
+    a_np = rng.randn(3, 4).astype('float32')
+    b_np = rng.randn(4, 2).astype('float32')
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    (a @ b).sum().backward()
+    eps = 1e-3
+    i, j = 1, 2
+    ap = a_np.copy(); ap[i, j] += eps
+    am = a_np.copy(); am[i, j] -= eps
+    fd = ((ap @ b_np).sum() - (am @ b_np).sum()) / (2 * eps)
+    assert abs(a.grad.numpy()[i, j] - fd) < 1e-2

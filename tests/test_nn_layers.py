@@ -1,0 +1,242 @@
+"""Layer tests (parity model: reference tests/unittests/test_layers.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear_shapes_and_grad():
+    lin = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    y = lin(x)
+    assert y.shape == [2, 4]
+    y.sum().backward()
+    assert lin.weight.grad is not None and lin.weight.grad.shape == [8, 4]
+    assert lin.bias.grad.shape == [4]
+
+
+def test_linear_matches_manual():
+    lin = nn.Linear(3, 2)
+    x = paddle.randn([5, 3])
+    y = lin(x)
+    manual = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+    assert np.allclose(y.numpy(), manual, rtol=1e-5, atol=1e-6)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[0, 1, 2]], dtype='int64'))
+    out = emb(ids)
+    assert out.shape == [1, 3, 4]
+    assert np.allclose(out.numpy()[0, 0], 0)  # padding row zero
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    assert conv(x).shape == [2, 8, 8, 8]
+    convg = nn.Conv2D(4, 8, 3, padding=1, groups=2)
+    assert convg(paddle.randn([1, 4, 8, 8])).shape == [1, 8, 8, 8]
+
+
+def test_conv2d_matches_numpy():
+    conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+    x_np = np.random.rand(1, 1, 5, 5).astype('float32')
+    out = conv(paddle.to_tensor(x_np))
+    w = conv.weight.numpy()[0, 0]
+    expect = np.zeros((3, 3), dtype='float32')
+    for i in range(3):
+        for j in range(3):
+            expect[i, j] = (x_np[0, 0, i:i + 3, j:j + 3] * w).sum()
+    assert np.allclose(out.numpy()[0, 0], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose():
+    deconv = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+    x = paddle.randn([1, 4, 8, 8])
+    assert deconv(x).shape == [1, 2, 15, 15]
+
+
+def test_pooling():
+    x = paddle.randn([2, 3, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+    assert nn.AdaptiveMaxPool2D((2, 3))(x).shape == [2, 3, 2, 3]
+
+
+def test_avgpool_matches_numpy():
+    x_np = np.random.rand(1, 1, 4, 4).astype('float32')
+    out = nn.AvgPool2D(2, 2)(paddle.to_tensor(x_np))
+    expect = x_np.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert np.allclose(out.numpy(), expect, rtol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5]) * 2 + 1
+    bn.train()
+    out = bn(x)
+    # normalized output roughly zero-mean unit-var
+    assert abs(float(out.numpy().mean())) < 1e-4
+    assert abs(float(out.numpy().std()) - 1.0) < 0.05
+    mean_after = bn._mean.numpy().copy()
+    assert not np.allclose(mean_after, 0)
+    bn.eval()
+    _ = bn(x)
+    assert np.allclose(bn._mean.numpy(), mean_after)  # no update in eval
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([4, 16]) * 3 + 2
+    out = ln(x).numpy()
+    assert np.allclose(out.mean(-1), 0, atol=1e-4)
+    assert np.allclose(out.std(-1), 1, atol=1e-2)
+
+
+def test_groupnorm_instancenorm():
+    x = paddle.randn([2, 4, 6, 6])
+    assert nn.GroupNorm(2, 4)(x).shape == [2, 4, 6, 6]
+    assert nn.InstanceNorm2D(4)(x).shape == [2, 4, 6, 6]
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    d.train()
+    out = d(x)
+    frac_zero = float((out.numpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    # upscale_in_train: expectation preserved
+    assert abs(float(out.numpy().mean()) - 1.0) < 0.1
+    d.eval()
+    assert np.allclose(d(x).numpy(), 1.0)
+
+
+def test_activations_shapes():
+    x = paddle.randn([3, 5])
+    for cls in [nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.LeakyReLU,
+                nn.Hardswish, nn.Swish, nn.Mish, nn.SELU, nn.ELU,
+                nn.Softplus, nn.LogSigmoid]:
+        assert cls()(x).shape == [3, 5]
+    assert np.allclose(nn.Softmax()(x).numpy().sum(-1), 1, atol=1e-5)
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert seq(paddle.randn([3, 4])).shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_state_dict_roundtrip():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = net.state_dict()
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    x = paddle.randn([2, 4])
+    assert np.allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_named_parameters_and_hooks():
+    net = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 1))
+    names = [n for n, _ in net.named_parameters()]
+    assert '0.weight' in names and '1.bias' in names
+    calls = []
+    h = net.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    net(paddle.randn([1, 2]))
+    assert calls
+    h.remove()
+    net(paddle.randn([1, 2]))
+    assert len(calls) == 1
+
+
+def test_rnn_cells_and_lstm():
+    cell = nn.LSTMCell(4, 8)
+    x = paddle.randn([2, 4])
+    h, (h2, c2) = cell(x)
+    assert h.shape == [2, 8] and c2.shape == [2, 8]
+
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    out, (h, c) = lstm(paddle.randn([2, 5, 4]))
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [2, 2, 8]
+
+    bi = nn.GRU(4, 8, direction='bidirect')
+    out, h = bi(paddle.randn([2, 5, 4]))
+    assert out.shape == [2, 5, 16]
+
+
+def test_rnn_grads_flow():
+    lstm = nn.LSTM(3, 4)
+    x = paddle.randn([2, 6, 3], )
+    x.stop_gradient = False
+    out, _ = lstm(x)
+    out.sum().backward()
+    assert x.grad is not None and x.grad.shape == [2, 6, 3]
+    for p in lstm.parameters():
+        assert p.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=32, nhead=4,
+                                       dim_feedforward=64)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 10, 32])
+    assert enc(x).shape == [2, 10, 32]
+
+
+def test_multihead_attention_cache():
+    mha = nn.MultiHeadAttention(32, 4)
+    q = paddle.randn([2, 5, 32])
+    out = mha(q)
+    assert out.shape == [2, 5, 32]
+    cache = mha.gen_cache(q)
+    step = paddle.randn([2, 1, 32])
+    out1, cache = mha(step, step, step, cache=cache)
+    assert out1.shape == [2, 1, 32]
+    assert cache.k.shape[1] == 1
+    out2, cache = mha(step, step, step, cache=cache)
+    assert cache.k.shape[1] == 2
+
+
+def test_losses():
+    logits = paddle.randn([4, 10])
+    labels = paddle.to_tensor(np.array([1, 2, 3, 4], dtype='int64'))
+    l = nn.CrossEntropyLoss()(logits, labels)
+    assert l.shape == []
+    # vs manual
+    import jax
+    expect = -np.take_along_axis(
+        np.asarray(jax.nn.log_softmax(logits.numpy(), -1)),
+        labels.numpy()[:, None], 1).mean()
+    assert abs(float(l.numpy()) - expect) < 1e-5
+    assert nn.MSELoss()(paddle.randn([3]), paddle.randn([3])).shape == []
+    b = nn.BCEWithLogitsLoss()(paddle.randn([4]),
+                               paddle.to_tensor([0., 1., 1., 0.]))
+    assert b.shape == []
+
+
+def test_ctc_loss_runs():
+    T, N, C, S = 12, 2, 5, 4
+    logp = paddle.randn([T, N, C])
+    labels = paddle.to_tensor(
+        np.random.randint(1, C, size=(N, S)).astype('int64'))
+    il = paddle.to_tensor(np.array([T, T], dtype='int64'))
+    ll = paddle.to_tensor(np.array([S, S - 1], dtype='int64'))
+    loss = nn.functional.ctc_loss(logp, labels, il, ll)
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_weight_norm():
+    lin = nn.Linear(4, 3)
+    nn.weight_norm(lin, 'weight')
+    names = dict(lin.named_parameters())
+    assert 'weight_g' in names and 'weight_v' in names
+    out = lin(paddle.randn([2, 4]))
+    assert out.shape == [2, 3]
+    nn.remove_weight_norm(lin)
+    assert 'weight' in dict(lin.named_parameters())

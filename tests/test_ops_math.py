@@ -1,0 +1,146 @@
+"""Math/manipulation op numeric tests vs numpy (parity model: reference
+test_*_op.py per-op unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(x):
+    return x.numpy()
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ('exp', np.exp), ('log', np.log), ('sqrt', np.sqrt), ('abs', np.abs),
+    ('sin', np.sin), ('cos', np.cos), ('tanh', np.tanh), ('floor', np.floor),
+    ('ceil', np.ceil), ('square', np.square),
+])
+def test_unary(name, np_fn):
+    x_np = np.random.rand(3, 4).astype('float32') + 0.5
+    x = paddle.to_tensor(x_np)
+    out = getattr(paddle, name)(x)
+    assert np.allclose(_np(out), np_fn(x_np), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ('add', np.add), ('subtract', np.subtract), ('multiply', np.multiply),
+    ('divide', np.divide), ('maximum', np.maximum), ('minimum', np.minimum),
+])
+def test_binary(name, np_fn):
+    a = np.random.rand(3, 4).astype('float32') + 0.5
+    b = np.random.rand(3, 4).astype('float32') + 0.5
+    out = getattr(paddle, name)(paddle.to_tensor(a), paddle.to_tensor(b))
+    assert np.allclose(_np(out), np_fn(a, b), rtol=1e-5)
+
+
+def test_reductions():
+    x = np.random.rand(2, 3, 4).astype('float32')
+    t = paddle.to_tensor(x)
+    assert np.allclose(_np(paddle.sum(t)), x.sum(), rtol=1e-5)
+    assert np.allclose(_np(paddle.mean(t, axis=1)), x.mean(1), rtol=1e-5)
+    assert np.allclose(_np(paddle.max(t, axis=[0, 2])), x.max((0, 2)))
+    assert np.allclose(_np(paddle.prod(t, axis=-1, keepdim=True)),
+                       x.prod(-1, keepdims=True), rtol=1e-4)
+
+
+def test_matmul_transpose_flags():
+    a = np.random.rand(3, 4).astype('float32')
+    b = np.random.rand(3, 5).astype('float32')
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                        transpose_x=True)
+    assert np.allclose(_np(out), a.T @ b, rtol=1e-5)
+
+
+def test_manipulation():
+    x = np.arange(24, dtype='float32').reshape(2, 3, 4)
+    t = paddle.to_tensor(x)
+    assert paddle.reshape(t, [4, 6]).shape == [4, 6]
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.squeeze(paddle.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+    assert paddle.flatten(t, 1).shape == [2, 12]
+    c = paddle.concat([t, t], axis=1)
+    assert c.shape == [2, 6, 4]
+    parts = paddle.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    s = paddle.stack([t, t], axis=0)
+    assert s.shape == [2, 2, 3, 4]
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(12, dtype='float32').reshape(4, 3))
+    idx = paddle.to_tensor(np.array([0, 2], dtype='int64'))
+    g = paddle.gather(x, idx)
+    assert np.allclose(_np(g), _np(x)[[0, 2]])
+    upd = paddle.to_tensor(np.ones((2, 3), dtype='float32'))
+    s = paddle.scatter(x, idx, upd)
+    expect = _np(x).copy(); expect[[0, 2]] = 1
+    assert np.allclose(_np(s), expect)
+
+
+def test_topk_argsort():
+    x = np.random.rand(4, 10).astype('float32')
+    vals, idx = paddle.topk(paddle.to_tensor(x), k=3)
+    expect = np.sort(x, axis=-1)[:, ::-1][:, :3]
+    assert np.allclose(_np(vals), expect, rtol=1e-6)
+    order = paddle.argsort(paddle.to_tensor(x), descending=True)
+    assert np.all(_np(order)[:, :3] == _np(idx))
+
+
+def test_where_nonzero():
+    x = np.array([[1., -1.], [-2., 3.]], dtype='float32')
+    t = paddle.to_tensor(x)
+    w = paddle.where(t > 0, t, paddle.zeros_like(t))
+    assert np.allclose(_np(w), np.where(x > 0, x, 0))
+    nz = paddle.nonzero(t > 0)
+    assert nz.shape == [2, 2]
+
+
+def test_einsum():
+    a = np.random.rand(2, 3).astype('float32')
+    b = np.random.rand(3, 4).astype('float32')
+    out = paddle.einsum('ij,jk->ik', paddle.to_tensor(a), paddle.to_tensor(b))
+    assert np.allclose(_np(out), a @ b, rtol=1e-5)
+
+
+def test_linalg():
+    a = np.random.rand(4, 4).astype('float32')
+    spd = a @ a.T + 4 * np.eye(4, dtype='float32')
+    t = paddle.to_tensor(spd)
+    l = paddle.cholesky(t)
+    assert np.allclose(_np(l) @ _np(l).T, spd, atol=1e-4)
+    assert np.allclose(_np(paddle.norm(paddle.to_tensor(a))),
+                       np.linalg.norm(a), rtol=1e-5)
+
+
+def test_cumsum_clip():
+    x = np.random.rand(3, 4).astype('float32')
+    t = paddle.to_tensor(x)
+    assert np.allclose(_np(paddle.cumsum(t, axis=1)), np.cumsum(x, 1),
+                       rtol=1e-5)
+    assert np.allclose(_np(paddle.clip(t, 0.2, 0.8)), np.clip(x, 0.2, 0.8))
+
+
+def test_indexing_and_setitem():
+    x = paddle.to_tensor(np.arange(12, dtype='float32').reshape(3, 4))
+    assert np.allclose(x[1].numpy(), [4, 5, 6, 7])
+    assert np.allclose(x[:, 1:3].numpy(), _np(x)[:, 1:3])
+    x[0, 0] = 99.0
+    assert float(x[0, 0].numpy()) == 99.0
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert np.allclose(paddle.arange(5).numpy(), np.arange(5))
+    assert np.allclose(paddle.linspace(0, 1, 5).numpy(),
+                       np.linspace(0, 1, 5), rtol=1e-6)
+    assert np.allclose(paddle.eye(3).numpy(), np.eye(3))
+    e = paddle.full([2, 2], 7.0)
+    assert np.all(e.numpy() == 7)
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([4, 4]).numpy()
+    paddle.seed(42)
+    b = paddle.randn([4, 4]).numpy()
+    assert np.allclose(a, b)

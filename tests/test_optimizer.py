@@ -1,0 +1,148 @@
+"""Optimizer tests (parity model: reference test_optimizer.py +
+test_adam_op.py convergence checks)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Parameter
+from paddle_tpu import optimizer as optim
+from paddle_tpu import nn
+
+
+def _quad_converges(opt_cls, lr=0.1, steps=150, tol=1e-2, **kw):
+    p = Parameter(jnp.asarray([4.0, -2.0]), name=f'p_{opt_cls.__name__}')
+    opt = opt_cls(learning_rate=lr, parameters=[p], **kw)
+    for _ in range(steps):
+        ((p * p).sum()).backward()
+        opt.step()
+        opt.clear_grad()
+    return float((p * p).sum().numpy()) < tol
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (optim.SGD, {}),
+    (optim.Momentum, {}),
+    (optim.Adam, {}),
+    (optim.AdamW, {}),
+    (optim.Adamax, {}),
+    (optim.RMSProp, {}),
+    (optim.Adagrad, {'lr': 0.5}),
+    (optim.Lamb, {}),
+])
+def test_convergence(cls, kw):
+    lr = kw.pop('lr', 0.1)
+    assert _quad_converges(cls, lr=lr, **kw)
+
+
+def test_adam_matches_reference_formula():
+    p = Parameter(jnp.asarray([1.0]), name='padam')
+    opt = optim.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                     epsilon=1e-8, parameters=[p])
+    (p * 3.0).sum().backward()  # grad = 3
+    opt.step()
+    m = 0.1 * 3
+    v = 0.001 * 9
+    mh = m / (1 - 0.9)
+    vh = v / (1 - 0.999)
+    expect = 1.0 - 0.1 * mh / (np.sqrt(vh) + 1e-8)
+    assert abs(float(p.numpy()[0]) - expect) < 1e-5
+
+
+def test_weight_decay_l2():
+    p = Parameter(jnp.asarray([1.0]), name='pwd')
+    opt = optim.SGD(learning_rate=0.1, parameters=[p],
+                    weight_decay=paddle.regularizer.L2Decay(0.5))
+    (p * 0.0).sum().backward()  # zero grad; decay only
+    opt.step()
+    assert abs(float(p.numpy()[0]) - (1.0 - 0.1 * 0.5)) < 1e-6
+
+
+def test_grad_clip_global_norm():
+    p1 = Parameter(jnp.asarray([3.0]), name='pc1')
+    p2 = Parameter(jnp.asarray([4.0]), name='pc2')
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    opt = optim.SGD(learning_rate=1.0, parameters=[p1, p2], grad_clip=clip)
+    (p1 * 3.0 + p2 * 4.0).backward()  # grads 3, 4 -> global norm 5
+    opt.step()
+    # clipped grads: 3/5, 4/5
+    assert abs(float(p1.numpy()[0]) - (3.0 - 0.6)) < 1e-5
+    assert abs(float(p2.numpy()[0]) - (4.0 - 0.8)) < 1e-5
+
+
+def test_lr_scheduler_step():
+    sched = optim.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    p = Parameter(jnp.asarray([1.0]), name='plr')
+    opt = optim.SGD(learning_rate=sched, parameters=[p])
+    lrs = []
+    for i in range(5):
+        lrs.append(opt.get_lr())
+        sched.step()
+    assert np.allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+
+def test_warmup_scheduler():
+    s = optim.lr.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(6):
+        vals.append(s())
+        s.step()
+    assert vals[0] < vals[1] < vals[3]
+    assert abs(vals[5] - 0.1) < 1e-6
+
+
+def test_cosine_noam():
+    c = optim.lr.CosineAnnealingDecay(0.1, T_max=10)
+    assert abs(c() - 0.1) < 1e-9
+    n = optim.lr.NoamDecay(d_model=512, warmup_steps=100, learning_rate=1.0)
+    v1 = n()
+    for _ in range(99):
+        n.step()
+    assert n() > v1  # ramps during warmup
+
+
+def test_state_dict_roundtrip():
+    p = Parameter(jnp.asarray([1.0, 2.0]), name='psd')
+    opt = optim.Adam(learning_rate=0.1, parameters=[p])
+    (p.sum()).backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = optim.Adam(learning_rate=0.1, parameters=[p])
+    opt2.set_state_dict(sd)
+    key = list(opt._accumulators)[0]
+    assert np.allclose(np.asarray(opt2._accumulators[key]['moment1']),
+                       np.asarray(opt._accumulators[key]['moment1']))
+
+
+def test_minimize_api():
+    p = Parameter(jnp.asarray([2.0]), name='pmin')
+    opt = optim.SGD(learning_rate=0.1, parameters=[p])
+    loss = (p * p).sum()
+    opt.minimize(loss)
+    assert float(p.numpy()[0]) < 2.0
+    assert p.grad is None  # cleared
+
+
+def test_functional_update_matches_step():
+    p = Parameter(jnp.asarray([1.5, -0.5]), name='pfn')
+    opt1 = optim.Adam(learning_rate=0.05, parameters=[p])
+    g = jnp.asarray([0.3, -0.2])
+
+    pv = {'p': p._value}
+    st = opt1.init_state_values(pv)
+    new_pv, _ = opt1.functional_update(pv, {'p': g}, st)
+
+    p.grad = paddle.to_tensor(np.asarray(g))
+    opt1.step()
+    assert np.allclose(np.asarray(new_pv['p']), p.numpy(), rtol=1e-6)
+
+
+def test_ema():
+    p = Parameter(jnp.asarray([1.0]), name='pema')
+    ema = optim.ExponentialMovingAverage(0.5)
+    ema.register([p])
+    p._inplace_value(jnp.asarray([3.0]))
+    ema.update()
+    with ema.apply():
+        assert float(p.numpy()[0]) < 3.0
+    assert float(p.numpy()[0]) == 3.0

@@ -1,0 +1,107 @@
+"""Static graph Program/Executor tests (parity model: reference
+test_executor_* and book examples e.g. fit_a_line)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu import fluid
+
+
+def teardown_function():
+    paddle.disable_static()
+
+
+def test_program_capture_and_run():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [4, 3], 'float32')
+        y = x * 2.0 + 1.0
+    exe = static.Executor()
+    x_np = np.random.rand(4, 3).astype('float32')
+    (out,) = exe.run(main, feed={'x': x_np}, fetch_list=[y])
+    assert np.allclose(out, x_np * 2 + 1, rtol=1e-6)
+    paddle.disable_static()
+
+
+def test_static_fc_forward():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [2, 4], 'float32')
+        out = static.nn.fc(x, size=3)
+    exe = static.Executor()
+    res = exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
+                  fetch_list=[out])
+    assert res[0].shape == (2, 3)
+    paddle.disable_static()
+
+
+def test_static_training_converges():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [8, 2], 'float32')
+        label = static.data('label', [8, 1], 'float32')
+        pred = static.nn.fc(x, size=1)
+        from paddle_tpu.nn.functional import mse_loss
+        loss = mse_loss(pred, label)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    w_true = np.array([[2.0], [-1.0]], dtype='float32')
+    first = last = None
+    for i in range(60):
+        xb = rng.rand(8, 2).astype('float32')
+        yb = xb @ w_true
+        (lv,) = exe.run(main, feed={'x': xb, 'label': yb}, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    assert last < first * 0.2, (first, last)
+    paddle.disable_static()
+
+
+def test_fluid_compat_namespace():
+    paddle.enable_static()
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.data('x', [3], 'float32')
+        y = fluid.layers.relu(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(main, feed={'x': np.array([[-1., 0., 2.]], 'float32')},
+                     fetch_list=[y])
+    assert np.allclose(out, [[0., 0., 2.]])
+    paddle.disable_static()
+
+
+def test_program_print():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [2, 2], 'float32')
+        _ = x + 1.0
+    s = str(main)
+    assert 'Program' in s and '->' in s
+    paddle.disable_static()
+
+
+def test_save_load_persistables(tmp_path):
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [2, 4], 'float32')
+        out = static.nn.fc(x, size=3)
+    exe = static.Executor()
+    before = exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
+                     fetch_list=[out])[0]
+    static.save_persistables(exe, str(tmp_path))
+    # perturb params then reload
+    for v in main.all_parameters():
+        v.concrete._inplace_value(v.concrete._value * 0)
+    static.load_persistables(exe, str(tmp_path), main)
+    after = exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
+                    fetch_list=[out])[0]
+    assert np.allclose(before, after)
+    paddle.disable_static()
