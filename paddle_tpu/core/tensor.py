@@ -32,6 +32,8 @@ class Tensor:
         self._grad = None
         self.name = name
         self.persistable = False
+        if _CAPTURE_WATCH[0] is not None:
+            _CAPTURE_WATCH[0].produced.add(id(self))
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -143,6 +145,12 @@ class Tensor:
 
     def _inplace_value(self, value):
         """Replace payload (breaks history — used by optimizers / set_value)."""
+        if _CAPTURE_WATCH[0] is not None:
+            # mutation of a pre-existing tensor must be visible to jit
+            # discovery even when the new value bypassed apply_op (e.g.
+            # __setitem__): record the PRE-mutation payload so the side
+            # effect is undone after discovery and replayed compiled.
+            _CAPTURE_WATCH[0].note_inputs((self,))
         self._value = value
         self._node = None
 
@@ -295,6 +303,55 @@ def set_symbolic_handler(handler):
     _SYMBOLIC_HANDLER[0] = handler
 
 
+class _CaptureWatch:
+    """Records pre-existing Tensors read by ops while active.
+
+    Used by jit.to_static discovery: any Tensor flowing into apply_op that was
+    NOT created during the watched region is an external capture (a closure
+    parameter/buffer/constant) that must become an explicit input of the
+    compiled function. Tensors constructed while the watch is active are
+    tracked as 'produced' via Tensor.__init__.
+    """
+
+    def __init__(self):
+        self.captured = []        # ordered unique external tensors
+        self.captured_vals = []   # their payloads at capture time
+        self.layers = []          # Layers invoked while watching (mode keys)
+        self._seen = set()
+        self._layer_seen = set()
+        self.produced = set()
+
+    def note_layer(self, layer):
+        i = id(layer)
+        if i not in self._layer_seen:
+            self._layer_seen.add(i)
+            self.layers.append(layer)
+
+    def note_inputs(self, tensors):
+        for t in tensors:
+            if not isinstance(t, Tensor):
+                continue
+            i = id(t)
+            if i in self.produced or i in self._seen:
+                continue
+            self._seen.add(i)
+            self.captured.append(t)
+            self.captured_vals.append(t._value)
+
+
+_CAPTURE_WATCH = [None]
+
+
+def capture_watch():
+    return _CAPTURE_WATCH[0]
+
+
+def set_capture_watch(w):
+    prev = _CAPTURE_WATCH[0]
+    _CAPTURE_WATCH[0] = w
+    return prev
+
+
 def apply_op(fn, tensors, n_outputs=1, differentiable=True):
     """Run a pure fn over tensor payloads; record on the tape if needed.
 
@@ -304,6 +361,8 @@ def apply_op(fn, tensors, n_outputs=1, differentiable=True):
     if _SYMBOLIC_HANDLER[0] is not None and any(
             getattr(t, '_symbolic', False) for t in tensors):
         return _SYMBOLIC_HANDLER[0](fn, tensors, n_outputs, differentiable)
+    if _CAPTURE_WATCH[0] is not None:
+        _CAPTURE_WATCH[0].note_inputs(tensors)
     tensors = tuple(t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
                     for t in tensors)
     vals = [t._value for t in tensors]

@@ -26,6 +26,7 @@ class Model:
         self._metrics = []
         self.stop_training = False
         self._jit_step = None
+        self._jit_state = None
         self._use_jit = False
 
     # -- setup --------------------------------------------------------------

@@ -8,6 +8,7 @@ needed because tracing handles Python control flow on static shapes, and
 lax.cond/while are exposed for data-dependent control flow.
 """
 import functools
+import hashlib
 import os
 import pickle
 
@@ -68,99 +69,178 @@ def _extract_tensors(obj):
 class StaticFunction:
     """Compiled wrapper around a Tensor-level python function.
 
-    The whole call compiles to one cached XLA computation. Gradients flow:
-    the compiled call is ONE tape node whose vjp re-traces the same pure
-    function under jax.vjp (XLA caches that too). Model parameters are
-    implicit differentiable inputs.
+    The whole call compiles to ONE cached XLA computation (per training flag +
+    argument structure). Design (TPU-first; replaces the reference's
+    ProgramTranslator AST pass, fluid/dygraph/jit.py):
+
+    - discovery pass: the fn runs eagerly once under a capture watch; every
+      pre-existing Tensor it reads (closure parameters, buffers, constants)
+      is recorded and becomes an explicit input of the compiled function, so
+      optimizer updates are picked up and gradients flow to parameters even
+      when they are captured by closure rather than passed as arguments.
+    - mutated captures (e.g. BatchNorm running stats) become extra OUTPUTS of
+      the pure function and are written back after each call — no tracer ever
+      leaks into live state.
+    - gradients: the compiled call is one tape node whose vjp re-traces the
+      same pure function under jax.vjp (XLA caches that too).
     """
 
     def __init__(self, fn, input_spec=None, instance=None):
         self._fn = fn
         self._instance = instance
         self._input_spec = input_spec
-        self._struct = None
-        self._n_out = None
-        self._jitted = None
+        self._layers = []         # union of Layers touched (mode cache keys)
+        self._layer_ids = set()
+        self._cache = {}          # (training, modes, tree_sig) -> entry
 
     def __get__(self, instance, owner):
         if instance is None:
             return self
-        bound = StaticFunction(self._fn, self._input_spec, instance)
-        return bound
+        cached = getattr(instance, '_jit_cache', None)
+        if cached is None:
+            cached = {}
+            object.__setattr__(instance, '_jit_cache', cached)
+        me = cached.get(id(self))
+        if me is None:
+            me = StaticFunction(self._fn, self._input_spec, instance)
+            cached[id(self)] = me
+        return me
 
     @property
     def __name__(self):
         return getattr(self._fn, '__name__', 'static_fn')
 
-    def _pure(self, rebuild, params, n_data, key, training):
-        fn, instance = self._fn, self._instance
-        sf = self
+    def _call_fn(self, args2, kwargs2):
+        if self._instance is not None:
+            return self._fn(self._instance, *args2, **kwargs2)
+        return self._fn(*args2, **kwargs2)
 
-        def pure(*vals):
+    def _discover(self, tensors, rebuild, entry):
+        """Eager run under a capture watch: find closure tensors + mutations.
+
+        Runs once per cache entry (per training-mode combination + argument
+        structure) — the set of touched tensors and which of them the fn
+        mutates is mode-dependent (e.g. BatchNorm running stats update only
+        in train mode).
+        """
+        from ..core import tensor as tensor_mod
+        clones = [Tensor(t._value) for t in tensors]
+        watch = tensor_mod._CaptureWatch()
+        for c in clones:
+            watch.produced.add(id(c))
+        key = _rng.next_key()
+        prev = tensor_mod.set_capture_watch(watch)
+        try:
+            with _rng.key_scope(key), autograd.no_grad():
+                args2, kwargs2 = rebuild(clones)
+                self._call_fn(args2, kwargs2)
+        finally:
+            tensor_mod.set_capture_watch(prev)
+        mutated = []
+        for i, (t, v) in enumerate(zip(watch.captured, watch.captured_vals)):
+            if t._value is not v:
+                mutated.append(i)
+                t._value = v  # undo the eager side effect; replayed compiled
+        entry['captured'] = list(watch.captured)
+        entry['mutated_idx'] = mutated
+        for l in watch.layers:
+            if id(l) not in self._layer_ids:
+                self._layer_ids.add(id(l))
+                self._layers.append(l)
+
+    def _make_pure(self, rebuild, n_data, entry):
+        fn_call = self._call_fn
+        ext, mutated = entry['captured'], entry['mutated_idx']
+
+        def pure(key, *vals):
             data_vals = vals[:n_data]
-            param_vals = vals[n_data:]
-            originals = [p._value for p in params]
-            for p, v in zip(params, param_vals):
+            ext_vals = vals[n_data:]
+            originals = [p._value for p in ext]
+            for p, v in zip(ext, ext_vals):
                 p._value = v
             try:
-                from ..core.rng import key_scope
-                with key_scope(key):
+                with _rng.key_scope(key), autograd.no_grad():
                     args2, kwargs2 = rebuild([Tensor(v) for v in data_vals])
-                    with autograd.no_grad():
-                        if instance is not None:
-                            out = fn(instance, *args2, **kwargs2)
-                        else:
-                            out = fn(*args2, **kwargs2)
+                    out = fn_call(args2, kwargs2)
+                state_out = tuple(ext[i]._value for i in mutated)
             finally:
-                for p, v in zip(params, originals):
+                for p, v in zip(ext, originals):
                     p._value = v
             flat, tree = _flatten_out(out)
-            sf._struct = tree
-            return tuple(t._value for t in flat)
+            entry['struct'] = tree
+            entry['n_user_out'] = len(flat)
+            return tuple(t._value for t in flat) + state_out
         return pure
 
     def __call__(self, *args, **kwargs):
         if not _jit_enabled[0]:
-            if self._instance is not None:
-                return self._fn(self._instance, *args, **kwargs)
-            return self._fn(*args, **kwargs)
+            return self._call_fn(args, dict(kwargs))
 
         tensors, rebuild = _extract_tensors((list(args), dict(kwargs)))
-        rebuild_ak = lambda ts: rebuild(ts)
-        if self._instance is not None and isinstance(self._instance, Layer):
-            params = [p for p in self._instance.parameters() if p.trainable]
-        else:
-            params = []
-        n_data = len(tensors)
+
+        def make_sig():
+            training = bool(getattr(self._instance, 'training', True))
+            modes = tuple(bool(l.training) for l in self._layers)
+            return (training, modes, _tree_sig((list(args), dict(kwargs))))
+
+        sig = make_sig()
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = {'struct': None, 'n_user_out': None}
+            self._discover(tensors, rebuild, entry)
+            # discovery may reveal new layers → the signature gains their
+            # mode flags; store under the refreshed key so later calls match
+            sig = make_sig()
+            entry['jitted'] = jax.jit(
+                self._make_pure(rebuild, len(tensors), entry))
+            self._cache[sig] = entry
+
         key = _rng.next_key()
-        training = getattr(self._instance, 'training', True)
+        jitted = entry['jitted']
+        captured, mutated_idx = entry['captured'], entry['mutated_idx']
+        all_inputs = (Tensor(key),) + tuple(tensors) + tuple(captured)
 
-        def rebuild2(ts):
-            a, k = rebuild_ak(ts)
-            return a, k
+        if entry['struct'] is None:
+            # learn output structure via one abstract trace (also warms jit)
+            jax.eval_shape(
+                jitted, *[jax.ShapeDtypeStruct(tuple(t._value.shape),
+                                               t._value.dtype)
+                          for t in all_inputs])
 
-        pure = self._pure(rebuild2, params, n_data, key, training)
-        all_inputs = tuple(tensors) + tuple(params)
+        n_user = entry['n_user_out']
+        n_total = n_user + len(mutated_idx)
+        if n_total == 1:
+            outs = (apply_op(lambda *v: jitted(*v)[0], all_inputs),)
+        else:
+            outs = apply_op(lambda *v: jitted(*v), all_inputs,
+                            n_outputs=n_total)
+        # write back mutated buffers (running stats etc.) eagerly
+        with autograd.no_grad():
+            for i, idx in enumerate(mutated_idx):
+                captured[idx]._value = outs[n_user + i]._value
+        return _unflatten_out(list(outs[:n_user]), entry['struct'])
 
-        if self._struct is None:
-            # first call: run the pure fn eagerly once to learn the output
-            # structure, then compile.
-            out_vals = pure(*[t._value for t in all_inputs])
-            self._n_out = len(out_vals)
-            self._jitted = jax.jit(pure)
-            if self._n_out == 1:
-                out = apply_op(lambda *v: pure(*v)[0], all_inputs)
-                return _unflatten_out([out], self._struct)
-            outs = apply_op(pure, all_inputs, n_outputs=self._n_out)
-            return _unflatten_out(list(outs), self._struct)
 
-        jitted = self._jitted
-        if self._n_out == 1:
-            out = apply_op(lambda *v: jitted(*v)[0], all_inputs)
-            return _unflatten_out([out], self._struct)
-        outs = apply_op(lambda *v: jitted(*v), all_inputs,
-                        n_outputs=self._n_out)
-        return _unflatten_out(list(outs), self._struct)
+def _tree_sig(obj):
+    """Hashable signature of the (args, kwargs) structure: tensors abstracted
+    to shape/dtype markers, constants kept (they get baked into the trace)."""
+    if isinstance(obj, Tensor):
+        return ('T', tuple(obj._value.shape), str(obj._value.dtype))
+    if isinstance(obj, list):
+        return ('L',) + tuple(_tree_sig(v) for v in obj)
+    if isinstance(obj, tuple):
+        return ('U',) + tuple(_tree_sig(v) for v in obj)
+    if isinstance(obj, dict):
+        return ('D',) + tuple(sorted((k, _tree_sig(v)) for k, v in obj.items()))
+    if isinstance(obj, np.ndarray):
+        # content hash — repr() truncates large arrays and would collide
+        return ('A', obj.shape, str(obj.dtype),
+                hashlib.sha1(np.ascontiguousarray(obj).tobytes()).hexdigest())
+    try:
+        hash(obj)
+        return ('C', obj)
+    except TypeError:
+        return ('C', repr(obj))
 
 
 def _flatten_out(out):

@@ -49,23 +49,28 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
 
     if use_batch_stats:
-        def fn(v, *wb):
+        n = int(np.prod([x.shape[i] for i in reduce_axes]))
+        unbias = n / max(n - 1, 1)
+        # running stats are apply_op INPUTS and the new stats are computed
+        # inside the pure fn — this keeps the whole update visible to traces
+        # (jit.to_static capture watch) so no tracer ever leaks into buffers.
+        tensors += [rm, rv]
+
+        def fn(v, *rest):
+            wb, (m0, v0) = rest[:-2], rest[-2:]
             mean = jnp.mean(v, axis=reduce_axes)
             var = jnp.var(v, axis=reduce_axes)
             inv = 1.0 / jnp.sqrt(var.reshape(shp) + epsilon)
             out = (v - mean.reshape(shp)) * inv
             if wb:
                 out = out * wb[0].reshape(shp) + wb[1].reshape(shp)
-            return out, mean, var
-        out, batch_mean, batch_var = apply_op(fn, tuple(tensors), n_outputs=3)
-        # running-stat update (eager semantics; functional_call captures this)
-        n = int(np.prod([x.shape[i] for i in reduce_axes]))
-        unbias = n / max(n - 1, 1)
+            new_rm = momentum * m0 + (1 - momentum) * mean.astype(m0.dtype)
+            new_rv = momentum * v0 + (1 - momentum) * (var * unbias).astype(v0.dtype)
+            return out, new_rm, new_rv
+        out, new_rm, new_rv = apply_op(fn, tuple(tensors), n_outputs=3)
         with _no_grad():
-            rm._inplace_value(momentum * rm._value +
-                              (1 - momentum) * batch_mean._value)
-            rv._inplace_value(momentum * rv._value +
-                              (1 - momentum) * batch_var._value * unbias)
+            rm._inplace_value(new_rm._value)
+            rv._inplace_value(new_rv._value)
         return out
 
     tensors += [rm, rv]

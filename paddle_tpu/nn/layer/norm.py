@@ -101,8 +101,11 @@ class SyncBatchNorm(_BatchNormBase):
         rm, rv = self._mean, self._variance
         tensors = [x] + ([self.weight, self.bias] if self.weight is not None else [])
 
-        def fn(v, *wb):
+        tensors += [rm, rv]
+
+        def fn(v, *rest):
             import jax
+            wb, (m0, v0) = rest[:-2], rest[-2:]
             n_local = np.prod([v.shape[i] for i in reduce_axes])
             s = jnp.sum(v, axis=reduce_axes)
             ss = jnp.sum(v * v, axis=reduce_axes)
@@ -114,12 +117,14 @@ class SyncBatchNorm(_BatchNormBase):
             out = (v - mean.reshape(shp)) / jnp.sqrt(var.reshape(shp) + eps)
             if wb:
                 out = out * wb[0].reshape(shp) + wb[1].reshape(shp)
-            return out, mean, var
-        out, m, v_ = apply_op(fn, tuple(tensors), n_outputs=3)
+            new_rm = momentum * m0 + (1 - momentum) * mean.astype(m0.dtype)
+            new_rv = momentum * v0 + (1 - momentum) * var.astype(v0.dtype)
+            return out, new_rm, new_rv
+        out, new_rm, new_rv = apply_op(fn, tuple(tensors), n_outputs=3)
         from ...core.autograd import no_grad
         with no_grad():
-            rm._inplace_value(momentum * rm._value + (1 - momentum) * m._value)
-            rv._inplace_value(momentum * rv._value + (1 - momentum) * v_._value)
+            rm._inplace_value(new_rm._value)
+            rv._inplace_value(new_rv._value)
         return out
 
     @classmethod

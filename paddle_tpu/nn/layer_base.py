@@ -320,6 +320,10 @@ class Layer:
         return helper
 
     def __call__(self, *inputs, **kwargs):
+        from ..core.tensor import capture_watch
+        w = capture_watch()
+        if w is not None:
+            w.note_layer(self)
         for hook in self._forward_pre_hooks.values():
             result = hook(self, inputs)
             if result is not None:

@@ -96,7 +96,7 @@ def test_save_load_persistables(tmp_path):
     exe = static.Executor()
     before = exe.run(main, feed={'x': np.ones((2, 4), 'float32')},
                      fetch_list=[out])[0]
-    static.save_persistables(exe, str(tmp_path))
+    static.save_persistables(exe, str(tmp_path), main)
     # perturb params then reload
     for v in main.all_parameters():
         v.concrete._inplace_value(v.concrete._value * 0)
