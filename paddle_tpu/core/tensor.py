@@ -7,6 +7,7 @@ every op is a pure closure recorded on the autograd tape (see autograd.py), so
 eager code, jit-traced code and grad transforms share one implementation.
 """
 import numbers
+import threading
 
 import numpy as np
 import jax
@@ -32,8 +33,8 @@ class Tensor:
         self._grad = None
         self.name = name
         self.persistable = False
-        if _CAPTURE_WATCH[0] is not None:
-            _CAPTURE_WATCH[0].produced.add(id(self))
+        if _CAPTURE_WATCH.w is not None:
+            _CAPTURE_WATCH.w.produced.add(id(self))
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -145,12 +146,12 @@ class Tensor:
 
     def _inplace_value(self, value):
         """Replace payload (breaks history — used by optimizers / set_value)."""
-        if _CAPTURE_WATCH[0] is not None:
+        if _CAPTURE_WATCH.w is not None:
             # mutation of a pre-existing tensor must be visible to jit
             # discovery even when the new value bypassed apply_op (e.g.
             # __setitem__): record the PRE-mutation payload so the side
             # effect is undone after discovery and replayed compiled.
-            _CAPTURE_WATCH[0].note_inputs((self,))
+            _CAPTURE_WATCH.w.note_inputs((self,))
         self._value = value
         self._node = None
 
@@ -339,16 +340,23 @@ class _CaptureWatch:
             self.captured_vals.append(t._value)
 
 
-_CAPTURE_WATCH = [None]
+class _WatchTL(threading.local):
+    # thread-local: DataLoader worker threads must not leak their tensor
+    # traffic into a jit discovery pass running on another thread
+    def __init__(self):
+        self.w = None
+
+
+_CAPTURE_WATCH = _WatchTL()
 
 
 def capture_watch():
-    return _CAPTURE_WATCH[0]
+    return _CAPTURE_WATCH.w
 
 
 def set_capture_watch(w):
-    prev = _CAPTURE_WATCH[0]
-    _CAPTURE_WATCH[0] = w
+    prev = _CAPTURE_WATCH.w
+    _CAPTURE_WATCH.w = w
     return prev
 
 
@@ -361,8 +369,8 @@ def apply_op(fn, tensors, n_outputs=1, differentiable=True):
     if _SYMBOLIC_HANDLER[0] is not None and any(
             getattr(t, '_symbolic', False) for t in tensors):
         return _SYMBOLIC_HANDLER[0](fn, tensors, n_outputs, differentiable)
-    if _CAPTURE_WATCH[0] is not None:
-        _CAPTURE_WATCH[0].note_inputs(tensors)
+    if _CAPTURE_WATCH.w is not None:
+        _CAPTURE_WATCH.w.note_inputs(tensors)
     tensors = tuple(t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
                     for t in tensors)
     vals = [t._value for t in tensors]

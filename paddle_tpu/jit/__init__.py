@@ -39,12 +39,23 @@ def _extract_tensors(obj):
         if isinstance(o, Tensor):
             tensors.append(o)
             return ('T', len(tensors) - 1)
+        if isinstance(o, np.ndarray):
+            # ndarray args become traced Tensor inputs (same conversion the
+            # reference applies to to_static inputs): keeps array data out
+            # of the cache key and the compiled constant pool. Host-side
+            # numpy use of such an arg inside the fn is unsupported under
+            # tracing — pass a hashable scalar/tuple instead.
+            tensors.append(Tensor(jnp.asarray(o)))
+            return ('T', len(tensors) - 1)
         if isinstance(o, list):
             return ('L', [rec(v) for v in o])
         if isinstance(o, tuple):
             return ('U', [rec(v) for v in o])
         if isinstance(o, dict):
-            return ('D', {k: rec(v) for k, v in o.items()})
+            # sorted: extraction order must agree with _tree_sig's sorted
+            # key order, or two kwarg orderings would share a cache entry
+            # while binding tensors to different slots
+            return ('D', {k: rec(o[k]) for k in sorted(o)})
         return ('C', o)
 
     tree = rec(obj)
@@ -91,7 +102,7 @@ class StaticFunction:
         self._input_spec = input_spec
         self._layers = []         # union of Layers touched (mode cache keys)
         self._layer_ids = set()
-        self._cache = {}          # (training, modes, tree_sig) -> entry
+        self._cache = {}          # (training, tree_sig) -> [mode variants]
 
     def __get__(self, instance, owner):
         if instance is None:
@@ -178,22 +189,28 @@ class StaticFunction:
 
         tensors, rebuild = _extract_tensors((list(args), dict(kwargs)))
 
-        def make_sig():
-            training = bool(getattr(self._instance, 'training', True))
-            modes = tuple(bool(l.training) for l in self._layers)
-            return (training, modes, _tree_sig((list(args), dict(kwargs))))
-
-        sig = make_sig()
-        entry = self._cache.get(sig)
+        training = bool(getattr(self._instance, 'training', True))
+        sig = (training, _tree_sig((list(args), dict(kwargs))))
+        # each signature holds mode VARIANTS: a variant compiled when the
+        # layer list had n_layers entries depends only on those layers'
+        # train/eval flags, so it stays reachable even after later discovery
+        # appends new layers (prefix match, not whole-list match)
+        variants = self._cache.setdefault(sig, [])
+        entry = None
+        for v in variants:
+            modes_now = tuple(bool(l.training)
+                              for l in self._layers[:v['n_layers']])
+            if modes_now == v['modes']:
+                entry = v
+                break
         if entry is None:
             entry = {'struct': None, 'n_user_out': None}
             self._discover(tensors, rebuild, entry)
-            # discovery may reveal new layers → the signature gains their
-            # mode flags; store under the refreshed key so later calls match
-            sig = make_sig()
+            entry['n_layers'] = len(self._layers)
+            entry['modes'] = tuple(bool(l.training) for l in self._layers)
             entry['jitted'] = jax.jit(
                 self._make_pure(rebuild, len(tensors), entry))
-            self._cache[sig] = entry
+            variants.append(entry)
 
         key = _rng.next_key()
         jitted = entry['jitted']
@@ -214,10 +231,12 @@ class StaticFunction:
         else:
             outs = apply_op(lambda *v: jitted(*v), all_inputs,
                             n_outputs=n_total)
-        # write back mutated buffers (running stats etc.) eagerly
+        # write back mutated buffers (running stats etc.) eagerly;
+        # _inplace_value clears any stale tape node and notifies an outer
+        # discovery watch (nested to_static)
         with autograd.no_grad():
             for i, idx in enumerate(mutated_idx):
-                captured[idx]._value = outs[n_user + i]._value
+                captured[idx]._inplace_value(outs[n_user + i]._value)
         return _unflatten_out(list(outs[:n_user]), entry['struct'])
 
 
@@ -233,14 +252,24 @@ def _tree_sig(obj):
     if isinstance(obj, dict):
         return ('D',) + tuple(sorted((k, _tree_sig(v)) for k, v in obj.items()))
     if isinstance(obj, np.ndarray):
-        # content hash — repr() truncates large arrays and would collide
-        return ('A', obj.shape, str(obj.dtype),
-                hashlib.sha1(np.ascontiguousarray(obj).tobytes()).hexdigest())
+        # arrays are lifted to traced inputs by _extract_tensors — only the
+        # shape/dtype matter for the compiled cache
+        return ('T', tuple(obj.shape), str(obj.dtype))
     try:
         hash(obj)
-        return ('C', obj)
+        return ('C', type(obj).__qualname__, obj)
     except TypeError:
-        return ('C', repr(obj))
+        # unhashable constant gets baked into the trace: key by VALUE, not
+        # repr (repr truncation would collide two different payloads)
+        try:
+            payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:
+            raise TypeError(
+                f"to_static argument of type {type(obj).__name__} is "
+                f"neither a Tensor/ndarray nor hashable/picklable; pass it "
+                f"as a Tensor or a hashable constant ({e})") from e
+        return ('C', type(obj).__qualname__,
+                hashlib.sha1(payload).hexdigest())
 
 
 def _flatten_out(out):

@@ -145,3 +145,41 @@ def test_hapi_model_fit():
     model.fit(train, epochs=1, batch_size=64, verbose=0)
     logs = model.evaluate(train, batch_size=64, verbose=0)
     assert 'loss' in logs
+
+
+def test_jit_cache_mode_variants_stable():
+    """A cache entry made before later discovery grows the layer list must
+    stay reachable (prefix-mode match), and ndarray args are traced inputs
+    (no recompile when array VALUES change but shapes don't)."""
+    lin_a = nn.Linear(3, 3)
+    lin_b = nn.Linear(3, 3)
+    discoveries = []
+
+    @paddle.jit.to_static
+    def f(x, use_b=False):
+        h = lin_a(x)
+        return lin_b(h) if use_b else h
+
+    orig = type(f)._discover
+
+    def counting(self, *a, **k):
+        discoveries.append(1)
+        return orig(self, *a, **k)
+
+    type(f)._discover = counting
+    try:
+        x = paddle.to_tensor(np.ones((2, 3), 'float32'))
+        f(x)                      # discover: lin_a only
+        f(x, use_b=True)          # discover: + lin_b (layer list grows)
+        n = len(discoveries)
+        f(x)                      # must still hit the first entry
+        assert len(discoveries) == n
+        # ndarray arg: second call with different values, same shape ->
+        # no new discovery/compile, and the new values are actually used
+        y1 = f(np.ones((2, 3), 'float32')).numpy()
+        n = len(discoveries)
+        y2 = f(np.full((2, 3), 2.0, 'float32')).numpy()
+        assert len(discoveries) == n
+        assert not np.allclose(y1, y2)
+    finally:
+        type(f)._discover = orig
