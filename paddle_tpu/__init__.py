@@ -26,6 +26,7 @@ from . import metric
 from . import distribution
 from . import vision
 from . import text
+from . import rec
 from . import distributed
 from . import static
 from . import jit

@@ -4,3 +4,5 @@ from .bert import (BertConfig, BertModel, BertForPretraining,
                    BertPretrainingHeads, bert_base, bert_large, ErnieModel)
 from .gpt import GPTConfig, GPTModel, gpt_small
 from .seq2seq import Seq2SeqTransformer
+from .word2vec import SkipGram, Word2Vec
+from .lm import LSTMLanguageModel

@@ -1,0 +1,123 @@
+"""Local-file loaders for text datasets (no network egress).
+
+Activated when the expected files exist under $PADDLE_TPU_DATA_HOME (default
+~/.cache/paddle_tpu). Formats follow the reference datasets
+(python/paddle/text/datasets/uci_housing.py, imdb.py, imikolov.py): same
+tarball/file layouts a user of the reference would already have on disk.
+"""
+import os
+import re
+import tarfile
+
+import numpy as np
+
+DATA_HOME = os.environ.get(
+    'PADDLE_TPU_DATA_HOME', os.path.expanduser('~/.cache/paddle_tpu'))
+
+
+def data_path(*parts):
+    return os.path.join(DATA_HOME, *parts)
+
+
+def load_uci_housing(mode='train', split=0.8):
+    """housing.data: whitespace-separated floats, 13 features + MEDV target.
+    Returns (x, y) float32 arrays or None when the file is absent."""
+    path = data_path('uci_housing', 'housing.data')
+    if not os.path.exists(path):
+        return None
+    raw = np.loadtxt(path).astype(np.float32)
+    feats, target = raw[:, :-1], raw[:, -1:]
+    # feature-wise max-min normalization over the train split (ref behavior)
+    n_train = int(len(raw) * split)
+    mx = feats[:n_train].max(axis=0)
+    mn = feats[:n_train].min(axis=0)
+    avg = feats[:n_train].mean(axis=0)
+    feats = (feats - avg) / np.maximum(mx - mn, 1e-6)
+    if mode == 'train':
+        return feats[:n_train], target[:n_train]
+    return feats[n_train:], target[n_train:]
+
+
+_TOKENIZE = re.compile(r"[a-z]+|[^a-z\s]")
+
+
+def _tokenize(line):
+    return _TOKENIZE.findall(line.lower())
+
+
+def load_imdb(mode='train', cutoff=150):
+    """aclImdb_v1.tar.gz: pos/neg review text -> (word-id docs, labels).
+
+    Builds the word dict from the train split with frequency cutoff.
+    Single streaming pass over the tarball: token lists are kept per split
+    and id-converted at the end (the archive is ~80MB gz — decompressing it
+    repeatedly per construction would dominate load time).
+    """
+    path = data_path('imdb', 'aclImdb_v1.tar.gz')
+    if not os.path.exists(path):
+        return None
+    pat = re.compile(r'aclImdb/(train|test)/(pos|neg)/.*\.txt$')
+    freq = {}
+    token_docs, labels = [], []
+    with tarfile.open(path) as tf:
+        for m in tf:
+            mm = pat.match(m.name)
+            if not mm:
+                continue
+            toks = _tokenize(tf.extractfile(m).read().decode(
+                'utf-8', 'ignore'))
+            if mm.group(1) == 'train':
+                for w in toks:
+                    freq[w] = freq.get(w, 0) + 1
+            if mm.group(1) == mode:
+                token_docs.append(toks)
+                labels.append(0 if mm.group(2) == 'pos' else 1)
+    word_idx = {w: i for i, (w, c) in enumerate(
+        sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+        if c >= cutoff}
+    unk = len(word_idx)
+    docs = [np.array([word_idx.get(w, unk) for w in toks], dtype=np.int64)
+            for toks in token_docs]
+    return docs, np.asarray(labels, np.int64), word_idx
+
+
+def load_imikolov_dict(min_word_freq=50):
+    path = data_path('imikolov', 'simple-examples.tgz')
+    if not os.path.exists(path):
+        return None
+    freq = {}
+    with tarfile.open(path) as tf:
+        f = tf.extractfile('./simple-examples/data/ptb.train.txt')
+        for line in f.read().decode('utf-8').splitlines():
+            for w in line.strip().split():
+                freq[w] = freq.get(w, 0) + 1
+    freq = {w: c for w, c in freq.items() if c >= min_word_freq and w != '<unk>'}
+    word_idx = {w: i for i, (w, c) in enumerate(
+        sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))}
+    word_idx['<unk>'] = len(word_idx)
+    return word_idx
+
+
+def load_imikolov(mode='train', data_type='NGRAM', window_size=5,
+                  min_word_freq=50):
+    """PTB ngrams/sequences from simple-examples.tgz, or None if absent."""
+    word_idx = load_imikolov_dict(min_word_freq)
+    if word_idx is None:
+        return None
+    fname = ('./simple-examples/data/ptb.train.txt' if mode == 'train'
+             else './simple-examples/data/ptb.valid.txt')
+    path = data_path('imikolov', 'simple-examples.tgz')
+    unk = word_idx['<unk>']
+    data = []
+    with tarfile.open(path) as tf:
+        f = tf.extractfile(fname)
+        for line in f.read().decode('utf-8').splitlines():
+            ids = [word_idx.get(w, unk) for w in line.strip().split()]
+            if data_type.upper() == 'NGRAM':
+                if len(ids) >= window_size:
+                    for i in range(window_size, len(ids) + 1):
+                        data.append(np.array(ids[i - window_size:i],
+                                             dtype=np.int64))
+            else:
+                data.append(np.array(ids, dtype=np.int64))
+    return data

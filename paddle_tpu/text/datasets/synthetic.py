@@ -39,11 +39,31 @@ class _SyntheticSeqDataset(Dataset):
 class Imdb(_SyntheticSeqDataset):
     VOCAB = 5147
 
+    def __init__(self, mode='train', cutoff=150, **kwargs):
+        from . import real
+        loaded = real.load_imdb(mode, cutoff)
+        if loaded is not None:
+            self.docs, self.labels, self.word_idx = loaded
+            self.synthetic = False
+            return
+        super().__init__(mode, **kwargs)
+
 
 class Imikolov(_SyntheticSeqDataset):
     """N-gram LM data: returns (context, next word)."""
     VOCAB = 2000
     SEQ = 5
+
+    def __init__(self, mode='train', data_type='NGRAM', window_size=5,
+                 min_word_freq=50, **kwargs):
+        from . import real
+        loaded = real.load_imikolov(mode, data_type, window_size,
+                                    min_word_freq)
+        if loaded is not None:
+            self.docs = loaded
+            self.synthetic = False
+            return
+        super().__init__(mode, **kwargs)
 
     def __getitem__(self, idx):
         seq = self.docs[idx]
@@ -68,11 +88,15 @@ class Movielens(Dataset):
 
 class UCIHousing(Dataset):
     def __init__(self, mode='train', **kwargs):
+        from . import real
+        loaded = real.load_uci_housing(mode)
+        if loaded is not None:
+            self.x, self.y = loaded
+            self.synthetic = False
+            return
         rng = np.random.RandomState(9 if mode == 'train' else 10)
         n = 404 if mode == 'train' else 102
         self.x = rng.randn(n, 13).astype(np.float32)
-        w = rng.RandomState(0).randn(13).astype(np.float32) if hasattr(
-            rng, 'RandomState') else rng.randn(13).astype(np.float32)
         w = np.linspace(-1, 1, 13).astype(np.float32)
         self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(
             np.float32).reshape(-1, 1)

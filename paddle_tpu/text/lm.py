@@ -1,0 +1,48 @@
+"""LSTM language model (PTB-style).
+
+Parity: the reference LSTM LM (ptb_lm example: fluid.layers.lstm stack +
+softmax over vocab, truncated BPTT). TPU-first: nn.LSTM lowers to lax.scan
+(one compiled loop, weights stay in registers/HBM across steps); logits tie
+optionally to the input embedding.
+"""
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+
+__all__ = ['LSTMLanguageModel']
+
+
+class LSTMLanguageModel(nn.Layer):
+    def __init__(self, vocab_size, hidden_size=200, num_layers=2,
+                 dropout=0.0, tie_weights=False):
+        super().__init__()
+        self.embedding = nn.Embedding(vocab_size, hidden_size)
+        self.lstm = nn.LSTM(hidden_size, hidden_size, num_layers=num_layers,
+                            dropout=dropout)
+        self.dropout = nn.Dropout(dropout)
+        self.tie_weights = tie_weights
+        if tie_weights:
+            # output projection reuses the [vocab, hidden] embedding table
+            # (transposed matmul); only a bias is learned separately
+            self.out_bias = self.create_parameter(
+                [vocab_size], is_bias=True)
+        else:
+            self.fc = nn.Linear(hidden_size, vocab_size)
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+
+    def forward(self, ids, state=None):
+        """ids: int [batch, seq]. Returns (logits [b, s, vocab], state)."""
+        x = self.dropout(self.embedding(ids))
+        out, state = self.lstm(x, state)
+        out = self.dropout(out)
+        if self.tie_weights:
+            logits = out.matmul(self.embedding.weight.T) + self.out_bias
+        else:
+            logits = self.fc(out)
+        return logits, state
+
+    def loss(self, logits, targets):
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]), targets.reshape([-1]))

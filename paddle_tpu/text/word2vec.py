@@ -1,0 +1,45 @@
+"""word2vec: skip-gram with negative sampling.
+
+Parity: the reference word2vec example trains skip-gram over Imikolov with
+hierarchical-softmax/NCE ops on a parameter server. TPU-first: in-batch
+negative sampling — one [batch, dim] x [dim, 1+k] matmul per center word,
+static shapes, no hsigmoid tree walk.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..tensor.random import randint
+
+__all__ = ['SkipGram', 'Word2Vec']
+
+
+class SkipGram(nn.Layer):
+    def __init__(self, vocab_size, embedding_dim=128, neg_samples=5):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.neg_samples = neg_samples
+        self.in_embed = nn.Embedding(vocab_size, embedding_dim)
+        self.out_embed = nn.Embedding(vocab_size, embedding_dim)
+
+    def forward(self, center, context, negatives=None):
+        """center/context: int [batch]; negatives: int [batch, k] (sampled
+        uniformly if not given). Returns scalar NEG loss."""
+        if negatives is None:
+            negatives = randint(0, self.vocab_size,
+                                [center.shape[0], self.neg_samples])
+        c = self.in_embed(center)                       # [b, d]
+        pos = self.out_embed(context)                   # [b, d]
+        neg = self.out_embed(negatives)                 # [b, k, d]
+        pos_score = (c * pos).sum(axis=-1)              # [b]
+        neg_score = (neg * c.unsqueeze(1)).sum(axis=-1)  # [b, k]
+        pos_loss = nn.functional.log_sigmoid(pos_score)
+        neg_loss = nn.functional.log_sigmoid(-neg_score).sum(axis=-1)
+        return -(pos_loss + neg_loss).mean()
+
+    def embedding(self):
+        return self.in_embed.weight
+
+
+Word2Vec = SkipGram

@@ -1,0 +1,74 @@
+"""Rec (WideDeep/DeepFM) + text (word2vec, LSTM LM) model tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+@pytest.mark.parametrize("cls_name", ["WideDeep", "DeepFM"])
+def test_ctr_model_trains(cls_name):
+    from paddle_tpu import rec
+    M = getattr(rec, cls_name)
+    rs = np.random.RandomState(0)
+    m = M([50] * 4, dense_dim=8, embedding_dim=8, hidden_sizes=(32,))
+    opt = paddle.optimizer.Adam(0.02, parameters=m.parameters())
+    ids = paddle.to_tensor(rs.randint(0, 50, (16, 4)).astype('int32'))
+    dense = paddle.to_tensor(rs.randn(16, 8).astype('float32'))
+    y = paddle.to_tensor(rs.randint(0, 2, (16, 1)).astype('float32'))
+    losses = []
+    for _ in range(10):
+        loss = nn.functional.binary_cross_entropy_with_logits(
+            m(ids, dense), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses[-1])
+
+
+def test_skipgram_trains():
+    from paddle_tpu.text import SkipGram
+    rs = np.random.RandomState(0)
+    sg = SkipGram(40, 16, neg_samples=3)
+    opt = paddle.optimizer.Adam(0.05, parameters=sg.parameters())
+    c = paddle.to_tensor(rs.randint(0, 40, (64,)).astype('int32'))
+    ctx = paddle.to_tensor((np.asarray(c.numpy()) + 1) % 40)
+    losses = []
+    for _ in range(10):
+        loss = sg(c, ctx)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert list(sg.embedding().shape) == [40, 16]
+
+
+def test_lstm_lm_shapes_and_state():
+    from paddle_tpu.text import LSTMLanguageModel
+    rs = np.random.RandomState(0)
+    lm = LSTMLanguageModel(60, 32, num_layers=2)
+    ids = paddle.to_tensor(rs.randint(0, 60, (4, 7)).astype('int32'))
+    logits, state = lm(ids)
+    assert list(logits.shape) == [4, 7, 60]
+    loss = lm.loss(logits, ids)
+    loss.backward()
+    assert np.isfinite(float(loss.numpy()))
+    # carried state feeds the next chunk (truncated BPTT)
+    logits2, _ = lm(ids, state)
+    assert list(logits2.shape) == [4, 7, 60]
+
+
+def test_lstm_lm_tied_weights():
+    from paddle_tpu.text import LSTMLanguageModel
+    rs = np.random.RandomState(0)
+    lm = LSTMLanguageModel(60, 32, num_layers=1, tie_weights=True)
+    ids = paddle.to_tensor(rs.randint(0, 60, (4, 7)).astype('int32'))
+    logits, _ = lm(ids)
+    assert list(logits.shape) == [4, 7, 60]
+    loss = lm.loss(logits, ids)
+    loss.backward()
+    # tied table receives grads from both embedding and output projection
+    assert lm.embedding.weight.grad is not None

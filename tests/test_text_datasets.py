@@ -1,0 +1,62 @@
+"""Text datasets: synthetic fallback + local-file loading path."""
+import os
+
+import numpy as np
+import pytest
+
+
+def test_synthetic_fallbacks_deterministic():
+    from paddle_tpu.text.datasets import Imdb, Imikolov, UCIHousing, WMT14
+    d1, d2 = Imdb(mode='train'), Imdb(mode='train')
+    assert len(d1) == len(d2)
+    np.testing.assert_array_equal(d1[0][0], d2[0][0])
+    doc, label = d1[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    ctx, nxt = Imikolov(mode='train')[0]
+    assert len(ctx) == 4 and len(nxt) == 1
+    x, y = UCIHousing(mode='test')[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    src, trg, nxt = WMT14(mode='train')[0]
+    assert src.shape == trg.shape == nxt.shape
+
+
+def test_uci_housing_local_file(tmp_path, monkeypatch):
+    from paddle_tpu.text.datasets import real
+    rs = np.random.RandomState(0)
+    raw = np.concatenate(
+        [rs.rand(50, 13), rs.rand(50, 1) * 50], axis=1)
+    ddir = tmp_path / 'uci_housing'
+    ddir.mkdir()
+    np.savetxt(ddir / 'housing.data', raw)
+    monkeypatch.setattr(real, 'DATA_HOME', str(tmp_path))
+    from paddle_tpu.text.datasets import UCIHousing
+    train = UCIHousing(mode='train')
+    test = UCIHousing(mode='test')
+    assert not train.synthetic and not test.synthetic
+    assert len(train) == 40 and len(test) == 10
+    # targets are untouched, features normalized
+    np.testing.assert_allclose(train[0][1], raw[0, -1:], rtol=1e-5)
+    assert abs(np.asarray([train[i][0] for i in range(40)]).mean()) < 0.5
+
+
+def test_imdb_local_tarball(tmp_path, monkeypatch):
+    import tarfile, io
+    from paddle_tpu.text.datasets import real
+    ddir = tmp_path / 'imdb'
+    ddir.mkdir()
+    with tarfile.open(ddir / 'aclImdb_v1.tar.gz', 'w:gz') as tf:
+        for split in ('train', 'test'):
+            for i, (pol, text) in enumerate(
+                    [('pos', b'great movie great fun'),
+                     ('neg', b'bad movie bad plot')] * 2):
+                data = io.BytesIO(text)
+                info = tarfile.TarInfo(f'aclImdb/{split}/{pol}/{i}_7.txt')
+                info.size = len(text)
+                tf.addfile(info, data)
+    monkeypatch.setattr(real, 'DATA_HOME', str(tmp_path))
+    from paddle_tpu.text.datasets import Imdb
+    d = Imdb(mode='train', cutoff=1)
+    assert not d.synthetic
+    assert len(d) == 4
+    assert set(int(l) for l in d.labels) == {0, 1}
+    assert 'movie' in d.word_idx
