@@ -10,4 +10,6 @@ from . import fleet
 from . import sharding
 from .sharding import shard_tensor, shard_layer
 from .ring_attention import ring_attention
+from . import pipeline
+from .pipeline import pipeline_apply
 from .launch import spawn, launch

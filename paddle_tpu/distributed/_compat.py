@@ -1,0 +1,14 @@
+"""jax.shard_map compatibility (check_rep was renamed check_vma in jax 0.8)."""
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, check=True):
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check)
+    except TypeError:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check)

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from ._compat import shard_map
 
 from . import env
 
@@ -87,4 +87,4 @@ def ring_attention(q, k, v, mesh=None, axis=env.SEQ_AXIS, causal=True,
         return _attn_reference(q, k, v, causal, scale)
     spec = P(None, None, axis, None)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+                     out_specs=spec, check=False)(q, k, v)

@@ -9,28 +9,32 @@ for vocabularies too big for one chip, shard the tables over the mesh with
 distributed.sharding.VocabParallelEmbedding — no parameter server, no async
 push/pull.
 """
+import numpy as np
 import jax.numpy as jnp
 
 from .. import nn
-from ..tensor.manipulation import stack, concat
+from ..tensor.manipulation import concat
 from ..core.tensor import Tensor
 
 __all__ = ['WideDeep', 'DeepFM']
 
 
 class _SparseEmbeddings(nn.Layer):
-    """One embedding table per sparse slot; ids: int [batch, num_slots]."""
+    """All sparse slots share ONE [sum(vocabs), dim] table; per-slot ids are
+    offset into their vocab range so the whole batch is a single fused
+    gather (one HBM read feeding the MXU towers, no per-slot dispatch)."""
 
-    def __init__(self, slot_vocab_sizes, embedding_dim, sparse=True):
+    def __init__(self, slot_vocab_sizes, embedding_dim):
         super().__init__()
-        self.tables = nn.LayerList([
-            nn.Embedding(v, embedding_dim, sparse=sparse)
-            for v in slot_vocab_sizes])
+        offsets = np.concatenate(
+            [[0], np.cumsum(slot_vocab_sizes)[:-1]]).astype(np.int32)
+        self._offsets = jnp.asarray(offsets)           # [num_slots]
+        self.table = nn.Embedding(int(np.sum(slot_vocab_sizes)),
+                                  embedding_dim)
 
     def forward(self, ids):
-        # [batch, num_slots, dim]
-        outs = [self.tables[i](ids[:, i]) for i in range(len(self.tables))]
-        return stack(outs, axis=1)
+        # ids: [batch, num_slots] -> [batch, num_slots, dim], one gather
+        return self.table(ids + Tensor(self._offsets))
 
 
 class _MLP(nn.Layer):
@@ -61,9 +65,8 @@ class WideDeep(nn.Layer):
                  hidden_sizes=(400, 400, 400)):
         super().__init__()
         self.embeddings = _SparseEmbeddings(slot_vocab_sizes, embedding_dim)
-        # wide part: per-slot scalar weight tables (linear model over ids)
-        self.wide_tables = nn.LayerList([
-            nn.Embedding(v, 1) for v in slot_vocab_sizes])
+        # wide part: per-slot scalar weights = a fused dim-1 table
+        self.wide_tables = _SparseEmbeddings(slot_vocab_sizes, 1)
         self.wide_dense = nn.Linear(dense_dim, 1)
         deep_in = len(slot_vocab_sizes) * embedding_dim + dense_dim
         self.deep = _MLP(deep_in, list(hidden_sizes))
@@ -73,11 +76,8 @@ class WideDeep(nn.Layer):
         emb = self.embeddings(sparse_ids)                 # [b, s, d]
         deep_in = concat([emb.flatten(1), dense_feats], axis=1)
         deep_logit = self.deep_out(self.deep(deep_in))
-        wide_terms = [self.wide_tables[i](sparse_ids[:, i])
-                      for i in range(len(self.wide_tables))]
-        wide_logit = self.wide_dense(dense_feats)
-        for t in wide_terms:
-            wide_logit = wide_logit + t
+        wide_logit = self.wide_dense(dense_feats) + \
+            self.wide_tables(sparse_ids).sum(axis=1)      # [b, 1]
         return deep_logit + wide_logit
 
 
@@ -92,8 +92,7 @@ class DeepFM(nn.Layer):
                  hidden_sizes=(400, 400)):
         super().__init__()
         self.embeddings = _SparseEmbeddings(slot_vocab_sizes, embedding_dim)
-        self.first_order = nn.LayerList([
-            nn.Embedding(v, 1) for v in slot_vocab_sizes])
+        self.first_order = _SparseEmbeddings(slot_vocab_sizes, 1)
         self.dense_first = nn.Linear(dense_dim, 1)
         deep_in = len(slot_vocab_sizes) * embedding_dim + dense_dim
         self.deep = _MLP(deep_in, list(hidden_sizes))
@@ -105,9 +104,8 @@ class DeepFM(nn.Layer):
         sum_emb = emb.sum(axis=1)
         fm2 = ((sum_emb * sum_emb) - (emb * emb).sum(axis=1)) \
             .sum(axis=1, keepdim=True) * 0.5
-        fm1 = self.dense_first(dense_feats)
-        for i in range(len(self.first_order)):
-            fm1 = fm1 + self.first_order[i](sparse_ids[:, i])
+        fm1 = self.dense_first(dense_feats) + \
+            self.first_order(sparse_ids).sum(axis=1)      # [b, 1]
         deep_in = concat([emb.flatten(1), dense_feats], axis=1)
         deep_logit = self.deep_out(self.deep(deep_in))
         return fm1 + fm2 + deep_logit

@@ -27,11 +27,12 @@ def load_uci_housing(mode='train', split=0.8):
         return None
     raw = np.loadtxt(path).astype(np.float32)
     feats, target = raw[:, :-1], raw[:, -1:]
-    # feature-wise max-min normalization over the train split (ref behavior)
+    # feature-wise (x - avg) / (max - min) over the FULL dataset, matching
+    # the reference loader (uci_housing.py feature_range over whole matrix)
     n_train = int(len(raw) * split)
-    mx = feats[:n_train].max(axis=0)
-    mn = feats[:n_train].min(axis=0)
-    avg = feats[:n_train].mean(axis=0)
+    mx = feats.max(axis=0)
+    mn = feats.min(axis=0)
+    avg = feats.mean(axis=0)
     feats = (feats - avg) / np.maximum(mx - mn, 1e-6)
     if mode == 'train':
         return feats[:n_train], target[:n_train]
@@ -66,9 +67,10 @@ def load_imdb(mode='train', cutoff=150):
                 continue
             toks = _tokenize(tf.extractfile(m).read().decode(
                 'utf-8', 'ignore'))
-            if mm.group(1) == 'train':
-                for w in toks:
-                    freq[w] = freq.get(w, 0) + 1
+            # dict counts BOTH splits (reference imdb.py word_dict pattern
+            # covers train|test), keeping ids compatible with the reference
+            for w in toks:
+                freq[w] = freq.get(w, 0) + 1
             if mm.group(1) == mode:
                 token_docs.append(toks)
                 labels.append(0 if mm.group(2) == 'pos' else 1)
@@ -81,43 +83,59 @@ def load_imdb(mode='train', cutoff=150):
     return docs, np.asarray(labels, np.int64), word_idx
 
 
-def load_imikolov_dict(min_word_freq=50):
-    path = data_path('imikolov', 'simple-examples.tgz')
-    if not os.path.exists(path):
-        return None
+def _imikolov_dict_from(tf, min_word_freq):
+    """Word dict from the open tarball's ptb.train.txt. Follows the
+    reference imikolov.py: lines wrapped with <s>/<e> markers before
+    counting, words kept when freq > min_word_freq (strict), <unk> last."""
     freq = {}
-    with tarfile.open(path) as tf:
-        f = tf.extractfile('./simple-examples/data/ptb.train.txt')
-        for line in f.read().decode('utf-8').splitlines():
-            for w in line.strip().split():
-                freq[w] = freq.get(w, 0) + 1
-    freq = {w: c for w, c in freq.items() if c >= min_word_freq and w != '<unk>'}
+    f = tf.extractfile('./simple-examples/data/ptb.train.txt')
+    for line in f.read().decode('utf-8').splitlines():
+        for w in ['<s>'] + line.strip().split() + ['<e>']:
+            freq[w] = freq.get(w, 0) + 1
+    freq = {w: c for w, c in freq.items()
+            if c > min_word_freq and w != '<unk>'}
     word_idx = {w: i for i, (w, c) in enumerate(
         sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))}
     word_idx['<unk>'] = len(word_idx)
     return word_idx
 
 
+def load_imikolov_dict(min_word_freq=50):
+    path = data_path('imikolov', 'simple-examples.tgz')
+    if not os.path.exists(path):
+        return None
+    with tarfile.open(path) as tf:
+        return _imikolov_dict_from(tf, min_word_freq)
+
+
 def load_imikolov(mode='train', data_type='NGRAM', window_size=5,
                   min_word_freq=50):
-    """PTB ngrams/sequences from simple-examples.tgz, or None if absent."""
-    word_idx = load_imikolov_dict(min_word_freq)
-    if word_idx is None:
+    """PTB ngrams/sequences from simple-examples.tgz, or None if absent.
+
+    NGRAM: windows over <s> line <e>; SEQ: (src, trg) = (<s>+ids, ids+<e>)
+    pairs, both per the reference imikolov.py. One decompression pass: the
+    dict is built from the same open tarball as the data read.
+    """
+    path = data_path('imikolov', 'simple-examples.tgz')
+    if not os.path.exists(path):
         return None
     fname = ('./simple-examples/data/ptb.train.txt' if mode == 'train'
              else './simple-examples/data/ptb.valid.txt')
-    path = data_path('imikolov', 'simple-examples.tgz')
-    unk = word_idx['<unk>']
     data = []
     with tarfile.open(path) as tf:
+        word_idx = _imikolov_dict_from(tf, min_word_freq)
+        unk = word_idx['<unk>']
         f = tf.extractfile(fname)
         for line in f.read().decode('utf-8').splitlines():
-            ids = [word_idx.get(w, unk) for w in line.strip().split()]
+            words = ['<s>'] + line.strip().split() + ['<e>']
+            ids = [word_idx.get(w, unk) for w in words]
             if data_type.upper() == 'NGRAM':
                 if len(ids) >= window_size:
                     for i in range(window_size, len(ids) + 1):
                         data.append(np.array(ids[i - window_size:i],
                                              dtype=np.int64))
             else:
-                data.append(np.array(ids, dtype=np.int64))
+                src = np.array(ids[:-1], dtype=np.int64)
+                trg = np.array(ids[1:], dtype=np.int64)
+                data.append((src, trg))
     return data

@@ -67,6 +67,8 @@ class Imikolov(_SyntheticSeqDataset):
 
     def __getitem__(self, idx):
         seq = self.docs[idx]
+        if isinstance(seq, tuple):      # real SEQ mode: (src, trg) pair
+            return seq
         return seq[:-1], seq[-1:]
 
 
