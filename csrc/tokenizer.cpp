@@ -1,0 +1,130 @@
+// Native text tokenizer: basic (whitespace+punct) and greedy wordpiece.
+//
+// Parity target: the reference's C++ text-processing utilities used by its
+// data feeders (the reference tokenizes in Python readers backed by C++
+// data_feed for PS training — paddle/fluid/framework/data_feed.cc). Here the
+// tokenize+lookup hot loop for text pipelines (BERT-style wordpiece and
+// classic word-level) runs in C++; Python hands in raw UTF-8 lines and gets
+// back int32 id buffers. ctypes releases the GIL during calls, so DataLoader
+// worker threads tokenize genuinely in parallel.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Vocab {
+  std::unordered_map<std::string, int> map;
+  int unk_id = 0;
+};
+
+inline bool is_ws(unsigned char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+}
+
+// ASCII punctuation split like BERT's BasicTokenizer
+inline bool is_punct(unsigned char c) {
+  return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+         (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+void basic_tokens(const char* text, bool lower,
+                  std::vector<std::string>* out) {
+  std::string cur;
+  for (const unsigned char* p = (const unsigned char*)text; *p; ++p) {
+    unsigned char c = *p;
+    if (lower && c >= 'A' && c <= 'Z') c += 32;
+    if (is_ws(c)) {
+      if (!cur.empty()) { out->push_back(cur); cur.clear(); }
+    } else if (is_punct(c)) {
+      if (!cur.empty()) { out->push_back(cur); cur.clear(); }
+      out->push_back(std::string(1, (char)c));
+    } else {
+      cur.push_back((char)c);
+    }
+  }
+  if (!cur.empty()) out->push_back(cur);
+}
+
+}  // namespace
+
+extern "C" {
+
+Vocab* vocab_create() { return new Vocab(); }
+
+void vocab_destroy(Vocab* v) { delete v; }
+
+void vocab_add(Vocab* v, const char* word, int id) { v->map[word] = id; }
+
+void vocab_set_unk(Vocab* v, int id) { v->unk_id = id; }
+
+int vocab_size(Vocab* v) { return (int)v->map.size(); }
+
+int vocab_lookup(Vocab* v, const char* word) {
+  auto it = v->map.find(word);
+  return it == v->map.end() ? v->unk_id : it->second;
+}
+
+// Word-level: tokenize + dict lookup. Returns number of ids written
+// (<= max_out).
+int tokenize_ids(Vocab* v, const char* text, int lower, int32_t* out,
+                 int max_out) {
+  std::vector<std::string> toks;
+  basic_tokens(text, lower != 0, &toks);
+  int n = 0;
+  for (const auto& t : toks) {
+    if (n >= max_out) break;
+    auto it = v->map.find(t);
+    out[n++] = it == v->map.end() ? v->unk_id : it->second;
+  }
+  return n;
+}
+
+// Greedy longest-match wordpiece over basic tokens (BERT WordPiece).
+// cont_prefix is the continuation marker ("##"). Unknown pieces emit unk.
+int wordpiece_ids(Vocab* v, const char* text, int lower, int32_t* out,
+                  int max_out, const char* cont_prefix,
+                  int max_chars_per_word) {
+  std::vector<std::string> toks;
+  basic_tokens(text, lower != 0, &toks);
+  std::string prefix(cont_prefix ? cont_prefix : "##");
+  int n = 0;
+  for (const auto& t : toks) {
+    if (n >= max_out) break;
+    if ((int)t.size() > max_chars_per_word) {
+      out[n++] = v->unk_id;
+      continue;
+    }
+    size_t start = 0;
+    std::vector<int> pieces;
+    bool bad = false;
+    while (start < t.size()) {
+      size_t end = t.size();
+      int found = -1;
+      while (end > start) {
+        std::string sub = t.substr(start, end - start);
+        if (start > 0) sub = prefix + sub;
+        auto it = v->map.find(sub);
+        if (it != v->map.end()) { found = it->second; break; }
+        --end;
+      }
+      if (found < 0) { bad = true; break; }
+      pieces.push_back(found);
+      start = end;
+    }
+    if (bad) {
+      out[n++] = v->unk_id;
+    } else {
+      for (int id : pieces) {
+        if (n >= max_out) break;
+        out[n++] = id;
+      }
+    }
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,243 @@
+"""Prefetch ring: native shm-backed (csrc/prefetch.cpp) + queue fallback.
+
+The native ring lives in ONE memory block — a multiprocessing.shared_memory
+segment for process workers (batches cross process boundaries with NO pickle
+of array payloads: workers serialize numpy batches straight into shared
+slots) or a private bytearray for thread workers. Slots are claimed by batch
+sequence number, so order is preserved even with racing producers; all
+blocking waits are pthread condvars with the GIL released.
+"""
+import ctypes
+import queue
+import struct
+
+import numpy as np
+
+from . import load as _load_lib
+
+_DTYPES = ['float32', 'float64', 'float16', 'int8', 'int16',
+           'int32', 'int64', 'uint8', 'bool']
+_DTYPE_CODE = {np.dtype(d): i for i, d in enumerate(_DTYPES)}
+
+
+def serialized_size(arrays):
+    total = 8
+    for a in arrays:
+        total += 8 * (2 + a.ndim) + a.nbytes
+    return total
+
+
+def _bind(lib):
+    lib.pring_block_bytes.restype = ctypes.c_int64
+    lib.pring_block_bytes.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.pring_init.restype = ctypes.c_int
+    lib.pring_init.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_int64]
+    lib.pring_valid.restype = ctypes.c_int
+    lib.pring_valid.argtypes = [ctypes.c_void_p]
+    lib.pring_slot_bytes.restype = ctypes.c_int64
+    lib.pring_slot_bytes.argtypes = [ctypes.c_void_p]
+    lib.pring_acquire_write_seq.restype = ctypes.c_int64
+    lib.pring_acquire_write_seq.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.pring_slot_ptr.restype = ctypes.c_void_p
+    lib.pring_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.pring_commit_write.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_int64]
+    lib.pring_abort_write.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.pring_acquire_read.restype = ctypes.c_int64
+    lib.pring_acquire_read.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_int64)]
+    lib.pring_acquire_read_timeout.restype = ctypes.c_int64
+    lib.pring_acquire_read_timeout.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    lib.pring_release_read.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.pring_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def native_available():
+    return _load_lib() is not None
+
+
+def block_bytes(capacity, slot_bytes):
+    lib = _bind(_load_lib())
+    return int(lib.pring_block_bytes(capacity, slot_bytes))
+
+
+class NativePrefetchRing:
+    """Ring over a caller-owned buffer (shm or private).
+
+    Create with ``NativePrefetchRing(capacity, slot_bytes)`` (private memory)
+    or ``NativePrefetchRing.attach(buf)`` (existing initialized block, e.g.
+    a SharedMemory.buf in a worker process).
+    """
+
+    def __init__(self, capacity=None, slot_bytes=None, _buf=None,
+                 _init=True):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native lib unavailable")
+        self._lib = _bind(lib)
+        if _buf is None:
+            nbytes = self._lib.pring_block_bytes(capacity, slot_bytes)
+            _buf = bytearray(nbytes)
+        self._buf = _buf   # keep alive; bytearray | memoryview(shm.buf)
+        c = (ctypes.c_char * 1).from_buffer(self._buf)
+        self._base = ctypes.addressof(c)
+        del c
+        if _init:
+            rc = self._lib.pring_init(self._base, capacity, slot_bytes)
+            if rc != 0:
+                raise RuntimeError(f"pring_init failed ({rc})")
+        elif not self._lib.pring_valid(self._base):
+            raise RuntimeError("buffer does not hold an initialized ring")
+        self._slot_bytes = self._lib.pring_slot_bytes(self._base)
+
+    @classmethod
+    def attach(cls, buf):
+        return cls(_buf=buf, _init=False)
+
+    @property
+    def slot_bytes(self):
+        return self._slot_bytes
+
+    def put(self, arrays, seq):
+        """Serialize numpy ``arrays`` as batch number ``seq`` (blocks until
+        it is seq's turn and the slot is free). False if the ring closed."""
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        need = serialized_size(arrays)
+        if need > self._slot_bytes:
+            raise ValueError(
+                f"batch needs {need}B > slot {self._slot_bytes}B")
+        idx = self._lib.pring_acquire_write_seq(self._base, seq)
+        if idx < 0:
+            return False
+        try:
+            base = self._lib.pring_slot_ptr(self._base, idx)
+            buf = (ctypes.c_char * self._slot_bytes).from_address(base)
+            off = 0
+            struct.pack_into('<q', buf, off, len(arrays))
+            off += 8
+            for a in arrays:
+                code = _DTYPE_CODE.get(a.dtype)
+                if code is None:
+                    raise ValueError(f"unsupported dtype {a.dtype}")
+                struct.pack_into('<qq', buf, off, code, a.ndim)
+                off += 16
+                for s in a.shape:
+                    struct.pack_into('<q', buf, off, s)
+                    off += 8
+                ctypes.memmove(base + off, a.ctypes.data, a.nbytes)
+                off += a.nbytes
+            self._lib.pring_commit_write(self._base, idx, off)
+            return True
+        except Exception:
+            self._lib.pring_abort_write(self._base, idx)
+            raise
+
+    def skip(self, seq):
+        """Claim ``seq`` and mark it as dropped (producer-side failure)."""
+        idx = self._lib.pring_acquire_write_seq(self._base, seq)
+        if idx >= 0:
+            self._lib.pring_abort_write(self._base, idx)
+
+    def get(self, timeout_ms=-1):
+        """-> (arrays, release_fn) | 'skip' (aborted) | 'timeout' |
+        None (drained). Arrays VIEW slot memory: copy or finish uploading
+        before release."""
+        size = ctypes.c_int64()
+        idx = self._lib.pring_acquire_read_timeout(
+            self._base, ctypes.byref(size), int(timeout_ms))
+        if idx == -2:
+            return 'timeout'
+        if idx < 0:
+            return None
+        if size.value == 0:   # aborted producer
+            self._lib.pring_release_read(self._base, idx)
+            return 'skip'
+        base = self._lib.pring_slot_ptr(self._base, idx)
+        buf = (ctypes.c_char * size.value).from_address(base)
+        mem = memoryview(buf)
+        off = 0
+        (n,) = struct.unpack_from('<q', mem, off)
+        off += 8
+        arrays = []
+        for _ in range(n):
+            code, ndim = struct.unpack_from('<qq', mem, off)
+            off += 16
+            shape = struct.unpack_from('<' + 'q' * ndim, mem, off)
+            off += 8 * ndim
+            dt = np.dtype(_DTYPES[code])
+            count = int(np.prod(shape)) if ndim else 1
+            arrays.append(np.frombuffer(mem, dtype=dt, count=count,
+                                        offset=off).reshape(shape))
+            off += count * dt.itemsize
+        lib, basep = self._lib, self._base
+        return arrays, (lambda: lib.pring_release_read(basep, idx))
+
+    def close(self):
+        self._lib.pring_close(self._base)
+
+    def destroy(self):
+        self._buf = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+class PyPrefetchRing:
+    """Thread-only fallback with the same (put(arrays, seq), get) surface."""
+
+    def __init__(self, capacity, slot_bytes=None):
+        import threading
+        self._q = queue.Queue(maxsize=capacity)
+        self._closed = False
+        self._next = 0
+        self._cv = threading.Condition()
+
+    @property
+    def slot_bytes(self):
+        return 1 << 62
+
+    def put(self, arrays, seq):
+        with self._cv:
+            while self._next != seq and not self._closed:
+                self._cv.wait(0.05)
+            if self._closed:
+                return False
+            # enqueue while holding the turnstile: releasing first would let
+            # the next seq's producer enqueue ahead and break FIFO order
+            self._q.put(list(arrays))
+            self._next = seq + 1
+            self._cv.notify_all()
+        return True
+
+    def get(self, timeout_ms=-1):
+        waited = 0.0
+        while True:
+            try:
+                return self._q.get(timeout=0.05), (lambda: None)
+            except queue.Empty:
+                if self._closed and self._q.empty():
+                    return None
+                waited += 0.05
+                if timeout_ms >= 0 and waited * 1000 >= timeout_ms:
+                    return 'timeout'
+
+    def close(self):
+        self._closed = True
+        with self._cv:
+            self._cv.notify_all()
+
+    def destroy(self):
+        pass
+
+
+def make_ring(capacity, slot_bytes):
+    try:
+        return NativePrefetchRing(capacity, slot_bytes)
+    except (RuntimeError, OSError):
+        return PyPrefetchRing(capacity, slot_bytes)

@@ -1,0 +1,160 @@
+"""Multiprocess DataLoader workers over the shared-memory prefetch ring.
+
+Parity: the reference DataLoader's multiprocess mode, which ships LoDTensors
+between worker processes through shared memory (core._convert_to_shared_
+memory / _array_to_share_memory_tensor) instead of pickling payloads.
+Here: fork()ed workers collate numpy batches and serialize them DIRECTLY
+into POSIX shared memory slots (csrc/prefetch.cpp ring); the parent maps
+each slot, copies out, releases. Array payloads never touch a pipe.
+
+Workers are data-only processes: they run dataset[i] + collate (numpy) and
+must not touch jax. Index batches and error strings travel over small
+multiprocessing queues; bulk bytes travel through the ring.
+"""
+import multiprocessing as mp
+import os
+import traceback
+
+import numpy as np
+
+from .prefetch import NativePrefetchRing, serialized_size, native_available
+
+
+def _worker_main(shm_name, task_q, err_q, dataset, collate_fn,
+                 worker_init_fn, wid):
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        from multiprocessing import shared_memory
+        shm = shared_memory.SharedMemory(name=shm_name)
+        ring = NativePrefetchRing.attach(shm.buf)
+        while True:
+            task = task_q.get()
+            if task is None:
+                break
+            seq, indices = task
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                arrays = [np.asarray(a) for a in
+                          (batch if isinstance(batch, (list, tuple))
+                           else [batch])]
+                if not ring.put(arrays, seq):
+                    break
+            except Exception:
+                err_q.put((seq, traceback.format_exc()))
+                ring.skip(seq)
+    except Exception:
+        try:
+            err_q.put((-1, traceback.format_exc()))
+        except Exception:
+            pass
+
+
+class ProcessWorkerPool:
+    """Iterator over collated batches produced by fork()ed workers."""
+
+    def __init__(self, dataset, batch_indices, collate_fn, num_workers,
+                 capacity=None, worker_init_fn=None, sample_batch=None):
+        from multiprocessing import shared_memory
+        if not native_available():
+            raise RuntimeError("native ring unavailable")
+        self._ctx = mp.get_context('fork')
+        self._batches = list(batch_indices)
+        if not self._batches:
+            self._procs = []
+            self._closed = True
+            self._shm = None
+            return
+        if sample_batch is None and self._batches:
+            sample_batch = collate_fn(
+                [dataset[i] for i in self._batches[0]])
+        self._single = not isinstance(sample_batch, (list, tuple))
+        arrays = [np.asarray(a) for a in
+                  ([sample_batch] if self._single else sample_batch)]
+        # 4x first-batch margin + 1MB headroom: batches may vary in
+        # padded length; beyond this the worker errors clearly
+        slot_bytes = max(serialized_size(arrays) * 4 + (1 << 20),
+                         1 << 16)
+        capacity = capacity or max(2 * num_workers, 4)
+        from .prefetch import block_bytes
+        self._shm = shared_memory.SharedMemory(
+            create=True, size=block_bytes(capacity, slot_bytes))
+        self._ring = NativePrefetchRing(capacity, slot_bytes,
+                                        _buf=self._shm.buf)
+        self._task_q = self._ctx.Queue()
+        self._err_q = self._ctx.Queue()
+        # batch 0 was already collated above for slot sizing: the parent
+        # seeds it as seq 0 rather than having a worker recompute it
+        self._ring.put(arrays, 0)
+        for seq, indices in enumerate(self._batches[1:], start=1):
+            self._task_q.put((seq, list(indices)))
+        for _ in range(num_workers):
+            self._task_q.put(None)
+        self._procs = [
+            self._ctx.Process(
+                target=_worker_main,
+                args=(self._shm.name, self._task_q, self._err_q, dataset,
+                      collate_fn, worker_init_fn, w),
+                daemon=True)
+            for w in range(num_workers)]
+        for p in self._procs:
+            p.start()
+        self._consumed = 0
+        self._closed = False
+
+    def __iter__(self):
+        if self._closed:
+            return
+        try:
+            while self._consumed < len(self._batches):
+                item = self._ring.get(timeout_ms=2000)
+                if item == 'timeout':
+                    # crashed worker never commits/aborts its seq — detect
+                    # instead of hanging forever
+                    if (self._consumed < len(self._batches) and
+                            not any(p.is_alive() for p in self._procs)):
+                        self._raise_worker_error()
+                    continue
+                self._consumed += 1
+                if item is None:
+                    break
+                if item == 'skip':
+                    self._raise_worker_error()
+                    continue
+                arrays, release = item
+                try:
+                    out = [np.array(a) for a in arrays]   # copy out of shm
+                finally:
+                    release()
+                yield out[0] if self._single and len(out) == 1 else out
+        finally:
+            self.shutdown()
+
+    def _raise_worker_error(self):
+        try:
+            seq, tb = self._err_q.get_nowait()
+        except Exception:
+            raise RuntimeError("DataLoader worker failed (no traceback)")
+        raise RuntimeError(f"DataLoader worker failed on batch {seq}:\n{tb}")
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._ring.close()
+        for p in self._procs:
+            p.join(timeout=2)
+            if p.is_alive():
+                p.terminate()
+        self._ring.destroy()
+        try:
+            self._shm.close()
+            self._shm.unlink()
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

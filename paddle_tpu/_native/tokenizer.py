@@ -1,0 +1,109 @@
+"""Tokenizer: native wordpiece/basic (csrc/tokenizer.cpp) + Python fallback.
+
+Used by text pipelines (BERT wordpiece encoding, word-level datasets). The
+native path keeps the tokenize->id hot loop out of the interpreter; ctypes
+releases the GIL so DataLoader workers tokenize in parallel.
+"""
+import ctypes
+import re
+
+import numpy as np
+
+from . import load as _load_lib
+
+_BASIC = re.compile(r"[^\s\w]|\w+", re.UNICODE)
+
+
+class Tokenizer:
+    """vocab: {token: id}. Falls back to pure Python without the native lib."""
+
+    def __init__(self, vocab, unk_token='[UNK]', lower=True,
+                 wordpiece=False, cont_prefix='##', max_chars_per_word=100):
+        self.vocab = dict(vocab)
+        self.lower = lower
+        self.wordpiece = wordpiece
+        self.cont_prefix = cont_prefix
+        self.max_chars = max_chars_per_word
+        self.unk_id = self.vocab.get(unk_token, 0)
+        self._lib = _load_lib()
+        self._cvocab = None
+        if self._lib is not None:
+            self._cvocab = self._lib.vocab_create()
+            for w, i in self.vocab.items():
+                self._lib.vocab_add(self._cvocab, w.encode('utf-8'), int(i))
+            self._lib.vocab_set_unk(self._cvocab, int(self.unk_id))
+
+    @property
+    def native(self):
+        return self._cvocab is not None
+
+    def encode(self, text, max_len=512):
+        """text -> int32 id array (truncated at max_len)."""
+        if self._cvocab is not None:
+            out = np.empty(max_len, np.int32)
+            ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            if self.wordpiece:
+                n = self._lib.wordpiece_ids(
+                    self._cvocab, text.encode('utf-8'), int(self.lower), ptr,
+                    max_len, self.cont_prefix.encode('utf-8'), self.max_chars)
+            else:
+                n = self._lib.tokenize_ids(
+                    self._cvocab, text.encode('utf-8'), int(self.lower), ptr,
+                    max_len)
+            return out[:n].copy()
+        return self._encode_py(text, max_len)
+
+    def encode_batch(self, texts, max_len=512, pad_id=0):
+        """list[str] -> [batch, max_len] int32 padded matrix + lengths."""
+        ids = [self.encode(t, max_len) for t in texts]
+        out = np.full((len(ids), max_len), pad_id, np.int32)
+        lens = np.empty(len(ids), np.int32)
+        for i, a in enumerate(ids):
+            out[i, :len(a)] = a
+            lens[i] = len(a)
+        return out, lens
+
+    # -- pure-python fallback ----------------------------------------------
+    def _basic_tokens(self, text):
+        if self.lower:
+            text = text.lower()
+        return _BASIC.findall(text)
+
+    def _encode_py(self, text, max_len):
+        toks = self._basic_tokens(text)
+        out = []
+        for t in toks:
+            if len(out) >= max_len:
+                break
+            if not self.wordpiece:
+                out.append(self.vocab.get(t, self.unk_id))
+                continue
+            if len(t) > self.max_chars:
+                out.append(self.unk_id)
+                continue
+            start, pieces, bad = 0, [], False
+            while start < len(t):
+                end = len(t)
+                found = None
+                while end > start:
+                    sub = t[start:end]
+                    if start > 0:
+                        sub = self.cont_prefix + sub
+                    if sub in self.vocab:
+                        found = self.vocab[sub]
+                        break
+                    end -= 1
+                if found is None:
+                    bad = True
+                    break
+                pieces.append(found)
+                start = end
+            out.extend([self.unk_id] if bad else pieces)
+        return np.asarray(out[:max_len], np.int32)
+
+    def __del__(self):
+        try:
+            if self._cvocab is not None and self._lib is not None:
+                self._lib.vocab_destroy(self._cvocab)
+        except Exception:
+            pass

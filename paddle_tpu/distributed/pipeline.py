@@ -19,8 +19,6 @@ Requirements: homogeneous stages (same stage_fn / param shapes per stage) —
 heterogeneous prologues/epilogues (embeddings, heads) run outside the
 pipelined trunk, which matches how transformer LMs are partitioned anyway.
 """
-import functools
-
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -121,8 +119,7 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh=None,
         out = lax.psum(out * keep, axis)
         return out.reshape(x_local.shape)
 
-    pspec_params = jax.tree.map(
-        lambda v: P(axis, *([None] * (v.ndim - 1))), stacked_params)
+    pspec_params = stage_pspec(stacked_params, axis)
     sm = shard_map(
         per_device, mesh=mesh,
         in_specs=(pspec_params, P()),    # x replicated over pipe axis

@@ -71,6 +71,7 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.prefetch_factor = max(int(prefetch_factor), 1)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -161,8 +162,57 @@ class DataLoader:
         finally:
             pass
 
+    def _process_batches(self):
+        """num_workers>0 + shared memory: fork()ed worker processes collate
+        batches into the native shm prefetch ring (csrc/prefetch.cpp) — no
+        pickling of array payloads. Falls back to the threaded path when the
+        native lib is unavailable or batches are not plain ndarray tuples."""
+        from .._native.process_pool import ProcessWorkerPool
+        indices = list(self.batch_sampler) if self.batch_sampler is not None \
+            else [[i] for i in range(len(self.dataset))]
+        pool = ProcessWorkerPool(self.dataset, indices, self.collate_fn,
+                                 self.num_workers,
+                                 capacity=self.num_workers *
+                                 self.prefetch_factor,
+                                 worker_init_fn=self.worker_init_fn)
+        yield from pool
+
+    def _shm_compatible(self):
+        """Process+shm transport handles flat tuples of numeric ndarrays
+        (the hot path); dicts/strings/objects use the threaded path."""
+        try:
+            if self.batch_sampler is not None:
+                first = next(iter(self.batch_sampler), None)
+            else:
+                first = [0] if len(self.dataset) else None
+            if first is None:
+                return False
+            batch = self.collate_fn([self.dataset[i] for i in first[:1]])
+            items = batch if isinstance(batch, (list, tuple)) else [batch]
+            import numpy as _np
+            for a in items:
+                a = _np.asarray(a)
+                if a.dtype == object or a.dtype.kind in 'USV':
+                    return False
+            return True
+        except Exception:
+            return False
+
+    def _parallel_batches(self):
+        if self._iterable_mode or not self.use_shared_memory:
+            return self._threaded_batches()
+        try:
+            from .._native import available as _native_ok
+            import multiprocessing as mp
+            if (_native_ok() and 'fork' in mp.get_all_start_methods()
+                    and self._shm_compatible()):
+                return self._process_batches()
+        except Exception:
+            pass
+        return self._threaded_batches()
+
     def __iter__(self):
-        source = self._threaded_batches() if self.num_workers > 0 else \
+        source = self._parallel_batches() if self.num_workers > 0 else \
             self._raw_batches()
         if not self.use_buffer_reader:
             for b in source:

@@ -6,3 +6,4 @@ from .gpt import GPTConfig, GPTModel, gpt_small
 from .seq2seq import Seq2SeqTransformer
 from .word2vec import SkipGram, Word2Vec
 from .lm import LSTMLanguageModel
+from .._native.tokenizer import Tokenizer
