@@ -33,7 +33,7 @@ def main():
         cfg = BertConfig(vocab_size=30522, hidden_size=1024,
                          num_hidden_layers=24, num_attention_heads=16,
                          intermediate_size=4096, max_position_embeddings=512)
-        batch, seq, steps, warmup = 32, 128, 10, 2
+        batch, seq, steps, warmup = 64, 128, 10, 2  # B=64: best MFU on v5e
     else:  # local smoke mode: same code path, tiny shapes
         cfg = BertConfig(vocab_size=1024, hidden_size=128,
                          num_hidden_layers=2, num_attention_heads=4,

@@ -340,6 +340,14 @@ class _CaptureWatch:
             self.captured_vals.append(t._value)
 
 
+# debug hook: utils.debug.enable_check_nan_inf installs a per-op NaN screen
+_NAN_CHECK_HOOK = [None]
+
+
+def set_nan_check_hook(hook):
+    _NAN_CHECK_HOOK[0] = hook
+
+
 class _WatchTL(threading.local):
     # thread-local: DataLoader worker threads must not leak their tensor
     # traffic into a jit discovery pass running on another thread
@@ -375,6 +383,8 @@ def apply_op(fn, tensors, n_outputs=1, differentiable=True):
                     for t in tensors)
     vals = [t._value for t in tensors]
     out_vals = fn(*vals)
+    if _NAN_CHECK_HOOK[0] is not None:
+        _NAN_CHECK_HOOK[0](fn, out_vals)
     multi = n_outputs > 1
     requires = (differentiable and autograd.is_grad_enabled()
                 and any(not t.stop_gradient for t in tensors))

@@ -1,3 +1,6 @@
 """Incubating features. Parity: python/paddle/incubate + fluid/incubate."""
 from . import checkpoint
 from ..distributed import fleet
+
+from . import custom_op
+from .custom_op import register_op

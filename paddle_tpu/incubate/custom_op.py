@@ -1,0 +1,81 @@
+"""Custom op registration: user-defined (e.g. Pallas) kernels as framework ops.
+
+Parity: the reference's C++ custom-operator path (paddle/fluid/framework/
+op_registry.h + load_op_library / utils.cpp_extension): users register a
+compute function and optional gradient and the op becomes callable on
+Tensors with autograd support. TPU-first: the "kernel" is any jax-traceable
+callable — typically a pallas_call TPU kernel — wired into the eager tape
+via jax.custom_vjp, so it works identically under eager, jit.to_static and
+grad transforms.
+"""
+import jax
+
+from ..core.tensor import Tensor, apply_op
+
+__all__ = ['register_op', 'get_op', 'list_ops', 'CustomOpError']
+
+_REGISTRY = {}
+
+
+class CustomOpError(RuntimeError):
+    pass
+
+
+def register_op(name, fn, vjp_fwd=None, vjp_bwd=None, n_outputs=1,
+                overwrite=False):
+    """Register ``fn(*jax_arrays) -> array(s)`` as op ``name``.
+
+    vjp_fwd/vjp_bwd: optional custom gradient pair with jax.custom_vjp
+    semantics — fwd returns (out, residuals), bwd(residuals, cotangents)
+    returns input cotangent tuple. Without them, jax autodiff differentiates
+    straight through ``fn`` (fine for most pallas kernels built from
+    differentiable primitives... supply the pair when the kernel uses
+    non-differentiable tricks or a hand-written backward kernel is faster).
+
+    Returns the Tensor-level callable (also retrievable via get_op(name)).
+    """
+    if name in _REGISTRY and not overwrite:
+        raise CustomOpError(f"op '{name}' already registered")
+    if (vjp_fwd is None) != (vjp_bwd is None):
+        raise CustomOpError("provide both vjp_fwd and vjp_bwd or neither")
+
+    has_vjp = vjp_fwd is not None
+    kernel = fn
+    if has_vjp:
+        kernel = jax.custom_vjp(fn)
+        kernel.defvjp(vjp_fwd, vjp_bwd)
+    try:
+        kernel.__name__ = name
+    except AttributeError:
+        pass
+
+    def tensor_op(*args, **kwargs):
+        tensors = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
+        if kwargs:
+            if has_vjp:
+                # jax.custom_vjp resolves kwargs into positional diff args,
+                # which breaks a bwd that returns tensor cotangents only
+                raise CustomOpError(
+                    f"op '{name}': keyword args are unsupported with a "
+                    f"custom vjp — close constants over the kernel or "
+                    f"register a partial instead")
+            def bound(*vals):
+                return kernel(*vals, **kwargs)
+            bound.__name__ = name
+            return apply_op(bound, tuple(tensors), n_outputs=n_outputs)
+        return apply_op(kernel, tuple(tensors), n_outputs=n_outputs)
+
+    tensor_op.__name__ = name
+    _REGISTRY[name] = tensor_op
+    return tensor_op
+
+
+def get_op(name):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise CustomOpError(f"op '{name}' is not registered") from None
+
+
+def list_ops():
+    return sorted(_REGISTRY)

@@ -3,9 +3,15 @@ from . import unique_name
 from .lazy_import import try_import
 from .deprecated import deprecated
 
-__all__ = ['unique_name', 'try_import', 'deprecated', 'run_check']
+__all__ = ['unique_name', 'try_import', 'deprecated', 'run_check',
+           'check_numerics', 'enable_check_nan_inf', 'divergence_check',
+           'deterministic_guard']
 
 
 def run_check():
     from .install_check import run_check as _rc
     return _rc()
+
+from . import debug
+from .debug import (check_numerics, enable_check_nan_inf,
+                    divergence_check, deterministic_guard)

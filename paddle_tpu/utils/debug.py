@@ -1,0 +1,225 @@
+"""Debug utilities: NaN/Inf checking, determinism verification, graph export.
+
+Parity targets in the reference:
+- FLAGS_check_nan_inf + framework/details/nan_inf_utils (per-op NaN screens)
+- the race-condition story: the reference's ParallelExecutor races are
+  C++-level; the TPU-first analogue is nondeterminism across identical runs
+  (unseeded RNG, async reduction order), checked by ``divergence_check``;
+- debugger/graphviz (python/paddle/fluid/net_drawer.py + debugger.draw_block_
+  graphviz): here ``draw_program`` / ``draw_tape`` emit Graphviz dot.
+"""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ['check_numerics', 'enable_check_nan_inf', 'nan_inf_enabled',
+           'divergence_check', 'deterministic_guard', 'draw_program',
+           'draw_tape']
+
+_check_nan = [bool(int(os.environ.get('PADDLE_TPU_CHECK_NAN_INF', '0')))]
+
+
+def _nan_hook(fn, out_vals):
+    name = getattr(fn, '__name__', 'op')
+    vals = out_vals if isinstance(out_vals, (tuple, list)) else [out_vals]
+    for i, v in enumerate(vals):
+        if isinstance(v, jax.core.Tracer):
+            continue  # traced region: screen applies to eager payloads only
+        a = np.asarray(jax.device_get(v))
+        if a.dtype.kind in 'fc' and not np.isfinite(a).all():
+            raise FloatingPointError(
+                f"NaN/Inf produced by op '{name}' (output {i}, shape "
+                f"{list(a.shape)} {a.dtype}) — check_nan_inf mode")
+
+
+def enable_check_nan_inf(flag=True):
+    """Global per-op NaN/Inf screening on the eager path (debug mode; forces
+    a host sync per op — the analogue of FLAGS_check_nan_inf)."""
+    from ..core import tensor as tensor_mod
+    prev = _check_nan[0]
+    _check_nan[0] = bool(flag)
+    tensor_mod.set_nan_check_hook(_nan_hook if flag else None)
+    return prev
+
+
+if _check_nan[0]:   # honor PADDLE_TPU_CHECK_NAN_INF=1 at import
+    enable_check_nan_inf(True)
+
+
+def nan_inf_enabled():
+    return _check_nan[0]
+
+
+def _leaves_with_paths(value, root):
+    """[(path_str, host ndarray)] for every Tensor/array leaf; traced leaves
+    are skipped (they cannot be inspected host-side)."""
+    from ..core.tensor import Tensor
+    from jax.tree_util import tree_flatten_with_path, keystr
+    flat, _ = tree_flatten_with_path(
+        value, is_leaf=lambda v: isinstance(v, Tensor))
+    out = []
+    for path, v in flat:
+        if v is None:
+            continue
+        arr = v._value if isinstance(v, Tensor) else v
+        if isinstance(arr, jax.core.Tracer):
+            continue
+        out.append((root + keystr(path),
+                    np.asarray(jax.device_get(arr))))
+    return out
+
+
+def check_numerics(value, name="tensor"):
+    """Raise FloatingPointError if ``value`` (Tensor/array/pytree) contains
+    NaN/Inf. Returns the value for chaining."""
+    for path, a in _leaves_with_paths(value, name):
+        if a.dtype.kind in 'fc':
+            bad_nan = int(np.isnan(a).sum())
+            bad_inf = int(np.isinf(a).sum())
+            if bad_nan or bad_inf:
+                raise FloatingPointError(
+                    f"check_numerics failed for '{path}': {bad_nan} NaN, "
+                    f"{bad_inf} Inf in shape {list(a.shape)} {a.dtype}")
+    return value
+
+
+def divergence_check(fn, *args, runs=2, rtol=0.0, atol=0.0, verbose=False):
+    """Run ``fn(*args)`` ``runs`` times and compare outputs (bitwise by
+    default). Returns True when all runs agree; raises AssertionError with
+    the first divergent leaf otherwise.
+
+    This is the TPU-first analogue of a race detector: with seeded RNG and
+    XLA's deterministic executables, ANY cross-run divergence indicates
+    nondeterminism (unseeded host RNG, data-order dependence, or
+    atomics/reduction-order effects in custom kernels).
+    """
+    def snapshot(out):
+        return _leaves_with_paths(out, "out")
+
+    base = snapshot(fn(*args))
+    for r in range(1, runs):
+        cur = snapshot(fn(*args))
+        if len(cur) != len(base):
+            raise AssertionError(
+                f"divergence_check: run {r} produced {len(cur)} leaves vs "
+                f"{len(base)}")
+        for (p0, a0), (p1, a1) in zip(base, cur):
+            same = (np.allclose(a0, a1, rtol=rtol, atol=atol, equal_nan=True)
+                    if (rtol or atol) else np.array_equal(
+                        a0, a1, equal_nan=(a0.dtype.kind in 'fc')))
+            if not same:
+                diff = np.max(np.abs(a0.astype(np.float64) -
+                                     a1.astype(np.float64))) \
+                    if a0.dtype.kind in 'fiu' else 'n/a'
+                raise AssertionError(
+                    f"divergence_check: output '{p0}' differs between run 0 "
+                    f"and run {r} (max abs diff {diff})")
+        if verbose:
+            print(f"divergence_check: run {r} identical")
+    return True
+
+
+class deterministic_guard:
+    """Context manager: seeds global RNG on entry, restores state on exit.
+
+    with deterministic_guard(1234):
+        out1 = train_step(...)
+    """
+
+    def __init__(self, seed=0):
+        self.seed = seed
+
+    def __enter__(self):
+        from ..core import rng
+        self._state = rng.get_rng_state()
+        rng.seed(self.seed)
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import rng
+        rng.set_rng_state(self._state)
+        return False
+
+
+def _dot_escape(s):
+    return str(s).replace('"', r'\"')
+
+
+def draw_program(program, path=None):
+    """Graphviz dot for a static Program's op/var graph (parity:
+    fluid.debugger.draw_block_graphviz). Returns the dot source; writes to
+    ``path`` when given."""
+    lines = ['digraph program {', '  rankdir=TB;',
+             '  node [shape=record, fontsize=10];']
+    seen_vars = set()
+    for b, block in enumerate(program.blocks):
+        for i, op in enumerate(block.ops):
+            op_id = f"op_{b}_{i}"
+            lines.append(
+                f'  {op_id} [label="{_dot_escape(op.type)}", '
+                f'style=filled, fillcolor=lightblue];')
+            for v in op.inputs:
+                name = getattr(v, 'name', str(v))
+                vid = f'var_{_dot_escape(name)}'
+                if name not in seen_vars:
+                    seen_vars.add(name)
+                    lines.append(f'  "{vid}" [label="{_dot_escape(name)}"];')
+                lines.append(f'  "{vid}" -> {op_id};')
+            for v in op.outputs:
+                name = getattr(v, 'name', str(v))
+                vid = f'var_{_dot_escape(name)}'
+                if name not in seen_vars:
+                    seen_vars.add(name)
+                    lines.append(f'  "{vid}" [label="{_dot_escape(name)}"];')
+                lines.append(f'  {op_id} -> "{vid}";')
+    lines.append('}')
+    dot = '\n'.join(lines)
+    if path:
+        with open(path, 'w') as f:
+            f.write(dot)
+    return dot
+
+
+def draw_tape(tensor, path=None, max_nodes=500):
+    """Graphviz dot of the autograd tape reaching ``tensor`` (eager-mode
+    analogue of the reference's graph visualizer)."""
+    lines = ['digraph tape {', '  rankdir=BT;',
+             '  node [shape=record, fontsize=10];']
+    visited = {}
+    stack = [tensor._node] if tensor._node is not None else []
+    count = 0
+    while stack and count < max_nodes:
+        node = stack.pop()
+        if node is None or id(node) in visited or node.released:
+            continue
+        nid = f"n{len(visited)}"
+        visited[id(node)] = nid
+        count += 1
+        fname = getattr(node.fn, '__name__', 'op')
+        outs = ','.join(str(list(o._value.shape)) for o in node.outputs)
+        lines.append(f'  {nid} [label="{_dot_escape(fname)}|{outs}"];')
+        for t in node.inputs:
+            if t._node is not None and not t._node.released:
+                stack.append(t._node)
+    # second pass: edges
+    def nid_of(node):
+        return visited.get(id(node))
+    stack = [tensor._node] if tensor._node is not None else []
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in seen or id(node) not in visited:
+            continue
+        seen.add(id(node))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) in visited:
+                lines.append(f'  {nid_of(t._node)} -> {nid_of(node)};')
+                stack.append(t._node)
+    lines.append('}')
+    dot = '\n'.join(lines)
+    if path:
+        with open(path, 'w') as f:
+            f.write(dot)
+    return dot

@@ -1,0 +1,109 @@
+"""Debug/aux subsystems: nan check, determinism, graph export, custom ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.utils import debug
+
+
+def test_check_nan_inf_mode():
+    debug.enable_check_nan_inf(True)
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], 'float32'))
+        with pytest.raises(FloatingPointError, match="true_divide"):
+            x / 0.0
+    finally:
+        debug.enable_check_nan_inf(False)
+    # off: silent inf
+    y = x / 0.0
+    assert np.isinf(y.numpy()).any()
+
+
+def test_check_numerics():
+    good = paddle.to_tensor(np.ones(3, 'float32'))
+    assert debug.check_numerics(good, "g") is good
+    bad = paddle.to_tensor(np.array([1.0, np.nan], 'float32'))
+    with pytest.raises(FloatingPointError, match=r"b\[.x.\]"):
+        debug.check_numerics({'x': bad}, "b")
+
+
+def test_divergence_check_detects_unseeded_rng():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    x = paddle.to_tensor(np.ones((2, 4), 'float32'))
+    net.eval()
+    assert debug.divergence_check(lambda: net(x), runs=3)
+    net.train()   # fresh rng key each call -> divergence
+    with pytest.raises(AssertionError, match="differs"):
+        debug.divergence_check(lambda: net(x), runs=4)
+
+
+def test_deterministic_guard():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    net.train()
+    x = paddle.to_tensor(np.ones((2, 4), 'float32'))
+    with debug.deterministic_guard(7):
+        o1 = net(x).numpy()
+    with debug.deterministic_guard(7):
+        o2 = net(x).numpy()
+    np.testing.assert_array_equal(o1, o2)
+
+
+def test_draw_tape_and_program():
+    lin = nn.Linear(4, 2)
+    x = paddle.to_tensor(np.ones((2, 4), 'float32'))
+    loss = (lin(x) ** 2).sum()
+    dot = debug.draw_tape(loss)
+    assert 'digraph tape' in dot and dot.count('->') >= 2
+
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+        prog = static.Program()
+        sp = static.Program()
+        with static.program_guard(prog, sp):
+            xd = static.data('x', [None, 4], 'float32')
+            paddle.static.nn.fc(xd, 8)
+        d = debug.draw_program(prog)
+        assert 'digraph program' in d and 'fillcolor' in d
+    finally:
+        paddle.disable_static()
+
+
+def test_custom_op_registration():
+    from paddle_tpu.incubate import custom_op
+
+    def triple(x):
+        return 3.0 * x
+
+    op = custom_op.register_op('triple_t', triple)
+    t = paddle.to_tensor(np.array([2.0], 'float32'))
+    t.stop_gradient = False
+    y = op(t)
+    y.backward()
+    assert float(y.numpy()) == 6.0
+    assert float(t.grad.numpy()) == 3.0
+    assert 'triple_t' in custom_op.list_ops()
+    with pytest.raises(custom_op.CustomOpError):
+        custom_op.register_op('triple_t', triple)
+
+
+def test_custom_op_custom_vjp():
+    from paddle_tpu.incubate import register_op
+
+    def sq2(x):
+        return 2.0 * x * x
+
+    def fwd(x):
+        return sq2(x), (x,)
+
+    def bwd(res, g):
+        return (g * 4.0 * res[0],)
+
+    op = register_op('sq2_t', sq2, vjp_fwd=fwd, vjp_bwd=bwd)
+    t = paddle.to_tensor(np.array([3.0], 'float32'))
+    t.stop_gradient = False
+    y = op(t)
+    y.backward()
+    assert float(y.numpy()) == 18.0
+    assert float(t.grad.numpy()) == 12.0
