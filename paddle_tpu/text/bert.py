@@ -122,11 +122,13 @@ class BertPretrainingHeads(nn.Layer):
 
     def forward(self, sequence_output, pooled_output, masked_positions=None):
         if masked_positions is not None:
-            from ..tensor.manipulation import gather_nd
+            from ..tensor.manipulation import gather_nd, concat
             B, K = masked_positions.shape
             batch_idx = arange(0, B, dtype='int64').unsqueeze(1) \
                 .expand([B, K]).unsqueeze(-1)
-            idx = batch_idx.concat([masked_positions.unsqueeze(-1)], axis=-1)
+            idx = concat([batch_idx,
+                          masked_positions.astype('int64').unsqueeze(-1)],
+                         axis=-1)
             sequence_output = gather_nd(sequence_output, idx)
         h = self.layer_norm(self.activation(self.transform(sequence_output)))
         logits = h.matmul(self.decoder_weight, transpose_y=True) + \
