@@ -69,11 +69,13 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     red = _LAX_REDUCE[op]
 
     def fn(v):
+        if env.axis_bound(axis):
+            return red(v, axis)
         if _in_trace(v):
-            try:
-                return red(v, axis)
-            except NameError:
-                pass
+            raise RuntimeError(
+                f"all_reduce over axis '{axis}' called inside a traced region "
+                f"where that axis is not bound; wrap the step in shard_map "
+                f"over '{axis}' or use shardings + GSPMD instead")
         mesh = env.get_mesh()
         if mesh is None or env.get_world_size(axis) <= 1:
             return v
@@ -100,8 +102,14 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=None):
     ax = axis or _axis(group)
 
     def fn(v):
-        if _in_trace(v):
+        if env.axis_bound(ax):
             return lax.all_gather(v, ax)
+        if _in_trace(v):
+            raise RuntimeError(
+                f"all_gather over unbound axis '{ax}' inside a traced region")
+        # eager single-controller: every "rank" holds the same global value,
+        # so the gathered list is n copies (matches reference semantics where
+        # each rank contributes its tensor).
         n = env.get_world_size(ax)
         return jnp.stack([v] * max(n, 1))
     out = apply_op(fn, (t,))
@@ -135,8 +143,12 @@ def reduce_scatter(output, input, op=ReduceOp.SUM, group=None, axis=None):
     ax = axis or _axis(group)
 
     def fn(v):
-        if _in_trace(v):
+        if env.axis_bound(ax):
             return lax.psum_scatter(v, ax, tiled=True)
+        if _in_trace(v):
+            raise RuntimeError(
+                f"reduce_scatter over unbound axis '{ax}' inside a traced "
+                f"region; wrap in shard_map over '{ax}'")
         return v
     out = apply_op(fn, (t,))
     if output is not None and isinstance(output, Tensor):
@@ -152,8 +164,12 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, axis=None):
     stacked = stack(ts, axis=0)
 
     def fn(v):
-        if _in_trace(v):
+        if env.axis_bound(ax):
             return lax.all_to_all(v, ax, split_axis=0, concat_axis=0)
+        if _in_trace(v):
+            raise RuntimeError(
+                f"alltoall over unbound axis '{ax}' inside a traced region; "
+                f"wrap in shard_map over '{ax}'")
         return v
     out = apply_op(fn, (stacked,))
     outs = unstack(out, axis=0)

@@ -80,6 +80,45 @@ def get_rank(axis=None):
     return jax.process_index() if axis is None else 0
 
 
+def _make_axis_bound():
+    """Feature-detect the axis-env probe once; jax keeps this machinery under
+    jax._src and has renamed it across releases, so degrade to a lax-probe
+    fallback instead of hard-failing the whole distributed package."""
+    try:
+        import jax._src.core as _jcore
+        _jcore.get_axis_env().axis_exists  # probe the API shape now
+
+        def _bound(name):
+            return _jcore.get_axis_env().axis_exists(name)
+        return _bound
+    except (ImportError, AttributeError):
+        from jax import lax as _lax
+
+        def _bound(name):
+            try:
+                _lax.axis_index(name)
+                return True
+            except Exception:
+                return False
+        return _bound
+
+
+_axis_bound_impl = _make_axis_bound()
+
+
+def axis_bound(name):
+    """True iff `name` is a bound SPMD axis in the current trace context.
+
+    Bound means we are inside shard_map (or pmap) over that axis, so per-shard
+    values are local and explicit lax collectives are required AND legal.
+    Unbound while tracing (plain jit/pjit) means values carry global semantics
+    and GSPMD inserts any collectives implied by shardings — issuing a manual
+    psum there would double-count, and jax raises NameError. This makes the
+    mode decision explicit instead of relying on try/except around lax calls.
+    """
+    return _axis_bound_impl(name)
+
+
 def current_data_axis():
     """Inside shard_map/pjit-traced code, the active data-parallel axis name."""
     return getattr(_state, 'data_axis', None)

