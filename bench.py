@@ -1,47 +1,61 @@
 """Headline benchmark: BERT-large pretrain train-step throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 The full train step (forward + backward + AdamW update) is compiled to a
-single XLA computation; compute runs in bfloat16 (TPU MXU-native) with fp32
-master weights, matching the reference's AMP fp16 + loss-scaling setup
-(BASELINE.json: BERT pretraining, Fleet c_allreduce path) without needing a
-scaler. Baseline: A100-class reference throughput for BERT-large seq128
-pretraining, samples/sec per accelerator.
+single XLA computation and runs in TRAIN mode (hidden + attention dropout
+active, as the reference pretrains); compute is bfloat16 (TPU MXU-native)
+with fp32 master weights, matching the reference's AMP fp16 + loss-scaling
+setup (BASELINE.json: BERT pretraining, Fleet c_allreduce path) without
+needing a scaler. At seq 512 (pretraining phase 2) attention dominates and
+dispatches the Pallas flash kernels (kernels/flash_attention.py), including
+in-kernel attention-probability dropout.
+
+Headline metric: phase-1 seq128 samples/sec vs the A100-class baseline in
+BASELINE.json; the phase-2 seq512 number is reported in "extras".
 """
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 
-BASELINE_SAMPLES_PER_SEC = 250.0  # A100-class BERT-large seq128 per-chip ref
+def _published_baseline(name, fallback):
+    """Single source of truth: BASELINE.json 'published' (with provenance)."""
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            'BASELINE.json')
+        with open(path) as f:
+            return float(json.load(f)['published'][name]['value'])
+    except Exception:
+        return fallback
 
 
-def main():
+BASELINE_SAMPLES_PER_SEC = _published_baseline(
+    'bert_large_seq128_samples_per_sec_per_chip', 250.0)
+BASELINE_SEQ512_SPS = _published_baseline(
+    'bert_large_seq512_samples_per_sec_per_chip', 80.0)
+
+
+def bench_bert(cfg_kwargs, batch, seq, steps, warmup, train_mode=True):
     import jax
     import jax.numpy as jnp
 
+    import paddle_tpu as paddle
     from paddle_tpu.nn.layer_base import functional_call, param_values
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.text.bert import BertConfig, BertForPretraining
     from paddle_tpu import optimizer as opt_mod
 
-    on_accel = jax.default_backend() not in ('cpu',)
-    if on_accel:
-        cfg = BertConfig(vocab_size=30522, hidden_size=1024,
-                         num_hidden_layers=24, num_attention_heads=16,
-                         intermediate_size=4096, max_position_embeddings=512)
-        batch, seq, steps, warmup = 64, 128, 10, 2  # B=64: best MFU on v5e
-    else:  # local smoke mode: same code path, tiny shapes
-        cfg = BertConfig(vocab_size=1024, hidden_size=128,
-                         num_hidden_layers=2, num_attention_heads=4,
-                         intermediate_size=256, max_position_embeddings=128)
-        batch, seq, steps, warmup = 8, 64, 3, 1
-
+    paddle.seed(0)
+    cfg = BertConfig(**cfg_kwargs)
     net = BertForPretraining(cfg)
-    net.eval()  # dropout off: benchmark the deterministic hot path
+    if train_mode:
+        net.train()   # dropout on: benchmark the real pretraining step
+    else:
+        net.eval()
     params = param_values(net, trainable_only=False)
     opt = opt_mod.AdamW(learning_rate=1e-4, weight_decay=0.01)
     opt_state = opt.init_state_values(params)
@@ -96,16 +110,44 @@ def main():
                                          mlm_labels, nsp_labels)
     float(loss)
     dt = time.perf_counter() - t0
+    return batch * steps / dt
 
-    sps = batch * steps / dt
-    metric = ("bert_large_pretrain_samples_per_sec_per_chip" if on_accel
-              else "bert_smoke_cpu_samples_per_sec")
-    print(json.dumps({
-        "metric": metric,
-        "value": round(sps, 2),
-        "unit": "samples/sec",
-        "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
-    }))
+
+def main():
+    import jax
+
+    on_accel = jax.default_backend() not in ('cpu',)
+    if on_accel:
+        large = dict(vocab_size=30522, hidden_size=1024,
+                     num_hidden_layers=24, num_attention_heads=16,
+                     intermediate_size=4096, max_position_embeddings=512)
+        # phase 1: seq128 (headline, comparable to BASELINE.json)
+        sps128 = bench_bert(large, batch=64, seq=128, steps=10, warmup=2)
+        # phase 2: seq512 — attention-dominated, Pallas flash path
+        sps512 = bench_bert(large, batch=16, seq=512, steps=10, warmup=2)
+        print(json.dumps({
+            "metric": "bert_large_pretrain_samples_per_sec_per_chip",
+            "value": round(sps128, 2),
+            "unit": "samples/sec",
+            "vs_baseline": round(sps128 / BASELINE_SAMPLES_PER_SEC, 4),
+            "mode": "train (hidden+attention dropout on)",
+            "extras": {
+                "seq512_samples_per_sec": round(sps512, 2),
+                "seq512_vs_baseline": round(sps512 / BASELINE_SEQ512_SPS, 4),
+                "seq512_baseline": BASELINE_SEQ512_SPS,
+            },
+        }))
+    else:  # local smoke mode: same code path, tiny shapes
+        tiny = dict(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=256,
+                    max_position_embeddings=128)
+        sps = bench_bert(tiny, batch=8, seq=64, steps=3, warmup=1)
+        print(json.dumps({
+            "metric": "bert_smoke_cpu_samples_per_sec",
+            "value": round(sps, 2),
+            "unit": "samples/sec",
+            "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
+        }))
 
 
 if __name__ == '__main__':

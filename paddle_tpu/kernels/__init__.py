@@ -1,5 +1,14 @@
-"""Pallas TPU kernels for hot ops (flash attention, fused norms).
+"""Pallas TPU kernels for hot ops.
+
+Implemented here (each with interpret-mode CPU tests):
+- flash_attention: forward + backward kernels, causal/non-causal, key-padding
+  bias, in-kernel PRNG attention dropout (kernels/flash_attention.py);
+- fused layer norm / rms norm forward kernels with closed-form backward
+  (kernels/fused_norm.py).
 
 These replace the reference's hand-written CUDA/cuDNN kernels
-(paddle/fluid/operators/*.cu) with TPU-native Pallas implementations.
+(paddle/fluid/operators/fused/*attention*, layer_norm_op.cu) with TPU-native
+Pallas implementations.
 """
+from .flash_attention import flash_attention_bhld  # noqa: F401
+from .fused_norm import fused_layer_norm, fused_rms_norm  # noqa: F401

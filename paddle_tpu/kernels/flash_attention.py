@@ -1,12 +1,31 @@
-"""Flash attention: Pallas TPU kernel (forward) + recompute backward.
+"""Flash attention: Pallas TPU kernels, forward AND backward, with dropout.
 
 Replaces the reference's fused attention CUDA path
 (paddle/fluid/operators/fused/*attention*). Online-softmax tiling keeps the
-(L, L) score matrix out of HBM: Q tiles stay resident in VMEM while K/V tiles
-stream through, which is the whole trick on a bandwidth-bound chip.
+(L, L) score matrix out of HBM in both directions: the forward streams K/V
+tiles against resident Q tiles and saves only O and the per-row logsumexp;
+the backward recomputes probability tiles from (q, k, lse) on the fly inside
+two kernels (dQ: grid over Q tiles; dK/dV: grid over K tiles), so no (L, L)
+matrix is ever materialized.
 
-Backward uses rematerialized plain-XLA attention (flash backward kernel is a
-planned optimization) via jax.custom_vjp.
+Features:
+- causal and non-causal attention;
+- additive key-padding bias of shape (B, Lk) — the form BERT's (B, 1, 1, L)
+  padding mask reduces to;
+- attention-probability dropout INSIDE the kernel: the keep-mask for tile
+  (bh, q_block, k_block) is regenerated from the TPU hardware PRNG
+  (pltpu.prng_seed keyed on the tile coordinates) identically in the forward
+  and both backward kernels, so no (L, L) mask is stored.
+
+The non-dropout kernels accept interpret=True so their numerics are testable
+on the CPU backend (tests/test_flash_attention.py); the interpret emulation of
+prng_random_bits is a zero-stub, so the dropout path is validated on real TPU
+hardware (tests marked tpu-only + finite-difference check in
+tests/test_flash_attention.py::test_flash_dropout_*).
+
+On non-TPU backends the public entry point falls back to plain-XLA attention
+with identical semantics (dropout there uses jax.random — same distribution,
+different stream).
 """
 import functools
 import math
@@ -22,114 +41,380 @@ except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
 NEG_INF = -1e30
+LSE_EMPTY = 1e30  # lse sentinel for fully-masked rows: exp(s - BIG) == 0
 
 
-def _attn_reference(q, k, v, causal, scale):
-    """Plain XLA attention on (B, H, L, D) — used for backward + fallback."""
+def _attn_reference(q, k, v, causal, scale, kpad_bias=None, dropout_p=0.0,
+                    dropout_key=None):
+    """Plain XLA attention on (B, H, L, D) — fallback + ground truth.
+
+    kpad_bias: optional (B, Lk) additive bias (0 for keep, large negative for
+    masked keys).
+    """
     scores = jnp.einsum('bhld,bhmd->bhlm', q, k) * scale
+    if kpad_bias is not None:
+        scores = scores + kpad_bias[:, None, None, :].astype(scores.dtype)
     if causal:
         L, M = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((L, M), dtype=bool))
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros_like(probs))
     return jnp.einsum('bhlm,bhmd->bhld', probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len, causal, scale):
-    """Grid: (batch*heads, q_blocks). One Q tile vs streamed K/V tiles."""
-    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, d)
-    block_q = q.shape[0]
-    q_idx = pl.program_id(1)
-    q_offset = q_idx * block_q
+def _score_tile(q_scaled, k_tile, bias_tile, causal, q_offset, k_offset):
+    """(block_q, block_k) scores for one tile pair, masked."""
+    s = jnp.dot(q_scaled, k_tile.T, preferred_element_type=jnp.float32)
+    if bias_tile is not None:
+        s = s + bias_tile
+    if causal:
+        bq, bk = s.shape
+        rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    return s
 
-    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)   # running max
-    l = jnp.zeros((block_q, 1), jnp.float32)           # running denom
+
+def _tile_keep_scale(seed_ref, tile_id, shape, dropout_p):
+    """Regenerate the dropout keep/(1-p) mask for one tile — identical across
+    forward and backward because the PRNG is re-seeded from the absolute tile
+    id (a unique function of bh, q_block, k_block) every time. Mosaic caps
+    prng_seed at 2 values, so the coordinates are pre-folded into tile_id."""
+    pltpu.prng_seed(seed_ref[0, 0], tile_id)
+    bits = pltpu.prng_random_bits(shape)
+    u = jax.lax.bitcast_convert_type(bits, jnp.uint32)
+    thresh = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    keep = u >= thresh
+    return keep.astype(jnp.float32) / (1.0 - dropout_p)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(*refs, block_k, seq_len, causal, scale, has_bias, dropout_p):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    idx = 3
+    bias_ref = seed_ref = None
+    if has_bias:
+        bias_ref = refs[idx]; idx += 1
+    if dropout_p > 0.0:
+        seed_ref = refs[idx]; idx += 1
+    o_ref, lse_ref = refs[idx:idx + 2]
+
+    q = q_ref[0].astype(jnp.float32) * scale           # (block_q, d)
+    block_q = q.shape[0]
+    q_blk = pl.program_id(1)
+    q_offset = q_blk * block_q
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
     acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
 
-    num_k_blocks = seq_len // block_k
     if causal:
-        # only iterate K blocks that intersect the causal triangle
-        num_k_blocks_needed = (q_offset + block_q + block_k - 1) // block_k
+        n_blocks = (q_offset + block_q + block_k - 1) // block_k
     else:
-        num_k_blocks_needed = num_k_blocks
+        n_blocks = seq_len // block_k
 
     def body(i, carry):
         m_i, l_i, acc_i = carry
-        k_tile = pl.load(k_ref, (0, pl.dslice(i * block_k, block_k),
-                                 pl.dslice(None))).astype(jnp.float32)
-        v_tile = pl.load(v_ref, (0, pl.dslice(i * block_k, block_k),
-                                 pl.dslice(None))).astype(jnp.float32)
-        s = jnp.dot(q, k_tile.T, preferred_element_type=jnp.float32)
-        if causal:
-            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (block_q, block_k), 0)
-            cols = i * block_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                          (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        k_tile = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        bias_tile = None
+        if bias_ref is not None:
+            bias_tile = bias_ref[0, :, pl.dslice(i * block_k, block_k)
+                                 ].astype(jnp.float32)      # (1, block_k)
+        s = _score_tile(q, k_tile, bias_tile, causal, q_offset, i * block_k)
         m_new = jnp.maximum(m_i, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_i - m_new)
+        # l accumulates UNdropped p: dropout applies to the normalized probs,
+        # and the final o = acc / l realizes drop(softmax(s)) @ v exactly.
         l_new = l_i * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc_i * corr + jnp.dot(p, v_tile,
+        p_acc = p
+        if dropout_p > 0.0:
+            nq, nk = seq_len // block_q, seq_len // block_k
+            tile_id = (pl.program_id(0) * nq + q_blk) * nk + i
+            p_acc = p * _tile_keep_scale(seed_ref, tile_id, p.shape,
+                                         dropout_p)
+        acc_new = acc_i * corr + jnp.dot(p_acc, v_tile,
                                          preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_k_blocks_needed, body, (m, l, acc))
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), LSE_EMPTY)
+    lse_ref[0] = lse.astype(jnp.float32)                # (block_q, 1)
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k):
+def _flash_forward(q, k, v, kpad_bias, seed, causal, scale, block_q, block_k,
+                   dropout_p, interpret):
     b, h, L, d = q.shape
-    bq = min(block_q, L)
-    bk = min(block_k, L)
-    if L % bq or L % bk:
-        return _attn_reference(q, k, v, causal, scale)
-    q3 = q.reshape(b * h, L, d)
-    k3 = k.reshape(b * h, L, d)
-    v3 = v.reshape(b * h, L, d)
-    kernel = functools.partial(_flash_kernel, block_k=bk, seq_len=L,
-                               causal=causal, scale=scale)
-    out = pl.pallas_call(
+    bq, bk = min(block_q, L), min(block_k, L)
+    q3, k3, v3 = (t.reshape(b * h, L, d) for t in (q, k, v))
+    has_bias = kpad_bias is not None
+    kernel = functools.partial(_fwd_kernel, block_k=bk, seq_len=L,
+                               causal=causal, scale=scale, has_bias=has_bias,
+                               dropout_p=dropout_p)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, L, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, L, d), lambda bh, i: (bh, 0, 0)),
+    ]
+    args = [q3, k3, v3]
+    if has_bias:
+        # (B, 1, L) so the block shape (1, 1, L) satisfies TPU tiling rules
+        in_specs.append(
+            pl.BlockSpec((1, 1, L), lambda bh, i, h=h: (bh // h, 0, 0)))
+        args.append(kpad_bias.astype(jnp.float32)[:, None, :])
+    if dropout_p > 0.0:
+        in_specs.append(pl.BlockSpec((1, 1), lambda bh, i: (0, 0)))
+        args.append(seed)
+    o, lse = pl.pallas_call(
         kernel,
         grid=(b * h, L // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, L, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, L, d), lambda bh, i: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        in_specs=in_specs,
+        out_specs=(pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+                   pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0))),
+        out_shape=(jax.ShapeDtypeStruct((b * h, L, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, L, 1), jnp.float32)),
+        interpret=interpret,
+    )(*args)
+    return o.reshape(b, h, L, d), lse.reshape(b, h, L)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(*refs, block_k, seq_len, causal, scale, has_bias, dropout_p):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    idx = 6
+    bias_ref = seed_ref = None
+    if has_bias:
+        bias_ref = refs[idx]; idx += 1
+    if dropout_p > 0.0:
+        seed_ref = refs[idx]; idx += 1
+    dq_ref = refs[idx]
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)                  # (block_q, d)
+    lse = lse_ref[0].astype(jnp.float32)                # (block_q, 1)
+    delta = delta_ref[0].astype(jnp.float32)            # (block_q, 1)
+    block_q = q.shape[0]
+    q_blk = pl.program_id(1)
+    q_offset = q_blk * block_q
+
+    if causal:
+        n_blocks = (q_offset + block_q + block_k - 1) // block_k
+    else:
+        n_blocks = seq_len // block_k
+
+    def body(i, dq_acc):
+        k_tile = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
+        bias_tile = None
+        if bias_ref is not None:
+            bias_tile = bias_ref[0, :, pl.dslice(i * block_k, block_k)
+                                 ].astype(jnp.float32)      # (1, block_k)
+        s = _score_tile(q, k_tile, bias_tile, causal, q_offset, i * block_k)
+        p = jnp.exp(s - lse)                            # (block_q, block_k)
+        dp = jnp.dot(do, v_tile.T, preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            nq, nk = seq_len // block_q, seq_len // block_k
+            tile_id = (pl.program_id(0) * nq + q_blk) * nk + i
+            dp = dp * _tile_keep_scale(seed_ref, tile_id, dp.shape,
+                                       dropout_p)
+        ds = p * (dp - delta)
+        return dq_acc + jnp.dot(ds, k_tile, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, n_blocks, body, jnp.zeros((block_q, q.shape[1]), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(*refs, block_q, seq_len, causal, scale, has_bias, dropout_p):
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    idx = 6
+    bias_ref = seed_ref = None
+    if has_bias:
+        bias_ref = refs[idx]; idx += 1
+    if dropout_p > 0.0:
+        seed_ref = refs[idx]; idx += 1
+    dk_ref, dv_ref = refs[idx:idx + 2]
+
+    k = k_ref[0].astype(jnp.float32)                    # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
+    block_k = k.shape[0]
+    k_blk = pl.program_id(1)
+    k_offset = k_blk * block_k
+    bias_tile = None
+    if bias_ref is not None:
+        bias_tile = bias_ref[0].astype(jnp.float32)     # (1, block_k)
+
+    n_q_blocks = seq_len // block_q
+    start = (k_offset // block_q) if causal else 0
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_tile = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32) * scale
+        do_tile = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * block_q, block_q), :
+                      ].astype(jnp.float32)             # (block_q, 1)
+        delta = delta_ref[0, pl.dslice(i * block_q, block_q), :
+                          ].astype(jnp.float32)         # (block_q, 1)
+        s = _score_tile(q_tile, k, bias_tile, causal, i * block_q, k_offset)
+        p = jnp.exp(s - lse)                            # (block_q, block_k)
+        p_drop = p
+        dp = jnp.dot(do_tile, v.T, preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            nq, nk = seq_len // block_q, seq_len // block_k
+            tile_id = (pl.program_id(0) * nq + i) * nk + k_blk
+            keep_scale = _tile_keep_scale(seed_ref, tile_id, p.shape,
+                                          dropout_p)
+            p_drop = p * keep_scale
+            dp = dp * keep_scale
+        dv_acc = dv_acc + jnp.dot(p_drop.T, do_tile,
+                                  preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc = dk_acc + jnp.dot(ds.T, q_tile,
+                                  preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    zero = jnp.zeros((block_k, k.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_q_blocks, body, (zero, zero))
+    # q_tile already carried `scale`, so dk = scale * ds^T q_raw
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, kpad_bias, seed, g, causal, scale,
+                    block_q, block_k, dropout_p, interpret):
+    b, h, L, d = q.shape
+    bq, bk = min(block_q, L), min(block_k, L)
+    q3, k3, v3, o3, g3 = (t.reshape(b * h, L, d) for t in (q, k, v, o, g))
+    lse3 = lse.reshape(b * h, L, 1)
+    delta = jnp.sum(g3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)             # (BH, L, 1)
+    has_bias = kpad_bias is not None
+    extra_args = []
+    if has_bias:
+        extra_args.append(kpad_bias.astype(jnp.float32)[:, None, :])  # (B,1,L)
+    if dropout_p > 0.0:
+        extra_args.append(seed)
+
+    tile_qd = pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0))
+    tile_q1 = pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0))
+    full_ld = pl.BlockSpec((1, L, d), lambda bh, i: (bh, 0, 0))
+    full_l1 = pl.BlockSpec((1, L, 1), lambda bh, i: (bh, 0, 0))
+    bias_full = pl.BlockSpec((1, 1, L), lambda bh, i, h=h: (bh // h, 0, 0))
+    seed_spec = pl.BlockSpec((1, 1), lambda bh, i: (0, 0))
+
+    dq_in = [tile_qd, full_ld, full_ld, tile_qd, tile_q1, tile_q1]
+    if has_bias:
+        dq_in.append(bias_full)
+    if dropout_p > 0.0:
+        dq_in.append(seed_spec)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=bk, seq_len=L, causal=causal,
+                          scale=scale, has_bias=has_bias, dropout_p=dropout_p),
+        grid=(b * h, L // bq),
+        in_specs=dq_in,
+        out_specs=tile_qd,
         out_shape=jax.ShapeDtypeStruct((b * h, L, d), q.dtype),
-    )(q3, k3, v3)
-    return out.reshape(b, h, L, d)
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse3, delta, *extra_args)
+
+    tile_kd = pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0))
+    bias_tile = pl.BlockSpec((1, 1, bk), lambda bh, j, h=h: (bh // h, 0, j))
+    dkv_in = [full_ld, tile_kd, tile_kd, full_ld, full_l1, full_l1]
+    if has_bias:
+        dkv_in.append(bias_tile)
+    if dropout_p > 0.0:
+        dkv_in.append(seed_spec)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=bq, seq_len=L, causal=causal,
+                          scale=scale, has_bias=has_bias, dropout_p=dropout_p),
+        grid=(b * h, L // bk),
+        in_specs=dkv_in,
+        out_specs=(tile_kd, tile_kd),
+        out_shape=(jax.ShapeDtypeStruct((b * h, L, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, L, d), v.dtype)),
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse3, delta, *extra_args)
+
+    return (dq.reshape(b, h, L, d), dk.reshape(b, h, L, d),
+            dv.reshape(b, h, L, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_k):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k)
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper + public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, kpad_bias, seed, causal, scale, block_q, block_k,
+           dropout_p, interpret):
+    o, _ = _flash_forward(q, k, v, kpad_bias, seed, causal, scale, block_q,
+                          block_k, dropout_p, interpret)
+    return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k), (q, k, v)
+def _flash_fwd_rule(q, k, v, kpad_bias, seed, causal, scale, block_q, block_k,
+                    dropout_p, interpret):
+    o, lse = _flash_forward(q, k, v, kpad_bias, seed, causal, scale, block_q,
+                            block_k, dropout_p, interpret)
+    return o, (q, k, v, o, lse, kpad_bias, seed)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _attn_reference(a, b, c, causal, scale),
-                     q, k, v)
-    return vjp(g)
+def _flash_bwd_rule(causal, scale, block_q, block_k, dropout_p, interpret,
+                    res, g):
+    q, k, v, o, lse, kpad_bias, seed = res
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, kpad_bias, seed, g, causal,
+                                 scale, block_q, block_k, dropout_p, interpret)
+    return dq, dk, dv, None, None
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention_bhld(q, k, v, causal=False, scale=None,
-                         block_q=512, block_k=512):
-    """q/k/v: (B, H, L, D). Returns (B, H, L, D)."""
+def flash_attention_bhld(q, k, v, causal=False, scale=None, kpad_bias=None,
+                         dropout_p=0.0, dropout_seed=None,
+                         block_q=512, block_k=512, interpret=False):
+    """Flash attention on (B, H, L, D) tensors.
+
+    kpad_bias: optional (B, Lk) additive key-padding bias (0 = keep, -1e4/-inf
+    style = masked). dropout_p: attention-probability dropout rate; when > 0,
+    dropout_seed must be an int32 array of shape (1, 1) (the keep-mask is a
+    deterministic function of it). Falls back to plain-XLA attention when
+    Pallas is unavailable (non-TPU backend and interpret=False) or L doesn't
+    tile.
+    """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    if jax.default_backend() != 'tpu' or not _HAS_PLTPU:
-        return _attn_reference(q, k, v, causal, scale)
-    try:
-        return _flash(q, k, v, causal, scale, block_q, block_k)
-    except Exception:
-        return _attn_reference(q, k, v, causal, scale)
+    L = q.shape[2]
+    dropout_p = float(dropout_p)
+    usable = (_HAS_PLTPU and (interpret is not False
+                              or jax.default_backend() == 'tpu')
+              and k.shape[2] == L
+              and L % min(block_q, L) == 0 and L % min(block_k, L) == 0)
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    if not usable:
+        key = None
+        if dropout_p > 0.0:
+            key = jax.random.PRNGKey(0)
+            key = jax.random.fold_in(key, dropout_seed.reshape(())
+                                     .astype(jnp.uint32))
+        return _attn_reference(q, k, v, causal, scale, kpad_bias,
+                               dropout_p, key)
+    seed = (dropout_seed if dropout_seed is not None
+            else jnp.zeros((1, 1), jnp.int32))
+    return _flash(q, k, v, kpad_bias, seed, causal, scale, block_q, block_k,
+                  dropout_p, interpret)

@@ -4,6 +4,7 @@ batch_norm takes/returns running stats explicitly in functional form so the
 stateful layer can collect updates (see layer_base.functional_call).
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor, apply_op
@@ -108,6 +109,18 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
     has_w = weight is not None
     has_b = bias is not None
 
+    if (n_norm == 1 and jax.default_backend() == 'tpu'
+            and x.shape[-1] % 128 == 0):
+        from ...kernels.fused_norm import fused_layer_norm
+
+        def fused(v, *wb):
+            i = 0
+            w = wb[i] if has_w else None
+            i += has_w
+            b = wb[i] if has_b else None
+            return fused_layer_norm(v, w, b, eps=epsilon)
+        return apply_op(fused, tuple(tensors))
+
     def fn(v, *wb):
         mean = jnp.mean(v, axis=axes, keepdims=True)
         var = jnp.var(v, axis=axes, keepdims=True)
@@ -126,6 +139,13 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (modern LLM stacks; pallas-fused variant in kernels/)."""
     x = _t(x)
     tensors = [x] + ([_t(weight)] if weight is not None else [])
+    if jax.default_backend() == 'tpu' and x.shape[-1] % 128 == 0:
+        from ...kernels.fused_norm import fused_rms_norm
+
+        def fused(v, *w):
+            return fused_rms_norm(v, w[0] if w else None, eps=epsilon)
+        return apply_op(fused, tuple(tensors))
+
     def fn(v, *w):
         ms = jnp.mean(v * v, axis=-1, keepdims=True)
         out = v / jnp.sqrt(ms + epsilon)

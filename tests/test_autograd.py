@@ -110,3 +110,26 @@ def test_backward_matmul_matches_finite_diff():
     am = a_np.copy(); am[i, j] -= eps
     fd = ((ap @ b_np).sum() - (am @ b_np).sum()) / (2 * eps)
     assert abs(a.grad.numpy()[i, j] - fd) < 1e-2
+
+
+def test_rng_next_key_no_tracer_leak_under_trace():
+    """Drawing dropout keys inside a traced region must not poison the
+    global generator state for later (eager or traced) calls."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core.tensor import Tensor
+
+    x = jnp.ones((4, 8), jnp.float32)
+
+    def f(v):
+        return F.dropout(Tensor(v), p=0.5, training=True)._value
+
+    jax.make_jaxpr(f)(x)          # trace once: keys drawn inside the trace
+    out = jax.jit(f)(x)           # re-trace + run: must not see leaked tracer
+    assert np.isfinite(np.asarray(out)).all()
+    eager = F.dropout(paddle.to_tensor(np.ones((4, 8), np.float32)),
+                      p=0.5, training=True)
+    assert np.isfinite(eager.numpy()).all()

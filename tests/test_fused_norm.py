@@ -1,0 +1,93 @@
+"""Fused layer/rms norm Pallas kernels vs XLA reference (interpret mode)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.kernels.fused_norm import fused_layer_norm, fused_rms_norm
+
+N, D = 48, 256
+
+
+def _x(seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(N, D) * 2 + 0.5,
+                       jnp.float32)
+
+
+def _ref_ln(x, w, b, eps=1e-5):
+    mean = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    if w is not None:
+        y = y * w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def _ref_rms(x, w, eps=1e-6):
+    y = x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + eps)
+    return y * w if w is not None else y
+
+
+@pytest.mark.parametrize("affine", [True, False])
+def test_fused_layer_norm_forward(affine):
+    x = _x()
+    w = jnp.asarray(np.random.RandomState(1).rand(D), jnp.float32) if affine else None
+    b = jnp.asarray(np.random.RandomState(2).randn(D), jnp.float32) if affine else None
+    out = fused_layer_norm(x, w, b, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref_ln(x, w, b)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_layer_norm_backward():
+    x = _x(3)
+    w = jnp.asarray(np.random.RandomState(4).rand(D) + 0.5, jnp.float32)
+    b = jnp.asarray(np.random.RandomState(5).randn(D), jnp.float32)
+
+    def loss_fused(x, w, b):
+        return jnp.sum(fused_layer_norm(x, w, b, interpret=True) ** 2)
+
+    def loss_ref(x, w, b):
+        return jnp.sum(_ref_ln(x, w, b) ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+    for a, r, n in zip(gf, gr, ['dx', 'dw', 'db']):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-4, atol=2e-4, err_msg=n)
+
+
+def test_fused_layer_norm_3d_shape():
+    x = jnp.asarray(np.random.RandomState(6).randn(4, 12, D), jnp.float32)
+    out = fused_layer_norm(x, None, None, interpret=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref_ln(x, None, None)),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("affine", [True, False])
+def test_fused_rms_norm_forward_backward(affine):
+    x = _x(7)
+    w = jnp.asarray(np.random.RandomState(8).rand(D) + 0.5, jnp.float32) if affine else None
+
+    out = fused_rms_norm(x, w, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref_rms(x, w)),
+                               rtol=1e-5, atol=1e-5)
+
+    argnums = (0, 1) if affine else (0,)
+
+    def loss_fused(*args):
+        return jnp.sum(fused_rms_norm(args[0], args[1] if affine else None,
+                                      interpret=True) ** 3)
+
+    def loss_ref(*args):
+        return jnp.sum(_ref_rms(args[0], args[1] if affine else None) ** 3)
+
+    args = (x, w) if affine else (x,)
+    gf = jax.grad(loss_fused, argnums=argnums)(*args)
+    gr = jax.grad(loss_ref, argnums=argnums)(*args)
+    for a, r in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-4, atol=2e-4)
