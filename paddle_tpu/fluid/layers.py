@@ -125,3 +125,10 @@ def switch_case(branch_index, branch_fns, default=None):
     if default is not None:
         return default()
     raise ValueError(f"no branch {idx}")
+
+
+# detection ops (parity: fluid/layers/detection.py) live in vision.ops,
+# re-exported here under the reference's fluid.layers namespace
+from ..vision.ops import (iou_similarity, box_coder, prior_box,  # noqa: E402,F401
+                          density_prior_box, anchor_generator, yolo_box,
+                          multiclass_nms, roi_align, box_clip, nms)

@@ -1,0 +1,515 @@
+"""Detection / box ops, TPU-first.
+
+Parity: python/paddle/fluid/layers/detection.py — iou_similarity (:763),
+box_coder (:817), yolo_box (:1133), prior_box (:1768), density_prior_box
+(:1930), anchor_generator (:2403), multiclass_nms (:3257), box_clip (:3037);
+and paddle/fluid/operators/roi_align_op.* for roi_align.
+
+TPU-first redesign: every op returns FIXED-shape dense tensors (XLA static
+shapes) — variable-length results (NMS keep lists) become padded top-k arrays
+plus a valid-count, instead of the reference's LoD outputs. All ops are pure
+jax under the hood and jit/grad-compatible where meaningful.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..tensor._helpers import _t
+
+__all__ = ['iou_similarity', 'box_coder', 'prior_box', 'density_prior_box',
+           'anchor_generator', 'yolo_box', 'multiclass_nms', 'roi_align',
+           'box_clip', 'nms']
+
+
+# ---------------------------------------------------------------------------
+# IoU / box coding
+# ---------------------------------------------------------------------------
+
+def _pairwise_iou(x, y, box_normalized=True):
+    """x: (N, 4), y: (M, 4) xyxy -> (N, M) IoU."""
+    off = 0.0 if box_normalized else 1.0
+    ax1, ay1, ax2, ay2 = [x[:, i] for i in range(4)]
+    bx1, by1, bx2, by2 = [y[:, i] for i in range(4)]
+    area_x = (ax2 - ax1 + off) * (ay2 - ay1 + off)
+    area_y = (bx2 - bx1 + off) * (by2 - by1 + off)
+    ix1 = jnp.maximum(ax1[:, None], bx1[None, :])
+    iy1 = jnp.maximum(ay1[:, None], by1[None, :])
+    ix2 = jnp.minimum(ax2[:, None], bx2[None, :])
+    iy2 = jnp.minimum(ay2[:, None], by2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU of (N, 4) vs (M, 4) xyxy boxes -> (N, M).
+
+    Parity: fluid.layers.iou_similarity (detection.py:763).
+    """
+    return apply_op(
+        lambda a, b: _pairwise_iou(a, b, box_normalized), (_t(x), _t(y)))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    """Encode/decode target boxes against priors.
+
+    Parity: fluid.layers.box_coder (detection.py:817). encode: target (N, 4),
+    prior (M, 4) -> (N, M, 4). decode: target (N, M, 4), prior (N|M, 4)
+    broadcast along `axis` -> (N, M, 4).
+    prior_box_var: None | (M, 4) tensor | 4-list.
+    """
+    p = _t(prior_box)
+    t = _t(target_box)
+    var_t = None
+    var_const = None
+    if prior_box_var is not None:
+        if isinstance(prior_box_var, (list, tuple)):
+            var_const = np.asarray(prior_box_var, np.float32)
+        else:
+            var_t = _t(prior_box_var)
+    off = 0.0 if box_normalized else 1.0
+    encode = code_type.lower() in ("encode_center_size", "encode")
+
+    def _centers(b):
+        w = b[..., 2] - b[..., 0] + off
+        h = b[..., 3] - b[..., 1] + off
+        cx = b[..., 0] + 0.5 * w
+        cy = b[..., 1] + 0.5 * h
+        return cx, cy, w, h
+
+    def fn(p, t, *var):
+        if var:
+            v = var[0]
+        elif var_const is not None:
+            v = jnp.asarray(var_const)
+        else:
+            v = None
+        pcx, pcy, pw, ph = _centers(p)            # (M,)
+        if encode:
+            tcx, tcy, tw, th = _centers(t)        # (N,)
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+            oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+            out = jnp.stack([ox, oy, ow, oh], axis=-1)   # (N, M, 4)
+            if v is not None:
+                v = jnp.broadcast_to(v.reshape((1, -1, 4))
+                                     if v.ndim == 2 else v.reshape((1, 1, 4)),
+                                     out.shape)
+                out = out / v
+            return out
+        # decode: t is (N, M, 4) offsets, p broadcasts along axis
+        if axis == 0:
+            pcx, pcy, pw, ph = (a[None, :] for a in (pcx, pcy, pw, ph))
+            if v is not None and v.ndim == 2:
+                v = v[None, :, :]
+        else:
+            pcx, pcy, pw, ph = (a[:, None] for a in (pcx, pcy, pw, ph))
+            if v is not None and v.ndim == 2:
+                v = v[:, None, :]
+        if v is None:
+            v = jnp.ones((1, 1, 4), t.dtype)
+        elif v.ndim == 1:
+            v = v.reshape((1, 1, 4))
+        dcx = v[..., 0] * t[..., 0] * pw + pcx
+        dcy = v[..., 1] * t[..., 1] * ph + pcy
+        dw = jnp.exp(v[..., 2] * t[..., 2]) * pw
+        dh = jnp.exp(v[..., 3] * t[..., 3]) * ph
+        return jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                          dcx + dw / 2 - off, dcy + dh / 2 - off], axis=-1)
+
+    tensors = (p, t) + ((var_t,) if var_t is not None else ())
+    return apply_op(fn, tensors)
+
+
+def box_clip(input, im_info, name=None):
+    """Clip xyxy boxes to image bounds.
+
+    Parity: fluid.layers.box_clip (detection.py:3037). im_info: (B, 3)
+    [h, w, scale]; boxes are clipped to [0, w/scale - 1] x [0, h/scale - 1].
+    """
+    def fn(b, info):
+        im_h = info[..., 0] / info[..., 2] - 1.0
+        im_w = info[..., 1] / info[..., 2] - 1.0
+        while im_h.ndim < b.ndim - 1:
+            im_h = im_h[..., None]
+            im_w = im_w[..., None]
+        x1 = jnp.clip(b[..., 0], 0.0, im_w)
+        y1 = jnp.clip(b[..., 1], 0.0, im_h)
+        x2 = jnp.clip(b[..., 2], 0.0, im_w)
+        y2 = jnp.clip(b[..., 3], 0.0, im_h)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+    return apply_op(fn, (_t(input), _t(im_info)))
+
+
+# ---------------------------------------------------------------------------
+# prior / anchor generation (host-side numpy: shapes + contents are static
+# functions of the feature-map geometry, so they fold into constants)
+# ---------------------------------------------------------------------------
+
+def _expand_list(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes for one feature map.
+
+    Parity: fluid.layers.prior_box (detection.py:1768). input: (B, C, H, W)
+    feature map; image: (B, C, IH, IW). Returns (boxes, variances), each
+    (H, W, num_priors, 4); boxes are normalized xyxy.
+    """
+    fh, fw = _t(input).shape[2], _t(input).shape[3]
+    ih, iw = _t(image).shape[2], _t(image).shape[3]
+    min_sizes = [float(s) for s in _expand_list(min_sizes)]
+    max_sizes = [float(s) for s in _expand_list(max_sizes)] if max_sizes else []
+    ars = [1.0]
+    for ar in _expand_list(aspect_ratios):
+        ar = float(ar)
+        if any(abs(ar - e) < 1e-6 for e in ars):
+            continue
+        ars.append(ar)
+        if flip:
+            ars.append(1.0 / ar)
+
+    step_w = float(steps[0]) if steps[0] else iw / fw
+    step_h = float(steps[1]) if steps[1] else ih / fh
+
+    whs = []  # (w, h) per prior, in pixels
+    for ms in min_sizes:
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                big = math.sqrt(ms * max_sizes[min_sizes.index(ms)])
+                whs.append((big, big))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            if max_sizes:
+                big = math.sqrt(ms * max_sizes[min_sizes.index(ms)])
+                whs.append((big, big))
+    whs = np.asarray(whs, np.float32)            # (P, 2)
+
+    cx = (np.arange(fw, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(fh, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)               # (H, W)
+    boxes = np.empty((fh, fw, len(whs), 4), np.float32)
+    boxes[..., 0] = (cxg[..., None] - whs[None, None, :, 0] / 2) / iw
+    boxes[..., 1] = (cyg[..., None] - whs[None, None, :, 1] / 2) / ih
+    boxes[..., 2] = (cxg[..., None] + whs[None, None, :, 0] / 2) / iw
+    boxes[..., 3] = (cyg[..., None] + whs[None, None, :, 1] / 2) / ih
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    variances = np.broadcast_to(
+        np.asarray(variance, np.float32), boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(variances))
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Densified prior boxes (face-detection SSD variant).
+
+    Parity: fluid.layers.density_prior_box (detection.py:1930). For each
+    (density, fixed_size) pair and each fixed_ratio, lays a density x density
+    grid of shifted centers inside each step cell.
+    """
+    fh, fw = _t(input).shape[2], _t(input).shape[3]
+    ih, iw = _t(image).shape[2], _t(image).shape[3]
+    densities = [int(d) for d in _expand_list(densities)]
+    fixed_sizes = [float(s) for s in _expand_list(fixed_sizes)]
+    fixed_ratios = [float(r) for r in _expand_list(fixed_ratios)]
+    step_w = float(steps[0]) if steps[0] else iw / fw
+    step_h = float(steps[1]) if steps[1] else ih / fh
+
+    all_boxes = []
+    cx = (np.arange(fw, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(fh, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)
+    for density, fs in zip(densities, fixed_sizes):
+        for ratio in fixed_ratios:
+            w = fs * math.sqrt(ratio)
+            h = fs / math.sqrt(ratio)
+            shift_w = step_w / density
+            shift_h = step_h / density
+            for di in range(density):
+                for dj in range(density):
+                    ccx = cxg - step_w / 2. + shift_w / 2. + dj * shift_w
+                    ccy = cyg - step_h / 2. + shift_h / 2. + di * shift_h
+                    all_boxes.append(np.stack([
+                        (ccx - w / 2.) / iw, (ccy - h / 2.) / ih,
+                        (ccx + w / 2.) / iw, (ccy + h / 2.) / ih], axis=-1))
+    boxes = np.stack(all_boxes, axis=2).astype(np.float32)  # (H, W, P, 4)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    variances = np.broadcast_to(
+        np.asarray(variance, np.float32), boxes.shape).copy()
+    if flatten_to_2d:
+        boxes = boxes.reshape(-1, 4)
+        variances = variances.reshape(-1, 4)
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(variances))
+
+
+def anchor_generator(input, anchor_sizes=(64., 128., 256., 512.),
+                     aspect_ratios=(0.5, 1.0, 2.0),
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """RPN anchors for one feature map.
+
+    Parity: fluid.layers.anchor_generator (detection.py:2403). Returns
+    (anchors, variances), each (H, W, num_anchors, 4), anchors in ABSOLUTE
+    xyxy pixels.
+    """
+    fh, fw = _t(input).shape[2], _t(input).shape[3]
+    sizes = [float(s) for s in _expand_list(anchor_sizes)]
+    ars = [float(r) for r in _expand_list(aspect_ratios)]
+    sw, sh = float(stride[0]), float(stride[1])
+
+    # reference recipe (anchor_generator_op.h): snap a stride-area cell to the
+    # aspect ratio, then scale to anchor_size
+    whs = []
+    for ar in ars:
+        for s in sizes:
+            base_w = round(math.sqrt(sw * sh / ar))
+            base_h = round(base_w * ar)
+            whs.append((s / sw * base_w, s / sh * base_h))
+    whs = np.asarray(whs, np.float32)  # (A, 2): (w, h)
+
+    cx = np.arange(fw, dtype=np.float32) * sw + offset * (sw - 1)
+    cy = np.arange(fh, dtype=np.float32) * sh + offset * (sh - 1)
+    cxg, cyg = np.meshgrid(cx, cy)
+    anchors = np.empty((fh, fw, len(whs), 4), np.float32)
+    anchors[..., 0] = cxg[..., None] - 0.5 * (whs[None, None, :, 0] - 1)
+    anchors[..., 1] = cyg[..., None] - 0.5 * (whs[None, None, :, 1] - 1)
+    anchors[..., 2] = cxg[..., None] + 0.5 * (whs[None, None, :, 0] - 1)
+    anchors[..., 3] = cyg[..., None] + 0.5 * (whs[None, None, :, 1] - 1)
+    variances = np.broadcast_to(
+        np.asarray(variance, np.float32), anchors.shape).copy()
+    return Tensor(jnp.asarray(anchors)), Tensor(jnp.asarray(variances))
+
+
+# ---------------------------------------------------------------------------
+# YOLO decode
+# ---------------------------------------------------------------------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode YOLOv3 head output into boxes + per-class scores.
+
+    Parity: fluid.layers.yolo_box (detection.py:1133). x: (B, A*(5+C), H, W);
+    img_size: (B, 2) [h, w]. Returns boxes (B, H*W*A, 4) absolute xyxy and
+    scores (B, H*W*A, C). Low-confidence boxes are zeroed (the reference's
+    conf_thresh gating) so shapes stay static.
+    """
+    anchors = [float(a) for a in anchors]
+    na = len(anchors) // 2
+    cnum = int(class_num)
+
+    def fn(xv, imgs):
+        b, _, h, w = xv.shape
+        xv = xv.reshape(b, na, 5 + cnum, h, w)
+        grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+
+        sig = jax.nn.sigmoid
+        alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+        bx = (sig(xv[:, :, 0]) * alpha + beta + grid_x) / w     # center, norm
+        by = (sig(xv[:, :, 1]) * alpha + beta + grid_y) / h
+        bw = jnp.exp(xv[:, :, 2]) * aw / (w * downsample_ratio)
+        bh = jnp.exp(xv[:, :, 3]) * ah / (h * downsample_ratio)
+        conf = sig(xv[:, :, 4])
+        probs = sig(xv[:, :, 5:]) * conf[:, :, None]            # (B,A,C,H,W)
+
+        im_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        im_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2.) * im_w
+        y1 = (by - bh / 2.) * im_h
+        x2 = (bx + bw / 2.) * im_w
+        y2 = (by + bh / 2.) * im_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0., im_w - 1.)
+            y1 = jnp.clip(y1, 0., im_h - 1.)
+            x2 = jnp.clip(x2, 0., im_w - 1.)
+            y2 = jnp.clip(y2, 0., im_h - 1.)
+        keep = (conf >= conf_thresh).astype(jnp.float32)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=2) * keep[:, :, None]
+        # (B, A, 4, H, W) -> (B, H*W*A, 4): reference emits row-major HW x A
+        boxes = boxes.transpose(0, 3, 4, 1, 2).reshape(b, -1, 4)
+        probs = probs * keep[:, :, None]
+        scores = probs.transpose(0, 3, 4, 1, 2).reshape(b, -1, cnum)
+        return boxes, scores
+
+    return apply_op(fn, (_t(x), _t(img_size)), n_outputs=2)
+
+
+# ---------------------------------------------------------------------------
+# NMS — fixed-shape padded formulation
+# ---------------------------------------------------------------------------
+
+def _nms_single(boxes, scores, iou_threshold, top_k, score_threshold,
+                normalized=True):
+    """boxes (M, 4), scores (M,) -> (keep_idx (top_k,), keep_mask (top_k,)).
+
+    Greedy hard-NMS as an O(top_k) lax loop over a precomputed IoU matrix
+    slice — fixed shapes throughout (TPU-first replacement for the
+    reference's dynamic keep list).
+    """
+    M = boxes.shape[0]
+    k = min(top_k, M)
+    scores = jnp.where(scores > score_threshold, scores, -jnp.inf)
+    order = jnp.argsort(-scores)[:k]             # candidates by score
+    cand_boxes = boxes[order]
+    cand_scores = scores[order]
+    iou = _pairwise_iou(cand_boxes, cand_boxes, normalized)   # (k, k)
+
+    def body(i, alive):
+        # kill every lower-scored candidate overlapping candidate i IF i is
+        # itself still alive
+        kill = (iou[i] > iou_threshold) & (jnp.arange(k) > i) & alive[i]
+        return alive & ~kill
+
+    alive = jnp.isfinite(cand_scores)
+    alive = jax.lax.fori_loop(0, k, body, alive)
+    return order, alive
+
+
+def nms(boxes, scores, iou_threshold=0.3, top_k=64, score_threshold=-1e30,
+        normalized=True):
+    """Single-class NMS: returns (indices, valid_mask) both shaped (top_k,).
+
+    Padded-output TPU formulation; `indices[i]` is only meaningful where
+    `valid_mask[i]`.
+    """
+    def fn(b, s):
+        return _nms_single(b, s, iou_threshold, top_k, score_threshold,
+                           normalized)
+    return apply_op(fn, (_t(boxes), _t(scores)), n_outputs=2)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None,
+                   return_index=False):
+    """Multi-class NMS with FIXED-shape padded output.
+
+    Parity: fluid.layers.multiclass_nms (detection.py:3257), TPU-first:
+    returns `out` of shape (B, keep_top_k, 6) [label, score, x1, y1, x2, y2]
+    padded with -1 rows, plus `valid_counts` (B,) — instead of the
+    reference's LoD tensor. bboxes: (B, M, 4); scores: (B, C, M).
+    """
+    def fn(bb, sc):
+        B, M, _ = bb.shape
+        C = sc.shape[1]
+        k = min(nms_top_k, M)
+
+        def per_image(boxes, scores_cm):
+            outs = []
+            for c in range(C):
+                if c == background_label:
+                    continue
+                order, alive = _nms_single(
+                    boxes, scores_cm[c], nms_threshold, k, score_threshold,
+                    normalized)
+                s = jnp.where(alive, scores_cm[c][order], -jnp.inf)
+                entry = jnp.concatenate([
+                    jnp.full((k, 1), float(c)), s[:, None], boxes[order]],
+                    axis=1)                       # (k, 6)
+                outs.append(entry)
+            allc = jnp.concatenate(outs, axis=0)  # (C'*k, 6)
+            kk = min(keep_top_k, allc.shape[0])
+            top = jnp.argsort(-allc[:, 1])[:kk]
+            sel = allc[top]
+            valid = jnp.isfinite(sel[:, 1])
+            sel = jnp.where(valid[:, None], sel, -1.0)
+            count = jnp.sum(valid.astype(jnp.int32))
+            pad = keep_top_k - kk
+            if pad > 0:
+                sel = jnp.concatenate(
+                    [sel, jnp.full((pad, 6), -1.0, sel.dtype)], axis=0)
+            return sel, count
+
+        sel, counts = jax.vmap(per_image)(bb, sc)
+        return sel, counts
+
+    return apply_op(fn, (_t(bboxes), _t(scores)), n_outputs=2)
+
+
+# ---------------------------------------------------------------------------
+# RoI align
+# ---------------------------------------------------------------------------
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+              sampling_ratio=-1, rois_num=None, name=None):
+    """RoI align (Mask R-CNN) with bilinear sampling.
+
+    Parity: paddle/fluid/operators/roi_align_op.* semantics. input:
+    (B, C, H, W); rois: (R, 4) absolute xyxy in input-image coordinates;
+    rois_num: (B,) boxes per image (defaults to all rois on image 0).
+    Returns (R, C, pooled_height, pooled_width).
+    """
+    x = _t(input)
+    r = _t(rois)
+    B = x.shape[0]
+    if rois_num is None:
+        batch_idx_np = np.zeros((r.shape[0],), np.int32)
+    else:
+        rn = np.asarray(_t(rois_num).numpy(), np.int64)
+        batch_idx_np = np.repeat(np.arange(B), rn).astype(np.int32)
+    batch_idx = jnp.asarray(batch_idx_np)
+    ph, pw = int(pooled_height), int(pooled_width)
+
+    def fn(xv, rv):
+        H, W = xv.shape[2], xv.shape[3]
+
+        def one_roi(roi, bidx):
+            x1, y1, x2, y2 = roi * spatial_scale
+            rw = jnp.maximum(x2 - x1, 1.0)
+            rh = jnp.maximum(y2 - y1, 1.0)
+            bin_w = rw / pw
+            bin_h = rh / ph
+            sr = sampling_ratio if sampling_ratio > 0 else 2
+            # sample grid: (ph*sr, pw*sr) bilinear taps, averaged per bin
+            ys = y1 + (jnp.arange(ph * sr) + 0.5) * (rh / (ph * sr))
+            xs = x1 + (jnp.arange(pw * sr) + 0.5) * (rw / (pw * sr))
+
+            def bilinear(img, yy, xx):           # img (C, H, W)
+                yy = jnp.clip(yy, 0.0, H - 1.0)
+                xx = jnp.clip(xx, 0.0, W - 1.0)
+                y0 = jnp.floor(yy).astype(jnp.int32)
+                x0 = jnp.floor(xx).astype(jnp.int32)
+                y1i = jnp.minimum(y0 + 1, H - 1)
+                x1i = jnp.minimum(x0 + 1, W - 1)
+                wy = yy - y0
+                wx = xx - x0
+                g = lambda yi, xi: img[:, yi, :][:, :, xi]   # (C, Sy, Sx)
+                v = (g(y0, x0) * ((1 - wy)[:, None] * (1 - wx)[None, :])[None]
+                     + g(y0, x1i) * ((1 - wy)[:, None] * wx[None, :])[None]
+                     + g(y1i, x0) * (wy[:, None] * (1 - wx)[None, :])[None]
+                     + g(y1i, x1i) * (wy[:, None] * wx[None, :])[None])
+                return v                          # (C, Sy, Sx)
+
+            img = xv[bidx]
+            samples = bilinear(img, ys, xs)       # (C, ph*sr, pw*sr)
+            C = samples.shape[0]
+            samples = samples.reshape(C, ph, sr, pw, sr)
+            return samples.mean(axis=(2, 4))      # (C, ph, pw)
+
+        return jax.vmap(one_roi)(rv, batch_idx)
+
+    return apply_op(fn, (x, r))
