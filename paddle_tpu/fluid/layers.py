@@ -132,3 +132,9 @@ def switch_case(branch_index, branch_fns, default=None):
 from ..vision.ops import (iou_similarity, box_coder, prior_box,  # noqa: E402,F401
                           density_prior_box, anchor_generator, yolo_box,
                           multiclass_nms, roi_align, box_clip, nms)
+
+# decoding stack (parity: fluid/layers/rnn.py:743-2036)
+from ..nn.decode import (Decoder, BeamSearchDecoder,  # noqa: E402,F401
+                         dynamic_decode, DecodeHelper, TrainingHelper,
+                         GreedyEmbeddingHelper, SampleEmbeddingHelper,
+                         BasicDecoder, beam_search, beam_search_decode)

@@ -38,6 +38,9 @@ from .layer.loss import (CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss,
                          CosineEmbeddingLoss, TripletMarginLoss)
 from .layer.rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN,
                         BiRNN, SimpleRNN, LSTM, GRU)
+from .decode import (Decoder, BeamSearchDecoder, dynamic_decode, DecodeHelper,
+                     TrainingHelper, GreedyEmbeddingHelper,
+                     SampleEmbeddingHelper, BasicDecoder)
 from .layer.transformer import (MultiHeadAttention, TransformerEncoderLayer,
                                 TransformerEncoder, TransformerDecoderLayer,
                                 TransformerDecoder, Transformer)

@@ -1,0 +1,515 @@
+"""Decoding stack: dynamic_decode + BeamSearchDecoder + decode helpers.
+
+Parity: /root/reference/python/paddle/fluid/layers/rnn.py:743 (Decoder),
+:856 (BeamSearchDecoder), :1327 (dynamic_decode), :1557 (DecodeHelper,
+TrainingHelper, GreedyEmbeddingHelper, SampleEmbeddingHelper), :1876
+(BasicDecoder).
+
+TPU-first design: the decode loop runs over PREALLOCATED fixed-shape output
+buffers written with ``lax.dynamic_update_index_in_dim`` — no growing arrays,
+so the whole loop lowers to one ``lax.while_loop`` under jit (static
+``max_step_num`` bound, early exit when every sequence is finished). The same
+code path runs eagerly as a python loop (see fluid.layers.while_loop).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..core import autograd
+
+__all__ = ['Decoder', 'BeamSearchDecoder', 'dynamic_decode', 'DecodeHelper',
+           'TrainingHelper', 'GreedyEmbeddingHelper', 'SampleEmbeddingHelper',
+           'BasicDecoder', 'beam_search', 'beam_search_decode']
+
+_KINF = 1e9
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _map_structure(fn, *structs):
+    """Apply fn over parallel nested list/tuple/dict structures of Tensors."""
+    s0 = structs[0]
+    if isinstance(s0, (list, tuple)):
+        mapped = [_map_structure(fn, *items) for items in zip(*structs)]
+        if hasattr(s0, '_fields'):  # namedtuple
+            return type(s0)(*mapped)
+        return type(s0)(mapped)
+    if isinstance(s0, dict):
+        return {k: _map_structure(fn, *(s[k] for s in structs)) for k in s0}
+    return fn(*structs)
+
+
+def _flatten(struct):
+    out = []
+    if isinstance(struct, (list, tuple)):
+        for s in struct:
+            out.extend(_flatten(s))
+    elif isinstance(struct, dict):
+        for k in sorted(struct):
+            out.extend(_flatten(struct[k]))
+    else:
+        out.append(struct)
+    return out
+
+
+class Decoder:
+    """Abstract decoder interface: initialize / step / finalize.
+
+    Parity: reference rnn.py:743.
+    """
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoder wrapping a cell (parity: reference rnn.py:856).
+
+    The cell's inputs/states are tiled to ``[batch_size * beam_size, ...]``;
+    tensors used inside ``cell.forward`` that are batch-major must be tiled
+    with :meth:`tile_beam_merge_with_batch` by the caller (e.g. attention
+    encoder output).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+
+    # -- shape utilities ----------------------------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B * beam, ...] with each row repeated beam times."""
+        x = _t(x)
+        return apply_op(
+            lambda v: jnp.repeat(v, beam_size, axis=0), (x,))
+
+    def _split_batch_beams(self, x):
+        x = _t(x)
+        W = self.beam_size
+        return apply_op(
+            lambda v: v.reshape((v.shape[0] // W, W) + v.shape[1:]), (x,))
+
+    def _merge_batch_beams(self, x):
+        x = _t(x)
+        return apply_op(
+            lambda v: v.reshape((v.shape[0] * v.shape[1],) + v.shape[2:]),
+            (x,))
+
+    def _expand_to_beam_size(self, x):
+        """[B, ...] -> [B, beam, ...]."""
+        x = _t(x)
+        return apply_op(
+            lambda v: jnp.broadcast_to(
+                v[:, None], (v.shape[0], self.beam_size) + v.shape[1:]), (x,))
+
+    def _gather(self, x, indices):
+        """Gather beams: x [B, W, ...], indices [B, W] -> x[b, indices[b, w]]."""
+        def fn(v, idx):
+            ii = idx.reshape(idx.shape + (1,) * (v.ndim - 2)).astype(jnp.int32)
+            return jnp.take_along_axis(v, ii, axis=1)
+        return apply_op(fn, (_t(x), _t(indices)))
+
+    # -- decoder interface --------------------------------------------------
+    def initialize(self, initial_cell_states):
+        state0 = _flatten(initial_cell_states)[0]
+        batch = state0.shape[0]
+        W = self.beam_size
+        cell_states = _map_structure(self._expand_to_beam_size,
+                                     initial_cell_states)
+        init_ids = Tensor(jnp.full((batch, W), self.start_token, jnp.int32))
+        log_probs = Tensor(jnp.broadcast_to(
+            jnp.array([[0.] + [-_KINF] * (W - 1)], jnp.float32), (batch, W)))
+        finished = Tensor(jnp.zeros((batch, W), jnp.bool_))
+        lengths = Tensor(jnp.zeros((batch, W), jnp.int32))
+        inputs = (self.embedding_fn(init_ids) if self.embedding_fn
+                  else init_ids)
+        states = {'cell_states': cell_states, 'log_probs': log_probs,
+                  'finished': finished, 'lengths': lengths}
+        return inputs, states, finished
+
+    def _beam_search_step(self, time, logits, next_cell_states, beam_state):
+        W = self.beam_size
+        vocab = logits.shape[-1]
+
+        def fn(lg, prev_lp, prev_fin, prev_len):
+            step_lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            noend = jnp.full((vocab,), -_KINF,
+                             jnp.float32).at[self.end_token].set(0.)
+            step_lp = jnp.where(prev_fin[..., None], noend, step_lp)
+            lp = step_lp + prev_lp[..., None]               # (B, W, V)
+            flat = lp.reshape(lp.shape[0], W * vocab)
+            topk_scores, topk_idx = jax.lax.top_k(flat, W)  # (B, W)
+            beam_idx = (topk_idx // vocab).astype(jnp.int32)
+            token_idx = (topk_idx % vocab).astype(jnp.int32)
+            nxt_fin = jnp.take_along_axis(prev_fin, beam_idx, axis=1)
+            nxt_len = jnp.take_along_axis(prev_len, beam_idx, axis=1)
+            nxt_len = nxt_len + (~nxt_fin).astype(jnp.int32)
+            nxt_fin = nxt_fin | (token_idx == self.end_token)
+            return (topk_scores, token_idx, beam_idx, topk_scores,
+                    nxt_fin, nxt_len)
+
+        (scores, token_idx, beam_idx, next_lp, next_fin,
+         next_len) = apply_op(
+            fn, (logits, beam_state['log_probs'], beam_state['finished'],
+                 beam_state['lengths']), n_outputs=6, differentiable=False)
+        next_cell_states = _map_structure(
+            lambda x: self._gather(x, beam_idx), next_cell_states)
+        output = {'scores': scores, 'predicted_ids': token_idx,
+                  'parent_ids': beam_idx}
+        state = {'cell_states': next_cell_states, 'log_probs': next_lp,
+                 'finished': next_fin, 'lengths': next_len}
+        return output, state
+
+    def step(self, time, inputs, states, **kwargs):
+        inputs = _map_structure(self._merge_batch_beams, inputs)
+        cell_states = _map_structure(self._merge_batch_beams,
+                                     states['cell_states'])
+        cell_outputs, next_cell_states = self.cell(inputs, cell_states,
+                                                   **kwargs)
+        cell_outputs = _map_structure(self._split_batch_beams, cell_outputs)
+        next_cell_states = _map_structure(self._split_batch_beams,
+                                          next_cell_states)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        output, state = self._beam_search_step(
+            time, cell_outputs, next_cell_states, states)
+        finished = state['finished']
+        sample_ids = output['predicted_ids']
+        next_inputs = (self.embedding_fn(sample_ids) if self.embedding_fn
+                       else sample_ids)
+        return output, state, next_inputs, finished
+
+    def pad_buffers(self, buffers, t_final):
+        """Fill unwritten slots after an early loop exit (t >= t_final):
+        predicted_ids -> end_token, parent_ids -> identity, so gather_tree's
+        backtrace passes through them unchanged."""
+        W = self.beam_size
+        end = self.end_token
+
+        def pad(name, b):
+            def fn(v, tf):
+                written = (jnp.arange(v.shape[0]) < tf).reshape(
+                    (-1,) + (1,) * (v.ndim - 1))
+                if name == 'predicted_ids':
+                    fill = jnp.full_like(v, end)
+                else:  # parent_ids: identity backtrace
+                    fill = jnp.broadcast_to(
+                        jnp.arange(W, dtype=v.dtype), v.shape)
+                return jnp.where(written, v, fill)
+            return apply_op(fn, (_t(b), _t(t_final)), differentiable=False)
+
+        out = dict(buffers)
+        out['predicted_ids'] = pad('predicted_ids', buffers['predicted_ids'])
+        out['parent_ids'] = pad('parent_ids', buffers['parent_ids'])
+        return out
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrace the beam tree; outputs are time-major [T, B, W]."""
+        from ..nn.functional.extension import gather_tree
+        predicted_ids = gather_tree(outputs['predicted_ids'],
+                                    outputs['parent_ids'])
+        return predicted_ids, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def _write_at(buf, t, val):
+    """Write val into time-major buffer buf at index t (jit-safe)."""
+    def fn(b, tt, v):
+        return jax.lax.dynamic_update_index_in_dim(
+            b, v.astype(b.dtype), tt.astype(jnp.int32), 0)
+    return apply_op(fn, (buf, t, val))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run ``decoder`` until every sequence finishes or ``max_step_num``.
+
+    Parity: reference rnn.py:1327. TPU-first: one fused loop over
+    preallocated [max_T, B, ...] buffers; under jit this is a single
+    ``lax.while_loop``. ``max_step_num`` must be a static python int
+    (defaults to 256 — XLA needs a static bound; documented divergence).
+    """
+    from ..fluid.layers import while_loop
+    max_T = int(max_step_num) if max_step_num is not None else 256
+
+    import contextlib
+    grad_ctx = autograd.no_grad if is_test else contextlib.nullcontext
+    with grad_ctx():
+        initial_inputs, initial_states, initial_finished = decoder.initialize(
+            inits)
+
+        # Probe step at t=0 to learn output structure (shapes/dtypes), then
+        # allocate the full time-major buffers.
+        outputs0, states0, next_inputs0, finished0 = decoder.step(
+            Tensor(jnp.asarray(0, jnp.int32)), initial_inputs, initial_states,
+            **kwargs)
+    if not decoder.tracks_own_finished:
+        finished0 = apply_op(lambda a, b: a | b,
+                             (_t(initial_finished), _t(finished0)),
+                             differentiable=False)
+    seq_len0 = apply_op(
+        lambda fin: (~fin).astype(jnp.int32), (_t(initial_finished),),
+        differentiable=False)
+
+    def alloc(o):
+        return Tensor(jnp.zeros((max_T,) + tuple(o.shape),
+                                o._value.dtype))
+    buffers = _map_structure(alloc, outputs0)
+    buffers = _map_structure(
+        lambda b, o: _write_at(b, Tensor(jnp.asarray(0, jnp.int32)), o),
+        buffers, outputs0)
+
+    def cond_fn(t, inputs, states, finished, seq_len, buffers):
+        return apply_op(
+            lambda tt, fin: (tt < max_T) & ~jnp.all(fin),
+            (t, _t(finished)), differentiable=False)
+
+    def body_fn(t, inputs, states, finished, seq_len, buffers):
+        outputs, next_states, next_inputs, next_finished = decoder.step(
+            t, inputs, states, **kwargs)
+        if not decoder.tracks_own_finished:
+            next_finished = apply_op(lambda a, b: a | b,
+                                     (_t(finished), _t(next_finished)),
+                                     differentiable=False)
+        next_seq_len = apply_op(
+            lambda sl, fin: sl + (~fin).astype(jnp.int32),
+            (_t(seq_len), _t(finished)), differentiable=False)
+        if impute_finished:
+            next_states = _map_structure(
+                lambda old, new: apply_op(
+                    lambda o, n, fin: jnp.where(
+                        fin.reshape(fin.shape + (1,) * (n.ndim - fin.ndim)),
+                        o.astype(n.dtype), n),
+                    (_t(old), _t(new), _t(finished))),
+                states, next_states)
+        buffers_new = _map_structure(
+            lambda b, o: _write_at(b, t, o), buffers, outputs)
+        t_next = apply_op(lambda tt: tt + 1, (t,), differentiable=False)
+        return (t_next, next_inputs, next_states, next_finished,
+                next_seq_len, buffers_new)
+
+    loop_vars = (Tensor(jnp.asarray(1, jnp.int32)), next_inputs0, states0,
+                 finished0, seq_len0, buffers)
+    with grad_ctx():
+        (t_final, _, final_states, final_finished, seq_len,
+         buffers) = while_loop(cond_fn, body_fn, list(loop_vars))
+
+    if decoder.tracks_own_finished and isinstance(final_states, dict) \
+            and 'lengths' in final_states:
+        seq_len = final_states['lengths']
+
+    if hasattr(decoder, 'pad_buffers'):
+        buffers = decoder.pad_buffers(buffers, t_final)
+    try:
+        final_outputs, final_states = decoder.finalize(
+            buffers, final_states, seq_len)
+    except NotImplementedError:
+        final_outputs = buffers
+
+    if not output_time_major:
+        final_outputs = _map_structure(
+            lambda x: apply_op(lambda v: jnp.swapaxes(v, 0, 1), (_t(x),),
+                               differentiable=False),
+            final_outputs)
+
+    if return_length:
+        return final_outputs, final_states, seq_len
+    return final_outputs, final_states
+
+
+# -- helper-based decoding (parity: reference rnn.py:1557-2036) -------------
+
+class DecodeHelper:
+    """Interface: initialize / sample / next_inputs."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher-forcing helper: slices the next ground-truth input each step.
+
+    Parity: reference rnn.py:1626.
+    """
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.inputs = _t(inputs)
+        self.sequence_length = _t(sequence_length)
+        self.time_major = time_major
+        self._max_t = (self.inputs.shape[0] if time_major
+                       else self.inputs.shape[1])
+
+    def initialize(self):
+        init_finished = apply_op(
+            lambda sl: sl <= 0, (self.sequence_length,),
+            differentiable=False)
+        init_inputs = apply_op(
+            lambda x: (x[0] if self.time_major else x[:, 0]), (self.inputs,))
+        return init_inputs, init_finished
+
+    def sample(self, time, outputs, states):
+        return apply_op(lambda o: jnp.argmax(o, axis=-1).astype(jnp.int32),
+                        (_t(outputs),), differentiable=False)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        axis = 0 if self.time_major else 1
+        max_t = self._max_t
+
+        def fin_fn(tt, sl):
+            return (tt + 1) >= jnp.minimum(sl, max_t)
+
+        def in_fn(x, tt):
+            nxt = jnp.minimum(tt + 1, max_t - 1).astype(jnp.int32)
+            sl = jax.lax.dynamic_index_in_dim(x, nxt, axis, keepdims=False)
+            return sl
+        finished = apply_op(fin_fn, (_t(time), self.sequence_length),
+                            differentiable=False)
+        next_in = apply_op(in_fn, (self.inputs, _t(time)))
+        return finished, next_in, states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Greedy argmax sampling + embedding lookup (reference rnn.py:1779)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = _t(np.asarray(start_tokens, np.int32))
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        batch = self.start_tokens.shape[0]
+        init_finished = Tensor(jnp.zeros((batch,), jnp.bool_))
+        return self.embedding_fn(self.start_tokens), init_finished
+
+    def sample(self, time, outputs, states):
+        return apply_op(lambda o: jnp.argmax(o, axis=-1).astype(jnp.int32),
+                        (_t(outputs),), differentiable=False)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        finished = apply_op(lambda s: s == self.end_token, (_t(sample_ids),),
+                            differentiable=False)
+        return finished, self.embedding_fn(sample_ids), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Multinomial sampling helper (reference rnn.py:1876)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        from ..core import rng
+        self._key = rng._make_key(seed) if seed is not None else rng.next_key()
+
+    def sample(self, time, outputs, states):
+        temp = self.temperature
+
+        def fn(o, tt):
+            logits = o if temp is None else o / temp
+            key = jax.random.fold_in(self._key, tt.astype(jnp.int32))
+            return jax.random.categorical(key, logits, axis=-1).astype(
+                jnp.int32)
+        return apply_op(fn, (_t(outputs), _t(time)), differentiable=False)
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, return_parent_idx=False):
+    """One beam-search step (parity: reference rnn.py:3040 beam_search op).
+
+    Dense TPU redesign of the LoD-based op: inputs are batch-major dense
+    tensors — pre_ids/pre_scores (B, W), scores (B, W, V) — instead of LoD
+    levels. Returns (selected_ids, selected_scores[, parent_idx]) each
+    (B, W). Finished beams (pre_ids == end_id) propagate end_id with their
+    frozen score, matching the reference's finished-branch handling.
+    """
+    pre_ids, pre_scores = _t(pre_ids), _t(pre_scores)
+    scores = _t(scores)
+    W, end = int(beam_size), int(end_id)
+
+    def fn(pids, pscores, sc):
+        sc = sc.astype(jnp.float32)
+        if not is_accumulated:
+            sc = jnp.log(sc) + pscores[..., None]
+        finished = pids == end
+        vocab = sc.shape[-1]
+        noend = jnp.full((vocab,), -_KINF, jnp.float32).at[end].set(0.)
+        sc = jnp.where(finished[..., None], noend + pscores[..., None], sc)
+        flat = sc.reshape(sc.shape[0], W * vocab)
+        top_sc, top_idx = jax.lax.top_k(flat, W)
+        parent = (top_idx // vocab).astype(jnp.int32)
+        token = (top_idx % vocab).astype(jnp.int32)
+        return token, top_sc, parent
+
+    token, top_sc, parent = apply_op(fn, (pre_ids, pre_scores, scores),
+                                     n_outputs=3, differentiable=False)
+    if return_parent_idx:
+        return token, top_sc, parent
+    return token, top_sc
+
+
+def beam_search_decode(ids, scores, beam_size, end_id):
+    """Backtrace full sequences from per-step beam outputs (parity:
+    reference rnn.py:3200 beam_search_decode op; dense analogue).
+
+    ids/scores: time-major (T, B, W) stacks of per-step (token, parent)
+    pairs is the LoD-free input here — pass ids=(token_ids, parent_ids).
+    Returns (sequences, sequence_scores) with sequences (T, B, W).
+    """
+    token_ids, parent_ids = ids
+    from ..nn.functional.extension import gather_tree
+    seqs = gather_tree(_t(token_ids), _t(parent_ids))
+    return seqs, _t(scores)
+
+
+class BasicDecoder(Decoder):
+    """cell + helper + optional output layer (reference rnn.py:1942)."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        initial_inputs, initial_finished = self.helper.initialize()
+        return initial_inputs, initial_cell_states, initial_finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_outputs, cell_states = self.cell(inputs, states, **kwargs)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time, cell_outputs, cell_states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, cell_outputs, cell_states, sample_ids)
+        outputs = {'cell_outputs': cell_outputs, 'sample_ids': sample_ids}
+        return outputs, next_states, next_inputs, finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError

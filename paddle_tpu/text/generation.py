@@ -1,0 +1,54 @@
+"""Autoregressive generation utilities: greedy / top-k / top-p sampling.
+
+Parity role: the reference's sampling story lives in beam_search ops and
+contrib samplers (/root/reference/python/paddle/fluid/layers/rnn.py:3040);
+modern top-k/top-p is capability parity for the GPT zoo. TPU-first: pure
+jnp filters usable inside a jit-compiled decode step (static shapes, no
+data-dependent python control flow).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['top_k_logits', 'top_p_logits', 'sample_token', 'greedy_token']
+
+_NEG = -1e9
+
+
+def top_k_logits(logits, k):
+    """Mask all but the k largest logits to -inf. logits: (..., V)."""
+    if k is None or k <= 0:
+        return logits
+    k = min(int(k), logits.shape[-1])
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, jnp.full_like(logits, _NEG), logits)
+
+
+def top_p_logits(logits, p):
+    """Nucleus filtering: keep the smallest prefix of the sorted vocab whose
+    cumulative probability reaches p. logits: (..., V)."""
+    if p is None or p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens whose cumulative mass *before* them is < p (always >= 1 kept)
+    keep = cum - probs < p
+    cutoff_idx = jnp.sum(keep, axis=-1, keepdims=True) - 1
+    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    return jnp.where(logits < cutoff, jnp.full_like(logits, _NEG), logits)
+
+
+def greedy_token(logits):
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def sample_token(logits, key, temperature=1.0, top_k=None, top_p=None):
+    """Sample one token per row from filtered logits. logits: (B, V)."""
+    logits = logits.astype(jnp.float32)
+    if temperature is not None and temperature != 1.0:
+        logits = logits / jnp.maximum(temperature, 1e-6)
+    logits = top_k_logits(logits, top_k)
+    logits = top_p_logits(logits, top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

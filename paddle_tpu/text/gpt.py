@@ -8,6 +8,7 @@ multi-chip.
 import math
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -40,11 +41,15 @@ class CausalSelfAttention(nn.Layer):
         self.proj = nn.Linear(config.hidden_size, config.hidden_size)
         self.dropout = nn.Dropout(config.dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
         B, L, E = x.shape
         qkv = self.qkv(x).reshape([B, L, 3, self.num_heads, E // self.num_heads])
         from ..tensor.manipulation import unstack
         q, k, v = unstack(qkv, axis=2)
+        if cache is not None:
+            out, cache = self._cached_attention(q, k, v, cache, pos)
+            out = out.reshape([B, L, E])
+            return self.dropout(self.proj(out)), cache
         if self.use_ring:
             from ..distributed.ring_attention import ring_attention
             from ..core.tensor import apply_op
@@ -60,6 +65,42 @@ class CausalSelfAttention(nn.Layer):
         out = out.reshape([B, L, E])
         return self.dropout(self.proj(out))
 
+    def _cached_attention(self, q, k, v, cache, pos):
+        """Fixed-size KV-cache attention (jit-safe incremental decode).
+
+        cache = (k_buf, v_buf) each (B, T, H, D) preallocated to the full
+        target length; q/k/v are the current chunk (B, S, H, D) with S the
+        prompt length at prefill and 1 per decode step. ``pos`` is the write
+        offset (scalar). The write is a lax.dynamic_update_slice and the
+        causal mask is computed against absolute positions, so shapes stay
+        static across the whole decode loop.
+        """
+        from ..core.tensor import apply_op
+        k_buf, v_buf = cache
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+        def fn(qv, kv, vv, kb, vb, p):
+            p = p.astype(jnp.int32)
+            kb = jax.lax.dynamic_update_slice(
+                kb, kv.astype(kb.dtype), (0, p, 0, 0))
+            vb = jax.lax.dynamic_update_slice(
+                vb, vv.astype(vb.dtype), (0, p, 0, 0))
+            qh = jnp.swapaxes(qv, 1, 2)          # (B, H, S, D)
+            kh = jnp.swapaxes(kb, 1, 2)          # (B, H, T, D)
+            vh = jnp.swapaxes(vb, 1, 2)
+            scores = jnp.einsum('bhsd,bhtd->bhst', qh, kh) * scale
+            S, T = scores.shape[2], scores.shape[3]
+            qpos = p + jnp.arange(S)
+            mask = jnp.arange(T)[None, :] <= qpos[:, None]
+            scores = jnp.where(mask[None, None], scores, -1e9)
+            attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+            out = jnp.einsum('bhst,bhtd->bhsd', attn.astype(vh.dtype), vh)
+            return jnp.swapaxes(out, 1, 2), kb, vb
+
+        out, k_buf, v_buf = apply_op(fn, (q, k, v, k_buf, v_buf, pos),
+                                     n_outputs=3)
+        return out, (k_buf, v_buf)
+
 
 class GPTBlock(nn.Layer):
     def __init__(self, config):
@@ -73,7 +114,12 @@ class GPTBlock(nn.Layer):
             nn.Linear(4 * config.hidden_size, config.hidden_size),
             nn.Dropout(config.dropout))
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if cache is not None:
+            a, cache = self.attn(self.ln1(x), cache, pos)
+            x = x + a
+            x = x + self.mlp(self.ln2(x))
+            return x, cache
         x = x + self.attn(self.ln1(x))
         x = x + self.mlp(self.ln2(x))
         return x
@@ -94,21 +140,163 @@ class GPTModel(nn.Layer):
                                     for _ in range(config.num_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos=None):
         B, L = input_ids.shape
-        pos = arange(0, L, dtype='int64').unsqueeze(0)
-        x = self.drop(self.wte(input_ids) + self.wpe(pos))
-        for blk in self.blocks:
-            x = blk(x)
+        if caches is None:
+            p = arange(0, L, dtype='int64').unsqueeze(0)
+            x = self.drop(self.wte(input_ids) + self.wpe(p))
+            for blk in self.blocks:
+                x = blk(x)
+            x = self.ln_f(x)
+            return x.matmul(self.wte.weight, transpose_y=True)
+        # incremental decode: absolute positions pos..pos+L-1
+        from ..core.tensor import apply_op
+        pos_ids = apply_op(
+            lambda pp: (pp.astype(jnp.int32) + jnp.arange(L))[None, :],
+            (pos,), differentiable=False)
+        x = self.drop(self.wte(input_ids) + self.wpe(pos_ids))
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, cache = blk(x, cache, pos)
+            new_caches.append(cache)
         x = self.ln_f(x)
-        # tied LM head
-        logits = x.matmul(self.wte.weight, transpose_y=True)
-        return logits
+        return x.matmul(self.wte.weight, transpose_y=True), new_caches
 
     def loss(self, input_ids, labels):
         logits = self(input_ids)
         return nn.functional.cross_entropy(
             logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
+
+    def init_caches(self, batch_size, max_len, dtype=jnp.float32):
+        """Preallocate fixed-size KV buffers: per layer (k, v) (B, T, H, D)."""
+        H = self.config.num_heads
+        D = self.config.hidden_size // H
+        shape = (batch_size, max_len, H, D)
+        return [(Tensor(jnp.zeros(shape, dtype)), Tensor(jnp.zeros(shape, dtype)))
+                for _ in range(self.config.num_layers)]
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
+                 seed=None):
+        """Autoregressive generation with a fixed-size KV cache.
+
+        The entire decode (prefill + ``lax.while_loop`` over single-token
+        steps) compiles to ONE XLA computation, cached per
+        (batch, prompt_len, max_new_tokens, sampling config). Finished rows
+        (hit ``eos_token_id``) emit eos and the loop exits early when every
+        row is done. Parity role: reference beam_search/sampling decode
+        (fluid/layers/rnn.py:1779 GreedyEmbeddingHelper et al).
+        """
+        from ..core import rng
+        from ..core import autograd
+
+        input_ids = input_ids if isinstance(input_ids, Tensor) else Tensor(
+            jnp.asarray(np.asarray(input_ids), jnp.int32))
+        B, L0 = input_ids.shape
+        T = L0 + int(max_new_tokens)
+        if T > self.config.max_seq_len:
+            raise ValueError(
+                f"generate length {T} exceeds max_seq_len "
+                f"{self.config.max_seq_len}")
+        was_training = self.training
+        self.eval()
+        try:
+            key = rng._make_key(seed) if seed is not None else rng.next_key()
+            eos = -1 if eos_token_id is None else int(eos_token_id)
+
+            gen_fn = self._generate_fn(L0, int(max_new_tokens), bool(do_sample),
+                                       float(temperature),
+                                       None if top_k is None else int(top_k),
+                                       None if top_p is None else float(top_p),
+                                       eos)
+            from ..nn.layer_base import state_values
+            with autograd.no_grad():
+                out = gen_fn(state_values(self), input_ids._value, key)
+            return Tensor(out)
+        finally:
+            if was_training:
+                self.train()
+
+    def _generate_fn(self, prompt_len, max_new, do_sample, temperature,
+                     top_k, top_p, eos):
+        """Build (and cache) the jitted whole-decode function."""
+        sig = (prompt_len, max_new, do_sample, temperature, top_k, top_p, eos)
+        cache = getattr(self, '_gen_cache', None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        fn = cache.get(sig)
+        if fn is not None:
+            return fn
+
+        from .generation import sample_token, greedy_token
+        from ..nn.layer_base import functional_call
+
+        H = self.config.num_heads
+        D = self.config.hidden_size // H
+        n_layers = self.config.num_layers
+
+        def decode(state, prompt, key):
+            def model_step(ids_val, caches_vals, pos_val):
+                """Run the eager layer graph on traced values (params come
+                from ``state`` so they are jit inputs, not baked constants)."""
+                caches_t = [(Tensor(k), Tensor(v)) for k, v in caches_vals]
+                (logits_t, new_caches_t), _ = functional_call(
+                    self, state, Tensor(ids_val), caches_t, Tensor(pos_val))
+                return logits_t._value, [(k._value, v._value)
+                                         for k, v in new_caches_t]
+
+            B = prompt.shape[0]
+            T = prompt_len + max_new
+            # KV buffers built inside the traced fn: XLA materialises them
+            # in-place, no host alloc or input copy per call
+            cache_vals = [(jnp.zeros((B, T, H, D), jnp.float32),
+                           jnp.zeros((B, T, H, D), jnp.float32))
+                          for _ in range(n_layers)]
+            logits, cache_vals = model_step(
+                prompt, cache_vals, jnp.asarray(0, jnp.int32))
+            last = logits[:, -1, :]
+
+            out_buf = jnp.zeros((B, T), jnp.int32)
+            out_buf = jax.lax.dynamic_update_slice(out_buf, prompt, (0, 0))
+            finished0 = jnp.zeros((B,), jnp.bool_)
+
+            def pick(lg, kk, step):
+                if do_sample:
+                    return sample_token(lg, jax.random.fold_in(kk, step),
+                                        temperature, top_k, top_p)
+                return greedy_token(lg)
+
+            def cond(carry):
+                i, _, _, _, fin = carry
+                return (i < max_new) & ~jnp.all(fin)
+
+            def body(carry):
+                i, buf, cv, lg, fin = carry
+                tok = pick(lg, key, i)
+                tok = jnp.where(fin, jnp.full_like(tok, max(eos, 0)), tok)
+                fin = fin | (tok == eos)
+                pos = prompt_len + i
+                buf = jax.lax.dynamic_update_slice(
+                    buf, tok[:, None], (0, pos))
+                new_logits, cv = model_step(tok[:, None], cv, pos)
+                return (i + 1, buf, cv, new_logits[:, -1, :], fin)
+
+            carry = (jnp.asarray(0, jnp.int32), out_buf, cache_vals, last,
+                     finished0)
+            _, out_buf, _, _, _ = jax.lax.while_loop(cond, body, carry)
+            if eos >= 0:
+                # pad everything after each row's first eos (early loop exit
+                # leaves those slots unwritten)
+                gen = jnp.arange(T)[None, :] >= prompt_len
+                is_eos = (out_buf == eos) & gen
+                after = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+                         - is_eos.astype(jnp.int32)) > 0
+                out_buf = jnp.where(after & gen, eos, out_buf)
+            return out_buf
+
+        jitted = jax.jit(decode)
+        cache[sig] = jitted
+        return jitted
 
 
 def gpt_small(**kwargs):

@@ -31,3 +31,67 @@ class Seq2SeqTransformer(nn.Layer):
         tgt_mask = nn.Transformer.generate_square_subsequent_mask(L)
         out = self.transformer(src, trg, tgt_mask=tgt_mask)
         return self.out_proj(out)
+
+    def translate(self, src_ids, bos_id=0, eos_id=1, beam_size=4,
+                  max_len=64):
+        """Beam-search translation (parity: reference transformer infer
+        program, fluid/layers/rnn.py:856 BeamSearchDecoder usage).
+
+        Encodes once, then decodes with nn.BeamSearchDecoder over an
+        incremental decoder cache. Returns int ids (B, T, beam) ranked
+        best-first along the beam axis.
+        """
+        from ..nn.decode import BeamSearchDecoder, dynamic_decode
+        from ..core import autograd
+        max_pos = self.pos_emb.weight.shape[0]
+        if max_len > max_pos:
+            raise ValueError(
+                f"translate max_len {max_len} exceeds positional table "
+                f"size {max_pos}")
+        was_training = self.training
+        self.eval()
+        try:
+            with autograd.no_grad():
+                memory = self.transformer.encoder(
+                    self._embed(src_ids, self.src_emb))
+                cache = self.transformer.decoder.gen_cache(memory)
+                cell = _TransformerDecodeCell(self)
+                decoder = BeamSearchDecoder(cell, start_token=bos_id,
+                                            end_token=eos_id,
+                                            beam_size=beam_size)
+                from ..core.tensor import Tensor
+                import jax.numpy as jnp
+                B = src_ids.shape[0]
+                pos0 = Tensor(jnp.zeros((B,), jnp.int32))
+                outputs, _ = dynamic_decode(
+                    decoder, inits={'cache': cache, 'pos': pos0},
+                    max_step_num=max_len, is_test=True)
+                return outputs  # (B, T, beam) ids after gather_tree+transpose
+        finally:
+            if was_training:
+                self.train()
+
+
+class _TransformerDecodeCell:
+    """RNNCell-style adapter over the TransformerDecoder incremental cache.
+
+    State = {'cache': decoder cache (incremental + static per layer),
+    'pos': (N,) int32 absolute position}. The static (cross-attention) cache
+    carries the projected encoder memory, so the memory argument to the
+    decoder is never re-read during decode.
+    """
+
+    def __init__(self, model):
+        self.model = model
+
+    def __call__(self, inputs, states):
+        import jax.numpy as jnp
+        cache, pos = states['cache'], states['pos']
+        ids = inputs.unsqueeze(1)                      # (N, 1)
+        x = self.model.trg_emb(ids) + self.model.pos_emb(pos.unsqueeze(1))
+        out, new_cache = self.model.transformer.decoder(
+            x, x, None, None, cache)                   # memory unused w/ static cache
+        logits = self.model.out_proj(out.squeeze(1))   # (N, V)
+        from ..core.tensor import apply_op
+        new_pos = apply_op(lambda p: p + 1, (pos,), differentiable=False)
+        return logits, {'cache': new_cache, 'pos': new_pos}

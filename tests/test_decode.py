@@ -1,0 +1,322 @@
+"""Generation/decoding stack tests: beam search vs numpy reference,
+GPT KV-cache generate parity, sampling filters, helper-based decode.
+
+Parity model: /root/reference/python/paddle/fluid/layers/rnn.py decode tests
+(test_rnn_decode_api.py style — numpy reference beam search)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import jax.numpy as jnp
+
+
+def _np_beam_search(step_logits_fn, init_state, batch, beam, vocab, bos, eos,
+                    max_t):
+    """Independent numpy beam search (log-softmax scores, finished->eos)."""
+    KINF = 1e9
+    log_probs = np.tile(np.array([[0.] + [-KINF] * (beam - 1)], np.float32),
+                        (batch, 1))
+    finished = np.zeros((batch, beam), bool)
+    lengths = np.zeros((batch, beam), np.int32)
+    state = init_state  # (B, W, ...) numpy
+    tokens = np.full((batch, beam), bos, np.int32)
+    pred_ids, parent_ids = [], []
+    for t in range(max_t):
+        logits, state_new = step_logits_fn(tokens, state)  # (B, W, V)
+        m = logits.max(-1, keepdims=True)
+        lp = logits - m - np.log(np.exp(logits - m).sum(-1, keepdims=True))
+        noend = np.full((vocab,), -KINF, np.float32)
+        noend[eos] = 0.
+        lp = np.where(finished[..., None], noend, lp)
+        total = lp + log_probs[..., None]
+        flat = total.reshape(batch, beam * vocab)
+        topk_idx = np.argsort(-flat, axis=1, kind='stable')[:, :beam]
+        topk_scores = np.take_along_axis(flat, topk_idx, axis=1)
+        beam_idx = topk_idx // vocab
+        token_idx = (topk_idx % vocab).astype(np.int32)
+        log_probs = topk_scores
+        finished = np.take_along_axis(finished, beam_idx, axis=1)
+        lengths = np.take_along_axis(lengths, beam_idx, axis=1)
+        lengths = lengths + (~finished).astype(np.int32)
+        finished = finished | (token_idx == eos)
+        state = np.take_along_axis(
+            state_new, beam_idx.reshape(beam_idx.shape + (1,) *
+                                        (state_new.ndim - 2)), axis=1)
+        pred_ids.append(token_idx)
+        parent_ids.append(beam_idx)
+        tokens = token_idx
+        if finished.all():
+            break
+    # backtrace (gather_tree)
+    T = len(pred_ids)
+    out = np.zeros((T, batch, beam), np.int32)
+    beams = np.tile(np.arange(beam), (batch, 1))
+    for t in range(T - 1, -1, -1):
+        out[t] = np.take_along_axis(pred_ids[t], beams, axis=1)
+        beams = np.take_along_axis(parent_ids[t], beams, axis=1)
+    return out, lengths
+
+
+class _ToyCell:
+    """Deterministic linear 'cell': logits = W[token] + U @ state."""
+
+    def __init__(self, W, U, vocab, hidden):
+        self.W, self.U = W, U
+        self.vocab, self.hidden = vocab, hidden
+
+    def __call__(self, inputs, states):
+        from paddle_tpu.core.tensor import apply_op, Tensor
+        W, U = self.W, self.U
+
+        def fn(ids, st):
+            logits = W[ids] + st @ U          # (N, V)
+            new_state = jnp.tanh(st + 0.1 * logits[:, :st.shape[-1]])
+            return logits, new_state
+        logits, new_state = apply_op(fn, (inputs, states), n_outputs=2,
+                                     differentiable=False)
+        return logits, new_state
+
+
+class TestBeamSearchVsNumpy:
+    def test_beam_matches_numpy_reference(self):
+        rng = np.random.RandomState(0)
+        B, W, V, H, maxT = 2, 3, 11, 5, 12
+        bos, eos = 0, 1
+        Wm = rng.randn(V, V).astype(np.float32)
+        Um = rng.randn(H, V).astype(np.float32)
+        cell = _ToyCell(Wm, Um, V, H)
+        init_state = rng.randn(B, H).astype(np.float32)
+
+        decoder = nn.BeamSearchDecoder(cell, start_token=bos, end_token=eos,
+                                       beam_size=W)
+        outputs, _, seq_len = nn.dynamic_decode(
+            decoder, inits=paddle.to_tensor(init_state), max_step_num=maxT,
+            is_test=True, return_length=True)
+        got = outputs.numpy()                      # (B, T, W)
+
+        def np_step(tokens, state):
+            # tokens (B, W), state (B, W, H) -> logits (B, W, V)
+            ids = tokens.reshape(-1)
+            st = state.reshape(-1, H)
+            logits = Wm[ids] + st @ Um
+            new_state = np.tanh(st + 0.1 * logits[:, :H])
+            return (logits.reshape(B, W, V),
+                    new_state.reshape(B, W, H))
+        ref, ref_len = _np_beam_search(
+            np_step, np.tile(init_state[:, None], (1, W, 1)), B, W, V, bos,
+            eos, maxT)
+        ref = ref.transpose(1, 0, 2)               # (B, T, W)
+        T = min(got.shape[1], ref.shape[1])
+        np.testing.assert_array_equal(got[:, :T, :], ref[:, :T, :])
+        np.testing.assert_array_equal(seq_len.numpy(), ref_len)
+
+    def test_beam_early_exit_all_finished(self):
+        # vocab where eos always wins -> finishes on step 1, loop exits early
+        V, H, B, W = 4, 3, 1, 2
+        Wm = np.zeros((V, V), np.float32)
+        Wm[:, 1] = 10.0                            # eos=1 dominates
+        Um = np.zeros((H, V), np.float32)
+        cell = _ToyCell(Wm, Um, V, H)
+        decoder = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                       beam_size=W)
+        outputs, states = nn.dynamic_decode(
+            decoder, inits=paddle.to_tensor(np.zeros((B, H), np.float32)),
+            max_step_num=50, is_test=True)
+        assert bool(states['finished'].numpy().all())
+        assert outputs.numpy()[0, 0, 0] == 1
+        # unwritten tail slots must be padded with eos, not raw zeros
+        assert (outputs.numpy()[0, 1:, 0] == 1).all()
+
+    def test_early_exit_preserves_diverged_beams(self):
+        # regression: beams diverge at step 0 (tokens 2 vs 3), both hit eos
+        # at step 1; early exit must not collapse beam 1 onto beam 0
+        V, H, B, W = 5, 3, 1, 2
+        bos, eos = 0, 1
+        Wm = np.full((V, V), -10.0, np.float32)
+        Wm[bos, 2] = 5.0        # from bos: best tokens are 2 then 3
+        Wm[bos, 3] = 4.0
+        Wm[2, eos] = 8.0        # from 2 or 3: eos dominates
+        Wm[3, eos] = 8.0
+        Um = np.zeros((H, V), np.float32)
+        cell = _ToyCell(Wm, Um, V, H)
+        decoder = nn.BeamSearchDecoder(cell, start_token=bos, end_token=eos,
+                                       beam_size=W)
+        outputs, _ = nn.dynamic_decode(
+            decoder, inits=paddle.to_tensor(np.zeros((B, H), np.float32)),
+            max_step_num=10, is_test=True)
+        ids = outputs.numpy()          # (B, T, W)
+        np.testing.assert_array_equal(ids[0, :2, 0], [2, eos])
+        np.testing.assert_array_equal(ids[0, :2, 1], [3, eos])
+
+
+class TestGPTGenerate:
+    @pytest.fixture(scope='class')
+    def model(self):
+        from paddle_tpu.text.gpt import GPTModel, GPTConfig
+        paddle.seed(7)
+        m = GPTModel(GPTConfig(vocab_size=37, hidden_size=32, num_layers=2,
+                               num_heads=4, max_seq_len=64, dropout=0.0))
+        m.eval()
+        return m
+
+    def test_kv_cache_greedy_matches_full_forward(self, model):
+        ids = paddle.to_tensor(np.array([[1, 2, 3], [9, 8, 7]], np.int32))
+        out = model.generate(ids, max_new_tokens=6)
+        cur = ids.numpy()
+        for _ in range(6):
+            logits = model(paddle.to_tensor(cur)).numpy()
+            nxt = logits[:, -1, :].argmax(-1).astype(np.int32)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out.numpy(), cur)
+
+    def test_generate_step_is_jit_compiled(self, model):
+        ids = paddle.to_tensor(np.array([[4, 5]], np.int32))
+        model.generate(ids, max_new_tokens=3)
+        fn = model._gen_cache[(2, 3, False, 1.0, None, None, -1)]
+        assert hasattr(fn, 'lower')  # a jax.jit-wrapped callable
+        # second call reuses the compiled fn (no retrace) and is deterministic
+        a = model.generate(ids, max_new_tokens=3).numpy()
+        b = model.generate(ids, max_new_tokens=3).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_eos_early_stop(self, model):
+        ids = paddle.to_tensor(np.array([[1, 2]], np.int32))
+        base = model.generate(ids, max_new_tokens=8).numpy()
+        eos = int(base[0, 2])      # force first generated token to be "eos"
+        out = model.generate(ids, max_new_tokens=8, eos_token_id=eos).numpy()
+        assert (out[0, 2:] == eos).all()
+
+    def test_sampling_deterministic_under_seed(self, model):
+        ids = paddle.to_tensor(np.array([[3, 1, 4]], np.int32))
+        a = model.generate(ids, max_new_tokens=5, do_sample=True, top_k=8,
+                           seed=13).numpy()
+        b = model.generate(ids, max_new_tokens=5, do_sample=True, top_k=8,
+                           seed=13).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestSamplingFilters:
+    def test_top_k_filter(self):
+        from paddle_tpu.text.generation import top_k_logits
+        logits = jnp.array([[1., 5., 3., 2.]])
+        out = np.asarray(top_k_logits(logits, 2))
+        assert out[0, 1] == 5. and out[0, 2] == 3.
+        assert out[0, 0] < -1e8 and out[0, 3] < -1e8
+
+    def test_top_p_filter_keeps_minimal_nucleus(self):
+        from paddle_tpu.text.generation import top_p_logits
+        # probs ~ [0.6, 0.3, 0.08, 0.02]
+        p = np.array([0.6, 0.3, 0.08, 0.02])
+        logits = jnp.asarray(np.log(p)[None, :])
+        out = np.asarray(top_p_logits(logits, 0.85))
+        assert np.isfinite(out[0, 0]) and out[0, 0] > -1e8
+        assert out[0, 1] > -1e8
+        assert out[0, 2] < -1e8 and out[0, 3] < -1e8
+
+    def test_top_p_always_keeps_one(self):
+        from paddle_tpu.text.generation import top_p_logits
+        logits = jnp.asarray(np.log([[0.9, 0.05, 0.05]]))
+        out = np.asarray(top_p_logits(logits, 0.01))
+        assert out[0, 0] > -1e8
+        assert out[0, 1] < -1e8 and out[0, 2] < -1e8
+
+
+class TestHelperDecode:
+    def test_greedy_embedding_helper_decode(self):
+        paddle.seed(3)
+        V, E, H, B = 13, 8, 8, 2
+        emb = nn.Embedding(V, E)
+        cell = nn.GRUCell(E, H)
+        proj = nn.Linear(H, V)
+        helper = nn.GreedyEmbeddingHelper(lambda ids: emb(ids),
+                                          start_tokens=np.zeros(B, np.int32),
+                                          end_token=1)
+        decoder = nn.BasicDecoder(cell, helper, output_fn=proj)
+        h0 = paddle.to_tensor(np.zeros((B, H), np.float32))
+        outputs, _, lengths = nn.dynamic_decode(
+            decoder, inits=h0, max_step_num=7, is_test=True,
+            return_length=True)
+        ids = outputs['sample_ids'].numpy()
+        assert ids.shape == (B, 7)
+        # greedy must equal argmax of the recorded cell logits
+        np.testing.assert_array_equal(
+            ids, outputs['cell_outputs'].numpy().argmax(-1))
+
+    def test_training_helper_teacher_forcing(self):
+        paddle.seed(5)
+        B, T, E, H, V = 2, 5, 4, 6, 9
+        inputs = np.random.RandomState(0).randn(B, T, E).astype(np.float32)
+        seq_len = np.array([5, 3], np.int64)
+        cell = nn.GRUCell(E, H)
+        proj = nn.Linear(H, V)
+        helper = nn.TrainingHelper(paddle.to_tensor(inputs),
+                                   paddle.to_tensor(seq_len))
+        decoder = nn.BasicDecoder(cell, helper, output_fn=proj)
+        h0 = paddle.to_tensor(np.zeros((B, H), np.float32))
+        outputs, _, lengths = nn.dynamic_decode(
+            decoder, inits=h0, max_step_num=T, return_length=True)
+        assert outputs['cell_outputs'].shape == [B, T, V]
+        np.testing.assert_array_equal(lengths.numpy(), [5, 3])
+
+
+class TestSeq2SeqTranslate:
+    def test_translate_shapes_and_beam_order(self):
+        from paddle_tpu.text.seq2seq import Seq2SeqTransformer
+        paddle.seed(11)
+        m = Seq2SeqTransformer(src_vocab_size=17, trg_vocab_size=19,
+                               d_model=16, nhead=2, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0, max_length=32)
+        src = paddle.to_tensor(np.array([[3, 4, 5, 6]], np.int32))
+        out = m.translate(src, bos_id=0, eos_id=1, beam_size=3, max_len=8)
+        ids = out.numpy()
+        assert ids.shape[0] == 1 and ids.shape[2] == 3
+        assert ids.dtype == np.int32
+        # deterministic across calls
+        ids2 = m.translate(src, bos_id=0, eos_id=1, beam_size=3,
+                           max_len=8).numpy()
+        np.testing.assert_array_equal(ids, ids2)
+
+
+class TestBeamSearchOps:
+    def test_beam_search_step_op(self):
+        from paddle_tpu.fluid import layers
+        B, W, V = 1, 2, 5
+        pre_ids = paddle.to_tensor(np.array([[2, 3]], np.int32))
+        pre_scores = paddle.to_tensor(np.array([[-0.5, -1.0]], np.float32))
+        scores = np.full((B, W, V), -5.0, np.float32)
+        scores[0, 0, 4] = -0.1      # best: beam 0 -> token 4
+        scores[0, 1, 2] = -0.2      # second: beam 1 -> token 2
+        tok, sc, parent = layers.beam_search(
+            pre_ids, pre_scores, None, paddle.to_tensor(scores),
+            beam_size=W, end_id=0, return_parent_idx=True)
+        np.testing.assert_array_equal(tok.numpy(), [[4, 2]])
+        np.testing.assert_array_equal(parent.numpy(), [[0, 1]])
+        np.testing.assert_allclose(sc.numpy(), [[-0.1, -0.2]], rtol=1e-6)
+
+    def test_beam_search_finished_propagates_end_id(self):
+        from paddle_tpu.fluid import layers
+        B, W, V = 1, 2, 4
+        end = 1
+        pre_ids = paddle.to_tensor(np.array([[end, 2]], np.int32))  # beam0 done
+        pre_scores = paddle.to_tensor(np.array([[-0.1, -9.0]], np.float32))
+        scores = np.full((B, W, V), -20.0, np.float32)
+        scores[0, 1, 3] = -10.0
+        tok, sc = layers.beam_search(pre_ids, pre_scores, None,
+                                     paddle.to_tensor(scores),
+                                     beam_size=W, end_id=end)
+        # finished beam keeps emitting end_id with its frozen score on top
+        assert tok.numpy()[0, 0] == end
+        np.testing.assert_allclose(sc.numpy()[0, 0], -0.1, rtol=1e-6)
+
+    def test_beam_search_decode_backtrace(self):
+        from paddle_tpu.fluid import layers
+        token_ids = np.array([[[5, 6]], [[7, 8]]], np.int32)   # (T=2, B=1, W=2)
+        parent_ids = np.array([[[0, 0]], [[1, 0]]], np.int32)
+        seqs, _ = layers.beam_search_decode(
+            (paddle.to_tensor(token_ids), paddle.to_tensor(parent_ids)),
+            paddle.to_tensor(np.zeros((2, 1, 2), np.float32)),
+            beam_size=2, end_id=0)
+        # beam 0 at t=1 has parent 1 -> sequence [6, 7]
+        np.testing.assert_array_equal(seqs.numpy()[:, 0, 0], [6, 7])
