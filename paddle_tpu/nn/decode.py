@@ -30,29 +30,14 @@ def _t(x):
 
 
 def _map_structure(fn, *structs):
-    """Apply fn over parallel nested list/tuple/dict structures of Tensors."""
-    s0 = structs[0]
-    if isinstance(s0, (list, tuple)):
-        mapped = [_map_structure(fn, *items) for items in zip(*structs)]
-        if hasattr(s0, '_fields'):  # namedtuple
-            return type(s0)(*mapped)
-        return type(s0)(mapped)
-    if isinstance(s0, dict):
-        return {k: _map_structure(fn, *(s[k] for s in structs)) for k in s0}
-    return fn(*structs)
+    """Apply fn over parallel nested structures (Tensor leaves)."""
+    return jax.tree_util.tree_map(
+        fn, *structs, is_leaf=lambda x: isinstance(x, Tensor))
 
 
 def _flatten(struct):
-    out = []
-    if isinstance(struct, (list, tuple)):
-        for s in struct:
-            out.extend(_flatten(s))
-    elif isinstance(struct, dict):
-        for k in sorted(struct):
-            out.extend(_flatten(struct[k]))
-    else:
-        out.append(struct)
-    return out
+    return jax.tree_util.tree_leaves(
+        struct, is_leaf=lambda x: isinstance(x, Tensor))
 
 
 class Decoder:

@@ -6,8 +6,6 @@ modern top-k/top-p is capability parity for the GPT zoo. TPU-first: pure
 jnp filters usable inside a jit-compiled decode step (static shapes, no
 data-dependent python control flow).
 """
-import functools
-
 import jax
 import jax.numpy as jnp
 

@@ -205,7 +205,8 @@ class GPTModel(nn.Layer):
             eos = -1 if eos_token_id is None else int(eos_token_id)
 
             gen_fn = self._generate_fn(L0, int(max_new_tokens), bool(do_sample),
-                                       float(temperature),
+                                       1.0 if temperature is None
+                                       else float(temperature),
                                        None if top_k is None else int(top_k),
                                        None if top_p is None else float(top_p),
                                        eos)
@@ -278,7 +279,12 @@ class GPTModel(nn.Layer):
                 pos = prompt_len + i
                 buf = jax.lax.dynamic_update_slice(
                     buf, tok[:, None], (0, pos))
-                new_logits, cv = model_step(tok[:, None], cv, pos)
+                # skip the transformer forward when no further token will be
+                # sampled (last step / all rows finished)
+                new_logits, cv = jax.lax.cond(
+                    (i + 1 < max_new) & ~jnp.all(fin),
+                    lambda c: model_step(tok[:, None], c, pos),
+                    lambda c: (lg[:, None, :], c), cv)
                 return (i + 1, buf, cv, new_logits[:, -1, :], fin)
 
             carry = (jnp.asarray(0, jnp.int32), out_buf, cache_vals, last,

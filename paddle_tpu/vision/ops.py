@@ -419,20 +419,24 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
         k = min(nms_top_k, M)
 
         def per_image(boxes, scores_cm):
-            outs = []
-            for c in range(C):
-                if c == background_label:
-                    continue
+            if background_label >= 0:
+                # exclude background by sinking its scores below threshold
+                scores_cm = scores_cm.at[background_label].set(-jnp.inf)
+
+            def per_class(scores_c):
                 order, alive = _nms_single(
-                    boxes, scores_cm[c], nms_threshold, k, score_threshold,
+                    boxes, scores_c, nms_threshold, k, score_threshold,
                     normalized)
-                s = jnp.where(alive, scores_cm[c][order], -jnp.inf)
-                entry = jnp.concatenate([
-                    jnp.full((k, 1), float(c)), s[:, None], boxes[order]],
-                    axis=1)                       # (k, 6)
-                outs.append(entry)
-            allc = jnp.concatenate(outs, axis=0)  # (C'*k, 6)
-            kk = min(keep_top_k, allc.shape[0])
+                s = jnp.where(alive, scores_c[order], -jnp.inf)
+                return s, boxes[order]
+
+            ss, bsel = jax.vmap(per_class)(scores_cm)      # (C,k), (C,k,4)
+            labels = jnp.broadcast_to(
+                jnp.arange(C, dtype=boxes.dtype)[:, None], (C, k))
+            allc = jnp.concatenate(
+                [labels[..., None], ss[..., None], bsel],
+                axis=-1).reshape(C * k, 6)
+            kk = min(keep_top_k, C * k)
             top = jnp.argsort(-allc[:, 1])[:kk]
             sel = allc[top]
             valid = jnp.isfinite(sel[:, 1])
@@ -465,16 +469,32 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
     """
     x = _t(input)
     r = _t(rois)
-    B = x.shape[0]
-    if rois_num is None:
-        batch_idx_np = np.zeros((r.shape[0],), np.int32)
-    else:
-        rn = np.asarray(_t(rois_num).numpy(), np.int64)
-        batch_idx_np = np.repeat(np.arange(B), rn).astype(np.int32)
-    batch_idx = jnp.asarray(batch_idx_np)
+    R = r.shape[0]
     ph, pw = int(pooled_height), int(pooled_width)
 
-    def fn(xv, rv):
+    # batch index per roi — jit-safe: a traced rois_num is mapped to batch
+    # indices with searchsorted over its cumsum (no host sync at trace time)
+    if rois_num is None:
+        rn_t, prexpanded = None, False
+    elif isinstance(rois_num, (list, tuple, np.ndarray)):
+        batch_idx_np = np.repeat(
+            np.arange(len(rois_num)),
+            np.asarray(rois_num, np.int64)).astype(np.int32)
+        rn_t, prexpanded = Tensor(jnp.asarray(batch_idx_np)), True
+    else:
+        rn_t, prexpanded = _t(rois_num), False
+
+    def _batch_idx(rn):
+        if rn is None:
+            return jnp.zeros((R,), jnp.int32)
+        if prexpanded:            # already per-roi indices
+            return rn.astype(jnp.int32)
+        bounds = jnp.cumsum(rn.astype(jnp.int32))
+        return jnp.searchsorted(bounds, jnp.arange(R, dtype=jnp.int32),
+                                side='right').astype(jnp.int32)
+
+    def fn(xv, rv, *rest):
+        batch_idx = _batch_idx(rest[0] if rest else None)
         H, W = xv.shape[2], xv.shape[3]
 
         def one_roi(roi, bidx):
@@ -512,4 +532,5 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
 
         return jax.vmap(one_roi)(rv, batch_idx)
 
-    return apply_op(fn, (x, r))
+    tensors = (x, r) + ((rn_t,) if rn_t is not None else ())
+    return apply_op(fn, tensors)

@@ -187,6 +187,24 @@ class TestGPTGenerate:
         out = model.generate(ids, max_new_tokens=8, eos_token_id=eos).numpy()
         assert (out[0, 2:] == eos).all()
 
+    def test_manual_incremental_decode_with_init_caches(self, model):
+        """Public manual-decode path: init_caches + forward(ids, caches, pos)
+        must reproduce the full non-cached forward logits step by step."""
+        ids = np.array([[2, 4, 6, 8]], np.int32)
+        T = ids.shape[1]
+        caches = model.init_caches(batch_size=1, max_len=T)
+        full = model(paddle.to_tensor(ids)).numpy()
+        # prefill first 2 tokens, then decode the rest one at a time
+        logits, caches = model(paddle.to_tensor(ids[:, :2]), caches,
+                               paddle.to_tensor(np.int32(0)))
+        np.testing.assert_allclose(logits.numpy(), full[:, :2], rtol=2e-4,
+                                   atol=2e-5)
+        for t in range(2, T):
+            logits, caches = model(paddle.to_tensor(ids[:, t:t + 1]), caches,
+                                   paddle.to_tensor(np.int32(t)))
+            np.testing.assert_allclose(logits.numpy()[:, 0], full[:, t],
+                                       rtol=2e-4, atol=2e-5)
+
     def test_sampling_deterministic_under_seed(self, model):
         ids = paddle.to_tensor(np.array([[3, 1, 4]], np.int32))
         a = model.generate(ids, max_new_tokens=5, do_sample=True, top_k=8,

@@ -1,0 +1,403 @@
+"""Numpy-reference tests for the detection-op library (vision/ops.py).
+
+Test style parity: /root/reference/python/paddle/fluid/tests/unittests/
+test_multiclass_nms_op.py, test_box_coder_op.py, test_yolo_box_op.py,
+test_roi_align_op.py — each op checked against an independent numpy
+implementation."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def _np_iou(a, b, normalized=True):
+    off = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    iw = np.maximum(ix2 - ix1 + off, 0)
+    ih = np.maximum(iy2 - iy1 + off, 0)
+    inter = iw * ih
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-10), 0.0)
+
+
+def _np_greedy_nms(boxes, scores, iou_thr, score_thr=-np.inf):
+    """Plain python greedy NMS returning kept original indices in order."""
+    idx = [i for i in np.argsort(-scores, kind='stable')
+           if scores[i] > score_thr]
+    keep = []
+    while idx:
+        i = idx.pop(0)
+        keep.append(i)
+        idx = [j for j in idx
+               if _np_iou(boxes[i:i + 1], boxes[j:j + 1])[0, 0] <= iou_thr]
+    return keep
+
+
+class TestIoUSimilarity:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        a = np.sort(rng.rand(5, 2, 2), axis=1).transpose(0, 2, 1).reshape(5, 4)
+        b = np.sort(rng.rand(7, 2, 2), axis=1).transpose(0, 2, 1).reshape(7, 4)
+        a, b = a.astype(np.float32), b.astype(np.float32)
+        got = ops.iou_similarity(paddle.to_tensor(a),
+                                 paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(got, _np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+    def test_unnormalized_offset(self):
+        a = np.array([[0., 0., 9., 9.]], np.float32)   # 10x10 px box
+        got = ops.iou_similarity(paddle.to_tensor(a), paddle.to_tensor(a),
+                                 box_normalized=False).numpy()
+        np.testing.assert_allclose(got, [[1.0]], atol=1e-6)
+
+    def test_disjoint_boxes_zero(self):
+        a = np.array([[0., 0., 1., 1.]], np.float32)
+        b = np.array([[5., 5., 6., 6.]], np.float32)
+        got = ops.iou_similarity(paddle.to_tensor(a),
+                                 paddle.to_tensor(b)).numpy()
+        assert got[0, 0] == 0.0
+
+
+class TestBoxCoder:
+    def _np_encode(self, prior, target, var=None):
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + 0.5 * pw
+        pcy = prior[:, 1] + 0.5 * ph
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + 0.5 * tw
+        tcy = target[:, 1] + 0.5 * th
+        out = np.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :],
+            np.log(tw[:, None] / pw[None, :]),
+            np.log(th[:, None] / ph[None, :])], axis=-1)
+        if var is not None:
+            out = out / var.reshape(1, -1, 4)
+        return out
+
+    def test_encode_matches_numpy(self):
+        rng = np.random.RandomState(1)
+        prior = np.abs(rng.rand(4, 4).astype(np.float32)) + \
+            np.array([0, 0, 1, 1], np.float32)
+        target = np.abs(rng.rand(3, 4).astype(np.float32)) + \
+            np.array([0, 0, 1, 1], np.float32)
+        got = ops.box_coder(paddle.to_tensor(prior), None,
+                            paddle.to_tensor(target)).numpy()
+        np.testing.assert_allclose(got, self._np_encode(prior, target),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(2)
+        prior = rng.rand(5, 4).astype(np.float32)
+        prior[:, 2:] = prior[:, :2] + 0.5 + rng.rand(5, 2).astype(np.float32)
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        prior_var = np.tile(var, (5, 1))
+        target = rng.rand(3, 4).astype(np.float32)
+        target[:, 2:] = target[:, :2] + 0.5 + rng.rand(3, 2).astype(np.float32)
+
+        enc = ops.box_coder(paddle.to_tensor(prior),
+                            paddle.to_tensor(prior_var),
+                            paddle.to_tensor(target),
+                            code_type='encode_center_size')
+        dec = ops.box_coder(paddle.to_tensor(prior),
+                            paddle.to_tensor(prior_var), enc,
+                            code_type='decode_center_size', axis=0).numpy()
+        # decode(encode(t)) must reproduce the target boxes for every prior
+        want = np.broadcast_to(target[:, None, :], dec.shape)
+        np.testing.assert_allclose(dec, want, rtol=1e-4, atol=1e-5)
+
+    def test_decode_var_as_list(self):
+        prior = np.array([[0., 0., 2., 2.]], np.float32)
+        offsets = np.zeros((1, 1, 4), np.float32)
+        dec = ops.box_coder(paddle.to_tensor(prior), [0.1, 0.1, 0.2, 0.2],
+                            paddle.to_tensor(offsets),
+                            code_type='decode_center_size').numpy()
+        # zero offsets decode to the prior itself
+        np.testing.assert_allclose(dec[0, 0], prior[0], atol=1e-6)
+
+
+class TestBoxClip:
+    def test_clip_to_image(self):
+        boxes = np.array([[[-5., -5., 30., 40.], [2., 3., 8., 9.]]],
+                         np.float32)
+        im_info = np.array([[20., 25., 1.]], np.float32)   # h=20 w=25
+        got = ops.box_clip(paddle.to_tensor(boxes),
+                           paddle.to_tensor(im_info)).numpy()
+        np.testing.assert_allclose(
+            got[0, 0], [0., 0., 24., 19.], atol=1e-6)
+        np.testing.assert_allclose(got[0, 1], [2., 3., 8., 9.], atol=1e-6)
+
+
+class TestPriorBox:
+    def test_centers_and_sizes(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        boxes, vars_ = ops.prior_box(feat, img, min_sizes=[16.],
+                                     aspect_ratios=[1.0])
+        b = boxes.numpy()
+        assert b.shape == (2, 2, 1, 4)
+        # step = 64/2 = 32; first center at (0.5*32, 0.5*32) = (16, 16)
+        np.testing.assert_allclose(
+            b[0, 0, 0], [(16 - 8) / 64, (16 - 8) / 64,
+                         (16 + 8) / 64, (16 + 8) / 64], atol=1e-6)
+        np.testing.assert_allclose(vars_.numpy()[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2], atol=1e-6)
+
+    def test_flip_and_max_size_prior_count(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 3, 3), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 96, 96), np.float32))
+        boxes, _ = ops.prior_box(feat, img, min_sizes=[32.], max_sizes=[64.],
+                                 aspect_ratios=[2.0], flip=True)
+        # ars = {1, 2, 1/2} -> 3 + 1 (sqrt(min*max)) = 4 priors
+        assert boxes.shape == [3, 3, 4, 4]
+        ar2 = boxes.numpy()[0, 0, 1]               # second prior: ar=2
+        # w/h must equal the aspect ratio 2.0
+        np.testing.assert_allclose(
+            (ar2[2] - ar2[0]) / (ar2[3] - ar2[1]), 2.0, rtol=1e-5)
+
+
+class TestDensityPriorBox:
+    def test_prior_count_and_size(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, _ = ops.density_prior_box(
+            feat, img, densities=[2], fixed_sizes=[8.], fixed_ratios=[1.0])
+        b = boxes.numpy()
+        assert b.shape == (2, 2, 4, 4)            # density^2 = 4 priors
+        w = (b[0, 0, 0, 2] - b[0, 0, 0, 0]) * 32
+        np.testing.assert_allclose(w, 8.0, rtol=1e-5)
+
+    def test_flatten_to_2d(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        boxes, vars_ = ops.density_prior_box(
+            feat, img, densities=[1], fixed_sizes=[4.], fixed_ratios=[1.0],
+            flatten_to_2d=True)
+        assert boxes.shape == [4, 4] and vars_.shape == [4, 4]
+
+
+class TestAnchorGenerator:
+    def test_matches_reference_recipe(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 3), np.float32))
+        anchors, vars_ = ops.anchor_generator(
+            feat, anchor_sizes=[64.], aspect_ratios=[1.0],
+            stride=[16., 16.], offset=0.5)
+        a = anchors.numpy()
+        assert a.shape == (2, 3, 1, 4)
+        # reference recipe: base cell 16x16 snapped to ar=1 -> 16x16,
+        # scaled by 64/16 -> 64x64, centered at (x*16 + 0.5*15)
+        cx = 0 * 16 + 0.5 * 15
+        np.testing.assert_allclose(
+            a[0, 0, 0], [cx - 0.5 * 63, cx - 0.5 * 63,
+                         cx + 0.5 * 63, cx + 0.5 * 63], atol=1e-4)
+
+
+class TestYoloBox:
+    def test_decode_matches_numpy(self):
+        rng = np.random.RandomState(3)
+        B, A, C, H, W = 1, 2, 3, 2, 2
+        anchors = [10, 14, 23, 27]
+        x = rng.randn(B, A * (5 + C), H, W).astype(np.float32)
+        img_size = np.array([[64, 64]], np.int32)
+        ds = 32
+        boxes, scores = ops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img_size), anchors, C,
+            conf_thresh=0.0, downsample_ratio=ds, clip_bbox=False)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+        xv = x.reshape(B, A, 5 + C, H, W)
+        want_boxes = np.zeros((B, H, W, A, 4), np.float32)
+        want_scores = np.zeros((B, H, W, A, C), np.float32)
+        for b in range(B):
+            for a in range(A):
+                for i in range(H):
+                    for j in range(W):
+                        bx = (sig(xv[b, a, 0, i, j]) + j) / W
+                        by = (sig(xv[b, a, 1, i, j]) + i) / H
+                        bw = np.exp(xv[b, a, 2, i, j]) * anchors[2 * a] / (W * ds)
+                        bh = np.exp(xv[b, a, 3, i, j]) * anchors[2 * a + 1] / (H * ds)
+                        conf = sig(xv[b, a, 4, i, j])
+                        want_boxes[b, i, j, a] = [
+                            (bx - bw / 2) * 64, (by - bh / 2) * 64,
+                            (bx + bw / 2) * 64, (by + bh / 2) * 64]
+                        want_scores[b, i, j, a] = sig(xv[b, a, 5:, i, j]) * conf
+        np.testing.assert_allclose(
+            boxes.numpy(), want_boxes.reshape(B, -1, 4), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            scores.numpy(), want_scores.reshape(B, -1, C), rtol=1e-4,
+            atol=1e-5)
+
+    def test_conf_thresh_zeroes_boxes(self):
+        x = np.zeros((1, 1 * 6, 1, 1), np.float32)
+        x[0, 4] = -10.0   # conf = sigmoid(-10) ~ 0
+        boxes, scores = ops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(np.array([[32, 32]], np.int32)),
+            [10, 10], 1, conf_thresh=0.5, downsample_ratio=32)
+        assert (boxes.numpy() == 0).all() and (scores.numpy() == 0).all()
+
+
+class TestNMS:
+    def test_matches_python_greedy(self):
+        rng = np.random.RandomState(4)
+        boxes = rng.rand(12, 4).astype(np.float32)
+        boxes[:, 2:] = boxes[:, :2] + 0.3 + 0.4 * rng.rand(12, 2).astype(np.float32)
+        scores = rng.rand(12).astype(np.float32)
+        idx, mask = ops.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                            iou_threshold=0.4, top_k=12)
+        got = idx.numpy()[mask.numpy()]
+        want = _np_greedy_nms(boxes, scores, 0.4)
+        np.testing.assert_array_equal(sorted(got.tolist()), sorted(want))
+        # kept candidates are in descending score order in the padded output
+        kept_scores = scores[got]
+        assert (np.diff(kept_scores) <= 1e-7).all()
+
+    def test_identical_boxes_keep_one(self):
+        boxes = np.tile(np.array([[0., 0., 1., 1.]], np.float32), (5, 1))
+        scores = np.array([0.9, 0.8, 0.7, 0.6, 0.5], np.float32)
+        idx, mask = ops.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                            iou_threshold=0.5, top_k=5)
+        kept = idx.numpy()[mask.numpy()]
+        np.testing.assert_array_equal(kept, [0])
+
+    def test_score_threshold_filters(self):
+        boxes = np.array([[0., 0., 1., 1.], [5., 5., 6., 6.]], np.float32)
+        scores = np.array([0.9, 0.05], np.float32)
+        idx, mask = ops.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                            iou_threshold=0.5, top_k=2, score_threshold=0.1)
+        kept = idx.numpy()[mask.numpy()]
+        np.testing.assert_array_equal(kept, [0])
+
+    def test_iou_exactly_at_threshold_survives(self):
+        # IoU == threshold must NOT suppress (reference uses strict >)
+        boxes = np.array([[0., 0., 1., 2.], [0., 1., 1., 3.]], np.float32)
+        # IoU = 1/3 ≈ 0.3333; threshold exactly 1/3
+        scores = np.array([0.9, 0.8], np.float32)
+        thr = 1.0 / 3.0
+        idx, mask = ops.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                            iou_threshold=thr, top_k=2)
+        assert mask.numpy().sum() == 2
+
+
+class TestMulticlassNMS:
+    def _np_multiclass(self, boxes, scores, score_thr, nms_thr, keep_top_k,
+                       background=0):
+        C = scores.shape[0]
+        entries = []
+        for c in range(C):
+            if c == background:
+                continue
+            keep = _np_greedy_nms(boxes, scores[c], nms_thr, score_thr)
+            for i in keep:
+                entries.append([c, scores[c][i], *boxes[i]])
+        entries.sort(key=lambda e: -e[1])
+        return np.asarray(entries[:keep_top_k], np.float32)
+
+    def test_matches_python_reference(self):
+        rng = np.random.RandomState(5)
+        M, C = 10, 3
+        boxes = rng.rand(1, M, 4).astype(np.float32)
+        boxes[..., 2:] = boxes[..., :2] + 0.4
+        scores = rng.rand(1, C, M).astype(np.float32)
+        out, counts = ops.multiclass_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.3, nms_top_k=M, keep_top_k=20,
+            nms_threshold=0.4, background_label=0)
+        n = int(counts.numpy()[0])
+        got = out.numpy()[0, :n]
+        want = self._np_multiclass(boxes[0], scores[0], 0.3, 0.4, 20)
+        assert n == len(want)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_padding_rows_are_minus_one(self):
+        boxes = np.array([[[0., 0., 1., 1.]]], np.float32)
+        scores = np.array([[[0.0], [0.9]]], np.float32)   # bg + 1 class
+        out, counts = ops.multiclass_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.5, keep_top_k=4, background_label=0)
+        o = out.numpy()[0]
+        assert counts.numpy()[0] == 1
+        assert (o[1:] == -1.0).all()
+        np.testing.assert_allclose(o[0], [1., 0.9, 0., 0., 1., 1.],
+                                   atol=1e-6)
+
+
+class TestRoiAlign:
+    def _np_roi_align(self, img, roi, ph, pw, scale, sr):
+        """Python bilinear reference for a single image/roi."""
+        C, H, W = img.shape
+        x1, y1, x2, y2 = roi * scale
+        rw = max(x2 - x1, 1.0)
+        rh = max(y2 - y1, 1.0)
+        out = np.zeros((C, ph, pw), np.float32)
+        for pi in range(ph):
+            for pj in range(pw):
+                acc = np.zeros(C, np.float32)
+                for si in range(sr):
+                    for sj in range(sr):
+                        yy = y1 + (pi * sr + si + 0.5) * rh / (ph * sr)
+                        xx = x1 + (pj * sr + sj + 0.5) * rw / (pw * sr)
+                        yy = min(max(yy, 0.0), H - 1.0)
+                        xx = min(max(xx, 0.0), W - 1.0)
+                        y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+                        y1i, x1i = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+                        wy, wx = yy - y0, xx - x0
+                        acc += (img[:, y0, x0] * (1 - wy) * (1 - wx)
+                                + img[:, y0, x1i] * (1 - wy) * wx
+                                + img[:, y1i, x0] * wy * (1 - wx)
+                                + img[:, y1i, x1i] * wy * wx)
+                out[:, pi, pj] = acc / (sr * sr)
+        return out
+
+    def test_matches_python_bilinear(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        rois = np.array([[1., 1., 5., 5.], [0., 2., 6., 7.],
+                         [2., 0., 7., 4.]], np.float32)
+        rois_num = [2, 1]
+        got = ops.roi_align(paddle.to_tensor(x), paddle.to_tensor(rois),
+                            pooled_height=2, pooled_width=2,
+                            spatial_scale=0.5, rois_num=rois_num).numpy()
+        batch_of = [0, 0, 1]
+        for r in range(3):
+            want = self._np_roi_align(x[batch_of[r]], rois[r], 2, 2, 0.5, 2)
+            np.testing.assert_allclose(got[r], want, rtol=1e-4, atol=1e-5)
+
+    def test_jit_safe_with_traced_rois_num(self):
+        """rois_num as a Tensor must not host-sync at trace time."""
+        import jax
+        import jax.numpy as jnp
+        x = np.random.RandomState(7).randn(2, 2, 6, 6).astype(np.float32)
+        rois = np.array([[0., 0., 4., 4.], [1., 1., 5., 5.]], np.float32)
+
+        from paddle_tpu.core.tensor import Tensor
+
+        def f(xv, rv, rn):
+            out = ops.roi_align(Tensor(jnp.asarray(xv)), Tensor(jnp.asarray(rv)),
+                                pooled_height=2, pooled_width=2,
+                                rois_num=Tensor(jnp.asarray(rn)))
+            return out._value
+
+        eager = f(x, rois, np.array([1, 1], np.int32))
+        jitted = jax.jit(f)(jnp.asarray(x), jnp.asarray(rois),
+                            jnp.asarray(np.array([1, 1], np.int32)))
+        np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_sampling_ratio_explicit(self):
+        x = np.random.RandomState(8).randn(1, 1, 6, 6).astype(np.float32)
+        rois = np.array([[0., 0., 5., 5.]], np.float32)
+        got = ops.roi_align(paddle.to_tensor(x), paddle.to_tensor(rois),
+                            pooled_height=3, pooled_width=3,
+                            sampling_ratio=3).numpy()
+        want = self._np_roi_align(x[0], rois[0], 3, 3, 1.0, 3)
+        np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=1e-5)
