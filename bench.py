@@ -39,7 +39,12 @@ BASELINE_SEQ512_SPS = _published_baseline(
     'bert_large_seq512_samples_per_sec_per_chip', 80.0)
 
 
-def bench_bert(cfg_kwargs, batch, seq, steps, warmup, train_mode=True):
+def bench_bert(cfg_kwargs, batch, seq, steps, warmup, train_mode=True,
+               use_flat=False):
+    # use_flat=False measured best on v5e: XLA overlaps per-tensor optimizer
+    # fusions with the tail of the backward pass, while the flat-buffer
+    # update serializes behind the full gradient (tools/bench_2x2.py:
+    # seq128 489.8 vs 462.1, seq512 89.3 vs 87.1 samples/s)
     import jax
     import jax.numpy as jnp
 
@@ -58,7 +63,23 @@ def bench_bert(cfg_kwargs, batch, seq, steps, warmup, train_mode=True):
         net.eval()
     params = param_values(net, trainable_only=False)
     opt = opt_mod.AdamW(learning_rate=1e-4, weight_decay=0.01)
-    opt_state = opt.init_state_values(params)
+    if use_flat:
+        # flat-buffer fused update: ONE streaming HBM pass over all 340M
+        # params instead of ~400 small per-tensor fusions (optimizer/fused.py)
+        flat = opt_mod.FlatFusedUpdate(opt, params)
+        flat_p = flat.flatten(params)
+        opt_state = flat.init_state(flat_p)
+        # the master buffer now owns the weights: drop the model's own eager
+        # copies (1.36 GB) — functional_call swaps real values in per step
+        for _, p in net.named_parameters():
+            p._value = jnp.zeros((1,), jnp.float32)
+        for _, b in net.named_buffers():
+            b._value = jnp.zeros((1,), jnp.float32)
+        del params
+    else:
+        flat = None
+        flat_p = params
+        opt_state = opt.init_state_values(params)
 
     # MLM labels only at masked positions (~15% of seq), the reference's
     # pretraining setup: the vocab-size logits matmul runs on [B, K] gathered
@@ -75,8 +96,12 @@ def bench_bert(cfg_kwargs, batch, seq, steps, warmup, train_mode=True):
         rs.randint(0, cfg.vocab_size, (batch, n_masked)), jnp.int32)
     nsp_labels = jnp.asarray(rs.randint(0, 2, (batch, 1)), jnp.int32)
 
-    def train_step(params, opt_state, input_ids, token_type_ids,
+    def train_step(flat_p, opt_state, input_ids, token_type_ids,
                    masked_positions, mlm_labels, nsp_labels):
+        # f32 master -> named tree (flat mode: slices of the master buffer,
+        # zero-copy views since the row packing matches the tiled layout)
+        p_tree = flat.unflatten(flat_p) if flat is not None else flat_p
+
         def loss_of(p):
             # bf16 compute, fp32 master weights (TPU-native mixed precision)
             pc = {k: (v.astype(jnp.bfloat16)
@@ -90,14 +115,17 @@ def bench_bert(cfg_kwargs, batch, seq, steps, warmup, train_mode=True):
                 Tensor(nsp._value.astype(jnp.float32)),
                 Tensor(mlm_labels), Tensor(nsp_labels))
             return loss._value
-        loss, grads = jax.value_and_grad(loss_of)(params)
-        new_params, new_opt = opt.functional_update(params, grads, opt_state)
-        return new_params, new_opt, loss
+        loss, grads = jax.value_and_grad(loss_of)(p_tree)
+        if flat is not None:
+            new_p, new_opt = flat.update(flat_p, grads, opt_state)
+        else:
+            new_p, new_opt = opt.functional_update(flat_p, grads, opt_state)
+        return new_p, new_opt, loss
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
 
     for _ in range(warmup):
-        params, opt_state, loss = jitted(params, opt_state, input_ids,
+        flat_p, opt_state, loss = jitted(flat_p, opt_state, input_ids,
                                          token_type_ids, masked_positions,
                                          mlm_labels, nsp_labels)
     float(loss)  # host fetch: forces the full dispatch chain to finish
@@ -105,7 +133,7 @@ def bench_bert(cfg_kwargs, batch, seq, steps, warmup, train_mode=True):
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, opt_state, loss = jitted(params, opt_state, input_ids,
+        flat_p, opt_state, loss = jitted(flat_p, opt_state, input_ids,
                                          token_type_ids, masked_positions,
                                          mlm_labels, nsp_labels)
     float(loss)

@@ -1,0 +1,32 @@
+"""Shared Pallas kernel utilities (single source for PRNG masks + tiling)."""
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+
+def tile_keep_scale(seed_ref, tile_id, shape, dropout_p):
+    """Regenerate a dropout keep/(1-p) mask for one tile from the TPU
+    hardware PRNG. Deterministic in (seed, tile_id), so forward and backward
+    kernels rebuild the identical mask without ever storing it. Mosaic caps
+    prng_seed at 2 values, so callers pre-fold coordinates into tile_id."""
+    pltpu.prng_seed(seed_ref[0, 0], tile_id)
+    bits = pltpu.prng_random_bits(shape)
+    u = jax.lax.bitcast_convert_type(bits, jnp.uint32)
+    thresh = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    keep = u >= thresh
+    return keep.astype(jnp.float32) / (1.0 - dropout_p)
+
+
+def row_block(n):
+    """Largest row-tile size dividing n. Returns None when n has no multiple-
+    of-8 tiling (Mosaic requires the sublane dim divisible by 8) — callers
+    must fall back to the XLA path."""
+    for bn in (256, 128, 64, 32, 16, 8):
+        if n % bn == 0:
+            return bn
+    return None

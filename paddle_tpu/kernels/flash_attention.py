@@ -66,9 +66,15 @@ def _attn_reference(q, k, v, causal, scale, kpad_bias=None, dropout_p=0.0,
     return jnp.einsum('bhlm,bhmd->bhld', probs, v)
 
 
-def _score_tile(q_scaled, k_tile, bias_tile, causal, q_offset, k_offset):
-    """(block_q, block_k) scores for one tile pair, masked."""
-    s = jnp.dot(q_scaled, k_tile.T, preferred_element_type=jnp.float32)
+def _score_tile(q, k_tile, bias_tile, causal, q_offset, k_offset, scale):
+    """(block_q, block_k) scores for one tile pair, masked.
+
+    q/k stay in their native dtype (bf16 on the training path) so the MXU
+    runs native-bf16 with fp32 accumulation — upcasting the tiles first would
+    force fp32 MXU passes at a fraction of the throughput. The scale is
+    applied to the fp32 scores after the matmul.
+    """
+    s = jnp.dot(q, k_tile.T, preferred_element_type=jnp.float32) * scale
     if bias_tile is not None:
         s = s + bias_tile
     if causal:
@@ -79,17 +85,7 @@ def _score_tile(q_scaled, k_tile, bias_tile, causal, q_offset, k_offset):
     return s
 
 
-def _tile_keep_scale(seed_ref, tile_id, shape, dropout_p):
-    """Regenerate the dropout keep/(1-p) mask for one tile — identical across
-    forward and backward because the PRNG is re-seeded from the absolute tile
-    id (a unique function of bh, q_block, k_block) every time. Mosaic caps
-    prng_seed at 2 values, so the coordinates are pre-folded into tile_id."""
-    pltpu.prng_seed(seed_ref[0, 0], tile_id)
-    bits = pltpu.prng_random_bits(shape)
-    u = jax.lax.bitcast_convert_type(bits, jnp.uint32)
-    thresh = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
-    keep = u >= thresh
-    return keep.astype(jnp.float32) / (1.0 - dropout_p)
+from ._common import tile_keep_scale as _tile_keep_scale  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -107,7 +103,7 @@ def _fwd_kernel(*refs, block_k, seq_len, causal, scale, has_bias, dropout_p):
         seed_ref = refs[idx]; idx += 1
     o_ref, lse_ref = refs[idx:idx + 2]
 
-    q = q_ref[0].astype(jnp.float32) * scale           # (block_q, d)
+    q = q_ref[0]                                       # (block_q, d) native
     block_q = q.shape[0]
     q_blk = pl.program_id(1)
     q_offset = q_blk * block_q
@@ -118,35 +114,52 @@ def _fwd_kernel(*refs, block_k, seq_len, causal, scale, has_bias, dropout_p):
 
     if causal:
         n_blocks = (q_offset + block_q + block_k - 1) // block_k
+        # tiles strictly below the diagonal need no causal mask: the mask's
+        # iota/where per tile costs real VPU time, so split the sweep into an
+        # unmasked interior phase and a masked diagonal phase. The numerator
+        # is clamped non-negative BEFORE the divide: Mosaic lowers // as
+        # truncating division, which disagrees with floor on negatives.
+        n_full = jnp.maximum(q_offset + 1 - block_k, 0) // block_k
+        n_full = jnp.where(q_offset + 1 >= block_k, n_full + 1, 0)
     else:
         n_blocks = seq_len // block_k
+        n_full = n_blocks
 
-    def body(i, carry):
-        m_i, l_i, acc_i = carry
-        k_tile = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v_tile = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        bias_tile = None
-        if bias_ref is not None:
-            bias_tile = bias_ref[0, :, pl.dslice(i * block_k, block_k)
-                                 ].astype(jnp.float32)      # (1, block_k)
-        s = _score_tile(q, k_tile, bias_tile, causal, q_offset, i * block_k)
-        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_i - m_new)
-        # l accumulates UNdropped p: dropout applies to the normalized probs,
-        # and the final o = acc / l realizes drop(softmax(s)) @ v exactly.
-        l_new = l_i * corr + jnp.sum(p, axis=-1, keepdims=True)
-        p_acc = p
-        if dropout_p > 0.0:
-            nq, nk = seq_len // block_q, seq_len // block_k
-            tile_id = (pl.program_id(0) * nq + q_blk) * nk + i
-            p_acc = p * _tile_keep_scale(seed_ref, tile_id, p.shape,
-                                         dropout_p)
-        acc_new = acc_i * corr + jnp.dot(p_acc, v_tile,
-                                         preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+    def make_body(masked):
+        def body(i, carry):
+            m_i, l_i, acc_i = carry
+            k_tile = k_ref[0, pl.dslice(i * block_k, block_k), :]
+            v_tile = v_ref[0, pl.dslice(i * block_k, block_k), :]
+            bias_tile = None
+            if bias_ref is not None:
+                bias_tile = bias_ref[0, :, pl.dslice(i * block_k, block_k)
+                                     ].astype(jnp.float32)  # (1, block_k)
+            s = _score_tile(q, k_tile, bias_tile, masked, q_offset,
+                            i * block_k, scale)
+            m_new = jnp.maximum(m_i, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_i - m_new)
+            # l accumulates UNdropped p: dropout applies to the normalized
+            # probs; the final o = acc / l realizes drop(softmax(s)) @ v.
+            l_new = l_i * corr + jnp.sum(p, axis=-1, keepdims=True)
+            p_acc = p
+            if dropout_p > 0.0:
+                nq, nk = seq_len // block_q, seq_len // block_k
+                tile_id = (pl.program_id(0) * nq + q_blk) * nk + i
+                p_acc = p * _tile_keep_scale(seed_ref, tile_id, p.shape,
+                                             dropout_p)
+            # p in the value matmul rides the MXU in v's dtype (bf16 on the
+            # training path); the accumulator stays fp32
+            acc_new = acc_i * corr + jnp.dot(
+                p_acc.astype(v_tile.dtype), v_tile,
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+        return body
 
-    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
+    m, l, acc = jax.lax.fori_loop(0, n_full, make_body(False), (m, l, acc))
+    if causal:
+        m, l, acc = jax.lax.fori_loop(n_full, n_blocks, make_body(True),
+                                      (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), LSE_EMPTY)
     lse_ref[0] = lse.astype(jnp.float32)                # (block_q, 1)
@@ -203,8 +216,8 @@ def _dq_kernel(*refs, block_k, seq_len, causal, scale, has_bias, dropout_p):
         seed_ref = refs[idx]; idx += 1
     dq_ref = refs[idx]
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)                  # (block_q, d)
+    q = q_ref[0]                                        # (block_q, d) native
+    do = do_ref[0]                                      # (block_q, d) native
     lse = lse_ref[0].astype(jnp.float32)                # (block_q, 1)
     delta = delta_ref[0].astype(jnp.float32)            # (block_q, 1)
     block_q = q.shape[0]
@@ -213,29 +226,39 @@ def _dq_kernel(*refs, block_k, seq_len, causal, scale, has_bias, dropout_p):
 
     if causal:
         n_blocks = (q_offset + block_q + block_k - 1) // block_k
+        # clamp-then-divide: Mosaic // truncates, floor needed on negatives
+        n_full = jnp.maximum(q_offset + 1 - block_k, 0) // block_k
+        n_full = jnp.where(q_offset + 1 >= block_k, n_full + 1, 0)
     else:
         n_blocks = seq_len // block_k
+        n_full = n_blocks
 
-    def body(i, dq_acc):
-        k_tile = k_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        v_tile = v_ref[0, pl.dslice(i * block_k, block_k), :].astype(jnp.float32)
-        bias_tile = None
-        if bias_ref is not None:
-            bias_tile = bias_ref[0, :, pl.dslice(i * block_k, block_k)
-                                 ].astype(jnp.float32)      # (1, block_k)
-        s = _score_tile(q, k_tile, bias_tile, causal, q_offset, i * block_k)
-        p = jnp.exp(s - lse)                            # (block_q, block_k)
-        dp = jnp.dot(do, v_tile.T, preferred_element_type=jnp.float32)
-        if dropout_p > 0.0:
-            nq, nk = seq_len // block_q, seq_len // block_k
-            tile_id = (pl.program_id(0) * nq + q_blk) * nk + i
-            dp = dp * _tile_keep_scale(seed_ref, tile_id, dp.shape,
-                                       dropout_p)
-        ds = p * (dp - delta)
-        return dq_acc + jnp.dot(ds, k_tile, preferred_element_type=jnp.float32)
+    def make_body(masked):
+        def body(i, dq_acc):
+            k_tile = k_ref[0, pl.dslice(i * block_k, block_k), :]
+            v_tile = v_ref[0, pl.dslice(i * block_k, block_k), :]
+            bias_tile = None
+            if bias_ref is not None:
+                bias_tile = bias_ref[0, :, pl.dslice(i * block_k, block_k)
+                                     ].astype(jnp.float32)  # (1, block_k)
+            s = _score_tile(q, k_tile, bias_tile, masked, q_offset,
+                            i * block_k, scale)
+            p = jnp.exp(s - lse)                        # (block_q, block_k)
+            dp = jnp.dot(do, v_tile.T, preferred_element_type=jnp.float32)
+            if dropout_p > 0.0:
+                nq, nk = seq_len // block_q, seq_len // block_k
+                tile_id = (pl.program_id(0) * nq + q_blk) * nk + i
+                dp = dp * _tile_keep_scale(seed_ref, tile_id, dp.shape,
+                                           dropout_p)
+            ds = p * (dp - delta)
+            return dq_acc + jnp.dot(ds.astype(k_tile.dtype), k_tile,
+                                    preferred_element_type=jnp.float32)
+        return body
 
-    dq = jax.lax.fori_loop(
-        0, n_blocks, body, jnp.zeros((block_q, q.shape[1]), jnp.float32))
+    zero_dq = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    dq = jax.lax.fori_loop(0, n_full, make_body(False), zero_dq)
+    if causal:
+        dq = jax.lax.fori_loop(n_full, n_blocks, make_body(True), dq)
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -250,8 +273,8 @@ def _dkv_kernel(*refs, block_q, seq_len, causal, scale, has_bias, dropout_p):
         seed_ref = refs[idx]; idx += 1
     dk_ref, dv_ref = refs[idx:idx + 2]
 
-    k = k_ref[0].astype(jnp.float32)                    # (block_k, d)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                        # (block_k, d) native
+    v = v_ref[0]
     block_k = k.shape[0]
     k_blk = pl.program_id(1)
     k_offset = k_blk * block_k
@@ -260,38 +283,55 @@ def _dkv_kernel(*refs, block_q, seq_len, causal, scale, has_bias, dropout_p):
         bias_tile = bias_ref[0].astype(jnp.float32)     # (1, block_k)
 
     n_q_blocks = seq_len // block_q
-    start = (k_offset // block_q) if causal else 0
+    if causal:
+        start = k_offset // block_q
+        # q tiles whose every row >= every col of this k tile are unmasked:
+        # i*block_q >= k_offset + block_k - 1
+        start_full = (k_offset + block_k - 1 + block_q - 1) // block_q
+    else:
+        start = 0
+        start_full = 0
 
-    def body(i, carry):
-        dk_acc, dv_acc = carry
-        q_tile = q_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32) * scale
-        do_tile = do_ref[0, pl.dslice(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(i * block_q, block_q), :
-                      ].astype(jnp.float32)             # (block_q, 1)
-        delta = delta_ref[0, pl.dslice(i * block_q, block_q), :
+    def make_body(masked):
+        def body(i, carry):
+            dk_acc, dv_acc = carry
+            q_tile = q_ref[0, pl.dslice(i * block_q, block_q), :]
+            do_tile = do_ref[0, pl.dslice(i * block_q, block_q), :]
+            lse = lse_ref[0, pl.dslice(i * block_q, block_q), :
                           ].astype(jnp.float32)         # (block_q, 1)
-        s = _score_tile(q_tile, k, bias_tile, causal, i * block_q, k_offset)
-        p = jnp.exp(s - lse)                            # (block_q, block_k)
-        p_drop = p
-        dp = jnp.dot(do_tile, v.T, preferred_element_type=jnp.float32)
-        if dropout_p > 0.0:
-            nq, nk = seq_len // block_q, seq_len // block_k
-            tile_id = (pl.program_id(0) * nq + i) * nk + k_blk
-            keep_scale = _tile_keep_scale(seed_ref, tile_id, p.shape,
-                                          dropout_p)
-            p_drop = p * keep_scale
-            dp = dp * keep_scale
-        dv_acc = dv_acc + jnp.dot(p_drop.T, do_tile,
-                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dk_acc = dk_acc + jnp.dot(ds.T, q_tile,
-                                  preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
+            delta = delta_ref[0, pl.dslice(i * block_q, block_q), :
+                              ].astype(jnp.float32)     # (block_q, 1)
+            s = _score_tile(q_tile, k, bias_tile, masked, i * block_q,
+                            k_offset, scale)
+            p = jnp.exp(s - lse)                        # (block_q, block_k)
+            p_drop = p
+            dp = jnp.dot(do_tile, v.T, preferred_element_type=jnp.float32)
+            if dropout_p > 0.0:
+                nq, nk = seq_len // block_q, seq_len // block_k
+                tile_id = (pl.program_id(0) * nq + i) * nk + k_blk
+                keep_scale = _tile_keep_scale(seed_ref, tile_id, p.shape,
+                                              dropout_p)
+                p_drop = p * keep_scale
+                dp = dp * keep_scale
+            dv_acc = dv_acc + jnp.dot(p_drop.T.astype(do_tile.dtype), do_tile,
+                                      preferred_element_type=jnp.float32)
+            ds = p * (dp - delta)
+            dk_acc = dk_acc + jnp.dot(ds.T.astype(q_tile.dtype), q_tile,
+                                      preferred_element_type=jnp.float32)
+            return dk_acc, dv_acc
+        return body
 
     zero = jnp.zeros((block_k, k.shape[1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, n_q_blocks, body, (zero, zero))
-    # q_tile already carried `scale`, so dk = scale * ds^T q_raw
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    if causal:
+        bound = jnp.minimum(jnp.maximum(start_full, start), n_q_blocks)
+        dk, dv = jax.lax.fori_loop(start, bound, make_body(True),
+                                   (zero, zero))
+        dk, dv = jax.lax.fori_loop(bound, n_q_blocks, make_body(False),
+                                   (dk, dv))
+    else:
+        dk, dv = jax.lax.fori_loop(start, n_q_blocks, make_body(False),
+                                   (zero, zero))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
@@ -400,6 +440,12 @@ def flash_attention_bhld(q, k, v, causal=False, scale=None, kpad_bias=None,
         scale = 1.0 / math.sqrt(q.shape[-1])
     L = q.shape[2]
     dropout_p = float(dropout_p)
+    if kpad_bias is not None:
+        # the fwd/dq kernels stream bias columns with an in-kernel dynamic
+        # slice of the minor dim, which Mosaic cannot lower for block_k < L;
+        # key-padding attention is non-causal and reads every K anyway, so
+        # stream the full row
+        block_k = L
     usable = (_HAS_PLTPU and (interpret is not False
                               or jax.default_backend() == 'tpu')
               and k.shape[2] == L

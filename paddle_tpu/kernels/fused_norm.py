@@ -51,12 +51,12 @@ def _rms_fwd_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps, has_w):
     rstd_ref[...] = rstd
 
 
+from ._common import row_block as _shared_row_block
+
+
 def _row_block(n, d):
     # one row tile per grid step; 8-row multiples satisfy TPU sublane tiling
-    for bn in (256, 128, 64, 32, 16, 8, 1):
-        if n % bn == 0:
-            return bn
-    return 1
+    return _shared_row_block(n)
 
 
 def _ln_forward(x2, w, b, eps, interpret):
@@ -161,8 +161,12 @@ _fused_rms_norm2d.defvjp(_rms_fwd_rule, _rms_bwd_rule)
 
 def fused_layer_norm(x, weight=None, bias=None, eps=1e-5, interpret=False):
     """Layer norm over the LAST axis of x (any leading shape)."""
-    if not (_HAS_PLTPU and (interpret is not False
-                            or jax.default_backend() == 'tpu')):
+    n_rows = 1
+    for s in x.shape[:-1]:
+        n_rows *= s
+    if not (_HAS_PLTPU and _row_block(n_rows, x.shape[-1]) is not None
+            and (interpret is not False
+                 or jax.default_backend() == 'tpu')):
         mean = jnp.mean(x, axis=-1, keepdims=True)
         var = jnp.var(x, axis=-1, keepdims=True)
         y = (x - mean) * jax.lax.rsqrt(var + eps)
@@ -179,8 +183,12 @@ def fused_layer_norm(x, weight=None, bias=None, eps=1e-5, interpret=False):
 
 def fused_rms_norm(x, weight=None, eps=1e-6, interpret=False):
     """RMS norm over the LAST axis of x (any leading shape)."""
-    if not (_HAS_PLTPU and (interpret is not False
-                            or jax.default_backend() == 'tpu')):
+    n_rows = 1
+    for s in x.shape[:-1]:
+        n_rows *= s
+    if not (_HAS_PLTPU and _row_block(n_rows, x.shape[-1]) is not None
+            and (interpret is not False
+                 or jax.default_backend() == 'tpu')):
         ms = jnp.mean(x * x, axis=-1, keepdims=True)
         y = x * jax.lax.rsqrt(ms + eps)
         if weight is not None:

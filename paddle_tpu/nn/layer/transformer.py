@@ -118,17 +118,22 @@ class TransformerEncoderLayer(Layer):
             src = self.self_attn(src, src, src, src_mask)
         else:
             src, cache = self.self_attn(src, src, src, src_mask, cache)
-        src = residual + self.dropout1(src)
-        if not self.normalize_before:
-            src = self.norm1(src)
+        src = self._sublayer_out(src, residual, self.dropout1, self.norm1)
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
         src = self.linear2(self.dropout(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
-        if not self.normalize_before:
-            src = self.norm2(src)
+        src = self._sublayer_out(src, residual, self.dropout2, self.norm2)
         return src if cache is None else (src, cache)
+
+    def _sublayer_out(self, src, residual, drop, norm):
+        """Post-norm epilogue: norm(residual + dropout(src)) rides the fused
+        pallas kernel on TPU; pre-norm keeps the composed form."""
+        if not self.normalize_before:
+            return F.fused_dropout_add_layer_norm(
+                src, residual, norm.weight, norm.bias, dropout_p=drop.p,
+                epsilon=norm._epsilon, training=self.training)
+        return residual + drop(src)
 
     def gen_cache(self, src):
         return self.self_attn.gen_cache(src)

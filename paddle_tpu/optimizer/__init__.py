@@ -4,3 +4,4 @@ from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
 from . import lr
 from .lr import *  # noqa
 from .extras import ExponentialMovingAverage, LookAhead, ModelAverage
+from .fused import FlatFusedUpdate

@@ -3,7 +3,8 @@ import os
 # Force CPU with 8 virtual devices so mesh/distributed tests run hermetically.
 # The axon sitecustomize registers the TPU PJRT plugin at interpreter start and
 # overrides JAX_PLATFORMS, so env vars alone are not enough — jax.config wins.
-os.environ["JAX_PLATFORMS"] = "cpu"
+if not os.environ.get("PADDLE_TPU_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -11,7 +12,8 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("PADDLE_TPU_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,16 @@
+import sys
+sys.path.insert(0, '/root/repo')
+import bench
+from paddle_tpu.nn.functional.norm import set_fused_dropout_norm
+
+large = dict(vocab_size=30522, hidden_size=1024, num_hidden_layers=24,
+             num_attention_heads=16, intermediate_size=4096,
+             max_position_embeddings=512)
+seq = int(sys.argv[1]); batch = 64 if seq == 128 else 16
+for flat in (True, False):
+    for fdn in (True, False):
+        set_fused_dropout_norm(fdn)
+        s = bench.bench_bert(large, batch=batch, seq=seq, steps=20, warmup=2,
+                             use_flat=flat)
+        print(f"seq{seq} flat={flat} fused_dn={fdn}: {s:8.2f} samples/s", flush=True)
+set_fused_dropout_norm(True)

@@ -1,0 +1,26 @@
+"""Ablate the seq512 BERT step: flash on/off, train/eval, dropout cost."""
+import sys
+sys.path.insert(0, '/root/repo')
+import bench
+from paddle_tpu.nn.functional.transformer import set_flash_attention
+
+large = dict(vocab_size=30522, hidden_size=1024, num_hidden_layers=24,
+             num_attention_heads=16, intermediate_size=4096,
+             max_position_embeddings=512)
+
+which = sys.argv[1] if len(sys.argv) > 1 else 'all'
+if which in ('all', 'flash_train'):
+    s = bench.bench_bert(large, batch=16, seq=512, steps=10, warmup=2)
+    print(f"flash+train : {s:8.2f} samples/s")
+if which in ('all', 'noflash_train'):
+    set_flash_attention(False)
+    s = bench.bench_bert(large, batch=16, seq=512, steps=10, warmup=2)
+    set_flash_attention(True)
+    print(f"dense+train : {s:8.2f} samples/s")
+if which in ('all', 'flash_eval'):
+    s = bench.bench_bert(large, batch=16, seq=512, steps=10, warmup=2,
+                         train_mode=False)
+    print(f"flash+eval  : {s:8.2f} samples/s")
+if which in ('all', 'b32'):
+    s = bench.bench_bert(large, batch=32, seq=512, steps=10, warmup=2)
+    print(f"flash+train b32: {s:8.2f} samples/s (per-chip {s:8.2f})")

@@ -1,0 +1,77 @@
+"""Microbenchmark: flash attention fwd+bwd vs XLA dense, block-size sweep.
+
+Run on the real TPU chip: python tools/bench_flash.py
+"""
+import functools
+import itertools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, '/root/repo')
+from paddle_tpu.kernels.flash_attention import (
+    flash_attention_bhld, _attn_reference)
+
+
+def timeit(f, *args, iters=20, warmup=3):
+    for _ in range(warmup):
+        r = f(*args)
+    jax.tree_util.tree_map(lambda x: x.block_until_ready(),  r)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = f(*args)
+    jax.tree_util.tree_map(lambda x: x.block_until_ready(), r)
+    # host sync through the tunnel
+    _ = np.asarray(jax.device_get(jax.tree_util.tree_leaves(r)[0][0, 0, 0]))
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_config(B, H, L, D, dtype, causal=False):
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, L, D), dtype)
+    k = jnp.asarray(rs.randn(B, H, L, D), dtype)
+    v = jnp.asarray(rs.randn(B, H, L, D), dtype)
+
+    def make_fb(attn_fn):
+        def loss(q, k, v):
+            return jnp.sum(attn_fn(q, k, v).astype(jnp.float32) ** 2)
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        f = jax.jit(attn_fn)
+        return f, g
+
+    results = {}
+    ref_f, ref_g = make_fb(lambda q, k, v: _attn_reference(
+        q, k, v, causal, 1.0 / np.sqrt(D)))
+    results['xla_dense'] = (timeit(ref_f, q, k, v), timeit(ref_g, q, k, v))
+
+    blocks = [128, 256, 512, 1024]
+    for bq, bk in itertools.product(blocks, blocks):
+        if bq > L or bk > L:
+            continue
+        fn = functools.partial(flash_attention_bhld, causal=causal,
+                               block_q=bq, block_k=bk)
+        try:
+            f, g = make_fb(fn)
+            results[f'flash_q{bq}_k{bk}'] = (timeit(f, q, k, v),
+                                             timeit(g, q, k, v))
+        except Exception as e:
+            results[f'flash_q{bq}_k{bk}'] = ('ERR', str(e)[:80])
+    return results
+
+
+if __name__ == '__main__':
+    print("backend:", jax.default_backend())
+    for (L, B) in [(512, 16), (128, 64), (256, 32), (1024, 8)]:
+        for causal in (False,):
+            print(f"\n=== B={B} H=16 L={L} D=64 bf16 causal={causal} ===")
+            res = bench_config(B, 16, L, 64, jnp.bfloat16, causal)
+            base_f, base_g = res['xla_dense']
+            for name, (tf, tg) in res.items():
+                if tf == 'ERR':
+                    print(f"{name:18s} ERR {tg}")
+                    continue
+                print(f"{name:18s} fwd {tf*1e3:7.3f}ms ({base_f/tf:4.2f}x)  "
+                      f"fwd+bwd {tg*1e3:7.3f}ms ({base_g/tg:4.2f}x)")

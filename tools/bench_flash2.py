@@ -1,0 +1,48 @@
+"""Focused flash sweep with robust timing (min over repeats)."""
+import functools, itertools, sys, time
+import jax, jax.numpy as jnp, numpy as np
+sys.path.insert(0, '/root/repo')
+from paddle_tpu.kernels.flash_attention import flash_attention_bhld, _attn_reference
+
+
+def timeit(f, *args, iters=30, repeats=3):
+    for _ in range(3):
+        r = f(*args)
+    jax.tree_util.tree_map(lambda x: x.block_until_ready(), r)
+    best = 1e9
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = f(*args)
+        jax.tree_util.tree_map(lambda x: x.block_until_ready(), r)
+        _ = np.asarray(jax.device_get(jax.tree_util.tree_leaves(r)[0][0, 0, 0]))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def run(B, H, L, D, configs, causal=False):
+    rs = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rs.randn(B, H, L, D), jnp.bfloat16) for _ in range(3))
+
+    def make_g(attn_fn):
+        def loss(q, k, v):
+            return jnp.sum(attn_fn(q, k, v).astype(jnp.float32) ** 2)
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    g = make_g(lambda q, k, v: _attn_reference(q, k, v, causal, 1.0 / np.sqrt(D)))
+    base = timeit(g, q, k, v)
+    print(f"B={B} L={L} causal={causal}: xla_dense fwd+bwd {base*1e3:7.3f}ms")
+    for bq, bk in configs:
+        if bq > L or bk > L: continue
+        g = make_g(functools.partial(flash_attention_bhld, causal=causal,
+                                     block_q=bq, block_k=bk))
+        t = timeit(g, q, k, v)
+        print(f"  q{bq}_k{bk}: {t*1e3:7.3f}ms ({base/t:4.2f}x)")
+
+
+if __name__ == '__main__':
+    cfgs = [(128,128),(128,256),(128,512),(256,256),(256,512),(512,256),(512,512)]
+    run(16, 16, 512, 64, cfgs)
+    run(64, 16, 128, 64, [(128,128)])
+    run(32, 16, 256, 64, [(128,128),(128,256),(256,256)])
+    run(16, 16, 512, 64, cfgs, causal=True)

@@ -1,0 +1,14 @@
+import sys
+sys.path.insert(0, '/root/repo')
+import jax
+import bench
+
+large = dict(vocab_size=30522, hidden_size=1024, num_hidden_layers=24,
+             num_attention_heads=16, intermediate_size=4096,
+             max_position_embeddings=512)
+try:
+    with jax.profiler.trace('/tmp/jaxtrace'):
+        s = bench.bench_bert(large, batch=16, seq=512, steps=3, warmup=1)
+    print("profiled ok", s)
+except Exception as e:
+    print("profile failed:", type(e).__name__, str(e)[:200])
