@@ -124,11 +124,13 @@ def bench_bert(cfg_kwargs, batch, seq, steps, warmup, train_mode=True,
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
 
+    loss = None
     for _ in range(warmup):
         flat_p, opt_state, loss = jitted(flat_p, opt_state, input_ids,
                                          token_type_ids, masked_positions,
                                          mlm_labels, nsp_labels)
-    float(loss)  # host fetch: forces the full dispatch chain to finish
+    if loss is not None:
+        float(loss)  # host fetch: forces the full dispatch chain to finish
     # (block_until_ready alone does not reliably sync through the PJRT tunnel)
 
     t0 = time.perf_counter()
@@ -141,10 +143,105 @@ def bench_bert(cfg_kwargs, batch, seq, steps, warmup, train_mode=True,
     return batch * steps / dt
 
 
+def bench_resnet50(batch, steps, warmup, train_mode=True):
+    """ResNet-50 ImageNet train-step throughput (bf16 compute, fp32 master,
+    SGD+momentum) vs the A100 baseline in BASELINE.json."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer_base import functional_call, param_values, \
+        buffer_values
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.vision.models import resnet50
+    from paddle_tpu import optimizer as opt_mod
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(0)
+    net = resnet50(num_classes=1000)
+    if train_mode:
+        net.train()
+    else:
+        net.eval()
+    params = param_values(net, trainable_only=False)
+    buffers = buffer_values(net)   # BN running stats: threaded through the
+    # step explicitly so functional_call restores the originals (no tracer
+    # ever leaks into the layer buffers) and stats actually advance
+    opt = opt_mod.Momentum(learning_rate=0.1, momentum=0.9,
+                           weight_decay=1e-4)
+    # ResNet's step is short and op-count-bound (161 small tensors): the
+    # flat-buffer update collapses ~1000 per-param update ops into one
+    # streaming fusion — the case FlatFusedUpdate is for (optimizer/fused.py)
+    flat = opt_mod.FlatFusedUpdate(opt, params)
+    flat_p = flat.flatten(params)
+    opt_state = flat.init_state(flat_p)
+
+    rs = np.random.RandomState(0)
+    images = jnp.asarray(rs.randn(batch, 3, 224, 224), jnp.bfloat16)
+    labels = jnp.asarray(rs.randint(0, 1000, (batch,)), jnp.int32)
+
+    def train_step(flat_p, opt_state, buffers, images, labels):
+        p_tree = flat.unflatten(flat_p)
+
+        def loss_of(p):
+            pc = {k: (v.astype(jnp.bfloat16)
+                      if v.dtype == jnp.float32 else v)
+                  for k, v in p.items()}
+            pc.update(buffers)
+            logits, new_buffers = functional_call(net, pc, Tensor(images))
+            loss = F.cross_entropy(
+                Tensor(logits._value.astype(jnp.float32)), Tensor(labels))
+            return loss._value, new_buffers
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(p_tree)
+        new_p, new_opt = flat.update(flat_p, grads, opt_state)
+        return new_p, new_opt, new_buffers, loss
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    loss = None
+    for _ in range(warmup):
+        flat_p, opt_state, buffers, loss = jitted(flat_p, opt_state, buffers,
+                                                  images, labels)
+    if loss is not None:
+        float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        flat_p, opt_state, buffers, loss = jitted(flat_p, opt_state, buffers,
+                                                  images, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+BASELINE_RESNET50_IPS = _published_baseline(
+    'resnet50_images_per_sec_per_chip', 2500.0)
+
+
 def main():
     import jax
 
     on_accel = jax.default_backend() not in ('cpu',)
+    model = sys.argv[1].lstrip('-').replace('model=', '') \
+        if len(sys.argv) > 1 else 'bert'
+    if model not in ('bert', 'resnet50'):
+        raise SystemExit(f"unknown model {model!r}: choose bert or resnet50")
+    if not on_accel and model == 'resnet50':
+        ips = bench_resnet50(batch=4, steps=2, warmup=1)  # CPU smoke
+        print(json.dumps({
+            "metric": "resnet50_smoke_cpu_images_per_sec",
+            "value": round(ips, 2), "unit": "images/sec",
+            "vs_baseline": round(ips / BASELINE_RESNET50_IPS, 4)}))
+        return
+    if on_accel and model == 'resnet50':
+        ips = bench_resnet50(batch=256, steps=10, warmup=2)
+        print(json.dumps({
+            "metric": "resnet50_images_per_sec_per_chip",
+            "value": round(ips, 2),
+            "unit": "images/sec",
+            "vs_baseline": round(ips / BASELINE_RESNET50_IPS, 4),
+            "mode": "train (bf16 compute, SGD+momentum)",
+        }))
+        return
     if on_accel:
         large = dict(vocab_size=30522, hidden_size=1024,
                      num_hidden_layers=24, num_attention_heads=16,
@@ -153,6 +250,7 @@ def main():
         sps128 = bench_bert(large, batch=64, seq=128, steps=10, warmup=2)
         # phase 2: seq512 — attention-dominated, Pallas flash path
         sps512 = bench_bert(large, batch=16, seq=512, steps=10, warmup=2)
+        resnet_ips = bench_resnet50(batch=256, steps=10, warmup=2)
         print(json.dumps({
             "metric": "bert_large_pretrain_samples_per_sec_per_chip",
             "value": round(sps128, 2),
@@ -163,6 +261,10 @@ def main():
                 "seq512_samples_per_sec": round(sps512, 2),
                 "seq512_vs_baseline": round(sps512 / BASELINE_SEQ512_SPS, 4),
                 "seq512_baseline": BASELINE_SEQ512_SPS,
+                "resnet50_images_per_sec": round(resnet_ips, 2),
+                "resnet50_vs_baseline": round(
+                    resnet_ips / BASELINE_RESNET50_IPS, 4),
+                "resnet50_baseline": BASELINE_RESNET50_IPS,
             },
         }))
     else:  # local smoke mode: same code path, tiny shapes

@@ -109,6 +109,13 @@ class FlatFusedUpdate:
         lr = self.opt.get_lr() if lr is None else lr
         g = (grads_tree if getattr(grads_tree, 'ndim', None) == 2
              else self.flatten(grads_tree))
+        # coupled (L2) weight decay, same semantics as functional_update's
+        # grad_term path — without this, Momentum/SGD weight_decay would be
+        # silently dropped on the flat path
+        from ..nn.regularizer import WeightDecayRegularizer
+        wd = getattr(self.opt, '_weight_decay', None)
+        if isinstance(wd, WeightDecayRegularizer):
+            g = g + wd.grad_term(flat_p)
         if self._decay_mask_buf is not None:
             # run the base rule without decoupled decay, then apply masked
             # decay (AdamW): p -= lr * coeff * mask * p

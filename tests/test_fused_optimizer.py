@@ -85,3 +85,24 @@ class TestFlatFusedUpdate:
                                           np.asarray(params[k]))
         bf = flat.unflatten(flat.flatten(params), dtype=jnp.bfloat16)
         assert all(v.dtype == jnp.bfloat16 for v in bf.values())
+
+
+class TestFlatWeightDecay:
+    def test_momentum_weight_decay_applied_on_flat_path(self):
+        from paddle_tpu.optimizer import Momentum, FlatFusedUpdate
+        params = _params()
+        grads = _grads()
+        opt = Momentum(learning_rate=0.1, momentum=0.9, weight_decay=1e-2)
+        ref_p = dict(params)
+        ref_state = opt.init_state_values(ref_p)
+        ref_p, _ = opt.functional_update(ref_p, grads, ref_state)
+
+        flat = FlatFusedUpdate(opt, params)
+        fp = flat.flatten(params)
+        st = flat.init_state(fp)
+        fp, _ = flat.update(fp, grads, st)
+        got = flat.unflatten(fp)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(got[k]),
+                                       np.asarray(ref_p[k]),
+                                       rtol=1e-6, atol=1e-6)
