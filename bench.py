@@ -217,6 +217,12 @@ BASELINE_RESNET50_IPS = _published_baseline(
     'resnet50_images_per_sec_per_chip', 2500.0)
 
 
+def _resnet50_accel_ips():
+    """The one accelerator-mode ResNet-50 measurement (shared by
+    `bench resnet50` and the combined default run so they always agree)."""
+    return bench_resnet50(batch=256, steps=10, warmup=2)
+
+
 def main():
     import jax
 
@@ -233,7 +239,7 @@ def main():
             "vs_baseline": round(ips / BASELINE_RESNET50_IPS, 4)}))
         return
     if on_accel and model == 'resnet50':
-        ips = bench_resnet50(batch=256, steps=10, warmup=2)
+        ips = _resnet50_accel_ips()
         print(json.dumps({
             "metric": "resnet50_images_per_sec_per_chip",
             "value": round(ips, 2),
@@ -250,7 +256,7 @@ def main():
         sps128 = bench_bert(large, batch=64, seq=128, steps=10, warmup=2)
         # phase 2: seq512 — attention-dominated, Pallas flash path
         sps512 = bench_bert(large, batch=16, seq=512, steps=10, warmup=2)
-        resnet_ips = bench_resnet50(batch=256, steps=10, warmup=2)
+        resnet_ips = _resnet50_accel_ips()
         print(json.dumps({
             "metric": "bert_large_pretrain_samples_per_sec_per_chip",
             "value": round(sps128, 2),

@@ -1,49 +1,216 @@
 """Auto checkpoint/resume. Parity: fluid/incubate/checkpoint/auto_checkpoint.py.
 
-TPU-first: orbax-backed async checkpointing of model+optimizer state.
+TPU-first design:
+- step-numbered checkpoint directories with a ``latest`` pointer file, each
+  committed via atomic rename so a crash mid-write can never corrupt the
+  checkpoint a resume would read;
+- genuinely asynchronous saves (``async_save=True`` / ``AsyncCheckpointer``):
+  the device->host snapshot happens on the caller thread (so the training loop
+  can immediately mutate params — donated buffers are already copied out), and
+  serialization + disk IO run on a background writer thread, overlapping the
+  next training steps the way the reference overlaps its trainer thread with
+  the checkpoint RPC (auto_checkpoint.py's _thread saver).
 """
+import json
 import os
+import shutil
+import threading
 
-__all__ = ['AutoCheckpoint', 'save_checkpoint', 'load_checkpoint']
+__all__ = ['AutoCheckpoint', 'AsyncCheckpointer', 'save_checkpoint',
+           'load_checkpoint']
 
 
-def save_checkpoint(path, layer=None, optimizer=None, step=0, use_orbax=True):
-    from ..framework import save
-    os.makedirs(path, exist_ok=True)
-    meta = {'step': int(step)}
+def _snapshot(layer=None, optimizer=None, step=0):
+    """Device->host copy of all state on the caller thread.
+
+    After this returns, the live params/opt-state may be mutated freely; the
+    snapshot is plain numpy payloads with no aliasing of device buffers.
+    """
+    from ..framework import _to_saveable
+    snap = {'meta': {'step': int(step)}}
     if layer is not None:
-        save(layer.state_dict(), os.path.join(path, 'model.pdparams'))
+        snap['model'] = _to_saveable(layer.state_dict())
     if optimizer is not None:
-        save(optimizer.state_dict(), os.path.join(path, 'opt.pdopt'))
-    import json
-    with open(os.path.join(path, 'meta.json'), 'w') as f:
-        json.dump(meta, f)
+        snap['opt'] = _to_saveable(optimizer.state_dict())
+    return snap
+
+
+def _write_snapshot(path, snap):
+    """Serialize a snapshot into ``path/ckpt-<step>`` via atomic rename."""
+    import pickle
+    step = snap['meta']['step']
+    os.makedirs(path, exist_ok=True)
+    final = os.path.join(path, 'ckpt-%d' % step)
+    tmp = os.path.join(path, '.tmp-ckpt-%d-%d' % (step, os.getpid()))
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    if 'model' in snap:
+        with open(os.path.join(tmp, 'model.pdparams'), 'wb') as f:
+            pickle.dump(snap['model'], f, protocol=4)
+    if 'opt' in snap:
+        with open(os.path.join(tmp, 'opt.pdopt'), 'wb') as f:
+            pickle.dump(snap['opt'], f, protocol=4)
+    with open(os.path.join(tmp, 'meta.json'), 'w') as f:
+        json.dump(snap['meta'], f)
+    if os.path.isdir(final):
+        shutil.rmtree(final)
+    os.rename(tmp, final)  # atomic commit of the checkpoint dir
+    # atomically flip the 'latest' pointer
+    ptr_tmp = os.path.join(path, '.latest.tmp')
+    with open(ptr_tmp, 'w') as f:
+        f.write('ckpt-%d' % step)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(ptr_tmp, os.path.join(path, 'latest'))
+    return final
+
+
+def _prune_old(path, max_keep):
+    """Delete all but the newest ``max_keep`` committed checkpoints."""
+    if not max_keep or not os.path.isdir(path):
+        return
+    steps = sorted(
+        int(d[5:]) for d in os.listdir(path)
+        if d.startswith('ckpt-') and d[5:].isdigit())
+    for s in steps[:-max_keep]:
+        shutil.rmtree(os.path.join(path, 'ckpt-%d' % s), ignore_errors=True)
+
+
+class AsyncCheckpointer:
+    """Background-thread checkpoint writer.
+
+    ``save()`` snapshots state synchronously (cheap device->host copies) and
+    returns immediately; pickling and disk writes happen on a single worker
+    thread. Overlapping saves are serialized in submission order. Worker
+    failures are re-raised on the next ``save()``/``wait_until_finished()``.
+    """
+
+    def __init__(self, path, max_keep=None):
+        self.path = path
+        self.max_keep = max_keep
+        self._submit = threading.Lock()  # serializes save() submissions
+        self._lock = threading.Lock()    # guards _pending/_error
+        self._pending = None   # thread handling the in-flight write, if any
+        self._error = None
+
+    def _raise_pending_error(self):
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def save(self, layer=None, optimizer=None, step=0):
+        # _submit makes concurrent save() calls atomic (wait+snapshot+spawn):
+        # without it two callers could both observe no pending write and
+        # orphan one writer thread, losing its error and its join.
+        with self._submit:
+            self._wait_pending()
+            self._raise_pending_error()
+            snap = _snapshot(layer, optimizer, step)
+
+            def _work():
+                try:
+                    _write_snapshot(self.path, snap)
+                    _prune_old(self.path, self.max_keep)
+                except BaseException as e:  # surfaced on next save/wait
+                    with self._lock:
+                        self._error = e
+
+            t = threading.Thread(target=_work, name='paddle-tpu-ckpt',
+                                 daemon=True)
+            with self._lock:
+                self._pending = t
+            t.start()
+
+    def _wait_pending(self):
+        with self._lock:
+            t = self._pending
+        if t is not None:
+            t.join()
+            with self._lock:
+                if self._pending is t:
+                    self._pending = None
+
+    def wait_until_finished(self):
+        self._wait_pending()
+        self._raise_pending_error()
+
+
+_shared_checkpointers = {}
+_shared_lock = threading.Lock()
+
+
+def save_checkpoint(path, layer=None, optimizer=None, step=0,
+                    async_save=False):
+    """Write a step-numbered checkpoint under ``path``.
+
+    ``async_save=True`` returns an :class:`AsyncCheckpointer` whose write is
+    already in flight (call ``wait_until_finished()`` before process exit);
+    otherwise the write is synchronous. Either way the commit is atomic.
+    Repeated async saves to the same path share one checkpointer, so
+    overlapping writes are serialized in submission order.
+    """
+    if async_save:
+        key = os.path.abspath(path)
+        with _shared_lock:
+            ck = _shared_checkpointers.setdefault(key, AsyncCheckpointer(path))
+        ck.save(layer, optimizer, step)
+        return ck
+    _write_snapshot(path, _snapshot(layer, optimizer, step))
+    return None
+
+
+def _resolve_latest(path):
+    """Return the directory holding the newest committed checkpoint.
+
+    The max step among committed ``ckpt-<step>`` dirs is authoritative (a dir
+    only exists post-rename, so every one is complete); the ``latest`` pointer
+    is a hint only — a slow out-of-order writer could leave it stale.
+    """
+    if os.path.isdir(path):
+        steps = sorted(
+            int(d[5:]) for d in os.listdir(path)
+            if d.startswith('ckpt-') and d[5:].isdigit())
+        if steps:
+            return os.path.join(path, 'ckpt-%d' % steps[-1])
+    if os.path.isfile(os.path.join(path, 'meta.json')):  # legacy flat layout
+        return path
+    return None
 
 
 def load_checkpoint(path, layer=None, optimizer=None):
+    """Restore the newest checkpoint under ``path``; returns its meta dict
+    (or ``None`` when no committed checkpoint exists)."""
     from ..framework import load
-    import json
-    meta_path = os.path.join(path, 'meta.json')
-    if not os.path.exists(meta_path):
+    d = _resolve_latest(path)
+    if d is None:
         return None
-    with open(meta_path) as f:
+    with open(os.path.join(d, 'meta.json')) as f:
         meta = json.load(f)
     if layer is not None:
-        layer.set_state_dict(load(os.path.join(path, 'model.pdparams')))
-    if optimizer is not None and os.path.exists(os.path.join(path, 'opt.pdopt')):
-        optimizer.set_state_dict(load(os.path.join(path, 'opt.pdopt')))
+        layer.set_state_dict(load(os.path.join(d, 'model.pdparams')))
+    if optimizer is not None and os.path.exists(os.path.join(d, 'opt.pdopt')):
+        optimizer.set_state_dict(load(os.path.join(d, 'opt.pdopt')))
     return meta
 
 
 class AutoCheckpoint:
-    """Periodic checkpoint + auto-resume helper."""
+    """Periodic async checkpoint + auto-resume helper.
 
-    def __init__(self, path, layer=None, optimizer=None, save_every=100):
+    Saves every ``save_every`` ticks on a background thread, keeps the newest
+    ``max_keep`` checkpoints, and ``resume()`` restores the latest committed
+    one (partial/crashed writes are invisible thanks to the atomic commit).
+    """
+
+    def __init__(self, path, layer=None, optimizer=None, save_every=100,
+                 max_keep=3):
         self.path = path
         self.layer = layer
         self.optimizer = optimizer
         self.save_every = save_every
         self.step = 0
+        self._ck = AsyncCheckpointer(path, max_keep=max_keep)
 
     def resume(self):
         meta = load_checkpoint(self.path, self.layer, self.optimizer)
@@ -54,4 +221,7 @@ class AutoCheckpoint:
     def tick(self):
         self.step += 1
         if self.step % self.save_every == 0:
-            save_checkpoint(self.path, self.layer, self.optimizer, self.step)
+            self._ck.save(self.layer, self.optimizer, self.step)
+
+    def wait_until_finished(self):
+        self._ck.wait_until_finished()

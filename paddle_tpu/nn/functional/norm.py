@@ -60,15 +60,18 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
         def fn(v, *rest):
             wb, (m0, v0) = rest[:-2], rest[-2:]
-            # single-pass stats in fp32: E[x] and E[x^2] reduce in one fused
-            # sweep; var = E[x^2] - E[x]^2 (the formulation flax BatchNorm
-            # uses). fp32 accumulation gives ~7 digits, ample for post-conv
-            # activations (|mean| ~ std scale); callers with pathological
-            # |mean| >> std distributions should standardize inputs.
+            # shifted single-pass stats in fp32: one fused sweep computes
+            # E[x-s] and E[(x-s)^2] with s = running mean, so the
+            # var = E[(x-s)^2] - E[x-s]^2 subtraction cancels only when
+            # |batch mean - s| >> std — which the running mean prevents —
+            # instead of whenever |mean| >> std (the naive E[x^2]-E[x]^2).
             vf = v.astype(jnp.float32)
-            mean = jnp.mean(vf, axis=reduce_axes)
-            m2 = jnp.mean(vf * vf, axis=reduce_axes)
-            var = jnp.maximum(m2 - mean * mean, 0.0)
+            s = jax.lax.stop_gradient(m0.astype(jnp.float32)).reshape(shp)
+            vc = vf - s
+            mean_c = jnp.mean(vc, axis=reduce_axes)
+            m2 = jnp.mean(vc * vc, axis=reduce_axes)
+            var = jnp.maximum(m2 - mean_c * mean_c, 0.0)
+            mean = mean_c + s.reshape(mean_c.shape)
             inv = jax.lax.rsqrt(var.reshape(shp) + epsilon)
             out = ((vf - mean.reshape(shp)) * inv).astype(v.dtype)
             if wb:

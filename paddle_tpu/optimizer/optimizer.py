@@ -60,8 +60,13 @@ class Optimizer:
 
     def state_dict(self):
         out = {}
-        for pname, state in self._accumulators.items():
-            for sname, v in state.items():
+        # emit groups in parameter order (not first-grad order) so a
+        # positional restore into a renamed model lines up correctly
+        order = [p.name for p in (self._parameters or [])
+                 if p.name in self._accumulators]
+        order += [n for n in self._accumulators if n not in order]
+        for pname in order:
+            for sname, v in self._accumulators[pname].items():
                 out[f"{pname}.{sname}"] = Tensor(v) if not isinstance(v, Tensor) \
                     else v
         out['global_step'] = self._global_step
@@ -73,12 +78,62 @@ class Optimizer:
         self._global_step = int(state_dict.get('global_step', 0))
         if 'LR_Scheduler' in state_dict and isinstance(self._lr, LRScheduler):
             self._lr.set_state_dict(state_dict['LR_Scheduler'])
+        grouped = {}   # saved pname -> {sname: val}, insertion-ordered
         for k, v in state_dict.items():
             if k in ('global_step', 'LR_Scheduler'):
                 continue
             pname, _, sname = k.rpartition('.')
             val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
-            self._accumulators.setdefault(pname, {})[sname] = val
+            grouped.setdefault(pname, {})[sname] = val
+        # Saved keys embed parameter names from the run that produced them;
+        # a fresh model instance gets new unique_name suffixes, so match by
+        # position (state_dict emits groups in parameter order) when names
+        # don't line up — otherwise the restored slots would sit unused and
+        # step() would silently re-create zeros. Every per-element slot must
+        # match its target parameter's shape; a mismatch means the checkpoint
+        # belongs to a different model, which must fail loudly, not scramble.
+        cur_params = list(self._parameters or [])
+        cur_names = [p.name for p in cur_params]
+        overlap = set(grouped) & set(cur_names)
+        if cur_names and not overlap and len(grouped) == len(cur_names):
+            # fully disjoint name sets: a renamed instance of the same model
+            for p, (old, slots) in zip(cur_params, grouped.items()):
+                for sname, v in slots.items():
+                    if v.ndim > 0 and tuple(v.shape) != tuple(p.shape):
+                        raise ValueError(
+                            "optimizer.set_state_dict: cannot positionally "
+                            "map saved state '%s.%s' (shape %s) onto "
+                            "parameter '%s' (shape %s); the checkpoint was "
+                            "saved from a different model" %
+                            (old, sname, tuple(v.shape), p.name,
+                             tuple(p.shape)))
+            grouped = {cn: sv for cn, sv in zip(cur_names, grouped.values())}
+        elif cur_names and grouped and not overlap:
+            # disjoint names but counts differ: no name matches and a
+            # positional map would be a guess — fail loudly, the state
+            # would otherwise sit unused and step() would re-zero it.
+            raise ValueError(
+                "optimizer.set_state_dict: none of the %d saved state "
+                "group(s) match the %d current parameter(s) by name, and "
+                "the counts differ so they cannot be mapped positionally "
+                "(saved e.g. %s; current e.g. %s)"
+                % (len(grouped), len(cur_names),
+                   sorted(grouped)[:3], cur_names[:3]))
+        elif cur_names and overlap and set(grouped) != set(cur_names):
+            # partial overlap: restore the by-name matches, warn about any
+            # leftovers — never guess positionally here. (A strict subset
+            # of current names is a valid lazy-accumulator checkpoint.)
+            unmatched = sorted(set(grouped) - set(cur_names))
+            if unmatched:
+                import warnings
+                warnings.warn(
+                    "optimizer.set_state_dict: %d saved state group(s) have "
+                    "no matching parameter and were ignored: %s"
+                    % (len(unmatched), unmatched[:5]))
+                grouped = {k: v for k, v in grouped.items()
+                           if k in cur_names}
+        for pname, slots in grouped.items():
+            self._accumulators.setdefault(pname, {}).update(slots)
 
     set_dict = set_state_dict
 

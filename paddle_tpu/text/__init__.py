@@ -1,7 +1,9 @@
 """NLP models + datasets. Parity: python/paddle/text/__init__.py."""
 from . import datasets
 from .bert import (BertConfig, BertModel, BertForPretraining,
-                   BertPretrainingHeads, bert_base, bert_large, ErnieModel)
+                   BertPretrainingHeads, bert_base, bert_large)
+from .ernie import (ErnieModel, ErnieForPretraining, ErnieConfig,
+                    ernie_knowledge_mask, ernie_mask_batch)
 from .gpt import GPTConfig, GPTModel, gpt_small
 from .seq2seq import Seq2SeqTransformer
 from .word2vec import SkipGram, Word2Vec

@@ -14,7 +14,7 @@ from ..core.tensor import Tensor
 from ..tensor.creation import arange, zeros, ones
 
 __all__ = ['BertConfig', 'BertModel', 'BertPretrainingHeads',
-           'BertForPretraining', 'bert_base', 'bert_large', 'ErnieModel',
+           'BertForPretraining', 'bert_base', 'bert_large',
            'bert_shard_rules']
 
 
@@ -168,13 +168,6 @@ def bert_base(**kwargs):
 def bert_large(**kwargs):
     return BertConfig(hidden_size=1024, num_hidden_layers=24,
                       num_attention_heads=16, intermediate_size=4096, **kwargs)
-
-
-class ErnieModel(BertModel):
-    """ERNIE 1.0 shares BERT's architecture (different pretraining masking);
-    parity: the reference ERNIE finetune path exercises dygraph + dynamic
-    shapes, which here is the eager tape + bucketed padding."""
-    pass
 
 
 def bert_shard_rules(axis_model='model'):

@@ -1,0 +1,222 @@
+"""Async checkpoint/resume + ERNIE knowledge-masking tests."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate.checkpoint import (
+    AutoCheckpoint, AsyncCheckpointer, save_checkpoint, load_checkpoint)
+
+
+def _tiny_model_and_opt():
+    paddle.seed(7)
+    m = nn.Linear(4, 3)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=m.parameters())
+    return m, opt
+
+
+def _train_steps(m, opt, n, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        x = paddle.to_tensor(rng.standard_normal((8, 4)).astype('float32'))
+        y = paddle.to_tensor(rng.standard_normal((8, 3)).astype('float32'))
+        loss = ((m(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
+class TestCheckpoint:
+    def test_sync_roundtrip(self, tmp_path):
+        m, opt = _tiny_model_and_opt()
+        _train_steps(m, opt, 3)
+        save_checkpoint(str(tmp_path), m, opt, step=3)
+        m2, opt2 = _tiny_model_and_opt()
+        meta = load_checkpoint(str(tmp_path), m2, opt2)
+        assert meta['step'] == 3
+        for (k, a), (_, b) in zip(sorted(m.state_dict().items()),
+                                  sorted(m2.state_dict().items())):
+            np.testing.assert_allclose(a.numpy(), b.numpy())
+
+    def test_async_overlaps_and_snapshot_isolated(self, tmp_path):
+        """The async save snapshots state at save() time: training continues
+        mutating params, yet the checkpoint on disk holds the old values."""
+        m, opt = _tiny_model_and_opt()
+        _train_steps(m, opt, 2)
+        frozen = {k: v.numpy().copy() for k, v in m.state_dict().items()}
+        ck = save_checkpoint(str(tmp_path), m, opt, step=2, async_save=True)
+        _train_steps(m, opt, 5, seed=1)   # mutate AFTER snapshot
+        ck.wait_until_finished()
+        m2, _ = _tiny_model_and_opt()
+        meta = load_checkpoint(str(tmp_path), m2)
+        assert meta['step'] == 2
+        for k, v in m2.state_dict().items():
+            np.testing.assert_allclose(v.numpy(), frozen[k])
+        # and the live model really did move on
+        assert not np.allclose(m.state_dict()['weight'].numpy(),
+                               frozen['weight'])
+
+    def test_async_writes_on_background_thread(self, tmp_path):
+        m, opt = _tiny_model_and_opt()
+        seen = []
+        orig = os.rename
+
+        def spy(src, dst):
+            seen.append(threading.current_thread().name)
+            return orig(src, dst)
+
+        os.rename = spy
+        try:
+            ck = AsyncCheckpointer(str(tmp_path))
+            ck.save(m, opt, step=1)
+            ck.wait_until_finished()
+        finally:
+            os.rename = orig
+        assert any('paddle-tpu-ckpt' in n for n in seen)
+
+    def test_resume_mid_training(self, tmp_path):
+        """Crash after step 10, resume, continue — matches an uninterrupted
+        run bit-for-bit (data replay keyed off the restored step)."""
+        def run(upto, auto):
+            m, opt = _tiny_model_and_opt()
+            auto.layer, auto.optimizer = m, opt
+            start = auto.resume()
+            rng = np.random.default_rng(123)
+            for s in range(upto):
+                x = rng.standard_normal((8, 4)).astype('float32')
+                y = rng.standard_normal((8, 3)).astype('float32')
+                if s < start:
+                    continue   # replay RNG stream only
+                loss = ((m(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2
+                        ).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                auto.step = s + 1
+                if auto.step % auto.save_every == 0:
+                    auto._ck.save(m, opt, auto.step)
+            auto.wait_until_finished()
+            return m
+
+        p = str(tmp_path / 'auto')
+        # interrupted run: 12 steps, checkpoints every 5 -> latest is step 10
+        run(12, AutoCheckpoint(p, save_every=5))
+        resumed = run(20, AutoCheckpoint(p, save_every=5))
+        clean = _tiny_model_and_opt()
+        m_clean, opt_clean = clean
+        rng = np.random.default_rng(123)
+        for _ in range(20):
+            x = rng.standard_normal((8, 4)).astype('float32')
+            y = rng.standard_normal((8, 3)).astype('float32')
+            loss = ((m_clean(paddle.to_tensor(x)) -
+                     paddle.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt_clean.step()
+            opt_clean.clear_grad()
+        np.testing.assert_allclose(resumed.state_dict()['weight'].numpy(),
+                                   m_clean.state_dict()['weight'].numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_partial_write_invisible(self, tmp_path):
+        """A torn write (tmp dir left behind, no rename) must not be seen."""
+        m, opt = _tiny_model_and_opt()
+        save_checkpoint(str(tmp_path), m, opt, step=5)
+        torn = tmp_path / '.tmp-ckpt-9-999'
+        torn.mkdir()
+        (torn / 'meta.json').write_text('{"step": 9}')
+        meta = load_checkpoint(str(tmp_path))
+        assert meta['step'] == 5
+
+    def test_max_keep_prunes(self, tmp_path):
+        m, opt = _tiny_model_and_opt()
+        ck = AsyncCheckpointer(str(tmp_path), max_keep=2)
+        for s in (1, 2, 3, 4):
+            ck.save(m, opt, step=s)
+        ck.wait_until_finished()
+        kept = sorted(d for d in os.listdir(str(tmp_path))
+                      if d.startswith('ckpt-'))
+        assert kept == ['ckpt-3', 'ckpt-4']
+
+    def test_worker_error_surfaces(self, tmp_path):
+        target = tmp_path / 'file_not_dir'   # unwritable checkpoint root
+        target.write_text('x')
+        ck = AsyncCheckpointer(str(target))
+        ck.save(step=1)
+        with pytest.raises(Exception):
+            ck.wait_until_finished()
+
+
+class TestErnieMasking:
+    def _sample(self):
+        # words:  tok: [w0, w0, w1, w2, w2, w2, w3, pad]
+        ids = np.array([11, 12, 13, 14, 15, 16, 17, 0])
+        words = np.array([0, 0, 1, 2, 2, 2, 3, -1])
+        return ids, words
+
+    def test_whole_word_units(self):
+        from paddle_tpu.text import ernie_knowledge_mask
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            ids, words = self._sample()
+            out, pos, lab = ernie_knowledge_mask(
+                ids, words, vocab_size=100, max_predictions=8, mask_token_id=99,
+                masked_lm_prob=0.4, rng=rng)
+            k = int((lab >= 0).sum())
+            masked_words = set(int(words[p]) for p in pos[:k])
+            # every masked word is masked completely
+            for w in masked_words:
+                toks = np.flatnonzero(words == w)
+                assert set(toks) <= set(int(p) for p in pos[:k])
+            # labels record the original ids
+            for p, l in zip(pos[:k], lab[:k]):
+                assert int(l) == int(ids[p])
+            # padding (-1 word) is never masked
+            assert all(words[p] >= 0 for p in pos[:k])
+
+    def test_phrase_span_masked_as_unit(self):
+        from paddle_tpu.text import ernie_knowledge_mask
+        ids, words = self._sample()
+        hit = False
+        rng = np.random.default_rng(3)
+        for _ in range(60):
+            out, pos, lab = ernie_knowledge_mask(
+                ids, words, vocab_size=100, max_predictions=8, mask_token_id=99,
+                masked_lm_prob=0.3, phrase_spans=[(1, 3)], rng=rng)
+            k = int((lab >= 0).sum())
+            mw = set(int(words[p]) for p in pos[:k])
+            if 1 in mw or 2 in mw:
+                assert {1, 2} <= mw   # phrase words always fall together
+                hit = True
+        assert hit
+
+    def test_static_output_shapes(self):
+        from paddle_tpu.text import ernie_mask_batch
+        ids, words = self._sample()
+        bi, bp, bl = ernie_mask_batch([ids, ids], [words, words],
+                                      vocab_size=100, max_predictions=6,
+                                      mask_token_id=99, seed=0)
+        assert bi.shape == (2, 8) and bp.shape == (2, 6) \
+            and bl.shape == (2, 6)
+
+    def test_pretrain_forward_on_masked_batch(self):
+        from paddle_tpu.text import ErnieForPretraining, ErnieConfig, \
+            ernie_mask_batch
+        cfg = ErnieConfig(vocab_size=100, hidden_size=32,
+                          num_hidden_layers=1, num_attention_heads=2,
+                          intermediate_size=64, max_position_embeddings=16)
+        model = ErnieForPretraining(cfg)
+        ids, words = self._sample()
+        bi, bp, bl = ernie_mask_batch([ids, ids], [words, words],
+                                      vocab_size=100, max_predictions=4,
+                                      mask_token_id=99, seed=1)
+        logits, nsp = model(paddle.to_tensor(bi),
+                            masked_positions=paddle.to_tensor(bp))
+        assert tuple(logits.shape) == (2, 4, 100)
+        loss = model.pretraining_loss(
+            logits, nsp, paddle.to_tensor(bl),
+            paddle.to_tensor(np.zeros((2, 1), dtype='int64')))
+        assert np.isfinite(float(loss.numpy()))
