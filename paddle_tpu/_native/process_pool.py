@@ -109,8 +109,15 @@ class ProcessWorkerPool:
             while self._consumed < len(self._batches):
                 item = self._ring.get(timeout_ms=2000)
                 if item == 'timeout':
-                    # crashed worker never commits/aborts its seq — detect
-                    # instead of hanging forever
+                    # a crashed worker never commits/aborts its seq, so the
+                    # ordered ring would wait on that slot forever. A worker
+                    # that exited with a nonzero code is dead-crashed even if
+                    # its siblings are alive and still producing later seqs —
+                    # the lost batch cannot be recovered, so raise.
+                    dead = [p for p in self._procs
+                            if p.exitcode not in (None, 0)]
+                    if dead and self._consumed < len(self._batches):
+                        self._raise_worker_error(dead)
                     if (self._consumed < len(self._batches) and
                             not any(p.is_alive() for p in self._procs)):
                         self._raise_worker_error()
@@ -130,10 +137,16 @@ class ProcessWorkerPool:
         finally:
             self.shutdown()
 
-    def _raise_worker_error(self):
+    def _raise_worker_error(self, dead=None):
         try:
             seq, tb = self._err_q.get_nowait()
         except Exception:
+            if dead:   # killed without a traceback (segfault, OOM, kill -9)
+                codes = ', '.join('worker %d exitcode %s'
+                                  % (self._procs.index(p), p.exitcode)
+                                  for p in dead)
+                raise RuntimeError(
+                    "DataLoader worker died without a traceback (%s)" % codes)
             raise RuntimeError("DataLoader worker failed (no traceback)")
         raise RuntimeError(f"DataLoader worker failed on batch {seq}:\n{tb}")
 

@@ -11,7 +11,9 @@ import numpy as np
 
 from . import load as _load_lib
 
-_BASIC = re.compile(r"[^\s\w]|\w+", re.UNICODE)
+# word chars exclude '_' so underscore splits as punctuation, matching the
+# native tokenizer's BERT-style BasicTokenizer ASCII-punct table
+_BASIC = re.compile(r"[^\W_]+|[^\s\w]|_", re.UNICODE)
 
 
 class Tokenizer:
@@ -38,8 +40,13 @@ class Tokenizer:
         return self._cvocab is not None
 
     def encode(self, text, max_len=512):
-        """text -> int32 id array (truncated at max_len)."""
-        if self._cvocab is not None:
+        """text -> int32 id array (truncated at max_len).
+
+        The native hot loop is byte/ASCII-level (whitespace + BERT-style
+        ASCII punct); non-ASCII lines take the Unicode-aware Python path so
+        both paths always produce identical ids for the text they handle.
+        """
+        if self._cvocab is not None and text.isascii():
             out = np.empty(max_len, np.int32)
             ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
             if self.wordpiece:

@@ -50,7 +50,7 @@ def stage_pspec(stacked_params, axis=env.PIPE_AXIS):
 
 
 def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh=None,
-                   axis=env.PIPE_AXIS, checkpoint=True):
+                   axis=env.PIPE_AXIS, checkpoint=True, data_spec='auto'):
     """Run ``x`` through the pipelined stage stack with a GPipe schedule.
 
     stage_fn(params, mb) -> mb_out: one stage's forward on ONE microbatch;
@@ -59,10 +59,18 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh=None,
     stacked_params: pytree of [n_stages, ...] leaves (see stack_stage_params);
       may live sharded over the pipe axis or replicated — shard_map slices it.
     x: [batch, ...] global input; batch must divide into n_microbatches.
+    data_spec: PartitionSpec for ``x`` (and the output). Default 'auto'
+      shards the batch dim over the mesh's data axis when the mesh has one
+      (dp×pp composition: each data-replica runs the pipe schedule on its
+      batch shard), else replicates. Each device's local batch must divide
+      into n_microbatches.
     Returns [batch, ...] output after all stages, differentiable end-to-end.
     """
     mesh = mesh or env.get_mesh()
     S = num_stages(mesh, axis)
+    if data_spec == 'auto':
+        data_spec = P(env.DATA_AXIS) \
+            if mesh is not None and env.DATA_AXIS in mesh.shape else P()
     n_stacked = jax.tree.leaves(stacked_params)[0].shape[0]
     if S <= 1:
         # no pipe axis: run ALL stacked stages sequentially per microbatch
@@ -78,10 +86,24 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh=None,
             f"stacked stage dim ({n_stacked}) != mesh '{axis}' size ({S})")
 
     B = x.shape[0]
-    if B % n_microbatches:
-        raise ValueError(f"batch {B} not divisible by {n_microbatches} "
-                         f"microbatches")
-    mb = B // n_microbatches
+    # local (per-data-replica) batch: the batch dim divides over any mesh
+    # axes named in data_spec's first entry before per_device sees it
+    dp = 1
+    if len(data_spec) > 0 and data_spec[0] is not None:
+        names = data_spec[0] if isinstance(data_spec[0], tuple) \
+            else (data_spec[0],)
+        for n in names:
+            dp *= int(mesh.shape[n])
+    if B % dp:
+        raise ValueError(f"batch {B} not divisible by data-axis size {dp}")
+    B_local = B // dp
+    if B_local % n_microbatches:
+        raise ValueError(
+            f"local (per-data-replica) batch {B_local} (= {B}/{dp}) not "
+            f"divisible by {n_microbatches} microbatches; shrink "
+            f"n_microbatches, grow the batch, or pass data_spec=P() to "
+            f"replicate the batch over the data axis instead")
+    mb = B_local // n_microbatches
     fn = jax.checkpoint(stage_fn) if checkpoint else stage_fn
     T = n_microbatches + S - 1
     fwd = [(i, (i + 1) % S) for i in range(S)]           # stage i -> i+1
@@ -122,7 +144,9 @@ def pipeline_apply(stage_fn, stacked_params, x, n_microbatches, mesh=None,
     pspec_params = stage_pspec(stacked_params, axis)
     sm = shard_map(
         per_device, mesh=mesh,
-        in_specs=(pspec_params, P()),    # x replicated over pipe axis
-        out_specs=P(),
+        # x replicated over the pipe axis, batch-sharded over the data axis
+        # (data_spec); params sharded over pipe only.
+        in_specs=(pspec_params, data_spec),
+        out_specs=data_spec,
         check=False)
     return sm(stacked_params, x)

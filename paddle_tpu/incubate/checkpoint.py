@@ -45,17 +45,29 @@ def _write_snapshot(path, snap):
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
+    def _dump(name, writer):
+        # fsync before the commit rename: the rename's metadata must never
+        # reach disk ahead of the payload pages, or a power loss could leave
+        # a committed-but-torn checkpoint that resume would trust.
+        with open(os.path.join(tmp, name), 'wb') as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+
     if 'model' in snap:
-        with open(os.path.join(tmp, 'model.pdparams'), 'wb') as f:
-            pickle.dump(snap['model'], f, protocol=4)
+        _dump('model.pdparams',
+              lambda f: pickle.dump(snap['model'], f, protocol=4))
     if 'opt' in snap:
-        with open(os.path.join(tmp, 'opt.pdopt'), 'wb') as f:
-            pickle.dump(snap['opt'], f, protocol=4)
-    with open(os.path.join(tmp, 'meta.json'), 'w') as f:
-        json.dump(snap['meta'], f)
+        _dump('opt.pdopt', lambda f: pickle.dump(snap['opt'], f, protocol=4))
+    _dump('meta.json', lambda f: f.write(json.dumps(snap['meta']).encode()))
     if os.path.isdir(final):
         shutil.rmtree(final)
     os.rename(tmp, final)  # atomic commit of the checkpoint dir
+    dir_fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)   # persist the rename itself
+    finally:
+        os.close(dir_fd)
     # atomically flip the 'latest' pointer
     ptr_tmp = os.path.join(path, '.latest.tmp')
     with open(ptr_tmp, 'w') as f:

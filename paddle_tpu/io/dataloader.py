@@ -182,7 +182,13 @@ class DataLoader:
         (the hot path); dicts/strings/objects use the threaded path."""
         try:
             if self.batch_sampler is not None:
-                first = next(iter(self.batch_sampler), None)
+                it = iter(self.batch_sampler)
+                first = next(it, None)
+                if it is self.batch_sampler and first is not None:
+                    # one-shot sampler (generator): the probe consumed its
+                    # first batch — stitch it back so iteration sees it
+                    import itertools
+                    self.batch_sampler = itertools.chain([first], it)
             else:
                 first = [0] if len(self.dataset) else None
             if first is None:

@@ -345,4 +345,48 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample: planned (PLM margin-softmax)")
+    """Sample ``num_samples`` class centers always containing the positives.
+
+    For margin-softmax / partial-FC large-class training: the classification
+    layer only materializes the sampled columns. Returns
+    ``(remapped_label, sampled_class_center)``:
+    - sampled_class_center: [num_samples] sorted ascending class ids — every
+      class present in ``label`` (while they fit), topped up with uniformly
+      random negatives;
+    - remapped_label: [N] index of each label within sampled_class_center.
+
+    TPU-first fixed-shape design: one jit-compatible top-k over a random
+    priority vector (positives keyed into [0,1), negatives into [1,2)) —
+    no host-side set arithmetic, fully static [num_samples] output. If more
+    than num_samples distinct positive classes exist, a uniform subset is
+    kept and the dropped ones remap to -1.
+    """
+    label = _t(label)
+    if num_samples > num_classes:
+        raise ValueError(
+            "class_center_sample: num_samples (%d) must be <= num_classes "
+            "(%d)" % (num_samples, num_classes))
+    if num_samples == num_classes:
+        # degenerate: keep every class, identity remap (shape stays
+        # [num_samples] as documented)
+        def fn_all(lv):
+            sampled = jnp.arange(num_classes, dtype=lv.dtype)
+            return lv, sampled
+        return apply_op(fn_all, (label,), n_outputs=2,
+                        differentiable=False)
+    key = _rng.next_key()
+
+    def fn(lv):
+        lab = lv.reshape(-1).astype(jnp.int32)
+        pos = jnp.zeros((num_classes,), jnp.bool_).at[lab].set(True)
+        u = jax.random.uniform(key, (num_classes,))
+        # positives sort strictly before any negative
+        priority = jnp.where(pos, u, u + 1.0)
+        _, sampled = jax.lax.top_k(-priority, num_samples)
+        sampled = jnp.sort(sampled).astype(lv.dtype)
+        table = jnp.full((num_classes,), -1, jnp.int32) \
+            .at[sampled].set(jnp.arange(num_samples, dtype=jnp.int32))
+        remapped = table[lab].reshape(lv.shape).astype(lv.dtype)
+        return remapped, sampled
+
+    return apply_op(fn, (label,), n_outputs=2, differentiable=False)

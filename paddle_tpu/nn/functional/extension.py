@@ -34,8 +34,20 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1):
 
 
 def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    """Lengths -> binary mask [..., maxlen].
+
+    TPU-first: the mask width is a compile-time constant (XLA has no
+    data-dependent shapes), so under jit/to_static ``maxlen`` must be given
+    explicitly; eager mode may infer it from ``x.max()`` (host sync).
+    """
     x = _t(x)
     if maxlen is None:
+        import jax.core as _jcore
+        if isinstance(x._value, _jcore.Tracer):
+            raise ValueError(
+                "sequence_mask(maxlen=None) cannot infer the mask width "
+                "from a traced tensor: XLA requires static output shapes. "
+                "Pass maxlen explicitly (e.g. the padded sequence length).")
         maxlen = int(np.asarray(x.numpy()).max())
     elif isinstance(maxlen, Tensor):
         maxlen = int(maxlen.item())

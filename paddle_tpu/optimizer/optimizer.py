@@ -132,7 +132,21 @@ class Optimizer:
                     % (len(unmatched), unmatched[:5]))
                 grouped = {k: v for k, v in grouped.items()
                            if k in cur_names}
+        # by-name restores get the same loud shape validation the positional
+        # path has: a same-named param of a different shape means the
+        # checkpoint came from a different model.
+        by_name = {p.name: p for p in cur_params}
         for pname, slots in grouped.items():
+            p = by_name.get(pname)
+            if p is not None:
+                for sname, v in slots.items():
+                    if v.ndim > 0 and tuple(v.shape) != tuple(p.shape):
+                        raise ValueError(
+                            "optimizer.set_state_dict: saved state '%s.%s' "
+                            "has shape %s but parameter '%s' has shape %s; "
+                            "the checkpoint was saved from a different model"
+                            % (pname, sname, tuple(v.shape), pname,
+                               tuple(p.shape)))
             self._accumulators.setdefault(pname, {}).update(slots)
 
     set_dict = set_state_dict
