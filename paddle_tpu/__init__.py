@@ -34,6 +34,7 @@ from . import amp
 from . import incubate
 from . import utils
 from . import device
+from . import reader
 from . import regularizer
 from . import sysconfig
 from .framework import save, load, in_dynamic_mode, enable_static, disable_static, in_static_mode

@@ -105,23 +105,29 @@ class ProcessWorkerPool:
     def __iter__(self):
         if self._closed:
             return
+        stalls = 0   # consecutive ring timeouts with zero progress
         try:
             while self._consumed < len(self._batches):
                 item = self._ring.get(timeout_ms=2000)
                 if item == 'timeout':
-                    # a crashed worker never commits/aborts its seq, so the
-                    # ordered ring would wait on that slot forever. A worker
-                    # that exited with a nonzero code is dead-crashed even if
-                    # its siblings are alive and still producing later seqs —
-                    # the lost batch cannot be recovered, so raise.
+                    # a worker that crashed AFTER claiming a batch never
+                    # commits/aborts its seq, so the ordered ring stalls on
+                    # that slot forever — raise once a dead (nonzero-exit)
+                    # worker coincides with sustained zero progress. A worker
+                    # killed while idle loses no batch: siblings keep
+                    # draining the shared task queue, progress continues,
+                    # and no error is raised.
+                    stalls += 1
                     dead = [p for p in self._procs
                             if p.exitcode not in (None, 0)]
-                    if dead and self._consumed < len(self._batches):
+                    if (dead and stalls >= 3 and
+                            self._consumed < len(self._batches)):
                         self._raise_worker_error(dead)
                     if (self._consumed < len(self._batches) and
                             not any(p.is_alive() for p in self._procs)):
-                        self._raise_worker_error()
+                        self._raise_worker_error(dead or None)
                     continue
+                stalls = 0
                 self._consumed += 1
                 if item is None:
                     break

@@ -1,0 +1,14 @@
+"""Reader-creator decorators. Parity: python/paddle/reader/decorator.py.
+
+A *reader creator* is a zero-arg callable returning an iterator of samples —
+the reference's original data-feeding abstraction, kept for API compat; the
+TPU-first hot path is paddle_tpu.io.DataLoader, and these decorators are the
+glue that lets legacy reader pipelines feed it.
+"""
+from .decorator import (map_readers, shuffle, chain, buffered, compose,
+                        firstn, xmap_readers, cache, multiprocess_reader,
+                        ComposeNotAligned)
+
+__all__ = ['map_readers', 'shuffle', 'chain', 'buffered', 'compose',
+           'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
+           'ComposeNotAligned']

@@ -1,0 +1,271 @@
+"""Reader-creator decorators (behavioral parity with the reference's
+python/paddle/reader/decorator.py, reimplemented for this runtime).
+
+All functions take and return *reader creators*: ``creator() -> iterator``.
+"""
+import itertools
+import queue
+import random
+import threading
+
+__all__ = ['map_readers', 'shuffle', 'chain', 'buffered', 'compose',
+           'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
+           'ComposeNotAligned']
+
+
+class ComposeNotAligned(ValueError):
+    """Raised by compose(check_alignment=True) when the component readers
+    yield different numbers of samples."""
+
+
+def map_readers(func, *readers):
+    """Zip several readers and map ``func`` over the tuples of samples:
+    yields ``func(r1_sample, r2_sample, ...)``."""
+
+    def reader():
+        its = [r() for r in readers]
+        for args in zip(*its):
+            yield func(*args)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: fill a ``buf_size`` window, shuffle it, drain,
+    repeat — bounded memory, locally (not globally) shuffled."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers: all samples of the first, then the second, ..."""
+
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples: (a, (b, c)) per-sample outputs become
+    (a, b, c). ``check_alignment=True`` (default) raises ComposeNotAligned
+    when the readers run out at different lengths."""
+    check_alignment = kwargs.pop('check_alignment', True)
+    if kwargs:
+        raise TypeError("compose() got unexpected kwargs %r" % list(kwargs))
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        its = [r() for r in readers]
+        done = object()
+        while True:
+            outs = [next(it, done) for it in its]
+            if all(o is done for o in outs):
+                return
+            if any(o is done for o in outs):
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "readers yielded different sample counts")
+                return
+            yield sum((make_tuple(o) for o in outs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Read-ahead on a worker thread through a bounded queue of ``size``
+    samples — overlaps producing with consuming."""
+
+    end = object()
+
+    def data_reader():
+        q = queue.Queue(maxsize=size)
+        err = []
+
+        def produce():
+            try:
+                for e in reader():
+                    q.put(e)
+            except BaseException as ex:   # surface in the consumer
+                err.append(ex)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                if err:
+                    raise err[0]
+                return
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """Limit a reader to its first ``n`` samples."""
+
+    def data_reader():
+        return itertools.islice(reader(), n)
+
+    return data_reader
+
+
+def cache(reader):
+    """Materialize the full stream on first iteration; replay from memory
+    afterwards (for small datasets with expensive readers). A first fill
+    that raises caches nothing, so a retry starts clean."""
+    state = {}
+
+    def data_reader():
+        if 'data' not in state:
+            state['data'] = list(reader())   # only cached when complete
+        return iter(state['data'])
+
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Map ``mapper`` over a reader with ``process_num`` worker threads and a
+    ``buffer_size``-bounded pipeline; ``order=True`` preserves input order.
+
+    Worker threads (not processes): the mappers this decorates are
+    numpy/PIL-style transforms that release the GIL, and samples stay in
+    shared memory — same overlap the reference gets, minus the pickling.
+    """
+
+    end = object()
+
+    def data_reader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+        err = []
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as ex:
+                err.append(ex)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, sample = item
+                try:
+                    out_q.put((i, mapper(sample)))
+                except BaseException as ex:
+                    err.append(ex)
+                    out_q.put(end)
+                    return
+
+        threads = [threading.Thread(target=feed, daemon=True)]
+        threads += [threading.Thread(target=work, daemon=True)
+                    for _ in range(process_num)]
+        for t in threads:
+            t.start()
+
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            i, mapped = item
+            if not order:
+                yield mapped
+            else:
+                pending[i] = mapped
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        if err:
+            raise err[0]
+        while next_i in pending:   # drain any stragglers in order mode
+            yield pending.pop(next_i)
+            next_i += 1
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Run several readers in forked worker processes, multiplexing their
+    samples into one stream (sample order across readers is arbitrary).
+
+    Samples cross the process boundary pickled through a multiprocessing
+    queue; use for python-bound readers (parsing, decompression). The
+    ``use_pipe`` flag is accepted for API parity — both modes use the
+    queue transport here. Requires a fork-capable platform (the worker
+    target is a closure, which spawn cannot pickle).
+    """
+    import multiprocessing as mp
+
+    def data_reader():
+        if 'fork' not in mp.get_all_start_methods():
+            raise RuntimeError(
+                "multiprocess_reader requires the 'fork' start method; "
+                "use xmap_readers/buffered on this platform")
+        ctx = mp.get_context('fork')
+        q = ctx.Queue(queue_size)
+
+        def work(r):
+            try:
+                for s in r():
+                    q.put(('s', s))
+            except BaseException as ex:
+                q.put(('e', repr(ex)))
+            finally:
+                q.put(('d', None))
+
+        procs = [ctx.Process(target=work, args=(r,), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        done = 0
+        try:
+            while done < len(procs):
+                kind, payload = q.get()
+                if kind == 'd':
+                    done += 1
+                elif kind == 'e':
+                    raise RuntimeError(
+                        "multiprocess_reader worker failed: %s" % payload)
+                else:
+                    yield payload
+        finally:
+            for p in procs:
+                p.join(timeout=1)
+                if p.is_alive():
+                    p.terminate()
+
+    return data_reader

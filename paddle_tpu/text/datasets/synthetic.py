@@ -73,7 +73,17 @@ class Imikolov(_SyntheticSeqDataset):
 
 
 class Movielens(Dataset):
+    """ml-1m ratings. Real loader (PADDLE_TPU_DATA_HOME/movielens/ml-1m.zip)
+    yields full (uid, gender, age, job, movie, categories, title, rating)
+    feature rows; the synthetic fallback keeps the 3-tuple shape."""
+
     def __init__(self, mode='train', **kwargs):
+        from . import real
+        loaded = real.load_movielens(mode)
+        if loaded is not None:
+            self.feats, self.meta = loaded
+            self.synthetic = False
+            return
         rng = np.random.RandomState(7 if mode == 'train' else 8)
         n = 4096 if mode == 'train' else 512
         self.users = rng.randint(0, 6040, n).astype(np.int64)
@@ -82,10 +92,12 @@ class Movielens(Dataset):
         self.synthetic = True
 
     def __getitem__(self, idx):
+        if not self.synthetic:
+            return self.feats[idx]
         return (self.users[idx], self.movies[idx], self.ratings[idx])
 
     def __len__(self):
-        return len(self.users)
+        return len(self.feats) if not self.synthetic else len(self.users)
 
 
 class UCIHousing(Dataset):
@@ -112,28 +124,77 @@ class UCIHousing(Dataset):
 
 
 class WMT14(_SyntheticSeqDataset):
-    """Translation pairs: (src_ids, trg_ids, trg_next_ids)."""
+    """Translation pairs: (src_ids, trg_ids, trg_next_ids). Real loader
+    reads PADDLE_TPU_DATA_HOME/wmt14/wmt14.tgz (reference wmt14.py layout)."""
     VOCAB = 30000
     SEQ = 32
 
+    def __init__(self, mode='train', dict_size=30000, **kwargs):
+        loaded = self._load_real(mode, dict_size, **kwargs)
+        if loaded is not None:
+            self.pairs, self.src_dict, self.trg_dict = loaded
+            self.synthetic = False
+            return
+        # synthetic ids must respect the requested dict size, or a model
+        # sized to it would gather out of bounds
+        self.VOCAB = min(type(self).VOCAB, dict_size)
+        super().__init__(mode, **kwargs)
+
+    def _load_real(self, mode, dict_size, **kwargs):
+        from . import real
+        return real.load_wmt14(mode, dict_size)
+
     def __getitem__(self, idx):
+        if not self.synthetic:
+            return self.pairs[idx]
         src = self.docs[idx]
         trg = np.roll(src, 1)
         return src, trg, np.roll(trg, -1)
 
+    def __len__(self):
+        return len(self.pairs) if not self.synthetic else len(self.docs)
+
 
 class WMT16(WMT14):
-    pass
+    """Multi30k en-de. Real loader reads
+    PADDLE_TPU_DATA_HOME/wmt16/wmt16.tar.gz (reference wmt16.py layout)."""
+
+    def __init__(self, mode='train', src_dict_size=10000,
+                 trg_dict_size=10000, src_lang='en', **kwargs):
+        self._cfg = (src_dict_size, trg_dict_size, src_lang)
+        super().__init__(mode, dict_size=min(src_dict_size, trg_dict_size),
+                         **kwargs)
+
+    def _load_real(self, mode, dict_size, **kwargs):
+        from . import real
+        src_size, trg_size, src_lang = self._cfg
+        return real.load_wmt16(mode, src_size, trg_size, src_lang)
 
 
 class Conll05st(_SyntheticSeqDataset):
-    """SRL: (words, predicate, marks..., labels)."""
+    """SRL. Real loader (PADDLE_TPU_DATA_HOME/conll05/) yields the
+    reference's 9-slot samples (words, 5 ctx windows, predicate, mark,
+    labels); synthetic fallback keeps the 3-tuple shape."""
     VOCAB = 44068
     SEQ = 64
     NUM_CLASSES = 67
 
+    def __init__(self, mode='train', **kwargs):
+        from . import real
+        loaded = real.load_conll05()
+        if loaded is not None:
+            self.samples = loaded
+            self.synthetic = False
+            return
+        super().__init__(mode, **kwargs)
+
     def __getitem__(self, idx):
+        if not self.synthetic:
+            return self.samples[idx]
         words = self.docs[idx]
         labels = (words % self.NUM_CLASSES).astype(np.int64)
         pred = words[:1]
         return words, pred, labels
+
+    def __len__(self):
+        return len(self.samples) if not self.synthetic else len(self.docs)

@@ -1,0 +1,341 @@
+"""Reader decorators + gated real text-dataset loaders.
+
+Loader tests build tiny archives in the reference's on-disk layouts inside a
+tmp PADDLE_TPU_DATA_HOME, so the gated code paths run without any network.
+"""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader
+
+
+def _r(items):
+    def creator():
+        return iter(items)
+    return creator
+
+
+class TestDecorators:
+    def test_map_readers(self):
+        out = list(reader.map_readers(lambda a, b: a + b,
+                                      _r([1, 2, 3]), _r([10, 20, 30]))())
+        assert out == [11, 22, 33]
+
+    def test_shuffle_is_permutation(self):
+        import random
+        random.seed(0)
+        out = list(reader.shuffle(_r(range(20)), buf_size=8)())
+        assert sorted(out) == list(range(20)) and out != list(range(20))
+
+    def test_chain(self):
+        assert list(reader.chain(_r([1, 2]), _r([3]), _r([4, 5]))()) \
+            == [1, 2, 3, 4, 5]
+
+    def test_compose_flattens_and_checks_alignment(self):
+        out = list(reader.compose(_r([(1, 2), (3, 4)]), _r(['a', 'b']))())
+        assert out == [(1, 2, 'a'), (3, 4, 'b')]
+        with pytest.raises(reader.ComposeNotAligned):
+            list(reader.compose(_r([1, 2, 3]), _r([1]))())
+        # check_alignment=False truncates silently
+        assert list(reader.compose(_r([1, 2, 3]), _r([9]),
+                                   check_alignment=False)()) == [(1, 9)]
+
+    def test_buffered_order_and_error_propagation(self):
+        assert list(reader.buffered(_r(range(10)), 3)()) == list(range(10))
+
+        def bad():
+            yield 1
+            raise ValueError("boom")
+
+        it = reader.buffered(lambda: bad(), 2)()
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="boom"):
+            list(it)
+
+    def test_firstn(self):
+        assert list(reader.firstn(_r(range(100)), 4)()) == [0, 1, 2, 3]
+
+    def test_cache_reads_underlying_once(self):
+        calls = []
+
+        def creator():
+            calls.append(1)
+            return iter([1, 2, 3])
+
+        c = reader.cache(creator)
+        assert list(c()) == [1, 2, 3]
+        assert list(c()) == [1, 2, 3]
+        assert len(calls) == 1
+
+    @pytest.mark.parametrize('order', [False, True])
+    def test_xmap_readers(self, order):
+        out = list(reader.xmap_readers(lambda x: x * 2, _r(range(30)),
+                                       process_num=4, buffer_size=8,
+                                       order=order)())
+        if order:
+            assert out == [x * 2 for x in range(30)]
+        else:
+            assert sorted(out) == [x * 2 for x in range(30)]
+
+    def test_xmap_error_propagates(self):
+        def mapper(x):
+            if x == 5:
+                raise RuntimeError("mapper died")
+            return x
+
+        with pytest.raises(RuntimeError, match="mapper died"):
+            list(reader.xmap_readers(mapper, _r(range(10)), 2, 4,
+                                     order=True)())
+
+    def test_multiprocess_reader(self):
+        rs = [_r([1, 2, 3]), _r([4, 5])]
+        out = sorted(reader.multiprocess_reader(rs)())
+        assert out == [1, 2, 3, 4, 5]
+
+    def test_fluid_io_reexports(self):
+        from paddle_tpu import io
+        assert io.xmap_readers is reader.xmap_readers
+        assert io.buffered is reader.buffered
+
+
+@pytest.fixture
+def data_home(tmp_path, monkeypatch):
+    from paddle_tpu.text.datasets import real
+    monkeypatch.setattr(real, 'DATA_HOME', str(tmp_path))
+    return tmp_path
+
+
+def _add_bytes(tf, name, payload):
+    info = tarfile.TarInfo(name)
+    info.size = len(payload)
+    tf.addfile(info, io.BytesIO(payload))
+
+
+class TestWMT14Loader:
+    def _build(self, home):
+        d = home / 'wmt14'
+        d.mkdir()
+        src_words = ['<s>', '<e>', '<unk>', 'hello', 'world', 'good']
+        trg_words = ['<s>', '<e>', '<unk>', 'bonjour', 'monde']
+        train = "hello world\tbonjour monde\ngood day\tbonjour\n"
+        long = ' '.join(['hello'] * 90) + "\tbonjour\n"   # filtered (>80)
+        with tarfile.open(d / 'wmt14.tgz', 'w:gz') as tf:
+            _add_bytes(tf, 'data/src.dict',
+                       '\n'.join(src_words).encode() + b'\n')
+            _add_bytes(tf, 'data/trg.dict',
+                       '\n'.join(trg_words).encode() + b'\n')
+            _add_bytes(tf, 'data/train/train', (train + long).encode())
+            _add_bytes(tf, 'data/test/test', b"hello\tmonde\n")
+
+    def test_roundtrip(self, data_home):
+        from paddle_tpu.text.datasets.real import load_wmt14
+        self._build(data_home)
+        pairs, src_dict, trg_dict = load_wmt14('train', dict_size=30000)
+        assert len(pairs) == 2    # the >80-token pair is filtered
+        src, trg, nxt = pairs[0]
+        # <s> hello world <e>
+        np.testing.assert_array_equal(src, [0, 3, 4, 1])
+        np.testing.assert_array_equal(trg, [0, 3, 4])     # <s> bonjour monde
+        np.testing.assert_array_equal(nxt, [3, 4, 1])     # bonjour monde <e>
+        # unknown word 'day' -> UNK_IDX 2
+        assert 2 in pairs[1][0]
+
+    def test_dataset_class_uses_real(self, data_home):
+        self._build(data_home)
+        from paddle_tpu.text.datasets import WMT14
+        ds = WMT14('test')
+        assert not ds.synthetic and len(ds) == 1
+        src, trg, nxt = ds[0]
+        assert src.tolist() == [0, 3, 1]   # <s> hello <e>
+
+
+class TestWMT16Loader:
+    def _build(self, home):
+        d = home / 'wmt16'
+        d.mkdir()
+        train = ("a cat\teine katze\n"
+                 "a dog runs\tein hund rennt\n"
+                 "a cat\teine katze\n")
+        val = "a bird\tein vogel\n"
+        with tarfile.open(d / 'wmt16.tar.gz', 'w:gz') as tf:
+            _add_bytes(tf, 'wmt16/train', train.encode())
+            _add_bytes(tf, 'wmt16/val', val.encode())
+            _add_bytes(tf, 'wmt16/test', b"a cat\tein hund\n")
+
+    def test_dict_ids_and_pairs(self, data_home):
+        from paddle_tpu.text.datasets.real import load_wmt16
+        self._build(data_home)
+        pairs, src_dict, trg_dict = load_wmt16('train')
+        assert src_dict['<s>'] == 0 and src_dict['<e>'] == 1 \
+            and src_dict['<unk>'] == 2
+        # 'a' and 'cat' are the most frequent English words
+        assert src_dict['a'] == 3 and src_dict['cat'] == 4
+        assert len(pairs) == 3
+        src, trg, nxt = pairs[0]
+        np.testing.assert_array_equal(src, [0, 3, 4, 1])
+        # val split: 'bird'/'vogel' unseen in train dict -> unk
+        vpairs, _, _ = load_wmt16('val')
+        assert 2 in vpairs[0][0]
+
+    def test_src_lang_de_swaps_columns(self, data_home):
+        from paddle_tpu.text.datasets.real import load_wmt16
+        self._build(data_home)
+        pairs, src_dict, _ = load_wmt16('train', src_lang='de')
+        assert 'katze' in src_dict and 'cat' not in src_dict
+
+
+class TestConll05Loader:
+    def _build(self, home):
+        d = home / 'conll05'
+        d.mkdir()
+        (d / 'wordDict.txt').write_text(
+            '\n'.join(['<unk>', 'the', 'cat', 'sat', 'bos', 'eos']) + '\n')
+        (d / 'verbDict.txt').write_text('\n'.join(['<unk>', 'sat']) + '\n')
+        (d / 'targetDict.txt').write_text(
+            '\n'.join(['B-A0', 'I-A0', 'B-V', 'O']) + '\n')
+        words = "the\ncat\nsat\n\n"
+        props = "-\t(A0*\n-\t*)\nsat\t(V*)\n\n"
+        # props file: first col is verb sense, following cols per predicate
+        props = "-  (A0*\n-  *)\nsat  (V*)\n\n"
+        wbuf, pbuf = io.BytesIO(), io.BytesIO()
+        with gzip.GzipFile(fileobj=wbuf, mode='w') as g:
+            g.write(words.encode())
+        with gzip.GzipFile(fileobj=pbuf, mode='w') as g:
+            g.write(props.encode())
+        with tarfile.open(d / 'conll05st-tests.tar.gz', 'w:gz') as tf:
+            _add_bytes(tf,
+                       'conll05st-release/test.wsj/words/test.wsj.words.gz',
+                       wbuf.getvalue())
+            _add_bytes(tf,
+                       'conll05st-release/test.wsj/props/test.wsj.props.gz',
+                       pbuf.getvalue())
+
+    def test_srl_sample(self, data_home):
+        from paddle_tpu.text.datasets.real import load_conll05
+        self._build(data_home)
+        samples = load_conll05()
+        assert len(samples) == 1
+        (word_ids, c_n2, c_n1, c_0, c_p1, c_p2, pred, mark,
+         labels) = samples[0]
+        np.testing.assert_array_equal(word_ids, [1, 2, 3])  # the cat sat
+        # predicate 'sat' at index 2: ctx_0 = 'sat', n1='cat', n2='the',
+        # p1/p2 past the end -> 'eos'
+        assert c_0.tolist() == [3, 3, 3]
+        assert c_n1.tolist() == [2, 2, 2] and c_n2.tolist() == [1, 1, 1]
+        assert c_p1.tolist() == [5, 5, 5] and c_p2.tolist() == [5, 5, 5]
+        np.testing.assert_array_equal(mark, [1, 1, 1])
+        # labels: B-A0 I-A0 B-V -> dict {B-A0:0,B-V:1,I-A0:2,I-V:3,O:4}
+        lbl_dict_order = ['B-A0', 'B-V', 'I-A0', 'I-V', 'O']
+        assert labels.tolist() == [
+            lbl_dict_order.index('B-A0'), lbl_dict_order.index('I-A0'),
+            lbl_dict_order.index('B-V')]
+
+    def test_dataset_class(self, data_home):
+        self._build(data_home)
+        from paddle_tpu.text.datasets import Conll05st
+        ds = Conll05st()
+        assert not ds.synthetic and len(ds) == 1 and len(ds[0]) == 9
+
+
+class TestMovielensLoader:
+    def _build(self, home):
+        d = home / 'movielens'
+        d.mkdir()
+        movies = ("1::Toy Story (1995)::Animation|Children's\n"
+                  "2::Jumanji (1995)::Adventure\n")
+        users = ("1::M::25::10::48067\n"
+                 "2::F::35::3::55117\n")
+        ratings = ("1::1::5::978300760\n"
+                   "1::2::3::978302109\n"
+                   "2::1::4::978301968\n" * 4)
+        with zipfile.ZipFile(d / 'ml-1m.zip', 'w') as z:
+            z.writestr('ml-1m/movies.dat', movies)
+            z.writestr('ml-1m/users.dat', users)
+            z.writestr('ml-1m/ratings.dat', ratings)
+
+    def test_features(self, data_home):
+        from paddle_tpu.text.datasets.real import load_movielens
+        self._build(data_home)
+        train, meta = load_movielens('train')
+        test, _ = load_movielens('test')
+        assert len(train) + len(test) == 12
+        uid, gender, age, job, mid, cats, title, rating = train[0]
+        assert gender in (0, 1) and 0 <= age <= 6
+        assert meta['n_users'] == 3 and meta['n_movies'] == 3
+        assert len(meta['categories']) == 3   # Animation, Children's, Adv.
+        assert rating in (3.0, 4.0, 5.0)
+
+    def test_dataset_class(self, data_home):
+        self._build(data_home)
+        from paddle_tpu.text.datasets import Movielens
+        ds = Movielens('train')
+        assert not ds.synthetic and len(ds[0]) == 8
+
+
+class TestSyntheticFallbacks:
+    def test_all_fall_back_without_files(self, data_home):
+        from paddle_tpu.text.datasets import (WMT14, WMT16, Conll05st,
+                                              Movielens)
+        for cls in (WMT14, WMT16, Conll05st, Movielens):
+            ds = cls('train')
+            assert ds.synthetic and len(ds) > 0
+            assert isinstance(ds[0], tuple)
+
+
+class TestReviewRegressions:
+    def test_synthetic_wmt_respects_dict_size(self, data_home):
+        from paddle_tpu.text.datasets import WMT14, WMT16
+        ds = WMT14('train', dict_size=500)
+        assert ds.synthetic
+        assert max(int(ds[i][0].max()) for i in range(8)) < 500
+        ds16 = WMT16('train', src_dict_size=300, trg_dict_size=800)
+        assert max(int(ds16[i][0].max()) for i in range(8)) < 300
+
+    def test_conll05_no_trailing_blank_line(self, data_home):
+        from paddle_tpu.text.datasets.real import load_conll05
+        d = data_home / 'conll05'
+        d.mkdir()
+        (d / 'wordDict.txt').write_text('<unk>\nthe\ncat\nsat\nbos\neos\n')
+        (d / 'verbDict.txt').write_text('<unk>\nsat\n')
+        (d / 'targetDict.txt').write_text('B-A0\nI-A0\nB-V\nO\n')
+        words = "the\ncat\nsat"                 # no trailing newline/blank
+        props = "-  (A0*\n-  *)\nsat  (V*)"
+        wbuf, pbuf = io.BytesIO(), io.BytesIO()
+        with gzip.GzipFile(fileobj=wbuf, mode='w') as g:
+            g.write(words.encode())
+        with gzip.GzipFile(fileobj=pbuf, mode='w') as g:
+            g.write(props.encode())
+        with tarfile.open(d / 'conll05st-tests.tar.gz', 'w:gz') as tf:
+            _add_bytes(tf,
+                       'conll05st-release/test.wsj/words/test.wsj.words.gz',
+                       wbuf.getvalue())
+            _add_bytes(tf,
+                       'conll05st-release/test.wsj/props/test.wsj.props.gz',
+                       pbuf.getvalue())
+        samples = load_conll05()
+        assert len(samples) == 1   # final sentence emitted without boundary
+
+    def test_cache_retry_not_duplicated(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            def gen():
+                yield 1
+                yield 2
+                if len(calls) == 1:
+                    raise ValueError("first pass dies")
+                yield 3
+            return gen()
+
+        c = reader.cache(flaky)
+        with pytest.raises(ValueError):
+            list(c())
+        assert list(c()) == [1, 2, 3]   # retry caches the clean stream once
