@@ -133,6 +133,14 @@ from ..vision.ops import (iou_similarity, box_coder, prior_box,  # noqa: E402,F4
                           density_prior_box, anchor_generator, yolo_box,
                           multiclass_nms, roi_align, box_clip, nms)
 
+# CRF stack (parity: fluid/layers/nn.py linear_chain_crf/crf_decoding)
+from ..nn.functional.crf import linear_chain_crf, crf_decoding  # noqa: E402,F401
+
+# metric ops (parity: fluid/layers/metric_op.py auc; nn.py edit_distance,
+# chunk_eval; detection.py detection_map)
+from ..metric import (auc, edit_distance, chunk_eval,  # noqa: E402,F401
+                      detection_map)
+
 # decoding stack (parity: fluid/layers/rnn.py:743-2036)
 from ..nn.decode import (Decoder, BeamSearchDecoder,  # noqa: E402,F401
                          dynamic_decode, DecodeHelper, TrainingHelper,

@@ -6,7 +6,10 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 
-__all__ = ['Metric', 'Accuracy', 'Precision', 'Recall', 'Auc', 'accuracy']
+__all__ = ['Metric', 'Accuracy', 'Precision', 'Recall', 'Auc', 'accuracy',
+           'EditDistance', 'ChunkEvaluator', 'DetectionMAP',
+           'CompositeMetric', 'edit_distance', 'chunk_eval', 'auc',
+           'detection_map']
 
 
 def _np(x):
@@ -173,3 +176,10 @@ def accuracy(input, label, k=1, correct=None, total=None):
         c = jnp.any(idx == yy, axis=-1)
         return jnp.mean(c.astype(jnp.float32))
     return apply_op(fn, (input, label), differentiable=False)
+
+
+# fluid.metrics extras (EditDistance, ChunkEvaluator, DetectionMAP,
+# CompositeMetric) + their host-side ops
+from .extras import (EditDistance, ChunkEvaluator, DetectionMAP,  # noqa: E402
+                     CompositeMetric, edit_distance, chunk_eval, auc,
+                     detection_map)

@@ -9,3 +9,4 @@ from .extension import *  # noqa
 from .vision import *  # noqa
 from .transformer import scaled_dot_product_attention, multi_head_attention  # noqa
 from .rnn import rnn_scan  # noqa
+from .crf import linear_chain_crf, crf_decoding  # noqa

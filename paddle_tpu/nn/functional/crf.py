@@ -1,0 +1,146 @@
+"""Linear-chain CRF: sequence log-likelihood + Viterbi decoding.
+
+Parity: the reference's linear_chain_crf / crf_decoding ops
+(paddle/fluid/operators/linear_chain_crf_op.cc, crf_decoding_op.cc;
+python surface fluid/layers/nn.py). The reference consumes LoD sequences
+and hand-codes the forward/backward recursions in C++; here sequences are
+padded-dense [B, T, D] with lengths, the forward algorithm is a log-space
+``lax.scan`` (one fused XLA loop, autodiff provides the gradient the
+reference's grad op hand-derives), and Viterbi is a scan + reverse-scan
+backtrack.
+
+Transition parameter layout (same as the reference):
+[(D+2), D] — row 0: start weights, row 1: stop weights, rows 2..D+1:
+transition weights w[i, j] for tag i -> tag j.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import apply_op
+from ...tensor._helpers import _t
+
+__all__ = ['linear_chain_crf', 'crf_decoding']
+
+
+def _split_transition(transition):
+    return transition[0], transition[1], transition[2:]
+
+
+def _seq_nll(emission, label, length, transition):
+    """Negative log-likelihood of one padded sequence [T, D], [T]."""
+    # jnp-coerce: a Parameter constructed from numpy carries a numpy
+    # payload, and numpy advanced indexing rejects traced index arrays
+    start, stop, w = _split_transition(jnp.asarray(transition))
+    T, D = emission.shape
+    t_idx = jnp.arange(T)
+    mask = (t_idx < length)
+    maskf = mask.astype(emission.dtype)
+
+    # log partition: alpha recursion
+    alpha0 = start + emission[0]
+
+    def fwd(alpha, t):
+        nxt = jax.nn.logsumexp(alpha[:, None] + w, axis=0) + emission[t]
+        alpha = jnp.where(mask[t], nxt, alpha)
+        return alpha, None
+
+    alpha, _ = lax.scan(fwd, alpha0, jnp.arange(1, T))
+    log_z = jax.nn.logsumexp(alpha + stop)
+
+    # gold path score
+    lab = label.astype(jnp.int32)
+    emit_score = jnp.sum(
+        jnp.take_along_axis(emission, lab[:, None], axis=1)[:, 0] * maskf)
+    trans_score = jnp.sum(w[lab[:-1], lab[1:]] * maskf[1:])
+    last = lab[jnp.maximum(length - 1, 0)]
+    gold = start[lab[0]] + emit_score + trans_score + stop[last]
+    return log_z - gold
+
+
+def linear_chain_crf(emission, label, transition, length=None, name=None):
+    """Per-sequence CRF negative log-likelihood (the training cost).
+
+    emission: [B, T, D] unnormalized tag scores; label: [B, T] int tags;
+    transition: [(D+2), D] (see module docstring); length: [B] valid
+    lengths (defaults to full T). Returns [B, 1] float — ``mean()`` it for
+    the loss, exactly how the reference's crf_cost is consumed.
+    Differentiable w.r.t. emission and transition.
+    """
+    emission, label, transition = _t(emission), _t(label), _t(transition)
+    B, T = emission.shape[0], emission.shape[1]
+    if length is None:
+        tensors = (emission, label, transition)
+
+        def fn(e, l, w):
+            lens = jnp.full((e.shape[0],), e.shape[1], jnp.int32)
+            return jax.vmap(_seq_nll, in_axes=(0, 0, 0, None))(
+                e, l, lens, w)[:, None]
+        return apply_op(fn, tensors)
+
+    length = _t(length)
+
+    def fn(e, l, lens, w):
+        return jax.vmap(_seq_nll, in_axes=(0, 0, 0, None))(
+            e, l, lens.astype(jnp.int32), w)[:, None]
+    return apply_op(fn, (emission, label, length, transition))
+
+
+def _seq_viterbi(emission, length, transition):
+    """Best tag path of one padded sequence; padded positions -> 0."""
+    start, stop, w = _split_transition(jnp.asarray(transition))
+    T, D = emission.shape
+    mask = jnp.arange(T) < length
+
+    delta0 = start + emission[0]
+
+    def fwd(delta, t):
+        scores = delta[:, None] + w                 # [from, to]
+        ptr = jnp.argmax(scores, axis=0)            # best predecessor
+        nxt = jnp.max(scores, axis=0) + emission[t]
+        keep = mask[t]
+        delta = jnp.where(keep, nxt, delta)
+        # padded steps point to themselves so backtrack passes through
+        ptr = jnp.where(keep, ptr, jnp.arange(D))
+        return delta, ptr
+
+    delta, ptrs = lax.scan(fwd, delta0, jnp.arange(1, T))  # ptrs: [T-1, D]
+    best_last = jnp.argmax(delta + stop)
+
+    def back(tag, ptr):
+        return ptr[tag], tag
+
+    # reverse scan: ys[k] = tag at step k+1, final carry = tag at step 0
+    tag0, tail = lax.scan(back, best_last, ptrs, reverse=True)
+    path = jnp.concatenate([jnp.array([tag0]), tail])
+    return jnp.where(mask, path, 0).astype(jnp.int64)
+
+
+def crf_decoding(emission, transition, length=None, label=None, name=None):
+    """Viterbi-decode the best tag sequence under a linear-chain CRF.
+
+    emission: [B, T, D]; transition: [(D+2), D]; length: [B] (defaults to
+    full T). Returns the [B, T] best path (padded positions 0) — or, when
+    ``label`` is given, the reference's error mask: 1 at valid positions
+    where the decoded tag differs from the label.
+    """
+    emission, transition = _t(emission), _t(transition)
+    B, T = emission.shape[0], emission.shape[1]
+    tensors = [emission, transition]
+    if length is not None:
+        tensors.append(_t(length))
+    if label is not None:
+        tensors.append(_t(label))
+
+    def fn(e, w, *rest):
+        rest = list(rest)
+        lens = rest.pop(0).astype(jnp.int32) if length is not None \
+            else jnp.full((e.shape[0],), e.shape[1], jnp.int32)
+        path = jax.vmap(_seq_viterbi, in_axes=(0, 0, None))(e, lens, w)
+        if label is None:
+            return path
+        lab = rest.pop(0).astype(jnp.int64)
+        valid = (jnp.arange(e.shape[1])[None, :] < lens[:, None])
+        return ((path != lab) & valid).astype(jnp.int64)
+
+    return apply_op(fn, tuple(tensors), differentiable=False)

@@ -211,9 +211,6 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     next_i += 1
         if err:
             raise err[0]
-        while next_i in pending:   # drain any stragglers in order mode
-            yield pending.pop(next_i)
-            next_i += 1
 
     return data_reader
 

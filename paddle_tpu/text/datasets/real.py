@@ -294,10 +294,12 @@ def load_conll05_dicts():
             line = line.strip()
             if line.startswith(('B-', 'I-')) and line[2:] not in tags:
                 tags.append(line[2:])
+    # B-t/I-t get adjacent ids per tag type, O last (the reference's
+    # load_label_dict layout; iteration order here is first-appearance,
+    # deterministic, where the reference iterates an unordered set)
     label_dict = {}
     for t in tags:
         label_dict['B-' + t] = len(label_dict)
-    for t in tags:
         label_dict['I-' + t] = len(label_dict)
     label_dict['O'] = len(label_dict)
     return word_dict, verb_dict, label_dict

@@ -77,9 +77,9 @@ class Movielens(Dataset):
     yields full (uid, gender, age, job, movie, categories, title, rating)
     feature rows; the synthetic fallback keeps the 3-tuple shape."""
 
-    def __init__(self, mode='train', **kwargs):
+    def __init__(self, mode='train', test_ratio=0.1, rand_seed=0, **kwargs):
         from . import real
-        loaded = real.load_movielens(mode)
+        loaded = real.load_movielens(mode, test_ratio, rand_seed)
         if loaded is not None:
             self.feats, self.meta = loaded
             self.synthetic = False

@@ -1,0 +1,265 @@
+"""CRF (log-likelihood + Viterbi) and fluid.metrics extras."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _brute_force(emission, transition, length):
+    """Enumerate all paths: returns (logZ, best_path, path_score_fn)."""
+    start, stop, w = transition[0], transition[1], transition[2:]
+    D = emission.shape[1]
+
+    def score(path):
+        s = start[path[0]] + emission[0, path[0]] + stop[path[-1]]
+        for t in range(1, len(path)):
+            s += w[path[t - 1], path[t]] + emission[t, path[t]]
+        return s
+
+    paths = list(itertools.product(range(D), repeat=length))
+    scores = np.array([score(p) for p in paths])
+    log_z = np.log(np.exp(scores - scores.max()).sum()) + scores.max()
+    return log_z, list(paths[int(np.argmax(scores))]), score
+
+
+class TestLinearChainCRF:
+    def _setup(self, B=3, T=5, D=4, seed=0):
+        rng = np.random.default_rng(seed)
+        emission = rng.standard_normal((B, T, D)).astype('float32')
+        transition = rng.standard_normal((D + 2, D)).astype('float32') * 0.5
+        label = rng.integers(0, D, (B, T)).astype('int64')
+        length = np.array([T, T - 2, 3, T - 1], dtype='int64')[:B]
+        return emission, transition, label, length
+
+    def test_nll_matches_brute_force(self):
+        emission, transition, label, length = self._setup()
+        nll = F.linear_chain_crf(
+            paddle.to_tensor(emission), paddle.to_tensor(label),
+            paddle.to_tensor(transition), paddle.to_tensor(length)).numpy()
+        for b in range(len(length)):
+            L = int(length[b])
+            log_z, _, score = _brute_force(emission[b], transition, L)
+            gold = score(label[b, :L].tolist())
+            np.testing.assert_allclose(nll[b, 0], log_z - gold, rtol=1e-4)
+
+    def test_gradients_vs_finite_differences(self):
+        import jax
+        emission, transition, label, length = self._setup(B=2, T=4, D=3)
+
+        def loss_np(trans_flat):
+            t = paddle.to_tensor(
+                trans_flat.reshape(transition.shape).astype('float32'))
+            return float(F.linear_chain_crf(
+                paddle.to_tensor(emission), paddle.to_tensor(label),
+                t, paddle.to_tensor(length)).numpy().mean())
+
+        t = paddle.to_tensor(transition)
+        t.stop_gradient = False
+        e = paddle.to_tensor(emission)
+        e.stop_gradient = False
+        nll = F.linear_chain_crf(e, paddle.to_tensor(label), t,
+                                 paddle.to_tensor(length)).mean()
+        nll.backward()
+        g = t.grad.numpy().reshape(-1)
+        flat = transition.reshape(-1).astype('float64')
+        eps = 1e-3
+        for idx in [0, 3, 7, 11, len(flat) - 1]:
+            up, dn = flat.copy(), flat.copy()
+            up[idx] += eps
+            dn[idx] -= eps
+            fd = (loss_np(up) - loss_np(dn)) / (2 * eps)
+            np.testing.assert_allclose(g[idx], fd, rtol=2e-2, atol=2e-3)
+        assert e.grad is not None   # emission grads flow too
+
+    def test_nll_positive_and_decreases_under_training(self):
+        emission, transition, label, length = self._setup(B=4, T=6, D=5,
+                                                          seed=3)
+        t = paddle.to_tensor(transition)
+        t.stop_gradient = False
+        e = paddle.to_tensor(emission)
+        e.stop_gradient = False
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[])
+        first = None
+        for step in range(30):
+            nll = F.linear_chain_crf(e, paddle.to_tensor(label), t,
+                                     paddle.to_tensor(length)).mean()
+            if first is None:
+                first = float(nll.numpy())
+            nll.backward()
+            for p in (e, t):
+                from paddle_tpu.core.tensor import Tensor
+                p._inplace_value(p._value - 0.1 * p.grad._value)
+                p.clear_grad()
+        assert float(nll.numpy()) < first * 0.5
+        assert first > 0
+
+
+class TestCRFDecoding:
+    def test_viterbi_matches_brute_force(self):
+        rng = np.random.default_rng(5)
+        B, T, D = 4, 5, 3
+        emission = rng.standard_normal((B, T, D)).astype('float32')
+        transition = rng.standard_normal((D + 2, D)).astype('float32')
+        length = np.array([5, 4, 2, 1], dtype='int64')
+        path = F.crf_decoding(paddle.to_tensor(emission),
+                              paddle.to_tensor(transition),
+                              paddle.to_tensor(length)).numpy()
+        for b in range(B):
+            L = int(length[b])
+            _, best, _ = _brute_force(emission[b], transition, L)
+            np.testing.assert_array_equal(path[b, :L], best)
+            np.testing.assert_array_equal(path[b, L:], 0)
+
+    def test_error_mask_with_label(self):
+        rng = np.random.default_rng(6)
+        emission = rng.standard_normal((2, 4, 3)).astype('float32')
+        transition = rng.standard_normal((5, 3)).astype('float32')
+        length = np.array([4, 3], dtype='int64')
+        path = F.crf_decoding(paddle.to_tensor(emission),
+                              paddle.to_tensor(transition),
+                              paddle.to_tensor(length)).numpy()
+        label = path.copy()
+        label[0, 1] = (label[0, 1] + 1) % 3    # one wrong tag
+        err = F.crf_decoding(paddle.to_tensor(emission),
+                             paddle.to_tensor(transition),
+                             paddle.to_tensor(length),
+                             label=paddle.to_tensor(label)).numpy()
+        assert err[0].tolist() == [0, 1, 0, 0]
+        assert err[1].tolist() == [0, 0, 0, 0]
+
+    def test_jit_safe(self):
+        from paddle_tpu.jit import to_static
+        rng = np.random.default_rng(7)
+        emission = rng.standard_normal((2, 4, 3)).astype('float32')
+        transition = rng.standard_normal((5, 3)).astype('float32')
+
+        @to_static
+        def f(e, t):
+            return F.crf_decoding(e, t)
+
+        p1 = f(paddle.to_tensor(emission), paddle.to_tensor(transition))
+        p2 = F.crf_decoding(paddle.to_tensor(emission),
+                            paddle.to_tensor(transition))
+        np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+
+class TestEditDistance:
+    def test_known_distances(self):
+        from paddle_tpu.metric import edit_distance
+        # "kitten"->"sitting" = 3 ; identical = 0
+        a = np.array([[1, 2, 3, 3, 4, 5, 0], [1, 2, 3, 0, 0, 0, 0]])
+        b = np.array([[6, 2, 3, 3, 2, 5, 7], [1, 2, 3, 0, 0, 0, 0]])
+        d, n = edit_distance(a, b, normalized=False,
+                             input_length=np.array([6, 3]),
+                             label_length=np.array([7, 3]))
+        assert d.numpy()[0, 0] == 3.0 and d.numpy()[1, 0] == 0.0
+        assert n.numpy()[0] == 2
+        dn, _ = edit_distance(a, b, normalized=True,
+                              input_length=np.array([6, 3]),
+                              label_length=np.array([7, 3]))
+        np.testing.assert_allclose(dn.numpy()[0, 0], 3.0 / 7.0, rtol=1e-6)
+
+    def test_ignored_tokens_and_metric(self):
+        from paddle_tpu.metric import edit_distance, EditDistance
+        a = np.array([[1, 9, 2]])
+        b = np.array([[1, 2, 9]])
+        d, _ = edit_distance(a, b, normalized=False, ignored_tokens=[9])
+        assert d.numpy()[0, 0] == 0.0
+        m = EditDistance()
+        m.update(np.array([2.0, 0.0, 1.0]))
+        avg, err = m.accumulate()
+        np.testing.assert_allclose(avg, 1.0)
+        np.testing.assert_allclose(err, 2 / 3)
+
+
+class TestChunkEval:
+    def test_iob_scheme(self):
+        from paddle_tpu.metric import chunk_eval, ChunkEvaluator
+        # 2 chunk types; IOB: tags B-0=0 I-0=1 B-1=2 I-1=3, O=4
+        label = np.array([[0, 1, 4, 2, 3, 4]])
+        infer = np.array([[0, 1, 4, 2, 4, 4]])   # second chunk truncated
+        p, r, f1, ni, nl, nc = chunk_eval(infer, label, 'IOB', 2)
+        assert ni.numpy()[0] == 2 and nl.numpy()[0] == 2
+        assert nc.numpy()[0] == 1
+        np.testing.assert_allclose(p.numpy()[0], 0.5)
+        ev = ChunkEvaluator()
+        ev.update(ni, nl, nc)
+        ev.update(ni, nl, nc)
+        prec, rec, f = ev.accumulate()
+        np.testing.assert_allclose(prec, 0.5)
+
+    def test_iobes_scheme(self):
+        from paddle_tpu.metric import chunk_eval
+        # 1 type, IOBES: B=0 I=1 E=2 S=3, O=4
+        label = np.array([[0, 1, 2, 4, 3]])   # chunk(0..3) + single(4)
+        p, r, f1, ni, nl, nc = chunk_eval(label, label, 'IOBES', 1)
+        assert ni.numpy()[0] == 2 and nc.numpy()[0] == 2
+        np.testing.assert_allclose(f1.numpy()[0], 1.0)
+
+
+class TestAucOp:
+    def test_matches_sklearn_style_auc(self):
+        from paddle_tpu.metric import auc
+        rng = np.random.default_rng(0)
+        n = 500
+        y = rng.integers(0, 2, n)
+        # informative scores: positives shifted up
+        s = np.clip(rng.normal(0.35 + 0.3 * y, 0.2), 0, 1)
+        probs = np.stack([1 - s, s], axis=1)
+        a = float(auc(probs, y).numpy())
+        # exact rank-based AUC
+        pos = s[y == 1]
+        neg = s[y == 0]
+        exact = (pos[:, None] > neg[None, :]).mean() + \
+            0.5 * (pos[:, None] == neg[None, :]).mean()
+        np.testing.assert_allclose(a, exact, atol=5e-3)
+
+
+class TestDetectionMAP:
+    def test_perfect_and_missed_detections(self):
+        from paddle_tpu.metric import detection_map, DetectionMAP
+        gt_box = [np.array([[0, 0, 10, 10], [20, 20, 30, 30]], 'float32')]
+        gt_label = [np.array([0, 1])]
+        perfect = [np.array([[0, 0.9, 0, 0, 10, 10],
+                             [1, 0.8, 20, 20, 30, 30]], 'float32')]
+        assert float(detection_map(perfect, gt_label, gt_box, 2).numpy()) \
+            == pytest.approx(1.0)
+        missed = [np.array([[0, 0.9, 0, 0, 10, 10],
+                            [1, 0.8, 50, 50, 60, 60]], 'float32')]
+        m = float(detection_map(missed, gt_label, gt_box, 2).numpy())
+        assert m == pytest.approx(0.5)   # class 0 AP=1, class 1 AP=0
+        acc = DetectionMAP(class_num=2)
+        acc.update(perfect, gt_label, gt_box)
+        assert acc.accumulate() == pytest.approx(1.0)
+
+    def test_11point_version(self):
+        from paddle_tpu.metric import detection_map
+        gt_box = [np.array([[0, 0, 10, 10]], 'float32')]
+        gt_label = [np.array([0])]
+        det = [np.array([[0, 0.9, 0, 0, 10, 10]], 'float32')]
+        v = float(detection_map(det, gt_label, gt_box, 1,
+                                ap_version='11point').numpy())
+        assert v == pytest.approx(1.0)
+
+
+def test_composite_metric():
+    from paddle_tpu.metric import CompositeMetric, EditDistance
+    c = CompositeMetric()
+    e1, e2 = EditDistance(), EditDistance()
+    c.add_metric(e1)
+    c.add_metric(e2)
+    c.update(np.array([1.0, 3.0]))
+    (a1, _), (a2, _) = c.accumulate()
+    assert a1 == a2 == 2.0
+    c.reset()
+    assert e1.seq_num == 0
+
+
+def test_fluid_layers_exports():
+    from paddle_tpu.fluid import layers as L
+    for name in ('linear_chain_crf', 'crf_decoding', 'auc',
+                 'edit_distance', 'chunk_eval', 'detection_map'):
+        assert callable(getattr(L, name))

@@ -232,7 +232,8 @@ class TestConll05Loader:
         assert c_p1.tolist() == [5, 5, 5] and c_p2.tolist() == [5, 5, 5]
         np.testing.assert_array_equal(mark, [1, 1, 1])
         # labels: B-A0 I-A0 B-V -> dict {B-A0:0,B-V:1,I-A0:2,I-V:3,O:4}
-        lbl_dict_order = ['B-A0', 'B-V', 'I-A0', 'I-V', 'O']
+        # adjacent B/I ids per tag type, O last (reference layout)
+        lbl_dict_order = ['B-A0', 'I-A0', 'B-V', 'I-V', 'O']
         assert labels.tolist() == [
             lbl_dict_order.index('B-A0'), lbl_dict_order.index('I-A0'),
             lbl_dict_order.index('B-V')]
