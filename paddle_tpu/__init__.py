@@ -35,6 +35,7 @@ from . import incubate
 from . import utils
 from . import device
 from . import reader
+from . import slim
 from . import regularizer
 from . import sysconfig
 from .framework import save, load, in_dynamic_mode, enable_static, disable_static, in_static_mode

@@ -5,8 +5,8 @@ DetectionMAP, ChunkEvaluator, CompositeMetric) and the ops feeding them
 (edit_distance_op.cc, chunk_eval_op.cc, detection_map_op.cc,
 fluid/layers/metric_op.py auc). The reference computes all of these on
 CPU inside the executor; here they are host-side numpy/python on padded
-arrays — metrics are eval-loop bookkeeping, not MXU work — except
-``edit_distance`` which also offers the jit path used in-graph.
+arrays — metrics are eval-loop bookkeeping, not MXU work — and none of
+them may be called under jit tracing.
 """
 import numpy as np
 
@@ -153,17 +153,20 @@ def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
 
     input: [B, 2] class probabilities (positive = column 1) or [B] scores;
     label: [B] / [B, 1] binary. Returns a scalar float32 Tensor.
+    Only curve='ROC' is implemented; topk/slide_steps are accepted for
+    signature parity but this computes one-shot (non-windowed) AUC.
     """
+    if curve != 'ROC':
+        raise NotImplementedError(
+            "auc: only curve='ROC' is implemented (got %r)" % curve)
     x, y = _np(input), _np(label).reshape(-1)
     scores = x[:, 1] if x.ndim == 2 else x
-    stat_pos = np.zeros(num_thresholds + 1)
-    stat_neg = np.zeros(num_thresholds + 1)
     idx = np.clip((scores * num_thresholds).astype(int), 0, num_thresholds)
-    for i, lab_v in zip(idx, y):
-        if lab_v:
-            stat_pos[i] += 1
-        else:
-            stat_neg[i] += 1
+    pos = y.astype(bool)
+    stat_pos = np.bincount(idx[pos], minlength=num_thresholds + 1) \
+        .astype(np.float64)
+    stat_neg = np.bincount(idx[~pos], minlength=num_thresholds + 1) \
+        .astype(np.float64)
     # integrate TPR/FPR from the highest threshold down (trapezoid rule)
     tot_pos = stat_pos.sum()
     tot_neg = stat_neg.sum()
@@ -186,8 +189,13 @@ def detection_map(detect_res, gt_label, gt_box, class_num,
 
     detect_res: list (per image) of [k, 6] arrays (label, score, x1, y1,
     x2, y2); gt_label/gt_box: lists of [m] labels and [m, 4] boxes.
-    Returns the scalar mAP.
+    Returns the scalar mAP. There is no difficult-flag input here, so only
+    evaluate_difficult=True (count every GT) is supported.
     """
+    if not evaluate_difficult:
+        raise NotImplementedError(
+            "detection_map: no difficult-flag input exists in this API; "
+            "only evaluate_difficult=True is supported")
     # gather per-class scored matches
     tps = {c: [] for c in range(class_num)}
     n_gt = {c: 0 for c in range(class_num)}
@@ -202,8 +210,8 @@ def detection_map(detect_res, gt_label, gt_box, class_num,
         order = np.argsort(-det[:, 1])
         for j in order:
             c, score = int(det[j, 0]), det[j, 1]
-            if c >= class_num:
-                continue
+            if not 0 <= c < class_num:   # incl. the -1 padding rows that
+                continue                 # multiclass_nms emits
             best_iou, best_g = 0.0, -1
             for g in range(len(labs)):
                 if labs[g] != c or g in matched:

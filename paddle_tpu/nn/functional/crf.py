@@ -68,16 +68,8 @@ def linear_chain_crf(emission, label, transition, length=None, name=None):
     Differentiable w.r.t. emission and transition.
     """
     emission, label, transition = _t(emission), _t(label), _t(transition)
-    B, T = emission.shape[0], emission.shape[1]
     if length is None:
-        tensors = (emission, label, transition)
-
-        def fn(e, l, w):
-            lens = jnp.full((e.shape[0],), e.shape[1], jnp.int32)
-            return jax.vmap(_seq_nll, in_axes=(0, 0, 0, None))(
-                e, l, lens, w)[:, None]
-        return apply_op(fn, tensors)
-
+        length = jnp.full((emission.shape[0],), emission.shape[1], jnp.int32)
     length = _t(length)
 
     def fn(e, l, lens, w):
@@ -125,7 +117,6 @@ def crf_decoding(emission, transition, length=None, label=None, name=None):
     where the decoded tag differs from the label.
     """
     emission, transition = _t(emission), _t(transition)
-    B, T = emission.shape[0], emission.shape[1]
     tensors = [emission, transition]
     if length is not None:
         tensors.append(_t(length))

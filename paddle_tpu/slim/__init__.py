@@ -1,0 +1,18 @@
+"""Model compression (quantization). Parity surface:
+python/paddle/fluid/contrib/slim/quantization — QAT transform pass and
+post-training quantization, rebuilt as layer wrapping + calibration
+(see quant.py / qat.py / ptq.py docstrings for the design mapping).
+"""
+from .quant import (abs_max_scale, channel_abs_max_scale, kl_scale,
+                    quantize_weight, dequantize_weight, fake_quant_dequant,
+                    FakeQuantAbsMax, MovingAverageAbsMax)
+from .qat import QuantedLinear, QuantedConv2D, quantize_qat
+from .ptq import (PostTrainingQuantization, Int8Linear, Int8Conv2D,
+                  save_quantized_model, load_quantized_model)
+
+__all__ = ['abs_max_scale', 'channel_abs_max_scale', 'kl_scale',
+           'quantize_weight', 'dequantize_weight', 'fake_quant_dequant',
+           'FakeQuantAbsMax', 'MovingAverageAbsMax',
+           'QuantedLinear', 'QuantedConv2D', 'quantize_qat',
+           'PostTrainingQuantization', 'Int8Linear', 'Int8Conv2D',
+           'save_quantized_model', 'load_quantized_model']

@@ -1,0 +1,250 @@
+"""Post-training quantization over a trained model.
+
+Parity: fluid/contrib/slim/quantization/post_training_quantization.py —
+the reference runs calibration batches through the inference Program,
+collects per-tensor activation ranges ('abs_max' / 'KL' algos), then
+rewrites weights to int8. Here calibration attaches forward pre-hooks on
+quantizable layers, and ``quantize()`` swaps them for int8-weight layers
+(int8 payload + scale held; dequantized on the fly for the bf16/fp32 MXU
+matmul — weight-only storage quantization plus simulated activation
+quantization, the TPU-honest equivalent of the reference's int8 kernels).
+
+``save_quantized_model``/``load_quantized_model`` round-trip the int8
+payloads + scales through an .npz, quartering weight bytes on disk.
+"""
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from .quant import (abs_max_scale, kl_scale, quantize_weight,
+                    fake_quant_dequant)
+
+__all__ = ['PostTrainingQuantization', 'Int8Linear', 'Int8Conv2D',
+           'save_quantized_model', 'load_quantized_model']
+
+
+class _Int8Layer(nn.Layer):
+    """Shared int8-weight wrapper.
+
+    The int8 payload (device array) + scale are the only persistent copy of
+    the weight — the inner layer's fp32 Parameter is released (set to None;
+    named_parameters/state_dict skip None slots), so resident weight bytes
+    really are quartered. Each forward dequantizes transiently (XLA fuses
+    the int8->fp cast+scale into the consumer matmul/conv under jit) and
+    fake-quants the input activation with the calibrated scale.
+    """
+
+    def __init__(self, layer, weight_name, channel_axis, act_scale,
+                 weight_bits=8, activation_bits=8):
+        super().__init__()
+        import jax.numpy as jnp
+        self.inner = layer
+        self._wname = weight_name
+        self._axis = channel_axis
+        self.act_scale = act_scale
+        self.act_bits = activation_bits
+        w = getattr(layer, weight_name)
+        q, s = quantize_weight(np.asarray(w.numpy()), bits=weight_bits,
+                               channel_axis=channel_axis)
+        self._adopt(q, s)
+
+    def _adopt(self, q_np, scale):
+        """Install an int8 payload + scale and release the fp Parameter."""
+        import jax.numpy as jnp
+        self.q_weight = jnp.asarray(q_np)
+        self.w_scale = scale
+        shape = [1] * self.q_weight.ndim
+        shape[self._axis] = -1
+        self._scale_dev = jnp.asarray(
+            np.asarray(scale, np.float32).reshape(shape)
+            if np.ndim(scale) else np.float32(scale))
+        self.inner._parameters[self._wname] = None   # free the fp32 copy
+        self.inner.__dict__.pop(self._wname, None)
+
+    def _dequantized(self):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        return Tensor(self.q_weight.astype(jnp.float32) * self._scale_dev)
+
+    def forward(self, x):
+        if self.act_scale is not None:
+            x = fake_quant_dequant(x, self.act_scale, self.act_bits)
+        # shadow the (released) Parameter slot with the transient weight
+        setattr(self.inner, self._wname, self._dequantized())
+        try:
+            return self.inner(x)
+        finally:
+            self.inner.__dict__.pop(self._wname, None)
+
+
+class Int8Linear(_Int8Layer):
+    """weight layout (in, out): per-out-channel scales on axis 1."""
+
+    def __init__(self, layer, act_scale=None, **kw):
+        super().__init__(layer, 'weight', 1, act_scale, **kw)
+
+
+class Int8Conv2D(_Int8Layer):
+    """weight layout (out, in, kh, kw): per-out-channel scales on axis 0."""
+
+    def __init__(self, layer, act_scale=None, **kw):
+        super().__init__(layer, 'weight', 0, act_scale, **kw)
+
+
+_PTQ_RULES = None
+
+
+def _rules():
+    global _PTQ_RULES
+    if _PTQ_RULES is None:
+        _PTQ_RULES = {nn.Linear: Int8Linear, nn.Conv2D: Int8Conv2D}
+    return _PTQ_RULES
+
+
+class PostTrainingQuantization:
+    """Calibrate activation scales on sample data, then quantize.
+
+    model: trained Layer; data_loader: iterable of input batches (a Tensor,
+    or a tuple whose first element is the input); algo: 'abs_max' | 'KL'.
+    """
+
+    def __init__(self, model, data_loader, algo='abs_max', batch_nums=None,
+                 activation_bits=8, weight_bits=8):
+        if algo not in ('abs_max', 'KL'):
+            raise ValueError("algo must be 'abs_max' or 'KL', got %r" % algo)
+        self.model = model
+        self.data_loader = data_loader
+        self.algo = algo
+        self.batch_nums = batch_nums
+        self.activation_bits = activation_bits
+        self.weight_bits = weight_bits
+        self._samples = {}     # layer id -> list of activation arrays
+
+    def _calibrate(self):
+        rules = _rules()
+        hooks = []
+
+        def make_hook(key):
+            def hook(layer, inputs):
+                x = inputs[0] if isinstance(inputs, tuple) else inputs
+                self._samples.setdefault(key, []).append(
+                    np.asarray(x.numpy() if isinstance(x, Tensor) else x))
+            return hook
+
+        for name, sub in self.model.named_sublayers():
+            if type(sub) in rules:
+                hooks.append(sub.register_forward_pre_hook(make_hook(name)))
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            for i, batch in enumerate(self.data_loader):
+                if self.batch_nums is not None and i >= self.batch_nums:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                if not isinstance(x, Tensor):
+                    x = Tensor(np.asarray(x))
+                self.model(x)
+        finally:
+            for h in hooks:
+                h.remove()
+            if was_training:
+                self.model.train()
+
+    def _act_scale(self, samples):
+        if self.algo == 'KL':
+            return kl_scale(samples, self.activation_bits)
+        return max(abs_max_scale(s, self.activation_bits) for s in samples)
+
+    def quantize(self):
+        """Returns the model with quantizable sublayers swapped for int8
+        wrappers (in place)."""
+        self._calibrate()
+        rules = _rules()
+        scales = {name: self._act_scale(s)
+                  for name, s in self._samples.items()}
+
+        def swap(layer, prefix=''):
+            for name, child in list(layer._sub_layers.items()):
+                full = prefix + name
+                cls = rules.get(type(child))
+                if cls is not None:
+                    layer._sub_layers[name] = cls(
+                        child, act_scale=scales.get(full),
+                        weight_bits=self.weight_bits,
+                        activation_bits=self.activation_bits)
+                else:
+                    swap(child, full + '.')
+            return layer
+
+        return swap(self.model)
+
+
+def save_quantized_model(model, path):
+    """Persist a PTQ-quantized model: int8 payloads + scales for wrapped
+    layers, fp32 for everything else, one .npz."""
+    arrays = {}
+    for name, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, _Int8Layer):
+            arrays['q:%s:weight' % name] = sub.q_weight
+            arrays['q:%s:w_scale' % name] = np.asarray(sub.w_scale)
+            if sub.act_scale is not None:
+                arrays['q:%s:act_scale' % name] = np.asarray(sub.act_scale)
+            bias = getattr(sub.inner, 'bias', None)
+            if bias is not None:
+                arrays['q:%s:bias' % name] = np.asarray(bias.numpy())
+    # non-quantized params by state_dict key
+    quant_prefixes = tuple(
+        name + '.' for name, sub in model.named_sublayers(include_self=True)
+        if isinstance(sub, _Int8Layer))
+    for k, v in model.state_dict().items():
+        if not k.startswith(quant_prefixes):
+            arrays['p:' + k] = np.asarray(v.numpy())
+    np.savez(path, **arrays)
+
+
+def load_quantized_model(model, path, activation_bits=8):
+    """Rebuild int8 wrappers on a fresh (same-architecture) model from a
+    save_quantized_model archive; returns the model."""
+    import jax.numpy as jnp
+    data = np.load(path)
+    qnames = sorted({k.split(':')[1] for k in data.files
+                     if k.startswith('q:')})
+    rules = _rules()
+
+    def find(layer, dotted):
+        obj = layer
+        for part in dotted.split('.'):
+            obj = obj._sub_layers[part]
+        return obj
+
+    def parent_of(layer, dotted):
+        parts = dotted.split('.')
+        obj = layer
+        for part in parts[:-1]:
+            obj = obj._sub_layers[part]
+        return obj, parts[-1]
+
+    for name in qnames:
+        child = find(model, name)
+        cls = rules.get(type(child))
+        if cls is None:
+            raise ValueError("layer %r is not quantizable (%s)"
+                             % (name, type(child).__name__))
+        act_key = 'q:%s:act_scale' % name
+        wrapper = cls(child,
+                      act_scale=(float(data[act_key])
+                                 if act_key in data.files else None),
+                      activation_bits=activation_bits)
+        wrapper._adopt(data['q:%s:weight' % name],
+                       data['q:%s:w_scale' % name])
+        bias_key = 'q:%s:bias' % name
+        if bias_key in data.files and child.bias is not None:
+            child.bias._inplace_value(jnp.asarray(data[bias_key]))
+        parent, leaf = parent_of(model, name)
+        parent._sub_layers[leaf] = wrapper
+    # restore untouched params
+    sd = model.state_dict()
+    for k in data.files:
+        if k.startswith('p:') and k[2:] in sd:
+            sd[k[2:]]._inplace_value(jnp.asarray(data[k]))
+    return model

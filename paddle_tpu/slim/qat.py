@@ -1,0 +1,80 @@
+"""Quantization-aware training: quant wrappers over Linear/Conv2D.
+
+Parity: fluid/contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass) — the reference walks the Program IR and
+inserts fake_quant ops before every quantizable op's weight/activation
+inputs; here the same effect is layer wrapping: ``quantize_qat(model)``
+swaps each Linear/Conv2D for a wrapper that fake-quant-dequants its
+weight (per-channel abs-max) and input activation (moving-average
+abs-max) on every forward, with straight-through gradients.
+"""
+from .. import nn
+from .quant import FakeQuantAbsMax, MovingAverageAbsMax
+
+__all__ = ['QuantedLinear', 'QuantedConv2D', 'quantize_qat']
+
+
+class _QuantWrapper(nn.Layer):
+    def __init__(self, layer, weight, channel_axis, weight_bits=8,
+                 activation_bits=8):
+        super().__init__()
+        self.inner = layer
+        self._wname = weight
+        self.weight_quanter = FakeQuantAbsMax(weight_bits, channel_axis)
+        self.act_quanter = MovingAverageAbsMax(activation_bits)
+
+    def forward(self, x):
+        x = self.act_quanter(x, training=self.training)
+        qw = self.weight_quanter(getattr(self.inner, self._wname))
+        # shadow the Parameter with the fake-quantized weight for this call:
+        # a plain Tensor assigned via __setattr__ lands in __dict__ and wins
+        # attribute lookup; popping it un-shadows the untouched Parameter
+        setattr(self.inner, self._wname, qw)
+        try:
+            out = self.inner(x)
+        finally:
+            self.inner.__dict__.pop(self._wname, None)
+        return out
+
+
+class QuantedLinear(_QuantWrapper):
+    """Linear with fake-quantized weight (per-out-channel, axis 1: weight
+    layout is (in, out)) + input activation."""
+
+    def __init__(self, layer, **kw):
+        super().__init__(layer, 'weight', channel_axis=1, **kw)
+
+
+class QuantedConv2D(_QuantWrapper):
+    """Conv2D with fake-quantized weight (per-out-channel, axis 0: weight
+    layout is (out, in, kh, kw)) + input activation."""
+
+    def __init__(self, layer, **kw):
+        super().__init__(layer, 'weight', channel_axis=0, **kw)
+
+
+_QAT_RULES = None
+
+
+def _rules():
+    global _QAT_RULES
+    if _QAT_RULES is None:
+        _QAT_RULES = {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
+    return _QAT_RULES
+
+
+def quantize_qat(model, weight_bits=8, activation_bits=8):
+    """Swap every Linear/Conv2D in ``model`` (in place, recursively) for
+    its quant-aware wrapper; returns the model. Train as usual afterwards —
+    state_dict keys gain an ``inner.`` segment, matching the wrapper tree.
+    """
+    rules = _rules()
+    for name, child in list(model._sub_layers.items()):
+        cls = rules.get(type(child))
+        if cls is not None:
+            model._sub_layers[name] = cls(
+                child, weight_bits=weight_bits,
+                activation_bits=activation_bits)
+        else:
+            quantize_qat(child, weight_bits, activation_bits)
+    return model

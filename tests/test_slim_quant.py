@@ -1,0 +1,188 @@
+"""Quantization (slim): scales, fake-quant STE, QAT wrappers, PTQ int8."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, slim
+
+
+def _lenet():
+    return nn.Sequential(
+        nn.Conv2D(1, 6, 5, padding=2), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Conv2D(6, 16, 5), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Flatten(), nn.Linear(400, 120), nn.ReLU(),
+        nn.Linear(120, 84), nn.ReLU(), nn.Linear(84, 10))
+
+
+def _mnist_like(n, seed=0):
+    """Synthetic 'digit' data: class = which quadrant lights up."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 10, n)
+    x = rng.normal(0, 0.1, (n, 1, 28, 28)).astype('float32')
+    for i, c in enumerate(y):
+        r, col = divmod(int(c), 4)
+        x[i, 0, 4 + r * 6:10 + r * 6, 4 + col * 6:10 + col * 6] += 1.0
+    return x, y.astype('int64')
+
+
+def _train(model, x, y, steps=60, lr=5e-3, bs=64):
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=model.parameters())
+    n = len(x)
+    rng = np.random.default_rng(1)
+    for s in range(steps):
+        idx = rng.integers(0, n, bs)
+        logits = model(paddle.to_tensor(x[idx]))
+        loss = nn.functional.cross_entropy(logits, paddle.to_tensor(y[idx]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return model
+
+
+def _accuracy(model, x, y, bs=256):
+    model.eval()
+    correct = 0
+    for i in range(0, len(x), bs):
+        logits = model(paddle.to_tensor(x[i:i + bs]))
+        correct += int((logits.numpy().argmax(-1) == y[i:i + bs]).sum())
+    return correct / len(x)
+
+
+class TestQuantPrimitives:
+    def test_weight_roundtrip_error_bounded(self):
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((64, 32)).astype('float32')
+        q, s = slim.quantize_weight(w)
+        assert q.dtype == np.int8
+        deq = slim.dequantize_weight(q, s)
+        assert np.abs(deq - w).max() <= s / 2 + 1e-7
+
+    def test_per_channel_beats_per_tensor(self):
+        rng = np.random.default_rng(1)
+        # channels with wildly different ranges
+        w = rng.standard_normal((8, 16)).astype('float32')
+        w[:, 0] *= 100
+        qt, st = slim.quantize_weight(w)
+        err_t = np.abs(slim.dequantize_weight(qt, st) - w).max(axis=0)
+        qc, sc = slim.quantize_weight(w, channel_axis=1)
+        err_c = np.abs(slim.dequantize_weight(qc, sc, 1) - w).max(axis=0)
+        # the small-range channels are far better per-channel
+        assert err_c[1:].max() < err_t[1:].max() / 10
+
+    def test_kl_scale_clips_outliers(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(0, 0.1, 10000).astype('float32')
+        x[0] = 50.0   # one massive outlier
+        s_abs = slim.abs_max_scale(x)
+        s_kl = slim.kl_scale([x])
+        assert s_kl < s_abs / 10   # KL ignores the outlier
+
+    def test_fake_quant_ste_gradient(self):
+        x = paddle.to_tensor(np.array([0.1, -0.5, 2.0], 'float32'))
+        x.stop_gradient = False
+        scale = 0.01  # qmax*scale = 1.27 -> 2.0 is clipped
+        y = slim.fake_quant_dequant(x, scale)
+        y.sum().backward()
+        g = x.grad.numpy()
+        np.testing.assert_array_equal(g, [1.0, 1.0, 0.0])
+        # values snap to the grid
+        np.testing.assert_allclose(y.numpy()[0], 0.1, atol=scale)
+
+
+class TestQAT:
+    def test_wrapping_and_param_not_shadowed(self):
+        m = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 2))
+        slim.quantize_qat(m)
+        assert isinstance(m[0], slim.QuantedLinear)
+        assert isinstance(m[2], slim.QuantedLinear)
+        x = paddle.to_tensor(np.ones((2, 8), 'float32'))
+        m(x)
+        # after forward, the inner weight attribute is the Parameter again
+        from paddle_tpu.core.tensor import Parameter
+        assert isinstance(m[0].inner.weight, Parameter)
+
+    def test_qat_trains(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        slim.quantize_qat(m)
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((256, 16)).astype('float32')
+        y = (x[:, :4].argmax(-1)).astype('int64')
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=m.parameters())
+        losses = []
+        for s in range(60):
+            logits = m(paddle.to_tensor(x))
+            loss = nn.functional.cross_entropy(logits, paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5
+        # activation observer collected a scale
+        assert m[0].act_quanter.scale is not None
+
+
+class TestPTQ:
+    @pytest.fixture(scope='class')
+    def trained(self):
+        """Class fixture holds trained WEIGHTS, not a model: quantize()
+        mutates its model in place, so each test rebuilds from these."""
+        paddle.seed(7)
+        x, y = _mnist_like(1536)
+        model = _train(_lenet(), x, y)
+        acc = _accuracy(model, x, y)
+        assert acc > 0.9, f"fp32 LeNet failed to train ({acc})"
+        return model.state_dict(), x, y, acc
+
+    @staticmethod
+    def _fresh(state):
+        m = _lenet()
+        m.set_state_dict(state)
+        m.eval()
+        return m
+
+    def test_ptq_within_one_percent(self, trained):
+        state, x, y, fp32_acc = trained
+        model = self._fresh(state)
+        calib = [paddle.to_tensor(x[i:i + 64]) for i in range(0, 512, 64)]
+        ptq = slim.PostTrainingQuantization(model, calib, algo='abs_max')
+        qmodel = ptq.quantize()
+        assert any(isinstance(l, slim.Int8Conv2D)
+                   for _, l in qmodel.named_sublayers())
+        q_acc = _accuracy(qmodel, x, y)
+        assert q_acc >= fp32_acc - 0.01, \
+            f"int8 {q_acc} vs fp32 {fp32_acc}"
+
+    def test_save_load_roundtrip(self, trained, tmp_path):
+        state, x, y, _ = trained
+        model = self._fresh(state)
+        calib = [paddle.to_tensor(x[:64])]
+        qmodel = slim.PostTrainingQuantization(model, calib).quantize()
+        ref = qmodel(paddle.to_tensor(x[:8])).numpy()
+        p = str(tmp_path / 'lenet_int8.npz')
+        slim.save_quantized_model(qmodel, p)
+        fresh = _lenet()            # random fresh weights
+        slim.load_quantized_model(fresh, p)
+        fresh.eval()
+        out = fresh(paddle.to_tensor(x[:8])).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        # int8 payloads really are int8 on disk
+        data = np.load(p)
+        qkeys = [k for k in data.files if k.endswith(':weight')]
+        assert qkeys and all(data[k].dtype == np.int8 for k in qkeys)
+
+    def test_kl_algo_runs(self, trained):
+        state, x, y, fp32_acc = trained
+        model = self._fresh(state)
+        calib = [paddle.to_tensor(x[:128])]
+        ptq = slim.PostTrainingQuantization(model, calib, algo='KL',
+                                            batch_nums=1)
+        qmodel = ptq.quantize()
+        q_acc = _accuracy(qmodel, x, y)
+        assert q_acc >= fp32_acc - 0.05
+
+    def test_bad_algo_raises(self):
+        with pytest.raises(ValueError, match="algo"):
+            slim.PostTrainingQuantization(nn.Linear(2, 2), [], algo='minmax')
