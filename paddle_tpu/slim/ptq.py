@@ -16,8 +16,7 @@ import numpy as np
 
 from .. import nn
 from ..core.tensor import Tensor
-from .quant import (abs_max_scale, kl_scale, quantize_weight,
-                    fake_quant_dequant)
+from .quant import (kl_scale_from_hist, quantize_weight, fake_quant_dequant)
 
 __all__ = ['PostTrainingQuantization', 'Int8Linear', 'Int8Conv2D',
            'save_quantized_model', 'load_quantized_model']
@@ -35,18 +34,19 @@ class _Int8Layer(nn.Layer):
     """
 
     def __init__(self, layer, weight_name, channel_axis, act_scale,
-                 weight_bits=8, activation_bits=8):
+                 weight_bits=8, activation_bits=8, q_payload=None):
         super().__init__()
-        import jax.numpy as jnp
         self.inner = layer
         self._wname = weight_name
         self._axis = channel_axis
         self.act_scale = act_scale
         self.act_bits = activation_bits
-        w = getattr(layer, weight_name)
-        q, s = quantize_weight(np.asarray(w.numpy()), bits=weight_bits,
-                               channel_axis=channel_axis)
-        self._adopt(q, s)
+        if q_payload is None:
+            w = getattr(layer, weight_name)
+            q_payload = quantize_weight(np.asarray(w.numpy()),
+                                        bits=weight_bits,
+                                        channel_axis=channel_axis)
+        self._adopt(*q_payload)
 
     def _adopt(self, q_np, scale):
         """Install an int8 payload + scale and release the fp Parameter."""
@@ -91,14 +91,56 @@ class Int8Conv2D(_Int8Layer):
         super().__init__(layer, 'weight', 0, act_scale, **kw)
 
 
-_PTQ_RULES = None
+_PTQ_RULES = {nn.Linear: Int8Linear, nn.Conv2D: Int8Conv2D}
 
 
-def _rules():
-    global _PTQ_RULES
-    if _PTQ_RULES is None:
-        _PTQ_RULES = {nn.Linear: Int8Linear, nn.Conv2D: Int8Conv2D}
-    return _PTQ_RULES
+class _AbsMaxObserver:
+    """O(1)-memory running abs-max over calibration batches."""
+
+    def __init__(self, bits):
+        self.bits = bits
+        self.amax = 0.0
+
+    def observe(self, arr):
+        self.amax = max(self.amax, float(np.abs(arr).max()))
+
+    def scale(self):
+        qmax = 2 ** (self.bits - 1) - 1
+        return (self.amax or 1.0) / qmax
+
+
+class _HistObserver:
+    """O(bins)-memory abs-value histogram for KL calibration.
+
+    The range grows by doubling when a batch exceeds it, merging adjacent
+    bin pairs — an exact rebin, so the histogram stays faithful without
+    retaining any activation tensors.
+    """
+
+    def __init__(self, bits, bins=2048):
+        self.bits = bits
+        self.bins = bins
+        self.range = None
+        self.hist = np.zeros(bins, np.float64)
+
+    def observe(self, arr):
+        a = np.abs(np.asarray(arr, np.float32)).reshape(-1)
+        amax = float(a.max()) if a.size else 0.0
+        if self.range is None:
+            self.range = amax or 1e-8
+        while amax > self.range:
+            merged = self.hist.reshape(-1, 2).sum(axis=1)
+            self.hist = np.concatenate(
+                [merged, np.zeros(self.bins // 2, np.float64)])
+            self.range *= 2
+        h, _ = np.histogram(a, bins=self.bins, range=(0, self.range))
+        self.hist += h
+
+    def scale(self):
+        if self.range is None:
+            return 1.0 / (2 ** (self.bits - 1) - 1)
+        edges = np.linspace(0, self.range, self.bins + 1)
+        return kl_scale_from_hist(self.hist, edges, self.bits)
 
 
 class PostTrainingQuantization:
@@ -106,6 +148,8 @@ class PostTrainingQuantization:
 
     model: trained Layer; data_loader: iterable of input batches (a Tensor,
     or a tuple whose first element is the input); algo: 'abs_max' | 'KL'.
+    Calibration is O(1)/O(bins) memory per layer — activations are folded
+    into running observers, never retained.
     """
 
     def __init__(self, model, data_loader, algo='abs_max', batch_nums=None,
@@ -118,21 +162,23 @@ class PostTrainingQuantization:
         self.batch_nums = batch_nums
         self.activation_bits = activation_bits
         self.weight_bits = weight_bits
-        self._samples = {}     # layer id -> list of activation arrays
+        self._observers = {}     # layer name -> observer
 
     def _calibrate(self):
-        rules = _rules()
         hooks = []
 
         def make_hook(key):
+            obs_cls = _HistObserver if self.algo == 'KL' else _AbsMaxObserver
+            self._observers[key] = obs = obs_cls(self.activation_bits)
+
             def hook(layer, inputs):
                 x = inputs[0] if isinstance(inputs, tuple) else inputs
-                self._samples.setdefault(key, []).append(
-                    np.asarray(x.numpy() if isinstance(x, Tensor) else x))
+                obs.observe(np.asarray(
+                    x.numpy() if isinstance(x, Tensor) else x))
             return hook
 
         for name, sub in self.model.named_sublayers():
-            if type(sub) in rules:
+            if type(sub) in _PTQ_RULES:
                 hooks.append(sub.register_forward_pre_hook(make_hook(name)))
         was_training = self.model.training
         self.model.eval()
@@ -150,18 +196,13 @@ class PostTrainingQuantization:
             if was_training:
                 self.model.train()
 
-    def _act_scale(self, samples):
-        if self.algo == 'KL':
-            return kl_scale(samples, self.activation_bits)
-        return max(abs_max_scale(s, self.activation_bits) for s in samples)
-
     def quantize(self):
         """Returns the model with quantizable sublayers swapped for int8
         wrappers (in place)."""
         self._calibrate()
-        rules = _rules()
-        scales = {name: self._act_scale(s)
-                  for name, s in self._samples.items()}
+        rules = _PTQ_RULES
+        scales = {name: obs.scale()
+                  for name, obs in self._observers.items()}
 
         def swap(layer, prefix=''):
             for name, child in list(layer._sub_layers.items()):
@@ -209,7 +250,7 @@ def load_quantized_model(model, path, activation_bits=8):
     data = np.load(path)
     qnames = sorted({k.split(':')[1] for k in data.files
                      if k.startswith('q:')})
-    rules = _rules()
+    rules = _PTQ_RULES
 
     def find(layer, dotted):
         obj = layer
@@ -234,9 +275,9 @@ def load_quantized_model(model, path, activation_bits=8):
         wrapper = cls(child,
                       act_scale=(float(data[act_key])
                                  if act_key in data.files else None),
-                      activation_bits=activation_bits)
-        wrapper._adopt(data['q:%s:weight' % name],
-                       data['q:%s:w_scale' % name])
+                      activation_bits=activation_bits,
+                      q_payload=(data['q:%s:weight' % name],
+                                 data['q:%s:w_scale' % name]))
         bias_key = 'q:%s:bias' % name
         if bias_key in data.files and child.bias is not None:
             child.bias._inplace_value(jnp.asarray(data[bias_key]))

@@ -8,7 +8,11 @@ swaps each Linear/Conv2D for a wrapper that fake-quant-dequants its
 weight (per-channel abs-max) and input activation (moving-average
 abs-max) on every forward, with straight-through gradients.
 """
+import numpy as np
+import jax.numpy as jnp
+
 from .. import nn
+from ..core.tensor import Tensor
 from .quant import FakeQuantAbsMax, MovingAverageAbsMax
 
 __all__ = ['QuantedLinear', 'QuantedConv2D', 'quantize_qat']
@@ -22,9 +26,20 @@ class _QuantWrapper(nn.Layer):
         self._wname = weight
         self.weight_quanter = FakeQuantAbsMax(weight_bits, channel_axis)
         self.act_quanter = MovingAverageAbsMax(activation_bits)
+        # the EMA activation scale must survive save/load: mirror it in a
+        # persistable buffer (negative sentinel = not yet observed)
+        self.register_buffer('act_scale',
+                             Tensor(np.array([-1.0], np.float32)))
 
     def forward(self, x):
+        if self.act_quanter.scale is None:
+            restored = float(self.act_scale.numpy()[0])
+            if restored > 0:   # a state_dict round-trip restored the scale
+                self.act_quanter.scale = restored
         x = self.act_quanter(x, training=self.training)
+        if self.act_quanter.scale is not None:
+            self.act_scale._inplace_value(jnp.asarray(
+                np.array([self.act_quanter.scale], np.float32)))
         qw = self.weight_quanter(getattr(self.inner, self._wname))
         # shadow the Parameter with the fake-quantized weight for this call:
         # a plain Tensor assigned via __setattr__ lands in __dict__ and wins
@@ -53,14 +68,7 @@ class QuantedConv2D(_QuantWrapper):
         super().__init__(layer, 'weight', channel_axis=0, **kw)
 
 
-_QAT_RULES = None
-
-
-def _rules():
-    global _QAT_RULES
-    if _QAT_RULES is None:
-        _QAT_RULES = {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
-    return _QAT_RULES
+_QAT_RULES = {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
 
 
 def quantize_qat(model, weight_bits=8, activation_bits=8):
@@ -68,9 +76,8 @@ def quantize_qat(model, weight_bits=8, activation_bits=8):
     its quant-aware wrapper; returns the model. Train as usual afterwards —
     state_dict keys gain an ``inner.`` segment, matching the wrapper tree.
     """
-    rules = _rules()
     for name, child in list(model._sub_layers.items()):
-        cls = rules.get(type(child))
+        cls = _QAT_RULES.get(type(child))
         if cls is not None:
             model._sub_layers[name] = cls(
                 child, weight_bits=weight_bits,

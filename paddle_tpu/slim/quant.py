@@ -23,8 +23,8 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, apply_op
 
 __all__ = ['abs_max_scale', 'channel_abs_max_scale', 'kl_scale',
-           'quantize_weight', 'dequantize_weight', 'fake_quant_dequant',
-           'FakeQuantAbsMax', 'MovingAverageAbsMax']
+           'kl_scale_from_hist', 'quantize_weight', 'dequantize_weight',
+           'fake_quant_dequant', 'FakeQuantAbsMax', 'MovingAverageAbsMax']
 
 
 def abs_max_scale(x, bits=8):
@@ -47,14 +47,24 @@ def kl_scale(samples, bits=8, bins=2048):
     clip threshold whose quantized distribution has minimal KL divergence
     from the original, then scale = threshold / qmax."""
     qmax = 2 ** (bits - 1) - 1
-    levels = 2 ** (bits - 1)   # abs-value histogram: positive levels only
     x = np.abs(np.concatenate([np.asarray(s).reshape(-1)
                                for s in samples]))
     amax = x.max()
     if amax == 0:
         return 1.0 / qmax
     hist, edges = np.histogram(x, bins=bins, range=(0, amax))
-    hist = hist.astype(np.float64)
+    return kl_scale_from_hist(hist, edges, bits)
+
+
+def kl_scale_from_hist(hist, edges, bits=8):
+    """KL threshold search over a prebuilt abs-value histogram (lets PTQ
+    calibrate in O(bins) memory instead of retaining activations)."""
+    qmax = 2 ** (bits - 1) - 1
+    levels = 2 ** (bits - 1)   # abs-value histogram: positive levels only
+    bins = len(hist)
+    hist = np.asarray(hist, np.float64)
+    if hist.sum() == 0:
+        return 1.0 / qmax
     best_kl, best_t = np.inf, bins
     for t in range(levels, bins + 1, 16):
         p = hist[:t].copy()

@@ -186,3 +186,28 @@ class TestPTQ:
     def test_bad_algo_raises(self):
         with pytest.raises(ValueError, match="algo"):
             slim.PostTrainingQuantization(nn.Linear(2, 2), [], algo='minmax')
+
+
+class TestQATPersistence:
+    def test_act_scale_survives_save_load(self):
+        """QAT activation scales round-trip through state_dict, so a
+        reloaded model fake-quants activations identically at eval."""
+        paddle.seed(3)
+        m = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        slim.quantize_qat(m)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 8)).astype('float32') * 3.0
+        m.train()
+        m(paddle.to_tensor(x))            # observe activation ranges
+        m.eval()
+        ref = m(paddle.to_tensor(x)).numpy()
+        state = m.state_dict()
+        paddle.seed(3)
+        m2 = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        slim.quantize_qat(m2)
+        m2.set_state_dict(state)
+        m2.eval()
+        out = m2(paddle.to_tensor(x)).numpy()
+        assert m2[0].act_quanter.scale is not None or \
+            float(m2[0].act_scale.numpy()[0]) > 0
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
