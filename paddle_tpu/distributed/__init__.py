@@ -12,4 +12,7 @@ from .sharding import shard_tensor, shard_layer
 from .ring_attention import ring_attention
 from . import pipeline
 from .pipeline import pipeline_apply
+from .recompute import recompute
+from . import ps
+from .ps import SparseShardedTable
 from .launch import spawn, launch

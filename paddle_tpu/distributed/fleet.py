@@ -113,8 +113,21 @@ class Fleet:
     # optimizer -------------------------------------------------------------
     def distributed_optimizer(self, optimizer, strategy=None):
         self._strategy = strategy or self._strategy or DistributedStrategy()
+        st = self._strategy
+        # lamb/lars meta-optimizers: swap the inner update rule, keeping the
+        # user's learning rate, parameters and grad clip (the reference's
+        # LambOptimizer/LarsOptimizer meta passes do the same rewrite)
+        from ..optimizer.optimizer import Lamb, LarsMomentum
+        if st.lamb and not isinstance(optimizer, Lamb):
+            optimizer = Lamb(learning_rate=optimizer._lr,
+                             parameters=optimizer._parameters,
+                             grad_clip=optimizer._grad_clip)
+        elif st.lars and not isinstance(optimizer, LarsMomentum):
+            optimizer = LarsMomentum(learning_rate=optimizer._lr,
+                                     parameters=optimizer._parameters,
+                                     grad_clip=optimizer._grad_clip)
         self._user_defined_optimizer = optimizer
-        return _DistributedOptimizer(optimizer, self._strategy)
+        return _DistributedOptimizer(optimizer, st)
 
     def distributed_model(self, model):
         from .parallel import DataParallel
@@ -137,6 +150,12 @@ class _DistributedOptimizer:
         self.inner = inner
         self.strategy = strategy
         self._accum = 0
+        self._scaler = None
+        if strategy is not None and strategy.amp:
+            from ..amp import GradScaler
+            cfg = strategy.amp_configs or {}
+            self._scaler = GradScaler(
+                init_loss_scaling=cfg.get('init_loss_scaling', 2.0 ** 15))
 
     @property
     def _parameters(self):
@@ -173,6 +192,19 @@ class _DistributedOptimizer:
         self.inner.step()
 
     def minimize(self, loss, *args, **kwargs):
+        if self._scaler is not None:
+            # amp strategy: dynamic loss scaling around backward + step,
+            # honoring gradient_merge accumulation exactly like the
+            # unscaled path (scaled grads accumulate; unscale at step)
+            self._scaler.scale(loss).backward()
+            k = (self.strategy.gradient_merge_configs.get('k_steps', 1)
+                 if self.strategy and self.strategy.gradient_merge else 1)
+            self._accum += 1
+            if self._accum % k == 0:
+                self._sync_grads()
+                self._scaler.step(self.inner)
+                self.inner.clear_grad()
+            return [], []
         loss.backward()
         self.step()
         self.clear_grad()
@@ -190,6 +222,17 @@ class _DistributedOptimizer:
     def set_state_dict(self, sd):
         return self.inner.set_state_dict(sd)
 
+
+class _FleetUtils:
+    """fleet.utils namespace (parity: paddle.distributed.fleet.utils)."""
+
+    @staticmethod
+    def recompute(function, *args, **kwargs):
+        from .recompute import recompute as _recompute
+        return _recompute(function, *args, **kwargs)
+
+
+utils = _FleetUtils()
 
 fleet = Fleet()
 

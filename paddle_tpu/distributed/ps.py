@@ -1,0 +1,80 @@
+"""Parameter-server-mode analogue: sparse push/pull over a sharded table.
+
+Parity: the reference's PS training path
+(fluid/operators/distributed lookup_table ops + fluid/incubate fleet PS
+mode): trainers *pull* the embedding rows they touch and *push* sparse
+gradients back to the servers holding the vocab shards. TPU-first: there
+are no server processes — the table is one array sharded over the
+'model' mesh axis, pull is a gather and push is a scatter-add executed as
+sharded XLA ops (SPMD; the "server" is wherever the shard lives, and the
+collectives ride ICI). The async/geo-SGD variants collapse to synchronous
+updates, the documented divergence of SURVEY §6.
+"""
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import env
+from .sharding import shard_tensor
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor, Parameter, apply_op
+from ..nn.initializer import Normal
+
+__all__ = ['SparseShardedTable']
+
+
+class SparseShardedTable:
+    """A vocab-sharded embedding table with pull/push semantics.
+
+    pull(ids):  gather rows — the PS 'prefetch' of touched parameters.
+    push(ids, grads, lr): scatter-add a sparse SGD update (duplicate ids
+    accumulate, like the reference's sparse gradient merge on the server).
+    """
+
+    def __init__(self, num_rows, dim, axis=env.MODEL_AXIS, name=None,
+                 initializer=None):
+        self.num_rows = num_rows
+        self.dim = dim
+        self.axis = axis
+        init = initializer or Normal(0., 0.02)
+        self.weight = Parameter(jnp.asarray(init([num_rows, dim],
+                                                 dtype='float32')),
+                                name=name or 'sparse_table')
+        mesh = env.get_mesh()
+        if mesh is not None and axis in mesh.shape:
+            shard_tensor(self.weight, P(axis, None))
+        # no 'model' axis in the current mesh: the table stays replicated,
+        # pull/push semantics are unchanged
+
+    def pull(self, ids):
+        """ids: int [...]; returns rows [..., dim]. Differentiable (the
+        backward is itself a sparse scatter-add, which is what makes
+        pull+autograd+push-free training work too)."""
+        from ..tensor._helpers import _t
+        ids = _t(ids)
+
+        def fn(i, w):
+            return jnp.take(w, i.astype(jnp.int32), axis=0)
+        return apply_op(fn, (ids, self.weight))
+
+    @no_grad()
+    def push(self, ids, grads, lr=1.0):
+        """Apply a sparse update: ``row[id] -= lr * grad`` with duplicate
+        ids accumulated — the PS server-side merge + update."""
+        from ..tensor._helpers import _t
+        ids_v = _t(ids)._value.astype(jnp.int32).reshape(-1)
+        g = _t(grads)._value
+        g = g.reshape((-1, g.shape[-1]))
+        new = self.weight._value.at[ids_v].add(-lr * g)
+        self.weight._inplace_value(new)
+
+    def rows(self):
+        return self.weight.shape[0]
+
+    def state_dict(self):
+        return {'weight': self.weight}
+
+    def set_state_dict(self, sd):
+        w = sd['weight']
+        self.weight._inplace_value(
+            w._value if isinstance(w, Tensor) else jnp.asarray(np.asarray(w)))

@@ -1,0 +1,95 @@
+"""Activation recomputation (gradient checkpointing).
+
+Parity: the reference's fleet recompute
+(python/paddle/distributed/fleet/utils/recompute in 2.x; fluid
+RecomputeOptimizer meta-optimizer in 1.8) — there, forward activations of
+marked segments are dropped and re-run during backward. TPU-first: the
+segment is traced once into a pure function and wrapped in
+``jax.checkpoint`` (XLA remat), which re-materializes it inside the
+backward pass of the enclosing computation — the standard HBM<->FLOPs
+trade on TPU.
+
+Notes:
+- randomness (dropout) inside the segment is safe: RNG keys are drawn at
+  trace time and baked into the jaxpr, so forward and rematerialized
+  values agree bit-for-bit;
+- buffer mutations (BatchNorm running stats) inside the segment are NOT
+  propagated — keep normalization-stat updates outside recompute blocks,
+  the same restriction GPipe-style remat imposes in the reference.
+"""
+import jax
+
+from ..core import autograd
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer_base import Layer, functional_call
+
+__all__ = ['recompute']
+
+
+class _Cell:
+    """Minimal cell-alike so bound-method receivers join the closure scan."""
+
+    def __init__(self, contents):
+        self.cell_contents = contents
+
+
+def recompute(function, *args, preserve_rng_state=True):
+    """Run ``function(*args)`` so its activations are rematerialized in
+    backward instead of stored.
+
+    function: a Layer (its parameters join the differentiable inputs) or a
+    pure callable over Tensors; args: input Tensors. Returns the output
+    Tensor (or tuple). ``preserve_rng_state`` is accepted for API parity —
+    keys are trace-time constants here, so it is always effectively True.
+    """
+    from ..tensor._helpers import _t
+    args = tuple(_t(a) for a in args)
+    layer = function if isinstance(function, Layer) else None
+    if layer is not None:
+        pnames = [n for n, _ in layer.named_parameters()]
+        params = [p for _, p in layer.named_parameters()]
+    else:
+        # a plain callable that closes over a Layer would bake that
+        # layer's parameters into the trace as constants — gradients for
+        # them would silently be zero. Refuse; pass the Layer itself.
+        closed = list(getattr(function, '__closure__', None) or ())
+        closed.append(None if not hasattr(function, '__self__')
+                      else _Cell(function.__self__))
+        for cell in closed:
+            v = getattr(cell, 'cell_contents', None) if cell else None
+            if isinstance(v, Layer) and any(
+                    not p.stop_gradient for p in v.parameters()):
+                raise ValueError(
+                    "recompute: the callable closes over a Layer with "
+                    "trainable parameters; their gradients would silently "
+                    "be lost. Pass the Layer as `function` directly "
+                    "(recompute(layer, *args)).")
+        pnames, params = [], []
+    n_args = len(args)
+
+    def pure(*vals):
+        xs = [Tensor(v) for v in vals[:n_args]]
+        with autograd.no_grad():   # jax differentiates; keep the tape out
+            if layer is not None:
+                state = dict(zip(pnames, vals[n_args:]))
+                out, _ = functional_call(layer, state, *xs)
+            else:
+                out = function(*xs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value for o in out)
+        return out._value
+
+    inputs = args + tuple(params)
+    # arity probe via abstract eval — with the jit capture-watch suspended,
+    # or its bookkeeping would hold references to the probe's tracers
+    from ..core import tensor as _ct
+    prev_watch = _ct._CAPTURE_WATCH.w
+    _ct._CAPTURE_WATCH.w = None
+    try:
+        shapes = jax.eval_shape(pure, *(t._value for t in inputs))
+    finally:
+        _ct._CAPTURE_WATCH.w = prev_watch
+    n_out = len(shapes) if isinstance(shapes, (tuple, list)) else 1
+    return apply_op(jax.checkpoint(pure), inputs, n_outputs=n_out)
+
+

@@ -1,0 +1,155 @@
+"""Torch interop: state-dict key/layout mapping.
+
+Parity: SURVEY §2.8.6 (torch-style state dict key mapping helper). The
+reference ecosystem ships torch checkpoints for many model zoos; this
+module converts them to/from this framework's state dicts:
+
+- key renames: BatchNorm ``running_mean``/``running_var`` <-> the
+  ``_mean``/``_variance`` buffer names used here; torch-only bookkeeping
+  (``num_batches_tracked``) is dropped;
+- layout: torch ``nn.Linear`` stores (out_features, in_features) while
+  this framework stores (in, out) — 2-D weights are transposed when the
+  target shape says so (shape-guided, so conv kernels and square matrices
+  that already match are left alone);
+- values arrive as anything numpy can consume (torch tensors included via
+  ``.detach().cpu().numpy()``).
+"""
+import numpy as np
+
+__all__ = ['torch_key_map', 'from_torch_state_dict', 'to_torch_state_dict',
+           'load_torch_state_dict']
+
+_TORCH_TO_PADDLE_SUFFIX = {
+    'running_mean': '_mean',
+    'running_var': '_variance',
+}
+_DROP_SUFFIXES = ('num_batches_tracked',)
+
+
+def _to_numpy(v):
+    if hasattr(v, 'detach'):          # torch tensor, no hard torch dep
+        v = v.detach().cpu().numpy()
+    return np.asarray(v)
+
+
+def torch_key_map(torch_keys, paddle_keys):
+    """Map torch key -> paddle key.
+
+    Exact matches after suffix renaming win; the remainder is matched
+    positionally within the (stable) ordering of the unmatched keys —
+    torch modules and their ports enumerate parameters in the same
+    definition order, which is what makes the positional fallback sound.
+    """
+    renamed = {}
+    for tk in torch_keys:
+        head, _, tail = tk.rpartition('.')
+        if tail in _DROP_SUFFIXES:
+            continue
+        tail = _TORCH_TO_PADDLE_SUFFIX.get(tail, tail)
+        renamed[tk] = (head + '.' + tail) if head else tail
+
+    paddle_set = set(paddle_keys)
+    mapping = {}
+    unmatched_t, used = [], set()
+    for tk, guess in renamed.items():
+        if guess in paddle_set and guess not in used:
+            mapping[tk] = guess
+            used.add(guess)
+        else:
+            unmatched_t.append(tk)
+    unmatched_p = [pk for pk in paddle_keys if pk not in used]
+    if unmatched_t or unmatched_p:
+        # positional pairing is only sound when both sides line up 1:1 —
+        # a count mismatch would shift every later pair onto the wrong
+        # parameter, so fail loudly instead
+        if len(unmatched_t) != len(unmatched_p):
+            raise ValueError(
+                "torch_key_map: %d torch key(s) and %d target key(s) left "
+                "after name matching cannot be paired positionally "
+                "(torch: %s; target: %s)"
+                % (len(unmatched_t), len(unmatched_p),
+                   unmatched_t[:4], unmatched_p[:4]))
+        for tk, pk in zip(unmatched_t, unmatched_p):
+            mapping[tk] = pk
+    return mapping
+
+
+def _linear_weight_keys(layer):
+    """state_dict keys holding Linear weights (these need the (out,in) ->
+    (in,out) transpose even when square, where shape can't tell)."""
+    from .nn.layer.common import Linear
+    keys = set()
+    for name, sub in layer.named_sublayers(include_self=True):
+        if isinstance(sub, Linear):
+            keys.add((name + '.' if name else '') + 'weight')
+    return keys
+
+
+def from_torch_state_dict(torch_sd, reference_sd, linear_keys=()):
+    """torch state dict -> framework state dict (numpy values).
+
+    reference_sd: the target layer's ``state_dict()`` (used for key names
+    and shape-guided transposes). linear_keys: target keys known to be
+    Linear weights — always transposed, covering the square case where
+    shapes alone cannot reveal the torch (out, in) layout;
+    ``load_torch_state_dict`` fills this from the layer automatically.
+    """
+    ref_shapes = {k: tuple(v.shape) for k, v in reference_sd.items()}
+    mapping = torch_key_map(list(torch_sd.keys()), list(reference_sd.keys()))
+    linear_keys = set(linear_keys)
+    out = {}
+    for tk, pk in mapping.items():
+        v = _to_numpy(torch_sd[tk])
+        want = ref_shapes.get(pk)
+        if pk in linear_keys and v.ndim == 2:
+            v = v.T                        # torch Linear (out,in) -> (in,out)
+        if want is not None and tuple(v.shape) != want:
+            if v.ndim == 2 and tuple(v.T.shape) == want:
+                v = v.T
+            elif v.size == int(np.prod(want)):
+                v = v.reshape(want)
+            else:
+                raise ValueError(
+                    "cannot adapt torch param %r %s to %r %s"
+                    % (tk, tuple(v.shape), pk, want))
+        out[pk] = v
+    return out
+
+
+def load_torch_state_dict(layer, torch_sd, strict=True):
+    """Load a torch state dict into ``layer`` in place; returns the layer."""
+    own = layer.state_dict()
+    converted = from_torch_state_dict(torch_sd, own,
+                                      linear_keys=_linear_weight_keys(layer))
+    if strict:
+        missing = sorted(set(own) - set(converted))
+        if missing:
+            raise ValueError(
+                "torch checkpoint is missing %d parameter(s): %s"
+                % (len(missing), missing[:5]))
+    layer.set_state_dict(converted)
+    return layer
+
+
+def to_torch_state_dict(layer):
+    """Framework layer -> torch-convention state dict (numpy values):
+    reverse renames + Linear transpose + synthesized zero
+    ``num_batches_tracked`` per BatchNorm, consumable by
+    ``torch_module.load_state_dict`` (strict) after ``torch.from_numpy``."""
+    inv = {v: k for k, v in _TORCH_TO_PADDLE_SUFFIX.items()}
+    out = {}
+    linear_weights = _linear_weight_keys(layer)
+    for k, v in layer.state_dict().items():
+        head, _, tail = k.rpartition('.')
+        tail = inv.get(tail, tail)
+        arr = np.asarray(v.numpy())
+        if k in linear_weights and arr.ndim == 2:
+            arr = arr.T
+        out[(head + '.' + tail) if head else tail] = arr
+    # torch BatchNorm carries num_batches_tracked which has no analogue
+    # here; emit zeros so strict load_state_dict round-trips
+    for name, sub in layer.named_sublayers(include_self=True):
+        if '_mean' in getattr(sub, '_buffers', {}):
+            prefix = name + '.' if name else ''
+            out[prefix + 'num_batches_tracked'] = np.array(0, np.int64)
+    return out

@@ -1,0 +1,278 @@
+"""Torch interop, fleet strategy depth, recompute, PS sparse table."""
+import importlib.util
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+_HAS_TORCH = importlib.util.find_spec('torch') is not None
+if _HAS_TORCH:
+    import torch
+
+
+@pytest.mark.skipif(not _HAS_TORCH, reason="torch interop needs torch")
+class TestTorchInterop:
+    def _torch_model(self):
+        import torch.nn as tnn
+        torch.manual_seed(0)
+        return tnn.Sequential(
+            tnn.Linear(8, 16), tnn.ReLU(), tnn.BatchNorm1d(16),
+            tnn.Linear(16, 4))
+
+    def _paddle_model(self):
+        return nn.Sequential(
+            nn.Linear(8, 16), nn.ReLU(), nn.BatchNorm1D(16),
+            nn.Linear(16, 4))
+
+    def test_outputs_match_after_conversion(self):
+        tm = self._torch_model().eval()
+        pm = self._paddle_model()
+        paddle.interop.load_torch_state_dict(pm, tm.state_dict())
+        pm.eval()
+        x = np.random.default_rng(0).standard_normal((5, 8)).astype('float32')
+        with torch.no_grad():
+            ref = tm(torch.from_numpy(x)).numpy()
+        out = pm(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_roundtrip_back_to_torch(self):
+        tm = self._torch_model().eval()
+        pm = self._paddle_model()
+        paddle.interop.load_torch_state_dict(pm, tm.state_dict())
+        back = paddle.interop.to_torch_state_dict(pm)
+        tm2 = self._torch_model()
+        tm2.load_state_dict(
+            {k: torch.from_numpy(np.ascontiguousarray(v))
+             for k, v in back.items()}, strict=False)
+        tm2.eval()
+        x = np.random.default_rng(1).standard_normal((3, 8)).astype('float32')
+        with torch.no_grad():
+            np.testing.assert_allclose(tm2(torch.from_numpy(x)).numpy(),
+                                       tm(torch.from_numpy(x)).numpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_conv_bn_model(self):
+        import torch.nn as tnn
+        torch.manual_seed(3)
+        tm = tnn.Sequential(tnn.Conv2d(3, 8, 3, padding=1),
+                            tnn.BatchNorm2d(8), tnn.ReLU()).eval()
+        pm = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1),
+                           nn.BatchNorm2D(8), nn.ReLU())
+        paddle.interop.load_torch_state_dict(pm, tm.state_dict())
+        pm.eval()
+        x = np.random.default_rng(2).standard_normal(
+            (2, 3, 10, 10)).astype('float32')
+        with torch.no_grad():
+            ref = tm(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(pm(paddle.to_tensor(x)).numpy(), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_strict_missing_raises(self):
+        pm = self._paddle_model()
+        with pytest.raises(ValueError, match="missing|positionally"):
+            paddle.interop.load_torch_state_dict(pm, {}, strict=True)
+
+
+class TestFleetStrategies:
+    def _data(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 8)).astype('float32')
+        y = rng.standard_normal((64, 1)).astype('float32')
+        return paddle.to_tensor(x), paddle.to_tensor(y)
+
+    def test_lamb_flag_swaps_optimizer(self):
+        from paddle_tpu.distributed import fleet as fleet_mod
+        from paddle_tpu.optimizer.optimizer import Lamb
+        st = fleet_mod.DistributedStrategy()
+        st.lamb = True
+        m = nn.Linear(8, 1)
+        base = paddle.optimizer.SGD(learning_rate=0.01,
+                                    parameters=m.parameters())
+        dopt = fleet_mod.fleet.distributed_optimizer(base, strategy=st)
+        assert isinstance(dopt.inner, Lamb)
+        x, y = self._data()
+        loss = ((m(x) - y) ** 2).mean()
+        dopt.minimize(loss)
+        assert np.isfinite(m.weight.numpy()).all()
+
+    def test_amp_flag_scales_loss(self):
+        from paddle_tpu.distributed import fleet as fleet_mod
+        st = fleet_mod.DistributedStrategy()
+        st.amp = True
+        m = nn.Linear(8, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=m.parameters())
+        dopt = fleet_mod.fleet.distributed_optimizer(opt, strategy=st)
+        assert dopt._scaler is not None
+        x, y = self._data()
+        w0 = m.weight.numpy().copy()
+        loss = ((m(x) - y) ** 2).mean()
+        dopt.minimize(loss)
+        w1 = m.weight.numpy()
+        assert not np.allclose(w0, w1)         # stepped
+        assert np.isfinite(w1).all()           # and unscaled correctly
+
+
+class TestRecompute:
+    def test_grads_match_plain_forward(self):
+        from paddle_tpu.distributed import recompute
+        paddle.seed(0)
+        block = nn.Sequential(nn.Linear(6, 12), nn.GELU(), nn.Linear(12, 6))
+        head = nn.Linear(6, 1)
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((4, 6))
+            .astype('float32'))
+
+        def loss_with(fn):
+            h = fn()
+            out = head(h)
+            return (out ** 2).mean()
+
+        # plain
+        l1 = loss_with(lambda: block(x))
+        l1.backward()
+        g_plain = {n: p.grad.numpy().copy()
+                   for n, p in block.named_parameters()}
+        for p in block.parameters() + head.parameters():
+            p.clear_grad()
+        # recomputed
+        l2 = loss_with(lambda: recompute(block, x))
+        l2.backward()
+        np.testing.assert_allclose(float(l1.numpy()), float(l2.numpy()),
+                                   rtol=1e-6)
+        for n, p in block.named_parameters():
+            np.testing.assert_allclose(p.grad.numpy(), g_plain[n],
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_callable_segment_and_fleet_utils(self):
+        from paddle_tpu.distributed.fleet import utils
+        x = paddle.to_tensor(np.ones((2, 3), 'float32'))
+        x.stop_gradient = False
+        y = utils.recompute(lambda t: (t * 3).tanh(), x)
+        y.sum().backward()
+        expected = 3 * (1 - np.tanh(3.0) ** 2)
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.full((2, 3), expected, 'float32'),
+                                   rtol=1e-5)
+
+    def test_under_jit(self):
+        from paddle_tpu.distributed import recompute
+        from paddle_tpu.jit import to_static
+        block = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 4))
+
+        @to_static
+        def f(inp):
+            return recompute(block, inp).sum()
+
+        x = paddle.to_tensor(np.ones((2, 4), 'float32'))
+        ref = block(x).sum()
+        np.testing.assert_allclose(float(f(x).numpy()),
+                                   float(ref.numpy()), rtol=1e-5)
+
+
+class TestSparseShardedTable:
+    def test_pull_push_semantics(self):
+        from paddle_tpu.distributed import SparseShardedTable
+        paddle.seed(0)
+        t = SparseShardedTable(100, 8)
+        ids = paddle.to_tensor(np.array([3, 7, 3], dtype='int64'))
+        rows = t.pull(ids)
+        assert tuple(rows.shape) == (3, 8)
+        w_before = t.weight.numpy().copy()
+        g = np.ones((3, 8), 'float32')
+        t.push(ids, paddle.to_tensor(g), lr=0.5)
+        w_after = t.weight.numpy()
+        # id 3 appears twice: updates accumulate
+        np.testing.assert_allclose(w_after[3], w_before[3] - 1.0, rtol=1e-6)
+        np.testing.assert_allclose(w_after[7], w_before[7] - 0.5, rtol=1e-6)
+        untouched = [i for i in range(100) if i not in (3, 7)]
+        np.testing.assert_allclose(w_after[untouched], w_before[untouched])
+
+    def test_pull_is_differentiable(self):
+        from paddle_tpu.distributed import SparseShardedTable
+        t = SparseShardedTable(10, 4)
+        ids = paddle.to_tensor(np.array([1, 2], dtype='int64'))
+        out = t.pull(ids)
+        out.sum().backward()
+        g = t.weight.grad.numpy()
+        assert g[1].sum() == 4 and g[2].sum() == 4 and g[0].sum() == 0
+
+    def test_pull_train_push_loop_learns(self):
+        """PS-style loop: pull rows, compute sparse grads, push back."""
+        from paddle_tpu.distributed import SparseShardedTable
+        paddle.seed(3)
+        t = SparseShardedTable(50, 4)
+        rng = np.random.default_rng(0)
+        target = rng.standard_normal((50, 4)).astype('float32')
+        for step in range(200):
+            ids_np = rng.integers(0, 50, 16)
+            ids = paddle.to_tensor(ids_np.astype('int64'))
+            rows = t.pull(ids)
+            diff = rows.numpy() - target[ids_np]
+            t.push(ids, paddle.to_tensor(2.0 * diff / len(ids_np)), lr=0.5)
+        err = np.abs(t.weight.numpy() - target).mean()
+        assert err < 0.05, err
+
+
+@pytest.mark.skipif(not _HAS_TORCH, reason="needs torch")
+class TestInteropReviewRegressions:
+    def test_square_linear_transposed(self):
+        import torch.nn as tnn
+        torch.manual_seed(5)
+        tm = tnn.Linear(6, 6).eval()      # square: shape can't reveal layout
+        pm = nn.Linear(6, 6)
+        paddle.interop.load_torch_state_dict(pm, tm.state_dict())
+        x = np.random.default_rng(0).standard_normal((3, 6)).astype('float32')
+        with torch.no_grad():
+            ref = tm(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(pm(paddle.to_tensor(x)).numpy(), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_count_mismatch_raises_not_shifts(self):
+        from paddle_tpu.interop import torch_key_map
+        with pytest.raises(ValueError, match="positionally"):
+            torch_key_map(['a.w', 'extra.buf', 'b.w'],
+                          ['x.weight', 'y.weight'])
+
+    def test_strict_torch_roundtrip_with_bn(self):
+        import torch.nn as tnn
+        torch.manual_seed(6)
+        tm = tnn.Sequential(tnn.Linear(4, 8), tnn.BatchNorm1d(8)).eval()
+        pm = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+        paddle.interop.load_torch_state_dict(pm, tm.state_dict())
+        back = paddle.interop.to_torch_state_dict(pm)
+        tm.load_state_dict({k: torch.from_numpy(np.ascontiguousarray(v))
+                            for k, v in back.items()})   # strict default
+
+
+class TestRecomputeClosureGuard:
+    def test_closure_over_layer_raises(self):
+        from paddle_tpu.distributed import recompute
+        block = nn.Linear(4, 4)
+        x = paddle.to_tensor(np.ones((2, 4), 'float32'))
+        with pytest.raises(ValueError, match="closes over a Layer"):
+            recompute(lambda t: block(t), x)
+
+
+class TestFleetAmpGradientMerge:
+    def test_amp_respects_k_steps(self):
+        from paddle_tpu.distributed import fleet as fleet_mod
+        st = fleet_mod.DistributedStrategy()
+        st.amp = True
+        st.gradient_merge = True
+        st.gradient_merge_configs = {'k_steps': 3}
+        m = nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        dopt = fleet_mod.fleet.distributed_optimizer(opt, strategy=st)
+        x = paddle.to_tensor(np.ones((4, 4), 'float32'))
+        y = paddle.to_tensor(np.zeros((4, 1), 'float32'))
+        w0 = m.weight.numpy().copy()
+        for i in range(2):
+            dopt.minimize(((m(x) - y) ** 2).mean())
+        np.testing.assert_array_equal(m.weight.numpy(), w0)  # still merging
+        dopt.minimize(((m(x) - y) ** 2).mean())              # 3rd: steps
+        assert not np.allclose(m.weight.numpy(), w0)
+        assert np.isfinite(m.weight.numpy()).all()
