@@ -34,6 +34,7 @@ from . import amp
 from . import incubate
 from . import utils
 from . import device
+from . import inference
 from . import interop
 from . import reader
 from . import slim

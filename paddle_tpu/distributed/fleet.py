@@ -119,13 +119,24 @@ class Fleet:
         # LambOptimizer/LarsOptimizer meta passes do the same rewrite)
         from ..optimizer.optimizer import Lamb, LarsMomentum
         if st.lamb and not isinstance(optimizer, Lamb):
+            kw = {}
+            wd = getattr(optimizer, '_weight_decay', None)
+            if isinstance(wd, (int, float)):
+                kw['lamb_weight_decay'] = float(wd)
             optimizer = Lamb(learning_rate=optimizer._lr,
                              parameters=optimizer._parameters,
-                             grad_clip=optimizer._grad_clip)
+                             grad_clip=optimizer._grad_clip, **kw)
         elif st.lars and not isinstance(optimizer, LarsMomentum):
+            kw = {}
+            m = getattr(optimizer, '_momentum', None)
+            if isinstance(m, (int, float)):
+                kw['momentum'] = float(m)   # keep the user's momentum
+            wd = getattr(optimizer, '_weight_decay', None)
+            if isinstance(wd, (int, float)):
+                kw['lars_weight_decay'] = float(wd)
             optimizer = LarsMomentum(learning_rate=optimizer._lr,
                                      parameters=optimizer._parameters,
-                                     grad_clip=optimizer._grad_clip)
+                                     grad_clip=optimizer._grad_clip, **kw)
         self._user_defined_optimizer = optimizer
         return _DistributedOptimizer(optimizer, st)
 
@@ -182,38 +193,32 @@ class _DistributedOptimizer:
                     collective.all_reduce(p.grad)
                     p.grad._inplace_value(p.grad._value / n)
 
+    def _k_steps(self):
+        return (self.strategy.gradient_merge_configs.get('k_steps', 1)
+                if self.strategy and self.strategy.gradient_merge else 1)
+
     def step(self):
-        k = (self.strategy.gradient_merge_configs.get('k_steps', 1)
-             if self.strategy and self.strategy.gradient_merge else 1)
         self._accum += 1
-        if self._accum % k != 0:
+        if self._accum % self._k_steps() != 0:
             return  # keep accumulating (grads already sum into .grad)
         self._sync_grads()
-        self.inner.step()
+        if self._scaler is not None:
+            self._scaler.step(self.inner)   # unscale + inner.step
+        else:
+            self.inner.step()
 
     def minimize(self, loss, *args, **kwargs):
-        if self._scaler is not None:
-            # amp strategy: dynamic loss scaling around backward + step,
-            # honoring gradient_merge accumulation exactly like the
-            # unscaled path (scaled grads accumulate; unscale at step)
-            self._scaler.scale(loss).backward()
-            k = (self.strategy.gradient_merge_configs.get('k_steps', 1)
-                 if self.strategy and self.strategy.gradient_merge else 1)
-            self._accum += 1
-            if self._accum % k == 0:
-                self._sync_grads()
-                self._scaler.step(self.inner)
-                self.inner.clear_grad()
-            return [], []
-        loss.backward()
+        # with amp, dynamic loss scaling wraps backward; the grads then
+        # accumulate scaled (scale is constant within a merge window) and
+        # step()/clear_grad() carry the single copy of the k_steps logic
+        (self._scaler.scale(loss) if self._scaler is not None
+         else loss).backward()
         self.step()
         self.clear_grad()
         return [], []
 
     def clear_grad(self):
-        k = (self.strategy.gradient_merge_configs.get('k_steps', 1)
-             if self.strategy and self.strategy.gradient_merge else 1)
-        if self._accum % k == 0:
+        if self._accum % self._k_steps() == 0:
             self.inner.clear_grad()
 
     def state_dict(self):

@@ -16,6 +16,61 @@ from ..core.dtypes import convert_dtype
 from .graph import Program, Variable, default_main_program
 
 
+def _program_params(program):
+    """Ordered parameter Variables a program's ops read."""
+    seen, out = set(), []
+    for op in program.global_block.ops:
+        for v in op.inputs:
+            if v.concrete is not None and isinstance(v.concrete, Parameter) \
+                    and id(v) not in seen:
+                seen.add(id(v))
+                out.append(v)
+    return out
+
+
+def _interpret_ops(ops, env):
+    """Run a Program op list over an id(var)->payload environment.
+
+    Ops whose inputs are unavailable are skipped (fetch-pruning happens
+    implicitly); constants come from each Variable's concrete payload.
+    Shared by Executor compilation and the portable jax.export path so the
+    two can never diverge.
+    """
+    for op in ops:
+        args = []
+        ok = True
+        for v in op.inputs:
+            if id(v) in env:
+                args.append(env[id(v)])
+            elif v.concrete is not None:
+                args.append(v.concrete._value)
+            else:
+                ok = False
+                break
+        if not ok:
+            continue
+        res = op.fn(*args)
+        if op.n_outputs == 1:
+            env[id(op.outputs[0])] = res
+        else:
+            for ov, r in zip(op.outputs, res):
+                env[id(ov)] = r
+    return env
+
+
+def _fetch_outs(fetch_vars, env):
+    outs = []
+    for fv in fetch_vars:
+        if id(fv) in env:
+            outs.append(env[id(fv)])
+        elif fv.concrete is not None:
+            outs.append(fv.concrete._value)
+        else:
+            raise RuntimeError(
+                f"fetch var {fv.name} not computed — check feeds")
+    return outs
+
+
 class Executor:
     def __init__(self, place=None):
         self.place = place
@@ -90,39 +145,13 @@ class Executor:
         raise TypeError(f"bad fetch entry {f!r}")
 
     def _program_params(self, program):
-        seen, out = set(), []
-        for op in program.global_block.ops:
-            for v in op.inputs:
-                if v.concrete is not None and isinstance(v.concrete, Parameter) \
-                        and id(v) not in seen:
-                    seen.add(id(v))
-                    out.append(v)
-        return out
+        return _program_params(program)
 
     def _compile(self, program, feed_names, fetch_vars, param_names, train_spec):
         ops = program.global_block.ops
 
         def interpret(env):
-            for op in ops:
-                args = []
-                ok = True
-                for v in op.inputs:
-                    if id(v) in env:
-                        args.append(env[id(v)])
-                    elif v.concrete is not None:
-                        args.append(v.concrete._value)
-                    else:
-                        ok = False
-                        break
-                if not ok:
-                    continue
-                res = op.fn(*args)
-                if op.n_outputs == 1:
-                    env[id(op.outputs[0])] = res
-                else:
-                    for ov, r in zip(op.outputs, res):
-                        env[id(ov)] = r
-            return env
+            return _interpret_ops(ops, env)
 
         block = program.global_block
         feed_vars = [block.var(n) for n in feed_names]
@@ -137,16 +166,7 @@ class Executor:
                 for v, val in zip(params, param_vals):
                     env[id(v)] = val
                 env = interpret(env)
-                outs = []
-                for fv in fetch_vars:
-                    if id(fv) in env:
-                        outs.append(env[id(fv)])
-                    elif fv.concrete is not None:
-                        outs.append(fv.concrete._value)
-                    else:
-                        raise RuntimeError(
-                            f"fetch var {fv.name} not computed — check feeds")
-                return outs, None
+                return _fetch_outs(fetch_vars, env), None
             return run
 
         loss_var, optimizer = train_spec
@@ -178,3 +198,30 @@ class Executor:
                     outs.append(fv.concrete._value)
             return outs, [new_pv[v.name] for v in params], new_state
         return train_run
+
+
+def program_infer_fn(program, feed_names, fetch_vars):
+    """Standalone pure inference function over a Program.
+
+    Returns ``(fn, params)`` where ``fn(feed_vals, param_vals) -> list`` of
+    fetch payloads and ``params`` is the ordered list of parameter
+    Variables the function takes positionally. Used by save_inference_model
+    to jax.export the fetch subgraph so a Predictor can run it in a fresh
+    process with no Program rebuild. Shares _interpret_ops/_fetch_outs with
+    Executor._compile, so the two execution paths cannot diverge.
+    """
+    ops = program.global_block.ops
+    block = program.global_block
+    feed_vars = [block.var(n) for n in feed_names]
+    params = _program_params(program)
+
+    def fn(feed_vals, param_vals):
+        env = {}
+        for v, val in zip(feed_vars, feed_vals):
+            env[id(v)] = val
+        for v, val in zip(params, param_vals):
+            env[id(v)] = val
+        env = _interpret_ops(ops, env)
+        return _fetch_outs(fetch_vars, env)
+
+    return fn, params

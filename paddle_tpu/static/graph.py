@@ -23,7 +23,8 @@ _var_counter = itertools.count()
 
 class Variable(Tensor):
     """Symbolic tensor in a Program. `_value` holds a ShapeDtypeStruct."""
-    __slots__ = ('_symbolic', 'block', 'op', 'is_data', 'concrete')
+    __slots__ = ('_symbolic', 'block', 'op', 'is_data', 'concrete',
+                 '_dynamic_dims')
 
     def __init__(self, aval, name=None, is_data=False, concrete=None):
         super().__init__(aval, stop_gradient=not (concrete is not None and
@@ -232,9 +233,12 @@ set_symbolic_handler(_symbolic_apply)
 def data(name, shape, dtype='float32', lod_level=0):
     """paddle.static.data — feed placeholder."""
     prog = current_capture_program() or default_main_program()
+    dynamic = tuple(i for i, s in enumerate(shape)
+                    if s is None or s == -1)
     shape = tuple(1 if (s is None or s == -1) else int(s) for s in shape)
     v = Variable(jax.ShapeDtypeStruct(shape, convert_dtype(dtype)), name=name,
                  is_data=True)
+    v._dynamic_dims = dynamic   # which dims were None/-1 (batch-symbolic)
     v.stop_gradient = True
     prog.global_block.vars[name] = v
     return v

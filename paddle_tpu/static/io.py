@@ -47,7 +47,13 @@ load_vars = load_persistables
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, **kwargs):
-    """Saves program description + params; exports StableHLO text."""
+    """Saves program description + params + a portable serialized export.
+
+    The fetch subgraph is jax.export'ed with symbolic batch dims (every
+    None/-1 feed dim), so inference.Predictor can run the model in a fresh
+    process with no Program rebuild — the TPU-first analogue of the
+    reference's self-contained __model__ ProgramDesc.
+    """
     os.makedirs(dirname, exist_ok=True)
     program = main_program or default_main_program()
     params = _collect_params(program)
@@ -56,12 +62,58 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         'fetch_names': [t.name for t in target_vars],
         'program_repr': str(program),
     }
+    try:
+        meta['exported'] = _export_portable(program, list(feeded_var_names),
+                                            list(target_vars))
+    except Exception as e:     # pragma: no cover - diagnostic path
+        import warnings
+        warnings.warn(
+            "save_inference_model: portable export failed (%r) — the model "
+            "dir will load via Executor in-process but inference.Predictor "
+            "cannot serve it standalone" % (e,))
+        meta['export_error'] = repr(e)
     with open(os.path.join(dirname, model_filename or '__model__'), 'wb') as f:
         pickle.dump(meta, f)
     with open(os.path.join(dirname, params_filename or '__params__'),
               'wb') as f:
         pickle.dump(params, f)
     return [t.name for t in target_vars]
+
+
+def _export_portable(program, feed_names, fetch_vars):
+    """jax.export the fetch subgraph: returns {blob, param_names}."""
+    import jax
+    import numpy as np
+    from .executor import program_infer_fn
+    from ..core.dtypes import convert_dtype
+    fn, params = program_infer_fn(program, feed_names, fetch_vars)
+    block = program.global_block
+    scope = jax.export.SymbolicScope()
+    feed_specs = []
+    feed_dtypes = []
+    for i, n in enumerate(feed_names):
+        v = block.var(n)
+        dyn = set(getattr(v, '_dynamic_dims', ()))
+        # dynamic dim 0 shares one 'batch' symbol across every feed (ops
+        # combining feeds must agree on it; shape-poly can't infer that),
+        # other dynamic positions get per-feed symbols
+        dims = []
+        for j, d in enumerate(v.shape):
+            if j in dyn or d is None or int(d) < 0:
+                dims.append('batch' if j == 0 else 'b%d_%d' % (i, j))
+            else:
+                dims.append(str(d))
+        shape = jax.export.symbolic_shape(','.join(dims), scope=scope)
+        dt = np.dtype(convert_dtype(v.dtype))
+        feed_dtypes.append(dt.name)
+        feed_specs.append(jax.ShapeDtypeStruct(shape, dt))
+    param_specs = [jax.ShapeDtypeStruct(tuple(p.concrete._value.shape),
+                                        p.concrete._value.dtype)
+                   for p in params]
+    exported = jax.export.export(jax.jit(fn))(feed_specs, param_specs)
+    return {'blob': exported.serialize(),
+            'param_names': [p.name for p in params],
+            'feed_dtypes': feed_dtypes}
 
 
 def load_inference_model(dirname, executor, model_filename=None,
