@@ -252,6 +252,21 @@ def main():
         large = dict(vocab_size=30522, hidden_size=1024,
                      num_hidden_layers=24, num_attention_heads=16,
                      intermediate_size=4096, max_position_embeddings=512)
+        # autotune the attention tiling for the two bench signatures on the
+        # real chip (cached on disk; warm runs skip this entirely)
+        try:
+            from paddle_tpu.kernels.autotune import autotune_attention
+            budget = float(os.environ.get('PADDLE_TPU_AUTOTUNE_BUDGET',
+                                          '120'))
+            for b, s in ((64, 128), (16, 512)):
+                dec = autotune_attention(
+                    b, 16, s, 64, dtype='bfloat16', causal=False,
+                    has_kpad=False, dropout_p=0.1, budget_s=budget,
+                    verbose=False)
+                print("autotune b%d l%d -> %s" % (b, s, dec),
+                      file=sys.stderr)
+        except Exception as e:   # never let tuning break the bench
+            print("autotune skipped: %r" % (e,), file=sys.stderr)
         # phase 1: seq128 (headline, comparable to BASELINE.json)
         sps128 = bench_bert(large, batch=64, seq=128, steps=10, warmup=2)
         # phase 2: seq512 — attention-dominated, Pallas flash path

@@ -161,6 +161,7 @@ class _DistributedOptimizer:
         self.inner = inner
         self.strategy = strategy
         self._accum = 0
+        self._scaled_pending = False
         self._scaler = None
         if strategy is not None and strategy.amp:
             from ..amp import GradScaler
@@ -202,8 +203,13 @@ class _DistributedOptimizer:
         if self._accum % self._k_steps() != 0:
             return  # keep accumulating (grads already sum into .grad)
         self._sync_grads()
-        if self._scaler is not None:
-            self._scaler.step(self.inner)   # unscale + inner.step
+        if self._scaled_pending:
+            # grads carry the loss scale (minimize scaled the loss):
+            # scaler.step unscales them before the inner update. A caller
+            # doing plain loss.backward(); step() has unscaled grads and
+            # must NOT be divided by the scale.
+            self._scaled_pending = False
+            self._scaler.step(self.inner)
         else:
             self.inner.step()
 
@@ -211,8 +217,11 @@ class _DistributedOptimizer:
         # with amp, dynamic loss scaling wraps backward; the grads then
         # accumulate scaled (scale is constant within a merge window) and
         # step()/clear_grad() carry the single copy of the k_steps logic
-        (self._scaler.scale(loss) if self._scaler is not None
-         else loss).backward()
+        if self._scaler is not None:
+            self._scaled_pending = True
+            self._scaler.scale(loss).backward()
+        else:
+            loss.backward()
         self.step()
         self.clear_grad()
         return [], []

@@ -1,0 +1,214 @@
+"""On-hardware autotuning for the flash-attention dispatch.
+
+The right (block_q, block_k) tiling — and whether the Pallas kernel beats
+XLA's fused attention at all — depends on sequence length, head dim,
+batch and the mask/dropout mix; fixed constants leave performance on the
+table (the round-2 kernel shipped block 512x512 everywhere). This module
+times candidates ON THE REAL CHIP once per shape signature:
+
+- ``autotune_attention(...)`` builds a training-shaped step (forward +
+  backward, the bench workload) per candidate, times best-of-k, and
+  records the winner;
+- results cache in-process and on disk (PADDLE_TPU_AUTOTUNE_CACHE, default
+  ~/.cache/paddle_tpu/autotune.json) keyed by backend + signature, so a
+  serving/bench process warm-starts instantly;
+- the traced attention dispatch (nn/functional/transformer.py) consults
+  ``lookup()`` at trace time — shapes are concrete under tracing, timing
+  never runs inside a trace;
+- everything is budget-capped and falls back to the static heuristic on
+  any failure: autotune can only ever improve on the defaults.
+"""
+import functools
+import json
+import math
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ['autotune_attention', 'lookup', 'attention_signature',
+           'clear_cache']
+
+_CACHE = {}
+_DISK_LOADED = [False]
+
+
+def _disk_path():
+    return os.environ.get(
+        'PADDLE_TPU_AUTOTUNE_CACHE',
+        os.path.join(os.path.expanduser('~/.cache/paddle_tpu'),
+                     'autotune.json'))
+
+
+def _load_disk():
+    if _DISK_LOADED[0]:
+        return
+    _DISK_LOADED[0] = True
+    try:
+        with open(_disk_path()) as f:
+            for k, v in json.load(f).items():
+                _CACHE.setdefault(k, v)
+    except Exception:
+        pass
+
+
+def _save_disk():
+    try:
+        path = _disk_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        merged = {}
+        try:   # re-merge: concurrent tuners must not drop each other's work
+            with open(path) as f:
+                merged.update(json.load(f))
+        except Exception:
+            pass
+        merged.update(_CACHE)
+        tmp = path + '.tmp.%d' % os.getpid()
+        with open(tmp, 'w') as f:
+            json.dump(merged, f, indent=1)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def attention_signature(batch, heads, seq, head_dim, causal, has_kpad,
+                        dropout, dtype='bfloat16'):
+    return 'attn:%s:%s:b%d_h%d_l%d_d%d_c%d_m%d_p%d' % (
+        jax.default_backend(), jnp.dtype(dtype).name, batch, heads, seq,
+        head_dim, int(causal), int(has_kpad), int(dropout > 0))
+
+
+def _valid_decision(d):
+    return (isinstance(d, dict) and d.get('mode') in ('flash', 'xla')
+            and isinstance(d.get('block_q'), int)
+            and isinstance(d.get('block_k'), int))
+
+
+def lookup(batch, heads, seq, head_dim, causal, has_kpad, dropout,
+           dtype='bfloat16'):
+    """Cached decision for this signature, or None.
+
+    Returns {'mode': 'flash'|'xla', 'block_q': int, 'block_k': int}.
+    Malformed disk entries (hand-edited / format drift) are treated as
+    untuned — the dispatch must never crash on cache contents.
+    """
+    _load_disk()
+    d = _CACHE.get(attention_signature(
+        batch, heads, seq, head_dim, causal, has_kpad, dropout, dtype))
+    return d if _valid_decision(d) else None
+
+
+def clear_cache():
+    _CACHE.clear()
+    _DISK_LOADED[0] = False
+
+
+def _time_step(fn, args, iters=5, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    best = float('inf')
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _candidate_blocks(seq, has_kpad):
+    """Tile candidates; with a key-padding bias block_k is pinned to the
+    full row (the kernel streams the whole bias), so only block_q varies."""
+    qs = [b for b in (128, 256, 512, 1024) if seq % b == 0 and b <= seq]
+    if has_kpad:
+        return [(bq, seq) for bq in qs]
+    ks = [b for b in (128, 256, 512, 1024) if seq % b == 0 and b <= seq]
+    return [(bq, bk) for bq in qs for bk in ks]
+
+
+def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
+                       causal=False, has_kpad=False, dropout_p=0.0,
+                       budget_s=90.0, verbose=False):
+    """Time flash block candidates + the XLA path for one shape signature
+    (training step: forward + grads wrt q/k/v); record and return the
+    winner. No-op (returns the cached decision) when already tuned.
+    """
+    sig = attention_signature(batch, heads, seq, head_dim, causal,
+                              has_kpad, dropout_p, dtype)
+    _load_disk()
+    if _valid_decision(_CACHE.get(sig)):
+        return _CACHE[sig]
+
+    from .flash_attention import flash_attention_bhld
+
+    rng = np.random.default_rng(0)
+    dt = jnp.dtype(dtype)
+    q = jnp.asarray(rng.standard_normal((batch, heads, seq, head_dim)),
+                    dtype=dt)
+    k = jnp.asarray(rng.standard_normal((batch, heads, seq, head_dim)),
+                    dtype=dt)
+    v = jnp.asarray(rng.standard_normal((batch, heads, seq, head_dim)),
+                    dtype=dt)
+    kpad = None
+    if has_kpad:
+        kpad = jnp.zeros((batch, seq), dt)
+    seed = jnp.zeros((1, 1), jnp.int32) if dropout_p > 0 else None
+    scale = 1.0 / math.sqrt(head_dim)
+
+    def make_flash_step(bq, bk):
+        def loss(qq, kk, vv):
+            out = flash_attention_bhld(
+                qq, kk, vv, causal=causal, scale=scale, kpad_bias=kpad,
+                dropout_p=dropout_p, dropout_seed=seed,
+                block_q=bq, block_k=bk)
+            return jnp.sum(out.astype(jnp.float32))
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def make_xla_step():
+        def loss(qq, kk, vv):
+            s = jnp.einsum('bhqd,bhkd->bhqk', qq, kk).astype(jnp.float32) \
+                * scale
+            if causal:
+                L = qq.shape[2]
+                mask = jnp.tril(jnp.ones((L, L), jnp.bool_))
+                s = jnp.where(mask, s, -1e30)
+            if kpad is not None:
+                s = s + kpad[:, None, None, :].astype(jnp.float32)
+            p = jax.nn.softmax(s, axis=-1).astype(qq.dtype)
+            out = jnp.einsum('bhqk,bhkd->bhqd', p, vv)
+            return jnp.sum(out.astype(jnp.float32))
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    deadline = time.monotonic() + budget_s
+    results = []   # (seconds, decision-dict)
+
+    def try_candidate(label, decision, builder):
+        if time.monotonic() > deadline and results:
+            return
+        try:
+            t = _time_step(builder(), (q, k, v))
+            results.append((t, decision))
+            if verbose:
+                print('  autotune %s %s: %.3f ms' % (sig, label, t * 1e3))
+        except Exception as e:
+            if verbose:
+                print('  autotune %s %s: failed (%r)' % (sig, label, e))
+
+    try_candidate('xla', {'mode': 'xla', 'block_q': 0, 'block_k': 0},
+                  make_xla_step)
+    if jax.default_backend() == 'tpu':
+        for bq, bk in _candidate_blocks(seq, has_kpad):
+            try_candidate(
+                'flash %dx%d' % (bq, bk),
+                {'mode': 'flash', 'block_q': bq, 'block_k': bk},
+                functools.partial(make_flash_step, bq, bk))
+
+    if not results:
+        return None
+    best_t, best = min(results, key=lambda r: r[0])
+    best = dict(best, ms=round(best_t * 1e3, 3))
+    _CACHE[sig] = best
+    _save_disk()
+    return best

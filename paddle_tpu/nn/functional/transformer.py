@@ -56,11 +56,27 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                       (am.ndim == 4 and am.shape[1] == 1 and
                        am.shape[2] == 1 and am.shape[3] == k.shape[1] and
                        am.shape[0] in (1, q.shape[0])))
-    use_flash = (_USE_FLASH[0] and mask_flashable and
-                 seq_len >= _FLASH_MIN_SEQ and seq_len == k.shape[1] and
-                 jax.default_backend() == 'tpu')
+    flash_eligible = (_USE_FLASH[0] and mask_flashable and
+                      seq_len == k.shape[1] and
+                      jax.default_backend() == 'tpu')
+    # on-chip autotuned decision (kernels/autotune.py) overrides the static
+    # threshold when this shape signature has been measured; shapes are
+    # concrete even under tracing, so the lookup is trace-safe
+    tuned = None
+    if flash_eligible:
+        from ...kernels.autotune import lookup as _at_lookup
+        n_heads = q.shape[2] if q.ndim == 4 else 1
+        tuned = _at_lookup(q.shape[0], n_heads, seq_len, q.shape[-1],
+                           is_causal, am is not None, p_eff,
+                           dtype=str(q.dtype))
+    if tuned is not None:
+        use_flash = tuned['mode'] == 'flash'
+    else:
+        use_flash = flash_eligible and seq_len >= _FLASH_MIN_SEQ
     if use_flash:
         from ...kernels.flash_attention import flash_attention_bhld
+        blocks = ({'block_q': tuned['block_q'],
+                   'block_k': tuned['block_k']} if tuned else {})
         seed = None
         if p_eff > 0.0:
             from ...core import rng as _rng
@@ -74,7 +90,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             qq, kk, vv = (jnp.swapaxes(t, 1, 2) for t in (qq, kk, vv))
             out = flash_attention_bhld(qq, kk, vv, causal=is_causal,
                                        kpad_bias=kpad, dropout_p=p_eff,
-                                       dropout_seed=seed)
+                                       dropout_seed=seed, **blocks)
             return jnp.swapaxes(out, 1, 2)
 
         return apply_op(ffn, tuple(tensors))
