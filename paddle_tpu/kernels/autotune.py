@@ -80,10 +80,19 @@ def attention_signature(batch, heads, seq, head_dim, causal, has_kpad,
         head_dim, int(causal), int(has_kpad), int(dropout > 0))
 
 
-def _valid_decision(d):
-    return (isinstance(d, dict) and d.get('mode') in ('flash', 'xla')
+def _valid_decision(d, seq=None):
+    if not (isinstance(d, dict) and d.get('mode') in ('flash', 'xla')
             and isinstance(d.get('block_q'), int)
-            and isinstance(d.get('block_k'), int))
+            and isinstance(d.get('block_k'), int)):
+        return False
+    if d['mode'] == 'flash':
+        bq, bk = d['block_q'], d['block_k']
+        if bq <= 0 or bk <= 0:
+            return False
+        if seq is not None and (seq % bq or seq % bk or bq > seq
+                                or bk > seq):
+            return False
+    return True
 
 
 def lookup(batch, heads, seq, head_dim, causal, has_kpad, dropout,
@@ -97,7 +106,7 @@ def lookup(batch, heads, seq, head_dim, causal, has_kpad, dropout,
     _load_disk()
     d = _CACHE.get(attention_signature(
         batch, heads, seq, head_dim, causal, has_kpad, dropout, dtype))
-    return d if _valid_decision(d) else None
+    return d if _valid_decision(d, seq) else None
 
 
 def clear_cache():
@@ -121,11 +130,10 @@ def _time_step(fn, args, iters=5, warmup=2):
 def _candidate_blocks(seq, has_kpad):
     """Tile candidates; with a key-padding bias block_k is pinned to the
     full row (the kernel streams the whole bias), so only block_q varies."""
-    qs = [b for b in (128, 256, 512, 1024) if seq % b == 0 and b <= seq]
+    bs = [b for b in (128, 256, 512, 1024) if seq % b == 0 and b <= seq]
     if has_kpad:
-        return [(bq, seq) for bq in qs]
-    ks = [b for b in (128, 256, 512, 1024) if seq % b == 0 and b <= seq]
-    return [(bq, bk) for bq in qs for bk in ks]
+        return [(bq, seq) for bq in bs]
+    return [(bq, bk) for bq in bs for bk in bs]
 
 
 def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
@@ -167,6 +175,8 @@ def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
         return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
 
     def make_xla_step():
+        drop_key = jax.random.PRNGKey(0)
+
         def loss(qq, kk, vv):
             s = jnp.einsum('bhqd,bhkd->bhqk', qq, kk).astype(jnp.float32) \
                 * scale
@@ -177,6 +187,12 @@ def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
             if kpad is not None:
                 s = s + kpad[:, None, None, :].astype(jnp.float32)
             p = jax.nn.softmax(s, axis=-1).astype(qq.dtype)
+            if dropout_p > 0:
+                # the real XLA fallback applies attention-prob dropout too;
+                # the candidates must pay the same costs to compare fairly
+                keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p,
+                                            p.shape)
+                p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
             out = jnp.einsum('bhqk,bhkd->bhqd', p, vv)
             return jnp.sum(out.astype(jnp.float32))
         return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
@@ -184,8 +200,8 @@ def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
     deadline = time.monotonic() + budget_s
     results = []   # (seconds, decision-dict)
 
-    def try_candidate(label, decision, builder):
-        if time.monotonic() > deadline and results:
+    def try_candidate(label, decision, builder, force=False):
+        if not force and time.monotonic() > deadline and results:
             return
         try:
             t = _time_step(builder(), (q, k, v))
@@ -198,12 +214,24 @@ def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
 
     try_candidate('xla', {'mode': 'xla', 'block_q': 0, 'block_k': 0},
                   make_xla_step)
+    flash_timed = 0
     if jax.default_backend() == 'tpu':
-        for bq, bk in _candidate_blocks(seq, has_kpad):
+        cands = _candidate_blocks(seq, has_kpad)
+        # the default tiling is always measured even with the budget gone:
+        # a decision comparing xla against NO flash candidate could cache a
+        # choice worse than the static heuristic
+        default = (512, 512) if (512, 512) in cands else \
+            (cands[len(cands) // 2] if cands else None)
+        for bq, bk in sorted(cands, key=lambda c: c != default):
+            before = len(results)
             try_candidate(
                 'flash %dx%d' % (bq, bk),
                 {'mode': 'flash', 'block_q': bq, 'block_k': bk},
-                functools.partial(make_flash_step, bq, bk))
+                functools.partial(make_flash_step, bq, bk),
+                force=((bq, bk) == default))
+            flash_timed += len(results) - before
+        if cands and not flash_timed:
+            return None   # nothing comparable was measured; don't cache
 
     if not results:
         return None

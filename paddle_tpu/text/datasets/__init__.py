@@ -1,7 +1,7 @@
 """Text datasets (synthetic fallbacks; no network egress).
 
-Parity: python/paddle/text/datasets/ (Imdb, Imikolov, Movielens, UCIHousing,
-WMT14/16, Conll05).
+Parity: python/paddle/text/datasets/ + python/paddle/dataset/ (Imdb,
+Imikolov, Movielens, UCIHousing, WMT14/16, Conll05, MQ2007, Sentiment).
 """
 from .synthetic import (Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
-                        Conll05st)
+                        Conll05st, MQ2007, Sentiment)

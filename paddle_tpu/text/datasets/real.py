@@ -421,3 +421,119 @@ def load_movielens(mode='train', test_ratio=0.1, rand_seed=0):
             'n_users': max(u[0] for u in users.values()) + 1,
             'n_movies': max(m[0] for m in movies.values()) + 1}
     return feats, meta
+
+
+# ---------------------------------------------------------------------------
+# MQ2007 (LETOR 4.0 learning-to-rank)
+# ---------------------------------------------------------------------------
+
+def load_mq2007(mode='pointwise', path_name='Querylevelnorm.txt'):
+    """LETOR MQ2007 querylevelnorm lines (reference dataset/mq2007.py):
+    ``rel qid:Q 1:v 2:v ... 46:v #docid = ...``. Returns samples per mode,
+    or None when the file is absent:
+
+    - pointwise: (relevance, feature[46]) per document;
+    - pairwise: (label=1, feat_hi, feat_lo) for every in-query document
+      pair with differing relevance (higher first, the reference's
+      C(n,2) full partial order);
+    - listwise: (relevance_list, feature_matrix) per query.
+    """
+    path = data_path('mq2007', path_name)
+    if not os.path.exists(path):
+        return None
+    queries = {}
+    order = []
+    with open(path) as f:
+        for line in f:
+            body = line.split('#', 1)[0].strip()
+            if not body:
+                continue
+            parts = body.split()
+            rel = int(parts[0])
+            qid = int(parts[1].split(':')[1])
+            feat = np.zeros(46, np.float32)
+            for p in parts[2:]:
+                k, v = p.split(':')
+                feat[int(k) - 1] = float(v)
+            if qid not in queries:
+                queries[qid] = []
+                order.append(qid)
+            queries[qid].append((rel, feat))
+    out = []
+    for qid in order:
+        docs = queries[qid]
+        if mode == 'pointwise':
+            out.extend((np.int64(rel), feat) for rel, feat in docs)
+        elif mode == 'pairwise':
+            for i in range(len(docs)):
+                for j in range(i + 1, len(docs)):
+                    ri, fi = docs[i]
+                    rj, fj = docs[j]
+                    if ri == rj:
+                        continue
+                    hi, lo = (fi, fj) if ri > rj else (fj, fi)
+                    out.append((np.int64(1), hi, lo))
+        elif mode == 'listwise':
+            out.append((np.asarray([r for r, _ in docs], np.int64),
+                        np.stack([f for _, f in docs])))
+        else:
+            raise ValueError("mq2007 mode must be pointwise/pairwise/"
+                             "listwise, got %r" % mode)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sentiment (NLTK movie_reviews layout)
+# ---------------------------------------------------------------------------
+
+_SENTIMENT_CORPUS = {}   # base path -> (per_file, freq); tokenizing 2000
+                         # reviews is the expensive part, do it once
+
+
+def _sentiment_corpus(base, test_ratio):
+    key = (base, test_ratio)
+    if key in _SENTIMENT_CORPUS:
+        return _SENTIMENT_CORPUS[key]
+    freq = {}
+    per_file = []
+    for label, cat in ((0, 'pos'), (1, 'neg')):
+        cat_dir = os.path.join(base, cat)
+        if not os.path.isdir(cat_dir):
+            return None
+        for i, fname in enumerate(sorted(os.listdir(cat_dir))):
+            with open(os.path.join(cat_dir, fname), errors='ignore') as f:
+                toks = _tokenize(f.read())
+            for w in toks:
+                freq[w] = freq.get(w, 0) + 1
+            is_test = (i % int(round(1 / test_ratio)) == 0)
+            per_file.append((toks, label, is_test))
+    _SENTIMENT_CORPUS[key] = (per_file, freq)
+    return per_file, freq
+
+
+def load_sentiment(mode='train', cutoff=0, test_ratio=0.1):
+    """movie_reviews/{pos,neg}/*.txt (reference dataset/sentiment.py via
+    NLTK). Returns (docs, labels, word_idx) or None; label 0 = pos,
+    1 = neg (the reference's ordering). Deterministic round-robin split:
+    every 10th file per class is held out for test. The parsed corpus is
+    cached so train+test loads tokenize the files once."""
+    base = data_path('sentiment', 'movie_reviews')
+    if not os.path.isdir(base):
+        return None
+    corpus = _sentiment_corpus(base, test_ratio)
+    if corpus is None:
+        return None
+    per_file, freq = corpus
+    word_idx = {w: i for i, (w, c) in enumerate(
+        sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+        if c >= cutoff}
+    unk = len(word_idx)
+    docs, labels = [], []
+    want_test = (mode == 'test')
+    for toks, label, is_test in per_file:
+        if is_test != want_test:
+            continue
+        docs.append(np.asarray([word_idx.get(w, unk) for w in toks],
+                               np.int64))
+        labels.append(label)
+    return docs, np.asarray(labels, np.int64), word_idx

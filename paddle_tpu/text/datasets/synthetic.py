@@ -10,7 +10,7 @@ import numpy as np
 from ...io import Dataset
 
 __all__ = ['Imdb', 'Imikolov', 'Movielens', 'UCIHousing', 'WMT14', 'WMT16',
-           'Conll05st']
+           'Conll05st', 'MQ2007', 'Sentiment']
 
 
 class _SyntheticSeqDataset(Dataset):
@@ -198,3 +198,77 @@ class Conll05st(_SyntheticSeqDataset):
 
     def __len__(self):
         return len(self.samples) if not self.synthetic else len(self.docs)
+
+
+class MQ2007(Dataset):
+    """LETOR MQ2007 learning-to-rank. Real loader reads
+    PADDLE_TPU_DATA_HOME/mq2007/Querylevelnorm.txt; synthetic fallback
+    generates query groups with a linear-in-features relevance rule.
+    mode: pointwise | pairwise | listwise (reference mq2007.py gens)."""
+
+    def __init__(self, mode='pointwise', **kwargs):
+        from . import real
+        loaded = real.load_mq2007(mode)
+        if loaded is not None:
+            self.samples = loaded
+            self.synthetic = False
+            return
+        rng = np.random.RandomState(11)
+        w = rng.randn(46).astype(np.float32)
+        samples = []
+        for qid in range(64):
+            n = rng.randint(4, 12)
+            feats = rng.rand(n, 46).astype(np.float32)
+            rel = np.clip((feats @ w / 4 + rng.randn(n) * 0.2) + 1, 0, 2) \
+                .astype(np.int64)
+            if mode == 'pointwise':
+                samples.extend((np.int64(r), f) for r, f in zip(rel, feats))
+            elif mode == 'pairwise':
+                for i in range(n):
+                    for j in range(i + 1, n):
+                        if rel[i] == rel[j]:
+                            continue
+                        hi, lo = ((feats[i], feats[j]) if rel[i] > rel[j]
+                                  else (feats[j], feats[i]))
+                        samples.append((np.int64(1), hi, lo))
+            elif mode == 'listwise':
+                samples.append((rel, feats))
+            else:
+                raise ValueError("bad mq2007 mode %r" % mode)
+        self.samples = samples
+        self.synthetic = True
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Sentiment(Dataset):
+    """NLTK movie_reviews polarity. Real loader reads
+    PADDLE_TPU_DATA_HOME/sentiment/movie_reviews/{pos,neg}/*.txt;
+    label 0 = pos, 1 = neg (reference sentiment.py)."""
+    VOCAB = 4000
+
+    def __init__(self, mode='train', **kwargs):
+        from . import real
+        loaded = real.load_sentiment(mode)
+        if loaded is not None:
+            self.docs, self.labels, self.word_idx = loaded
+            self.synthetic = False
+            return
+        rng = np.random.RandomState(13 if mode == 'train' else 14)
+        n = 1024 if mode == 'train' else 128
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+        # class-dependent token distribution so models can learn
+        self.docs = [rng.randint(lab * 100, self.VOCAB - (1 - lab) * 100,
+                                 size=rng.randint(20, 120)).astype(np.int64)
+                     for lab in self.labels]
+        self.synthetic = True
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)

@@ -340,3 +340,85 @@ class TestReviewRegressions:
         with pytest.raises(ValueError):
             list(c())
         assert list(c()) == [1, 2, 3]   # retry caches the clean stream once
+
+
+class TestMQ2007Loader:
+    def _build(self, home):
+        d = home / 'mq2007'
+        d.mkdir()
+        lines = [
+            "2 qid:10 1:0.5 2:0.1 46:0.9 #docid = GX1",
+            "0 qid:10 1:0.1 2:0.2 46:0.0 #docid = GX2",
+            "1 qid:10 1:0.3 2:0.3 46:0.5 #docid = GX3",
+            "1 qid:20 1:0.7 46:0.2 #docid = GX4",
+            "1 qid:20 1:0.6 46:0.1 #docid = GX5",
+        ]
+        (d / 'Querylevelnorm.txt').write_text('\n'.join(lines) + '\n')
+
+    def test_pointwise(self, data_home):
+        from paddle_tpu.text.datasets.real import load_mq2007
+        self._build(data_home)
+        samples = load_mq2007('pointwise')
+        assert len(samples) == 5
+        rel, feat = samples[0]
+        assert rel == 2 and feat.shape == (46,)
+        assert feat[0] == np.float32(0.5) and feat[45] == np.float32(0.9)
+        assert feat[5] == 0.0            # unspecified features default 0
+
+    def test_pairwise_orders_by_relevance(self, data_home):
+        from paddle_tpu.text.datasets.real import load_mq2007
+        self._build(data_home)
+        pairs = load_mq2007('pairwise')
+        # qid 10: (2,0),(2,1),(0,1) -> 3 pairs; qid 20: equal rel -> none
+        assert len(pairs) == 3
+        for lab, hi, lo in pairs:
+            assert lab == 1 and hi.shape == lo.shape == (46,)
+        # the rel-2 doc is always on the hi side
+        assert pairs[0][1][0] == np.float32(0.5)
+
+    def test_listwise_groups_by_query(self, data_home):
+        from paddle_tpu.text.datasets.real import load_mq2007
+        self._build(data_home)
+        lists = load_mq2007('listwise')
+        assert len(lists) == 2
+        rels, feats = lists[0]
+        assert rels.tolist() == [2, 0, 1] and feats.shape == (3, 46)
+
+    def test_dataset_class_and_fallback(self, data_home):
+        from paddle_tpu.text.datasets import MQ2007
+        ds = MQ2007('pairwise')          # no file -> synthetic
+        assert ds.synthetic and len(ds) > 0 and len(ds[0]) == 3
+        self._build(data_home)
+        ds2 = MQ2007('listwise')
+        assert not ds2.synthetic and len(ds2) == 2
+
+
+class TestSentimentLoader:
+    def _build(self, home):
+        base = home / 'sentiment' / 'movie_reviews'
+        for cat, texts in (('pos', ['a great movie', 'great fun !'] * 5),
+                           ('neg', ['a bad movie', 'terribly bad .'] * 5)):
+            d = base / cat
+            d.mkdir(parents=True)
+            for i, t in enumerate(texts):
+                (d / ('cv%03d.txt' % i)).write_text(t)
+
+    def test_load_and_split(self, data_home):
+        from paddle_tpu.text.datasets.real import load_sentiment
+        self._build(data_home)
+        train = load_sentiment('train')
+        test = load_sentiment('test')
+        docs, labels, word_idx = train
+        tdocs, tlabels, _ = test
+        assert len(docs) + len(tdocs) == 20
+        assert set(labels.tolist()) == {0, 1}
+        # most frequent tokens get the smallest ids
+        assert word_idx['movie'] < word_idx['fun']
+
+    def test_dataset_class(self, data_home):
+        self._build(data_home)
+        from paddle_tpu.text.datasets import Sentiment
+        ds = Sentiment('train')
+        assert not ds.synthetic
+        doc, lab = ds[0]
+        assert doc.dtype == np.int64 and lab in (0, 1)
