@@ -33,6 +33,7 @@ from . import jit
 from . import amp
 from . import incubate
 from . import utils
+from . import dataset
 from . import device
 from . import inference
 from . import interop

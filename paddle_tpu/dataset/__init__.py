@@ -1,0 +1,16 @@
+"""Classic fluid-era ``paddle.dataset`` reader-creator API.
+
+Parity: python/paddle/dataset/__init__.py — the reference's primary data
+surface in 1.8: each submodule exposes zero-arg reader creators
+(``mnist.train()``, ``uci_housing.test()``, ``imdb.word_dict()``...)
+yielding numpy samples, consumed through ``paddle.batch`` + feeders.
+These bridge to the same Dataset classes the DataLoader path uses, so the
+underlying loaders (real local files or synthetic fallbacks) are shared.
+"""
+from . import (mnist, cifar, uci_housing, imdb, imikolov, movielens,
+               conll05, sentiment, wmt14, wmt16, mq2007, flowers, voc2012,
+               common)
+
+__all__ = ['mnist', 'cifar', 'uci_housing', 'imdb', 'imikolov',
+           'movielens', 'conll05', 'sentiment', 'wmt14', 'wmt16',
+           'mq2007', 'flowers', 'voc2012', 'common']

@@ -1,0 +1,40 @@
+"""paddle.dataset.cifar readers. Parity: python/paddle/dataset/cifar.py —
+yields (float32[3072] in [0, 1], int label)."""
+import itertools
+
+import numpy as np
+
+__all__ = ['train10', 'test10', 'train100', 'test100']
+
+
+def _reader(cls_name, mode, cycle=False):
+    def reader():
+        from ..vision import datasets as vd
+        ds = getattr(vd, cls_name)(mode=mode)
+        def once():
+            for i in range(len(ds)):
+                img, lab = ds[i]
+                # items are CHW float32 in [0, 1] -> flat [3072]
+                yield np.asarray(img, np.float32).reshape(-1), int(lab)
+        if cycle:
+            while True:
+                yield from once()
+        else:
+            yield from once()
+    return reader
+
+
+def train10(cycle=False):
+    return _reader('Cifar10', 'train', cycle)
+
+
+def test10(cycle=False):
+    return _reader('Cifar10', 'test', cycle)
+
+
+def train100():
+    return _reader('Cifar100', 'train')
+
+
+def test100():
+    return _reader('Cifar100', 'test')

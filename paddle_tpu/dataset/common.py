@@ -1,0 +1,53 @@
+"""dataset.common analogue: shared data-home helpers (no downloads —
+zero-egress environment; files are expected under PADDLE_TPU_DATA_HOME).
+Parity: python/paddle/dataset/common.py (download/md5 machinery replaced
+by the gated local-file convention of text/datasets/real.py)."""
+import os
+
+from ..text.datasets.real import DATA_HOME, data_path
+
+__all__ = ['DATA_HOME', 'data_path', 'split', 'cluster_files_reader']
+
+
+def split(reader, line_count, suffix_template='%05d.pickle', dumper=None):
+    """Split a reader's samples into pickled chunk files of ``line_count``
+    (reference common.split)."""
+    import pickle
+    dumper = dumper or pickle.dump
+    lines = []
+    idx = 0
+    out = []
+    for sample in reader():
+        lines.append(sample)
+        if len(lines) == line_count:
+            name = suffix_template % idx
+            with open(name, 'wb') as f:
+                dumper(lines, f)
+            out.append(name)
+            lines, idx = [], idx + 1
+    if lines:
+        name = suffix_template % idx
+        with open(name, 'wb') as f:
+            dumper(lines, f)
+        out.append(name)
+    return out
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Round-robin chunk files over trainers (reference
+    common.cluster_files_reader)."""
+    import glob
+    import pickle
+    loader = loader or pickle.load
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        for i, fname in enumerate(flist):
+            if i % trainer_count != trainer_id:
+                continue
+            with open(fname, 'rb') as f:
+                for sample in loader(f):
+                    yield sample
+
+    return reader

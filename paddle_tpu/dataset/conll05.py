@@ -1,0 +1,27 @@
+"""paddle.dataset.conll05 readers. Parity:
+python/paddle/dataset/conll05.py — test() yields the 9-slot SRL samples;
+get_dict() returns (word, verb, label) dicts."""
+
+__all__ = ['test', 'get_dict']
+
+
+def get_dict():
+    from ..text.datasets.real import load_conll05_dicts
+    dicts = load_conll05_dicts()
+    if dicts is not None:
+        return dicts
+    from ..text.datasets import Conll05st
+    ds = Conll05st()
+    word = {str(i): i for i in range(ds.VOCAB)}
+    verb = {str(i): i for i in range(ds.VOCAB)}
+    label = {str(i): i for i in range(ds.NUM_CLASSES)}
+    return word, verb, label
+
+
+def test():
+    def reader():
+        from ..text.datasets import Conll05st
+        ds = Conll05st(mode='test')
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader

@@ -1,0 +1,28 @@
+"""paddle.dataset.flowers readers. Parity:
+python/paddle/dataset/flowers.py — train/test/valid() yielding
+(CHW float32 image, int label)."""
+import numpy as np
+
+__all__ = ['train', 'test', 'valid']
+
+
+def _reader(mode):
+    def reader():
+        from ..vision.datasets import Flowers
+        ds = Flowers(mode=mode)
+        for i in range(len(ds)):
+            img, lab = ds[i]
+            yield np.asarray(img, np.float32), int(np.asarray(lab).item())
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader('train')
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader('test')
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader('valid')

@@ -1,0 +1,43 @@
+"""paddle.dataset.imdb readers. Parity: python/paddle/dataset/imdb.py —
+word_dict() then train/test(word_dict) yielding (word-id list, 0/1)."""
+
+__all__ = ['word_dict', 'train', 'test']
+
+_CACHE = {}
+
+
+def _dataset(mode, cutoff=150):
+    key = (mode, cutoff)
+    if key not in _CACHE:
+        from ..text.datasets import Imdb
+        _CACHE[key] = Imdb(mode=mode, cutoff=cutoff)
+    return _CACHE[key]
+
+
+def word_dict(cutoff=150):
+    """token -> id (frequency-sorted); the synthetic fallback exposes a
+    dense integer vocabulary."""
+    ds = _dataset('train', cutoff)
+    if getattr(ds, 'word_idx', None) is not None:
+        return dict(ds.word_idx)
+    return {str(i): i for i in range(ds.VOCAB)}
+
+
+def _reader(mode, cutoff):
+    def reader():
+        ds = _dataset(mode, cutoff)
+        for i in range(len(ds)):
+            doc, lab = ds[i]
+            yield list(int(t) for t in doc), int(lab)
+    return reader
+
+
+def train(word_idx=None, cutoff=150):
+    """``word_idx`` is accepted for API parity; ids always come from the
+    dataset's own dict at this ``cutoff`` — pass the SAME cutoff used for
+    ``word_dict()`` so the id spaces agree."""
+    return _reader('train', cutoff)
+
+
+def test(word_idx=None, cutoff=150):
+    return _reader('test', cutoff)

@@ -1,0 +1,37 @@
+"""paddle.dataset.imikolov readers. Parity:
+python/paddle/dataset/imikolov.py — build_dict() + train/test(word_idx, n)
+yielding n-gram tuples (or (src, trg) in SEQ mode)."""
+
+__all__ = ['build_dict', 'train', 'test']
+
+
+def build_dict(min_word_freq=50):
+    from ..text.datasets.real import load_imikolov_dict
+    d = load_imikolov_dict(min_word_freq)
+    if d is not None:
+        return d
+    from ..text.datasets import Imikolov
+    return {str(i): i for i in range(Imikolov.VOCAB)}
+
+
+def _reader(mode, n, data_type):
+    def reader():
+        from ..text.datasets import Imikolov
+        ds = Imikolov(mode=mode, data_type=data_type, window_size=n)
+        for i in range(len(ds)):
+            item = ds[i]
+            if data_type.upper() == 'NGRAM':
+                ctx, nxt = item
+                yield tuple(int(t) for t in ctx) + tuple(
+                    int(t) for t in nxt)
+            else:
+                yield item
+    return reader
+
+
+def train(word_idx=None, n=5, data_type='NGRAM'):
+    return _reader('train', n, data_type)
+
+
+def test(word_idx=None, n=5, data_type='NGRAM'):
+    return _reader('test', n, data_type)

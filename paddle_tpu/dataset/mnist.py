@@ -1,0 +1,25 @@
+"""paddle.dataset.mnist readers. Parity: python/paddle/dataset/mnist.py —
+yields (float32[784] pixels scaled to [-1, 1], int label)."""
+import numpy as np
+
+__all__ = ['train', 'test']
+
+
+def _reader(mode):
+    def reader():
+        from ..vision.datasets import MNIST
+        ds = MNIST(mode=mode, backend=None)
+        for i in range(len(ds)):
+            img, lab = ds[i]
+            # dataset items are float32 (1, 28, 28) in [0, 1]
+            vec = np.asarray(img, np.float32).reshape(-1) * 2.0 - 1.0
+            yield vec, int(lab)
+    return reader
+
+
+def train():
+    return _reader('train')
+
+
+def test():
+    return _reader('test')

@@ -1,0 +1,52 @@
+"""paddle.dataset.movielens readers. Parity:
+python/paddle/dataset/movielens.py — train/test() yield per-rating rows;
+with the real ml-1m present each row is the full feature tuple."""
+
+__all__ = ['train', 'test', 'max_user_id', 'max_movie_id', 'max_job_id',
+           'age_table']
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_CACHE = {}
+
+
+def _dataset(mode):
+    if mode not in _CACHE:
+        from ..text.datasets import Movielens
+        _CACHE[mode] = Movielens(mode=mode)
+    return _CACHE[mode]
+
+
+def _reader(mode):
+    def reader():
+        ds = _dataset(mode)
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader
+
+
+def train():
+    return _reader('train')
+
+
+def test():
+    return _reader('test')
+
+
+def _meta(key, fallback):
+    ds = _dataset('train')
+    if not ds.synthetic:
+        return int(ds.meta[key]) - 1
+    return fallback
+
+
+def max_user_id():
+    return _meta('n_users', 6040 - 1) + 0
+
+
+def max_movie_id():
+    return _meta('n_movies', 3952 - 1) + 0
+
+
+def max_job_id():
+    return 20

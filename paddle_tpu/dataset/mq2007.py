@@ -1,0 +1,28 @@
+"""paddle.dataset.mq2007 readers. Parity: python/paddle/dataset/mq2007.py
+— train/test(format=...) yielding pointwise/pairwise/listwise samples."""
+
+__all__ = ['train', 'test']
+
+_FMT = {'pointwise': 'pointwise', 'pairwise': 'pairwise',
+        'listwise': 'listwise'}
+
+
+def _reader(format):
+    mode = _FMT.get(format)
+    if mode is None:
+        raise ValueError("mq2007 format must be one of %s" % list(_FMT))
+
+    def reader():
+        from ..text.datasets import MQ2007
+        ds = MQ2007(mode=mode)
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader
+
+
+def train(format='pairwise'):
+    return _reader(format)
+
+
+def test(format='pairwise'):
+    return _reader(format)

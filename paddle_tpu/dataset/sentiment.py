@@ -1,0 +1,38 @@
+"""paddle.dataset.sentiment readers. Parity:
+python/paddle/dataset/sentiment.py — get_word_dict() + train/test()
+yielding (word-id list, 0=pos/1=neg)."""
+
+__all__ = ['get_word_dict', 'train', 'test']
+
+_CACHE = {}
+
+
+def _dataset(mode):
+    if mode not in _CACHE:
+        from ..text.datasets import Sentiment
+        _CACHE[mode] = Sentiment(mode=mode)
+    return _CACHE[mode]
+
+
+def get_word_dict():
+    ds = _dataset('train')
+    if getattr(ds, 'word_idx', None) is not None:
+        return dict(ds.word_idx)
+    return {str(i): i for i in range(ds.VOCAB)}
+
+
+def _reader(mode):
+    def reader():
+        ds = _dataset(mode)
+        for i in range(len(ds)):
+            doc, lab = ds[i]
+            yield list(int(t) for t in doc), int(lab)
+    return reader
+
+
+def train():
+    return _reader('train')
+
+
+def test():
+    return _reader('test')

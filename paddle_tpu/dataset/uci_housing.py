@@ -1,0 +1,26 @@
+"""paddle.dataset.uci_housing readers. Parity:
+python/paddle/dataset/uci_housing.py — yields (float32[13], float32[1])."""
+import numpy as np
+
+__all__ = ['train', 'test', 'feature_names']
+
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+
+
+def _reader(mode):
+    def reader():
+        from ..text.datasets import UCIHousing
+        ds = UCIHousing(mode=mode)
+        for i in range(len(ds)):
+            x, y = ds[i]
+            yield np.asarray(x, np.float32), np.asarray(y, np.float32)
+    return reader
+
+
+def train():
+    return _reader('train')
+
+
+def test():
+    return _reader('test')

@@ -1,0 +1,28 @@
+"""paddle.dataset.voc2012 readers. Parity:
+python/paddle/dataset/voc2012.py — train/test/val() yielding
+(image, segmentation label)."""
+import numpy as np
+
+__all__ = ['train', 'test', 'val']
+
+
+def _reader(mode):
+    def reader():
+        from ..vision.datasets import VOC2012
+        ds = VOC2012(mode=mode)
+        for i in range(len(ds)):
+            img, lab = ds[i]
+            yield np.asarray(img), np.asarray(lab)
+    return reader
+
+
+def train():
+    return _reader('train')
+
+
+def test():
+    return _reader('test')
+
+
+def val():
+    return _reader('valid')

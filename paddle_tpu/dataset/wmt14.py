@@ -1,0 +1,36 @@
+"""paddle.dataset.wmt14 readers. Parity: python/paddle/dataset/wmt14.py —
+train/test(dict_size) yield (src_ids, trg_ids, trg_ids_next)."""
+
+__all__ = ['train', 'test', 'get_dict']
+
+
+def _reader(mode, dict_size):
+    def reader():
+        from ..text.datasets import WMT14
+        ds = WMT14(mode=mode, dict_size=dict_size)
+        for i in range(len(ds)):
+            src, trg, nxt = ds[i]
+            yield (list(int(t) for t in src), list(int(t) for t in trg),
+                   list(int(t) for t in nxt))
+    return reader
+
+
+def train(dict_size):
+    return _reader('train', dict_size)
+
+
+def test(dict_size):
+    return _reader('test', dict_size)
+
+
+def get_dict(dict_size, reverse=False):
+    from ..text.datasets import WMT14
+    ds = WMT14(mode='train', dict_size=dict_size)
+    if ds.synthetic:
+        src = trg = {str(i): i for i in range(ds.VOCAB)}
+    else:
+        src, trg = ds.src_dict, ds.trg_dict
+    if reverse:
+        return ({v: k for k, v in src.items()},
+                {v: k for k, v in trg.items()})
+    return src, trg

@@ -1,0 +1,43 @@
+"""paddle.dataset.wmt16 readers. Parity: python/paddle/dataset/wmt16.py —
+train/test/validation(src_dict_size, trg_dict_size, src_lang)."""
+
+__all__ = ['train', 'test', 'validation', 'get_dict']
+
+_MODE_MAP = {'train': 'train', 'test': 'test', 'validation': 'val'}
+
+
+def _reader(mode, src_dict_size, trg_dict_size, src_lang):
+    def reader():
+        from ..text.datasets import WMT16
+        ds = WMT16(mode=_MODE_MAP[mode], src_dict_size=src_dict_size,
+                   trg_dict_size=trg_dict_size, src_lang=src_lang)
+        for i in range(len(ds)):
+            src, trg, nxt = ds[i]
+            yield (list(int(t) for t in src), list(int(t) for t in trg),
+                   list(int(t) for t in nxt))
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang='en'):
+    return _reader('train', src_dict_size, trg_dict_size, src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang='en'):
+    return _reader('test', src_dict_size, trg_dict_size, src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang='en'):
+    return _reader('validation', src_dict_size, trg_dict_size, src_lang)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    from ..text.datasets import WMT16
+    ds = WMT16(mode='train', src_dict_size=dict_size,
+               trg_dict_size=dict_size, src_lang=lang)
+    if ds.synthetic:
+        d = {str(i): i for i in range(ds.VOCAB)}
+    else:
+        d = ds.src_dict
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d

@@ -146,7 +146,7 @@ def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
     sig = attention_signature(batch, heads, seq, head_dim, causal,
                               has_kpad, dropout_p, dtype)
     _load_disk()
-    if _valid_decision(_CACHE.get(sig)):
+    if _valid_decision(_CACHE.get(sig), seq):
         return _CACHE[sig]
 
     from .flash_attention import flash_attention_bhld

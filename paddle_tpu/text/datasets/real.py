@@ -459,9 +459,15 @@ def load_mq2007(mode='pointwise', path_name='Querylevelnorm.txt'):
                 queries[qid] = []
                 order.append(qid)
             queries[qid].append((rel, feat))
+    return mq2007_samples((queries[qid] for qid in order), mode)
+
+
+def mq2007_samples(query_groups, mode):
+    """[(rel, feat[46])] per query -> mode-specific samples; the single
+    implementation of the pointwise/pairwise/listwise generators (shared
+    by the real loader and the synthetic fallback)."""
     out = []
-    for qid in order:
-        docs = queries[qid]
+    for docs in query_groups:
         if mode == 'pointwise':
             out.extend((np.int64(rel), feat) for rel, feat in docs)
         elif mode == 'pairwise':

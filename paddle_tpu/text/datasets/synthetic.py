@@ -21,7 +21,11 @@ class _SyntheticSeqDataset(Dataset):
     NUM_CLASSES = 2
 
     def __init__(self, mode='train', **kwargs):
-        seed = hash((type(self).__name__, mode)) % (2 ** 31)
+        import zlib
+        # crc32, not hash(): str hashing is salted per process, and the
+        # synthetic data must be identical across runs
+        seed = zlib.crc32(
+            ('%s:%s' % (type(self).__name__, mode)).encode()) % (2 ** 31)
         rng = np.random.RandomState(seed)
         n = self.N_TRAIN if mode == 'train' else self.N_TEST
         self.docs = rng.randint(1, self.VOCAB, size=(n, self.SEQ)).astype(
@@ -63,6 +67,9 @@ class Imikolov(_SyntheticSeqDataset):
             self.docs = loaded
             self.synthetic = False
             return
+        # synthetic n-grams must honor the requested window, or a model
+        # built for n-grams gets wrong context widths
+        self.SEQ = int(window_size)
         super().__init__(mode, **kwargs)
 
     def __getitem__(self, idx):
@@ -213,29 +220,17 @@ class MQ2007(Dataset):
             self.samples = loaded
             self.synthetic = False
             return
+        from .real import mq2007_samples
         rng = np.random.RandomState(11)
         w = rng.randn(46).astype(np.float32)
-        samples = []
+        groups = []
         for qid in range(64):
             n = rng.randint(4, 12)
             feats = rng.rand(n, 46).astype(np.float32)
             rel = np.clip((feats @ w / 4 + rng.randn(n) * 0.2) + 1, 0, 2) \
                 .astype(np.int64)
-            if mode == 'pointwise':
-                samples.extend((np.int64(r), f) for r, f in zip(rel, feats))
-            elif mode == 'pairwise':
-                for i in range(n):
-                    for j in range(i + 1, n):
-                        if rel[i] == rel[j]:
-                            continue
-                        hi, lo = ((feats[i], feats[j]) if rel[i] > rel[j]
-                                  else (feats[j], feats[i]))
-                        samples.append((np.int64(1), hi, lo))
-            elif mode == 'listwise':
-                samples.append((rel, feats))
-            else:
-                raise ValueError("bad mq2007 mode %r" % mode)
-        self.samples = samples
+            groups.append(list(zip(rel, feats)))
+        self.samples = mq2007_samples(groups, mode)
         self.synthetic = True
 
     def __getitem__(self, idx):

@@ -13,7 +13,9 @@ class VOC2012(Dataset):
                  download=True, backend='cv2'):
         self.transform = transform
         self.synthetic = True
-        rng = np.random.RandomState(4 if mode == 'train' else 5)
+        # distinct seed per mode string (val vs test must differ)
+        rng = np.random.RandomState(
+            {'train': 4, 'test': 5, 'valid': 8}.get(mode, 9))
         n = 256 if mode == 'train' else 64
         self.images = (rng.rand(n, 128, 128, 3) * 255).astype(np.uint8)
         masks = np.zeros((n, 128, 128), dtype=np.uint8)

@@ -1,0 +1,99 @@
+"""Classic paddle.dataset reader-creator compat surface."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import dataset
+from paddle_tpu.batch import batch
+
+
+def _first(reader, n=3):
+    out = []
+    for s in reader():
+        out.append(s)
+        if len(out) >= n:
+            break
+    return out
+
+
+def test_mnist_range_and_shapes():
+    samples = _first(dataset.mnist.train())
+    img, lab = samples[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert 0 <= lab <= 9
+    assert _first(dataset.mnist.test(), 1)
+
+
+def test_cifar_variants():
+    img, lab = _first(dataset.cifar.train10(), 1)[0]
+    assert img.shape == (3072,) and 0.0 <= img.max() <= 1.0
+    img, lab = _first(dataset.cifar.test100(), 1)[0]
+    assert img.shape == (3072,)
+
+
+def test_uci_housing():
+    x, y = _first(dataset.uci_housing.train(), 1)[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert dataset.uci_housing.feature_names[0] == 'CRIM'
+
+
+def test_imdb_and_sentiment():
+    w = dataset.imdb.word_dict()
+    assert len(w) > 100
+    doc, lab = _first(dataset.imdb.train(w), 1)[0]
+    assert isinstance(doc, list) and lab in (0, 1)
+    sw = dataset.sentiment.get_word_dict()
+    doc, lab = _first(dataset.sentiment.train(), 1)[0]
+    assert isinstance(doc, list) and lab in (0, 1)
+
+
+def test_imikolov_ngrams():
+    d = dataset.imikolov.build_dict()
+    grams = _first(dataset.imikolov.train(d, 5), 2)
+    assert all(len(g) == 5 for g in grams)
+
+
+def test_translation_readers():
+    s, t, nxt = _first(dataset.wmt14.train(1000), 1)[0]
+    assert isinstance(s, list) and isinstance(t, list) and len(nxt) == len(t)
+    src, trg = dataset.wmt14.get_dict(1000)
+    assert len(src) > 0
+    s, t, nxt = _first(dataset.wmt16.train(1000, 1000), 1)[0]
+    assert isinstance(s, list)
+    v = _first(dataset.wmt16.validation(1000, 1000), 1)
+    assert v
+
+
+def test_mq2007_and_conll05_and_vision():
+    lab, hi, lo = _first(dataset.mq2007.train('pairwise'), 1)[0]
+    assert hi.shape == (46,)
+    with pytest.raises(ValueError):
+        dataset.mq2007.train('bogus')
+    sample = _first(dataset.conll05.test(), 1)[0]
+    assert isinstance(sample, tuple)
+    img, lab = _first(dataset.flowers.train(), 1)[0]
+    assert img.ndim == 3
+    img, seg = _first(dataset.voc2012.val(), 1)[0]
+    assert img.ndim >= 2
+
+
+def test_batch_composes_with_readers():
+    """The classic fluid loop: paddle.batch over a dataset reader."""
+    batches = _first(batch(dataset.uci_housing.train(), 32), 2)
+    assert len(batches[0]) == 32
+    xs = np.stack([s[0] for s in batches[0]])
+    assert xs.shape == (32, 13)
+
+
+def test_common_split_and_cluster_reader(tmp_path):
+    import os
+    tmpl = str(tmp_path / 'chunk-%05d.pickle')
+    files = dataset.common.split(
+        lambda: iter(range(10)), 4, suffix_template=tmpl)
+    assert len(files) == 3
+    r0 = dataset.common.cluster_files_reader(
+        str(tmp_path / 'chunk-*.pickle'), 2, 0)
+    r1 = dataset.common.cluster_files_reader(
+        str(tmp_path / 'chunk-*.pickle'), 2, 1)
+    assert sorted(list(r0()) + list(r1())) == list(range(10))
