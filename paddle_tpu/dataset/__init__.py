@@ -9,8 +9,8 @@ underlying loaders (real local files or synthetic fallbacks) are shared.
 """
 from . import (mnist, cifar, uci_housing, imdb, imikolov, movielens,
                conll05, sentiment, wmt14, wmt16, mq2007, flowers, voc2012,
-               common)
+               image, common)
 
 __all__ = ['mnist', 'cifar', 'uci_housing', 'imdb', 'imikolov',
            'movielens', 'conll05', 'sentiment', 'wmt14', 'wmt16',
-           'mq2007', 'flowers', 'voc2012', 'common']
+           'mq2007', 'flowers', 'voc2012', 'image', 'common']

@@ -51,3 +51,10 @@ def cluster_files_reader(files_pattern, trainer_count, trainer_id,
                     yield sample
 
     return reader
+
+
+def dense_word_dict(n):
+    """Synthetic-fallback vocabulary: dense int ids with string keys (the
+    shared shape every reader module's word_dict falls back to when no
+    real corpus is on disk)."""
+    return {str(i): i for i in range(n)}

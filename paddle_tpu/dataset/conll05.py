@@ -11,11 +11,10 @@ def get_dict():
     if dicts is not None:
         return dicts
     from ..text.datasets import Conll05st
+    from .common import dense_word_dict
     ds = Conll05st()
-    word = {str(i): i for i in range(ds.VOCAB)}
-    verb = {str(i): i for i in range(ds.VOCAB)}
-    label = {str(i): i for i in range(ds.NUM_CLASSES)}
-    return word, verb, label
+    return (dense_word_dict(ds.VOCAB), dense_word_dict(ds.VOCAB),
+            dense_word_dict(ds.NUM_CLASSES))
 
 
 def test():

@@ -6,23 +6,32 @@ import numpy as np
 __all__ = ['train', 'test', 'valid']
 
 
-def _reader(mode):
+def _reader(mode, mapper=None, cycle=False):
     def reader():
         from ..vision.datasets import Flowers
         ds = Flowers(mode=mode)
-        for i in range(len(ds)):
-            img, lab = ds[i]
-            yield np.asarray(img, np.float32), int(np.asarray(lab).item())
+
+        def once():
+            for i in range(len(ds)):
+                img, lab = ds[i]
+                sample = (np.asarray(img, np.float32),
+                          int(np.asarray(lab).item()))
+                yield mapper(sample) if mapper is not None else sample
+        if cycle:
+            while True:
+                yield from once()
+        else:
+            yield from once()
     return reader
 
 
 def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
-    return _reader('train')
+    return _reader('train', mapper, cycle)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
-    return _reader('test')
+    return _reader('test', mapper, cycle)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=True):
-    return _reader('valid')
+    return _reader('valid', mapper)

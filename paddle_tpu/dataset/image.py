@@ -1,0 +1,100 @@
+"""paddle.dataset.image utilities. Parity: python/paddle/dataset/image.py
+— HWC uint8 numpy image helpers used by the classic vision readers
+(pure numpy; cv2 decode is used when available for file/bytes loading).
+"""
+import numpy as np
+
+__all__ = ['load_image', 'load_image_bytes', 'resize_short', 'to_chw',
+           'center_crop', 'random_crop', 'left_right_flip',
+           'simple_transform', 'load_and_transform']
+
+
+def _cv2():
+    try:
+        import cv2
+        return cv2
+    except Exception:
+        return None
+
+
+def load_image_bytes(bytes_, is_color=True):
+    cv2 = _cv2()
+    if cv2 is None:
+        raise RuntimeError("load_image_bytes requires cv2")
+    flag = 1 if is_color else 0
+    arr = np.frombuffer(bytes_, dtype='uint8')
+    return cv2.imdecode(arr, flag)
+
+
+def load_image(file, is_color=True):
+    cv2 = _cv2()
+    if cv2 is None:
+        raise RuntimeError("load_image requires cv2")
+    return cv2.imread(file, 1 if is_color else 0)
+
+
+def resize_short(im, size):
+    """Scale so the shorter edge becomes ``size`` (bilinear, numpy when
+    cv2 is unavailable)."""
+    h, w = im.shape[:2]
+    if h > w:
+        new_h, new_w = int(round(h * size / w)), size
+    else:
+        new_h, new_w = size, int(round(w * size / h))
+    cv2 = _cv2()
+    if cv2 is not None:
+        return cv2.resize(im, (new_w, new_h))
+    # nearest-neighbor fallback keeps this dependency-free
+    ys = np.clip((np.arange(new_h) * h / new_h).astype(int), 0, h - 1)
+    xs = np.clip((np.arange(new_w) * w / new_w).astype(int), 0, w - 1)
+    return im[ys][:, xs]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = max((h - size) // 2, 0)
+    w0 = max((w - size) // 2, 0)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, max(h - size, 0) + 1)
+    w0 = np.random.randint(0, max(w - size, 0) + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short -> crop (+random flip at train) -> CHW float32
+    (-mean), the reference's standard train/eval pipeline."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype('float32')
+    if mean is not None:
+        mean = np.asarray(mean, 'float32')
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

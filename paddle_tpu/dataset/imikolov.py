@@ -11,7 +11,8 @@ def build_dict(min_word_freq=50):
     if d is not None:
         return d
     from ..text.datasets import Imikolov
-    return {str(i): i for i in range(Imikolov.VOCAB)}
+    from .common import dense_word_dict
+    return dense_word_dict(Imikolov.VOCAB)
 
 
 def _reader(mode, n, data_type):

@@ -18,7 +18,8 @@ def get_word_dict():
     ds = _dataset('train')
     if getattr(ds, 'word_idx', None) is not None:
         return dict(ds.word_idx)
-    return {str(i): i for i in range(ds.VOCAB)}
+    from .common import dense_word_dict
+    return dense_word_dict(ds.VOCAB)
 
 
 def _reader(mode):

@@ -27,7 +27,8 @@ def get_dict(dict_size, reverse=False):
     from ..text.datasets import WMT14
     ds = WMT14(mode='train', dict_size=dict_size)
     if ds.synthetic:
-        src = trg = {str(i): i for i in range(ds.VOCAB)}
+        from .common import dense_word_dict
+        src = trg = dense_word_dict(ds.VOCAB)
     else:
         src, trg = ds.src_dict, ds.trg_dict
     if reverse:

@@ -35,7 +35,8 @@ def get_dict(lang, dict_size, reverse=False):
     ds = WMT16(mode='train', src_dict_size=dict_size,
                trg_dict_size=dict_size, src_lang=lang)
     if ds.synthetic:
-        d = {str(i): i for i in range(ds.VOCAB)}
+        from .common import dense_word_dict
+        d = dense_word_dict(ds.VOCAB)
     else:
         d = ds.src_dict
     if reverse:

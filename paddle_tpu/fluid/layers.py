@@ -146,3 +146,118 @@ from ..nn.decode import (Decoder, BeamSearchDecoder,  # noqa: E402,F401
                          dynamic_decode, DecodeHelper, TrainingHelper,
                          GreedyEmbeddingHelper, SampleEmbeddingHelper,
                          BasicDecoder, beam_search, beam_search_decode)
+
+
+# -- classic 1.8 op functions (round-3 completions) --------------------------
+
+from ..static.graph import data  # noqa: E402,F401  (feed placeholder)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    """fluid-era signature: ``alpha`` keyword, default 0.02 (the 2.x
+    functional uses negative_slope=0.01)."""
+    from ..nn import functional as F
+    return F.leaky_relu(x, negative_slope=alpha)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    """fluid-era defaults: slope 0.2 (the 2.x functional uses 1/6)."""
+    from ..nn import functional as F
+    return F.hardsigmoid(x, slope=slope, offset=offset)
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """Constant tensor of ``shape``/``dtype`` (fluid/layers/tensor.py)."""
+    from ..tensor.creation import full
+    return full(shape, value, dtype=dtype)
+
+
+def uniform_random(shape, dtype='float32', min=-1.0, max=1.0, seed=0,
+                   name=None):
+    from ..tensor.random import uniform
+    return uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def sums(input, out=None, name=None):
+    """Elementwise sum of a list of tensors (fluid/layers/tensor.py)."""
+    acc = input[0]
+    for t in input[1:]:
+        acc = acc + t
+    return acc
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    """Per-element BCE on logits with ignore_index masking
+    (fluid/layers/loss.py)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+
+    def fn(xv, lv):
+        lv = lv.astype(xv.dtype)
+        loss = jnp.maximum(xv, 0) - xv * lv + jnp.log1p(jnp.exp(-jnp.abs(xv)))
+        keep = (lv != ignore_index)
+        loss = jnp.where(keep, loss, 0.0)
+        if normalize:
+            loss = loss / jnp.maximum(keep.sum(), 1)
+        return loss
+
+    return apply_op(fn, (_t(x), _t(label)))
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, name=None):
+    """Static-style layer_norm over trailing dims from begin_norm_axis
+    (fluid/layers/nn.py) — creates scale/shift parameters on the fly."""
+    from .. import nn
+    from ..nn import functional as F
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    ln = nn.LayerNorm(shape, epsilon=epsilon,
+                      weight_attr=param_attr if scale else False,
+                      bias_attr=bias_attr if shift else False)
+    out = ln(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
+         num_layers=1, dropout_prob=0.0, is_bidirec=False, **kwargs):
+    """cuDNN-style fused LSTM surface (fluid/layers/rnn.py lstm) on the
+    padded-dense LSTM: returns (out, last_h, last_c)."""
+    from .. import nn
+    hidden_size = hidden_size or init_h.shape[-1]
+    layer = nn.LSTM(input.shape[-1], hidden_size, num_layers=num_layers,
+                    direction='bidirect' if is_bidirec else 'forward',
+                    dropout=dropout_prob)
+    out, (h, c) = layer(input, (init_h, init_c))
+    return out, h, c
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, use_peepholes=False,
+                 is_reverse=False, **kwargs):
+    """LoD-era dynamic LSTM -> padded-dense LSTM (hidden = size // 4,
+    matching the reference's 4x-gate-packed ``size`` convention).
+    Returns (hidden_seq, cell_seq), both [B, T, hidden] like the
+    reference's two sequence outputs; ``is_reverse`` runs right-to-left.
+    """
+    import jax.numpy as jnp
+    from ..nn.layer.rnn import LSTMCell
+    from ..nn.functional.rnn import rnn_scan
+    from ..tensor.creation import zeros
+    hidden = size // 4
+    cell = LSTMCell(input.shape[-1], hidden)
+    B = input.shape[0]
+    h0 = h_0 if h_0 is not None else zeros([B, hidden], 'float32')
+    c0 = c_0 if c_0 is not None else zeros([B, hidden], 'float32')
+
+    def step(state, x_t, *params):
+        new_state, h = cell.cell_fn(state, x_t, *params)
+        # emit h|c so the caller gets BOTH per-step sequences
+        return new_state, jnp.concatenate(new_state, axis=-1)
+
+    outs, _ = rnn_scan(step, input, (h0, c0), reverse=bool(is_reverse),
+                       extra_params=cell._params())
+    return outs[:, :, :hidden], outs[:, :, hidden:]

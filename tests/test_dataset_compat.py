@@ -97,3 +97,92 @@ def test_common_split_and_cluster_reader(tmp_path):
     r1 = dataset.common.cluster_files_reader(
         str(tmp_path / 'chunk-*.pickle'), 2, 1)
     assert sorted(list(r0()) + list(r1())) == list(range(10))
+
+
+def test_image_utils():
+    from paddle_tpu.dataset import image
+    im = (np.arange(40 * 60 * 3) % 255).reshape(40, 60, 3).astype('uint8')
+    r = image.resize_short(im, 30)
+    assert min(r.shape[:2]) == 30 and r.shape[0] == 30
+    c = image.center_crop(r, 24)
+    assert c.shape[:2] == (24, 24)
+    f = image.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, 0], c[:, -1])
+    chw = image.to_chw(c)
+    assert chw.shape == (3, 24, 24)
+    out = image.simple_transform(im, 32, 24, is_train=False,
+                                 mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 24, 24) and out.dtype == np.float32
+    tr = image.simple_transform(im, 32, 24, is_train=True)
+    assert tr.shape == (3, 24, 24)
+
+
+def test_classic_fluid_layers_roundtrip():
+    """The newly completed fluid.layers ops behave sanely end to end."""
+    import paddle_tpu as paddle
+    from paddle_tpu.fluid import layers as L
+    c = L.fill_constant([2, 3], 'float32', 1.5)
+    np.testing.assert_allclose(c.numpy(), np.full((2, 3), 1.5, 'float32'))
+    u = L.uniform_random([4, 4], min=0.0, max=1.0)
+    assert 0.0 <= float(u.numpy().min()) and float(u.numpy().max()) <= 1.0
+    s = L.sums([c, c, c])
+    np.testing.assert_allclose(s.numpy(), np.full((2, 3), 4.5, 'float32'))
+    x = paddle.to_tensor(np.array([[0.5, -1.0]], 'float32'))
+    lab = paddle.to_tensor(np.array([[1.0, 0.0]], 'float32'))
+    bce = L.sigmoid_cross_entropy_with_logits(x, lab)
+    ref = np.maximum(x.numpy(), 0) - x.numpy() * lab.numpy() + \
+        np.log1p(np.exp(-np.abs(x.numpy())))
+    np.testing.assert_allclose(bce.numpy(), ref, rtol=1e-6)
+    h = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((2, 5, 8)).astype('float32'))
+    ln = L.layer_norm(h, begin_norm_axis=2)
+    np.testing.assert_allclose(ln.numpy().mean(-1), 0.0, atol=1e-5)
+    out, hh, cc = L.lstm(h, paddle.to_tensor(np.zeros((1, 2, 6), 'float32')),
+                         paddle.to_tensor(np.zeros((1, 2, 6), 'float32')),
+                         hidden_size=6)
+    assert tuple(out.shape) == (2, 5, 6)
+    seq, cell_seq = L.dynamic_lstm(h, size=24)
+    assert tuple(seq.shape) == (2, 5, 6)
+    assert tuple(cell_seq.shape) == (2, 5, 6)   # full per-step cell states
+
+
+def test_fluid_era_activation_defaults():
+    import paddle_tpu as paddle
+    from paddle_tpu.fluid import layers as L
+    x = paddle.to_tensor(np.array([-1.0, 2.0], 'float32'))
+    np.testing.assert_allclose(L.leaky_relu(x).numpy(), [-0.02, 2.0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(L.leaky_relu(x, alpha=0.1).numpy(),
+                               [-0.1, 2.0], rtol=1e-6)
+    # fluid hard_sigmoid: clip(slope*x + offset, 0, 1) with slope 0.2
+    np.testing.assert_allclose(L.hard_sigmoid(x).numpy(), [0.3, 0.9],
+                               rtol=1e-5)
+
+
+def test_dynamic_lstm_reverse_and_cell_seq():
+    import paddle_tpu as paddle
+    from paddle_tpu.fluid import layers as L
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 6, 4)).astype('float32')
+    paddle.seed(3)
+    h_f, c_f = L.dynamic_lstm(paddle.to_tensor(x), size=12)
+    assert tuple(h_f.shape) == (2, 6, 3) and tuple(c_f.shape) == (2, 6, 3)
+    # reverse really processes right-to-left: running it on the flipped
+    # input with the same weights must equal the flipped forward output
+    paddle.seed(3)
+    h_r, c_r = L.dynamic_lstm(paddle.to_tensor(x[:, ::-1].copy()), size=12,
+                              is_reverse=True)
+    np.testing.assert_allclose(h_r.numpy()[:, ::-1], h_f.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_flowers_cycle_and_mapper():
+    from paddle_tpu import dataset
+    it = dataset.flowers.train(mapper=lambda s: (s[0] * 0 + 1, s[1]),
+                               cycle=True)()
+    first = next(it)
+    assert float(np.asarray(first[0]).max()) == 1.0   # mapper applied
+    # cycle: pull more samples than one epoch holds
+    n_epoch = 1024
+    for _ in range(n_epoch + 2):
+        next(it)   # does not StopIteration
