@@ -27,6 +27,13 @@ from ..framework import (in_dygraph_mode, enable_static, disable_static,
 from ..core import rng as _rng
 from . import layers
 from . import dygraph
+from . import nets
+from . import metrics
+from . import io
+from . import backward as backward
+from .backward import append_backward
+from .data_feeder import DataFeeder
+from . import data_feeder
 from ..optimizer import optimizer as _opt_mod
 from ..utils import unique_name
 from ..utils import profiler

@@ -1,0 +1,67 @@
+"""fluid.backward: static-graph autodiff surface.
+
+Parity: python/paddle/fluid/backward.py — the reference's append_backward
+walks the ProgramDesc emitting grad ops from a per-op registry. Here one
+gradient Operator is appended whose fn is ``jax.grad`` over the captured
+forward subprogram (re-interpreted inside the same jit — XLA CSE merges
+the recomputed forward with the original, so no double compute survives
+compilation). Grad Variables are named ``<param>@GRAD`` like the
+reference, and ``(param, grad)`` pairs are returned for hand-written
+update rules.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply_op
+
+__all__ = ['append_backward']
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Append gradient computation for ``loss``; returns [(param_var,
+    grad_var)] with grads fetchable through Executor.run."""
+    from ..static.graph import current_capture_program, \
+        default_main_program
+    from ..static.executor import _program_params, _interpret_ops
+    prog = current_capture_program() or default_main_program()
+    block = prog.global_block
+    ops = list(block.ops)          # snapshot: grads of the graph so far
+    params = _program_params(prog)
+    if parameter_list:
+        keep = {p if isinstance(p, str) else p.name for p in parameter_list}
+        params = [p for p in params if p.name in keep]
+    if no_grad_set:
+        drop = {v if isinstance(v, str) else v.name for v in no_grad_set}
+        params = [p for p in params if p.name not in drop]
+    if not params:
+        return []
+    feed_vars = [v for v in block.vars.values()
+                 if getattr(v, 'is_data', False)]
+    n_feed = len(feed_vars)
+
+    def grad_fn(*vals):
+        feeds, pvals = vals[:n_feed], list(vals[n_feed:])
+
+        def loss_of(pv):
+            env = {}
+            for v, val in zip(feed_vars, feeds):
+                env[id(v)] = val
+            for p, val in zip(params, pv):
+                env[id(p)] = val
+            env = _interpret_ops(ops, env)
+            return jnp.sum(env[id(loss)])
+
+        grads = jax.grad(loss_of)(pvals)
+        # apply_op treats a tuple return as ONE payload when n_outputs=1:
+        # a single-parameter program must return the bare array
+        return grads[0] if len(grads) == 1 else tuple(grads)
+
+    outs = apply_op(grad_fn, tuple(feed_vars) + tuple(params),
+                    n_outputs=len(params))
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    for p, g in zip(params, outs):
+        g.name = p.name + '@GRAD'
+        block.vars[g.name] = g
+    return list(zip(params, outs))

@@ -1,0 +1,33 @@
+"""fluid.data_feeder: DataFeeder. Parity: python/paddle/fluid/data_feeder.py
+— converts reader minibatches (lists of per-sample tuples) into the feed
+dict Executor.run consumes, casting to each feed Variable's dtype."""
+import numpy as np
+
+__all__ = ['DataFeeder']
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = list(feed_list)
+        self.place = place
+
+    def feed(self, iterable):
+        """[(slot0, slot1, ...)] per sample -> {var_name: stacked array}."""
+        slots = list(zip(*iterable))
+        if len(slots) != len(self.feed_vars):
+            raise ValueError(
+                "DataFeeder: samples have %d slot(s) but feed_list has %d"
+                % (len(slots), len(self.feed_vars)))
+        out = {}
+        for var, vals in zip(self.feed_vars, slots):
+            name = var if isinstance(var, str) else var.name
+            dtype = None if isinstance(var, str) else np.dtype(var.dtype)
+            arr = np.stack([np.asarray(v) for v in vals])
+            if dtype is not None and arr.dtype != dtype:
+                arr = arr.astype(dtype)
+            # feed vars declared [..., 1] accept scalar-slot samples
+            want_ndim = None if isinstance(var, str) else len(var.shape)
+            if want_ndim is not None and arr.ndim == want_ndim - 1:
+                arr = arr.reshape(arr.shape + (1,))
+            out[name] = arr
+        return out

@@ -1,0 +1,20 @@
+"""fluid.io namespace. Parity: python/paddle/fluid/io.py — model/param
+save-load plus the DataLoader and reader decorators (`from paddle.reader
+import *` in the reference)."""
+from ..static.io import (save_inference_model, load_inference_model,
+                         save_persistables, load_persistables, save_params,
+                         load_params)
+from ..io import DataLoader, Dataset, BatchSampler
+from ..framework import save, load
+from ..reader import (map_readers, shuffle, chain, buffered, compose,
+                      firstn, xmap_readers, cache, multiprocess_reader,
+                      ComposeNotAligned)
+from ..batch import batch
+
+__all__ = ['save_inference_model', 'load_inference_model',
+           'save_persistables', 'load_persistables', 'save_params',
+           'load_params', 'DataLoader', 'Dataset', 'BatchSampler',
+           'save', 'load', 'batch',
+           'map_readers', 'shuffle', 'chain', 'buffered', 'compose',
+           'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
+           'ComposeNotAligned']

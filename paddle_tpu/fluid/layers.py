@@ -11,7 +11,7 @@ from ..tensor.math import (elementwise_add, elementwise_sub, elementwise_mul,
 from ..tensor.creation import assign, zeros, ones, full, create_tensor
 from ..tensor.attribute import shape, rank
 from ..nn.functional import (relu, sigmoid, softmax, log_softmax, tanh,
-                             cross_entropy, softmax_with_cross_entropy,
+                             softmax_with_cross_entropy,
                              square_error_cost, one_hot, embedding, dropout,
                              pad, unfold, log_loss, sequence_mask,
                              sequence_pool, sequence_softmax, sequence_expand,
@@ -261,3 +261,17 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, use_peepholes=False,
     outs, _ = rnn_scan(step, input, (h0, c0), reverse=bool(is_reverse),
                        extra_params=cell._params())
     return outs[:, :, :hidden], outs[:, :, hidden:]
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100,
+                  name=None):
+    """1.8 semantics: ``input`` is a PROBABILITY distribution (the classic
+    recipe is ``cross_entropy(softmax(logits), label)``) — unlike the 2.x
+    functional, which takes logits. Delegates to the functional CE with
+    use_softmax=False; output keeps the 1.8 per-sample (N, 1) shape.
+    """
+    from ..nn import functional as F
+    out = F.cross_entropy(input, label, soft_label=soft_label,
+                          ignore_index=ignore_index, reduction='none',
+                          use_softmax=False)
+    return out.unsqueeze(-1)

@@ -1,0 +1,10 @@
+"""fluid.metrics namespace. Parity: python/paddle/fluid/metrics.py —
+the same accumulator classes live in paddle_tpu.metric; re-exported here
+under the classic names."""
+from ..metric import (Metric as MetricBase, Accuracy, Precision, Recall,
+                      Auc, EditDistance, ChunkEvaluator, DetectionMAP,
+                      CompositeMetric)
+
+__all__ = ['MetricBase', 'Accuracy', 'Precision', 'Recall', 'Auc',
+           'EditDistance', 'ChunkEvaluator', 'DetectionMAP',
+           'CompositeMetric']

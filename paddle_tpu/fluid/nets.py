@@ -1,0 +1,98 @@
+"""fluid.nets: the classic composed-op helpers.
+
+Parity: python/paddle/fluid/nets.py (simple_img_conv_pool,
+img_conv_group, sequence_conv_pool analogue, glu,
+scaled_dot_product_attention).
+"""
+from . import layers
+
+__all__ = ['simple_img_conv_pool', 'img_conv_group', 'glu',
+           'scaled_dot_product_attention', 'sequence_conv_pool']
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type='max',
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    conv_out = layers.conv2d(input, num_filters, filter_size,
+                             stride=conv_stride, padding=conv_padding,
+                             dilation=conv_dilation, groups=conv_groups,
+                             param_attr=param_attr, bias_attr=bias_attr,
+                             act=act)
+    return layers.pool2d(conv_out, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride,
+                         pool_padding=pool_padding,
+                         global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   param_attr=None, conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=0.0, pool_stride=1,
+                   pool_type='max', use_cudnn=True):
+    """Stacked conv(+BN+dropout) block followed by one pool — the VGG
+    building block. Per-conv list values are accepted for conv_padding,
+    conv_filter_size, param_attr, conv_with_batchnorm and
+    conv_batchnorm_drop_rate (the reference's __extend_list__)."""
+    n = len(conv_num_filter)
+
+    def extend(v):
+        if isinstance(v, (list, tuple)):
+            if len(v) != n:
+                raise ValueError(
+                    "img_conv_group: per-conv list must have length %d, "
+                    "got %d" % (n, len(v)))
+            return list(v)
+        return [v] * n
+
+    paddings = extend(conv_padding)
+    fsizes = extend(conv_filter_size)
+    attrs = extend(param_attr)
+    with_bn = extend(conv_with_batchnorm)
+    drop_rates = extend(conv_batchnorm_drop_rate)
+
+    tmp = input
+    for i in range(n):
+        tmp = layers.conv2d(tmp, conv_num_filter[i], fsizes[i],
+                            padding=paddings[i], param_attr=attrs[i],
+                            act=None if with_bn[i] else conv_act)
+        if with_bn[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            if abs(drop_rates[i]) > 1e-5:
+                tmp = layers.dropout(tmp, p=drop_rates[i])
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in two along dim, a * sigmoid(b)."""
+    a, b = layers.split(input, 2, axis=dim)
+    return a * layers.sigmoid(b)
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head attention over (B, L, D) q/k/v (fluid/nets.py)."""
+    from ..nn import functional as F
+    B, Lq, D = queries.shape
+    head = D // num_heads
+    q = queries.reshape([B, Lq, num_heads, head])
+    k = keys.reshape([B, keys.shape[1], num_heads, head])
+    v = values.reshape([B, values.shape[1], num_heads, head])
+    out = F.scaled_dot_product_attention(q, k, v, dropout_p=dropout_rate)
+    return out.reshape([B, Lq, D])
+
+
+def sequence_conv_pool(input, num_filters, filter_size, length=None,
+                       act='sigmoid', pool_type='max'):
+    """LoD-era text-conv block on padded-dense input (B, T, D): 1-D conv
+    over time then length-masked sequence_pool."""
+    from .. import nn
+    from ..nn import functional as F
+    conv = nn.Conv1D(input.shape[-1], num_filters, filter_size,
+                     padding=(filter_size - 1) // 2, data_format='NLC')
+    h = conv(input)
+    h = getattr(F, act)(h)
+    return F.sequence_pool(h, pool_type, length=length)
