@@ -275,3 +275,341 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100,
                           ignore_index=ignore_index, reduction='none',
                           use_softmax=False)
     return out.unsqueeze(-1)
+
+
+# -- remaining 1.8 op functions (sequence/vision/loss/array extras) ----------
+
+from ..nn.functional import (temporal_shift, pixel_shuffle,  # noqa: E402,F401
+                             gather_tree, sampled_softmax_with_cross_entropy)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """CTC loss surface (fluid/layers/loss.py warpctc): padded dense mode —
+    logits TIME-MAJOR (T, B, C) like the reference's padded input, labels
+    (B, S)."""
+    if input_length is None or label_length is None:
+        raise ValueError(
+            "warpctc (padded dense mode) requires input_length and "
+            "label_length — the LoD calling convention has no analogue "
+            "in static-shape TPU tensors")
+    from ..nn import functional as F
+    return F.ctc_loss(input, label, input_length, label_length, blank=blank,
+                      reduction='none').unsqueeze(-1)
+
+
+def kldiv_loss(x, target, reduction='mean', name=None):
+    from ..nn import functional as F
+    return F.kl_div(x, target, reduction=reduction)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """Per-sample smooth-L1 over trailing dims (fluid/layers/loss.py)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+    delta = 1.0 / (sigma * sigma) if sigma else 1.0
+    tensors = [_t(x), _t(y)]
+    has_in = inside_weight is not None
+    has_out = outside_weight is not None
+    if has_in:
+        tensors.append(_t(inside_weight))
+    if has_out:
+        tensors.append(_t(outside_weight))
+
+    def fn(xv, yv, *ws):
+        d = xv - yv
+        if has_in:
+            d = d * ws[0]
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        if has_out:
+            loss = loss * ws[-1]
+        return loss.reshape(loss.shape[0], -1).sum(-1, keepdims=True)
+
+    return apply_op(fn, tuple(tensors))
+
+
+def huber_loss(input, label, delta):
+    from ..nn import functional as F
+    return F.huber_loss(input, label, delta, reduction='none')
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """max(0, -label*(left-right) + margin) (fluid/layers/loss.py)."""
+    from ..nn import functional as F
+    return F.margin_ranking_loss(left, right, label, margin=margin,
+                                 reduction='none')
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss on raw scores (fluid/layers/loss.py)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+
+    def fn(lv, a, b):
+        d = a - b
+        return jnp.log1p(jnp.exp(d)) - lv * d
+
+    return apply_op(fn, (_t(label), _t(left), _t(right)))
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking over softmax inputs
+    (fluid/layers/loss.py): mean over negatives of -log(sigmoid(p_pos -
+    p_neg))."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+
+    def fn(pv, lv):
+        idx = lv.astype(jnp.int32).reshape(-1)
+        pos = jnp.take_along_axis(pv, idx[:, None], axis=1)
+        diff = pos - pv
+        loss = -jnp.log(jnp.clip(jax.nn.sigmoid(diff), 1e-10, 1.0))
+        C = pv.shape[1]
+        mask = jnp.ones_like(pv).at[jnp.arange(pv.shape[0]), idx].set(0.0)
+        return ((loss * mask).sum(-1) / (C - 1))[:, None]
+
+    import jax
+    return apply_op(fn, (_t(input), _t(label)))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    from ..nn import functional as F
+    return F.npair_loss(anchor, positive, labels, l2_reg)
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode on (B, T, C) probs/logits: argmax per step,
+    merge repeats, drop blanks. Returns (decoded (B, T) padded ids,
+    lengths (B, 1)) — dense analogue of the reference's LoD output."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+    tensors = [_t(input)]
+    has_len = input_length is not None
+    if has_len:
+        tensors.append(_t(input_length))
+
+    def fn(pv, *rest):
+        B, T, C = pv.shape
+        ids = jnp.argmax(pv, axis=-1)                    # (B, T)
+        valid = jnp.ones((B, T), bool)
+        if has_len:
+            lens = rest[0].astype(jnp.int32).reshape(-1)
+            valid = jnp.arange(T)[None, :] < lens[:, None]
+        prev = jnp.concatenate([jnp.full((B, 1), -1, ids.dtype),
+                                ids[:, :-1]], axis=1)
+        keep = (ids != blank) & (ids != prev) & valid
+        # stable-compact kept ids to the left
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        compacted = jnp.take_along_axis(ids, order, axis=1)
+        kept_sorted = jnp.take_along_axis(keep, order, axis=1)
+        out = jnp.where(kept_sorted, compacted, padding_value)
+        return out, keep.sum(axis=1).astype(jnp.int32)[:, None]
+
+    return apply_op(fn, tuple(tensors), n_outputs=2, differentiable=False)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    """(B, C, H, W) -> (B, L, C*kh*kw) patch rows (fluid/layers/nn.py
+    im2sequence, dense analogue of its LoD output)."""
+    from ..nn import functional as F
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cols = F.unfold(input, list(ks), strides=stride, paddings=padding)
+    return cols.transpose([0, 2, 1])
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead (row) convolution over (B, T, D): each step mixes the next
+    ``future_context_size`` frames per-feature (fluid/layers/nn.py
+    row_conv, the DeepSpeech2 op)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..core.tensor import Parameter
+    from ..nn.initializer import XavierUniform
+    from ..tensor._helpers import _t
+    x = _t(input)
+    D = x.shape[-1]
+    k = future_context_size + 1
+    w = Parameter(jnp.asarray(XavierUniform()([k, D], dtype='float32')),
+                  name='row_conv_w')
+
+    def fn(v, wv):
+        pad = jnp.pad(v, ((0, 0), (0, k - 1), (0, 0)))
+        # explicit accumulation: the module-level `from ..tensor import *`
+        # shadows builtins.sum with the tensor reduction
+        out = pad[:, 0:v.shape[1], :] * wv[0]
+        for i in range(1, k):
+            out = out + pad[:, i:i + v.shape[1], :] * wv[i]
+        return out
+
+    out = apply_op(fn, (x, w))
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def shuffle_channel(x, group, name=None):
+    """Channel shuffle (B, C, H, W) by ``group`` (ShuffleNet)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+
+    def fn(v):
+        B, C, H, W = v.shape
+        return v.reshape(B, group, C // group, H, W) \
+            .swapaxes(1, 2).reshape(B, C, H, W)
+
+    return apply_op(fn, (_t(x),))
+
+
+def space_to_depth(x, blocksize, name=None):
+    """(B, C, H, W) -> (B, C*bs*bs, H/bs, W/bs)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+
+    def fn(v):
+        B, C, H, W = v.shape
+        bs = blocksize
+        v = v.reshape(B, C, H // bs, bs, W // bs, bs)
+        return v.transpose(0, 3, 5, 1, 2, 4).reshape(
+            B, C * bs * bs, H // bs, W // bs)
+
+    return apply_op(fn, (_t(x),))
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure gram matrix between two (B, C, H, W)
+    feature maps (distillation; fluid/layers/nn.py fsp_matrix)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+
+    def fn(a, b):
+        B, C1, H, W = a.shape
+        C2 = b.shape[1]
+        af = a.reshape(B, C1, H * W)
+        bf = b.reshape(B, C2, H * W)
+        return jnp.einsum('bch,bdh->bcd', af, bf) / (H * W)
+
+    return apply_op(fn, (_t(x), _t(y)))
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad ``y`` up to the shape of ``x`` with pad_value."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+
+    def fn(xv, yv):
+        pads = [(0, xs - ys) for xs, ys in zip(xv.shape, yv.shape)]
+        return jnp.pad(yv, pads, constant_values=pad_value)
+
+    return apply_op(fn, (_t(x), _t(y)))
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """alpha*x + beta*sinusoid_pos_enc (fluid/layers/nn.py)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+
+    def fn(v):
+        B, T, D = v.shape
+        pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+        i = jnp.arange(D // 2, dtype=jnp.float32)[None, :]
+        angle = pos / jnp.power(10000.0, 2 * i / D)
+        enc = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+        return alpha * v + beta * enc[None, :, :].astype(v.dtype)
+
+    return apply_op(fn, (_t(input),))
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out_k = x^T W_k y + b (fluid/layers/nn.py)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Parameter
+    from ..nn.initializer import XavierUniform
+    from ..core.tensor import apply_op
+    from ..tensor._helpers import _t
+    xt, yt = _t(x), _t(y)
+    dx, dy = xt.shape[-1], yt.shape[-1]
+    w = Parameter(jnp.asarray(XavierUniform()([size, dx, dy],
+                                              dtype='float32')),
+                  name='bilinear_w')
+    b = Parameter(jnp.zeros((size,), jnp.float32), name='bilinear_b')
+
+    def fn(xv, yv, wv, bv):
+        return jnp.einsum('bi,kij,bj->bk', xv, wv, yv) + bv
+
+    out = apply_op(fn, (xt, yt, w, b))
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step (fluid/layers/nn.py lstm_unit): returns (h, c).
+    ``forget_bias`` is added to the forget-gate pre-activation like the
+    reference (gate packing here is i, f, g, o)."""
+    import jax.numpy as jnp
+    from ..nn.layer.rnn import LSTMCell
+    hidden = hidden_t_prev.shape[-1]
+    cell = LSTMCell(x_t.shape[-1], hidden)
+    if forget_bias:
+        b = cell.bias_ih._value
+        cell.bias_ih._inplace_value(
+            b.at[hidden:2 * hidden].add(jnp.asarray(forget_bias, b.dtype)))
+    out, (h, c) = cell(x_t, (hidden_t_prev, cell_t_prev))
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation='tanh', gate_activation='sigmoid',
+             origin_mode=False):
+    """One GRU step (fluid/layers/nn.py gru_unit): returns (h, reset_h, h)
+    — gate internals collapse to the new hidden in this dense rebuild."""
+    from ..nn.layer.rnn import GRUCell
+    cell = GRUCell(input.shape[-1], size // 3)
+    out, h = cell(input, hidden)
+    return h, h, h
+
+
+def create_array(dtype='float32'):
+    """LoDTensorArray analogue: a plain python list (works in eager mode
+    and inside the op-capture because writes happen at trace time)."""
+    return []
+
+
+def array_write(x, i, array=None):
+    from ..core.tensor import Tensor
+    idx = int(i.item()) if isinstance(i, Tensor) else int(i)
+    if array is None:
+        array = []
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    from ..core.tensor import Tensor
+    idx = int(i.item()) if isinstance(i, Tensor) else int(i)
+    return array[idx]
+
+
+def array_length(array):
+    from ..tensor.creation import to_tensor
+    import numpy as _np
+    return to_tensor(_np.array([len(array)], dtype='int64'))

@@ -1,0 +1,166 @@
+"""The remaining classic fluid.layers ops added in round 3."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import layers as L
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestLosses:
+    def test_smooth_l1(self):
+        x = _t(np.array([[0.1, 2.0]], 'float32'))
+        y = _t(np.array([[0.0, 0.0]], 'float32'))
+        out = L.smooth_l1(x, y)
+        expected = 0.5 * 0.1 ** 2 + (2.0 - 0.5)
+        np.testing.assert_allclose(out.numpy(), [[expected]], rtol=1e-5)
+
+    def test_huber_loss(self):
+        x = _t(np.array([[0.0]], 'float32'))
+        y = _t(np.array([[3.0]], 'float32'))
+        out = L.huber_loss(x, y, delta=1.0)
+        np.testing.assert_allclose(out.numpy(), [[3.0 - 0.5]], rtol=1e-6)
+
+    def test_margin_and_rank_loss(self):
+        lab = _t(np.array([[1.0]], 'float32'))
+        left = _t(np.array([[0.2]], 'float32'))
+        right = _t(np.array([[0.6]], 'float32'))
+        m = L.margin_rank_loss(lab, left, right, margin=0.1)
+        np.testing.assert_allclose(m.numpy(), [[0.5]], rtol=1e-5)
+        r = L.rank_loss(lab, left, right)
+        d = 0.2 - 0.6
+        np.testing.assert_allclose(r.numpy(),
+                                   [[np.log1p(np.exp(d)) - d]], rtol=1e-5)
+
+    def test_bpr_loss_prefers_confident_positive(self):
+        probs = _t(np.array([[0.7, 0.2, 0.1]], 'float32'))
+        lab = _t(np.array([[0]], 'int64'))
+        good = float(L.bpr_loss(probs, lab).numpy())
+        bad = float(L.bpr_loss(
+            _t(np.array([[0.1, 0.2, 0.7]], 'float32')), lab).numpy())
+        assert good < bad
+
+    def test_kldiv_and_warpctc_surfaces(self):
+        x = _t(np.log(np.array([[0.5, 0.5]], 'float32')))
+        t = _t(np.array([[0.5, 0.5]], 'float32'))
+        assert abs(float(L.kldiv_loss(x, t).numpy())) < 1e-6
+        logits = _t(np.random.default_rng(0)        # TIME-MAJOR (T, B, C)
+                    .standard_normal((8, 2, 5)).astype('float32'))
+        labels = _t(np.array([[1, 2], [3, 4]], 'int64'))
+        out = L.warpctc(logits, labels,
+                        input_length=_t(np.array([8, 8], 'int64')),
+                        label_length=_t(np.array([2, 2], 'int64')))
+        assert np.isfinite(out.numpy()).all()
+
+
+class TestCTCGreedyDecoder:
+    def test_merge_repeats_and_drop_blank(self):
+        # argmax path: [1, 1, blank, 2, 2, blank] -> [1, 2]
+        T, C, blank = 6, 4, 0
+        path = [1, 1, 0, 2, 2, 0]
+        probs = np.full((1, T, C), -5.0, 'float32')
+        for t, c in enumerate(path):
+            probs[0, t, c] = 5.0
+        ids, lens = L.ctc_greedy_decoder(_t(probs), blank)
+        assert lens.numpy()[0, 0] == 2
+        np.testing.assert_array_equal(ids.numpy()[0, :2], [1, 2])
+
+    def test_input_length_truncates(self):
+        probs = np.full((1, 4, 3), -5.0, 'float32')
+        for t, c in enumerate([1, 2, 1, 2]):
+            probs[0, t, c] = 5.0
+        ids, lens = L.ctc_greedy_decoder(
+            _t(probs), blank=0, input_length=_t(np.array([2], 'int64')))
+        assert lens.numpy()[0, 0] == 2
+        np.testing.assert_array_equal(ids.numpy()[0, :2], [1, 2])
+
+
+class TestShapeOps:
+    def test_im2sequence(self):
+        x = _t(np.arange(16, dtype='float32').reshape(1, 1, 4, 4))
+        out = L.im2sequence(x, filter_size=2, stride=2)
+        assert tuple(out.shape) == (1, 4, 4)
+        np.testing.assert_array_equal(out.numpy()[0, 0], [0, 1, 4, 5])
+
+    def test_shuffle_channel_roundtrip(self):
+        x = np.arange(2 * 6 * 2 * 2, dtype='float32').reshape(2, 6, 2, 2)
+        once = L.shuffle_channel(_t(x), group=2).numpy()
+        assert once.shape == x.shape and not np.array_equal(once, x)
+        back = L.shuffle_channel(_t(once), group=3).numpy()
+        np.testing.assert_array_equal(back, x)   # inverse group ordering
+
+    def test_space_to_depth(self):
+        x = _t(np.arange(16, dtype='float32').reshape(1, 1, 4, 4))
+        out = L.space_to_depth(x, 2)
+        assert tuple(out.shape) == (1, 4, 2, 2)
+
+    def test_fsp_matrix(self):
+        a = _t(np.random.default_rng(0).standard_normal(
+            (2, 3, 4, 4)).astype('float32'))
+        b = _t(np.random.default_rng(1).standard_normal(
+            (2, 5, 4, 4)).astype('float32'))
+        out = L.fsp_matrix(a, b)
+        assert tuple(out.shape) == (2, 3, 5)
+
+    def test_pad_constant_like(self):
+        x = _t(np.zeros((2, 4), 'float32'))
+        y = _t(np.ones((1, 2), 'float32'))
+        out = L.pad_constant_like(x, y, pad_value=9.0)
+        assert tuple(out.shape) == (2, 4)
+        assert out.numpy()[1, 3] == 9.0 and out.numpy()[0, 0] == 1.0
+
+    def test_add_position_encoding(self):
+        x = _t(np.zeros((1, 6, 8), 'float32'))
+        out = L.add_position_encoding(x, alpha=1.0, beta=1.0)
+        # position 0: sin(0)=0 for first half, cos(0)=1 for second half
+        np.testing.assert_allclose(out.numpy()[0, 0, :4], 0.0, atol=1e-6)
+        np.testing.assert_allclose(out.numpy()[0, 0, 4:], 1.0, atol=1e-6)
+
+
+class TestParamOps:
+    def test_bilinear_tensor_product(self):
+        x = _t(np.random.default_rng(0).standard_normal(
+            (3, 4)).astype('float32'))
+        y = _t(np.random.default_rng(1).standard_normal(
+            (3, 5)).astype('float32'))
+        out = L.bilinear_tensor_product(x, y, size=6)
+        assert tuple(out.shape) == (3, 6)
+
+    def test_row_conv_mixes_future_only(self):
+        x = np.zeros((1, 5, 2), 'float32')
+        x[0, 3] = 1.0                      # impulse at t=3
+        out = L.row_conv(_t(x), future_context_size=2).numpy()
+        assert np.isfinite(out).all()
+        # steps later than the impulse window (t >= 4? no: t in {1,2,3}
+        # see the impulse; t=0 does not reach t=3 with context 2)
+        assert np.allclose(out[0, 0], 0.0)
+        assert not np.allclose(out[0, 3], 0.0)
+
+    def test_lstm_gru_units(self):
+        x = _t(np.random.default_rng(2).standard_normal(
+            (2, 4)).astype('float32'))
+        h = _t(np.zeros((2, 3), 'float32'))
+        c = _t(np.zeros((2, 3), 'float32'))
+        h1, c1 = L.lstm_unit(x, h, c)
+        assert tuple(h1.shape) == (2, 3) and tuple(c1.shape) == (2, 3)
+        gh, _, _ = L.gru_unit(x, _t(np.zeros((2, 3), 'float32')), size=9)
+        assert tuple(gh.shape) == (2, 3)
+
+
+def test_array_ops():
+    arr = L.create_array()
+    a = _t(np.array([1.0], 'float32'))
+    b = _t(np.array([2.0], 'float32'))
+    arr = L.array_write(a, 0, arr)
+    arr = L.array_write(b, _t(np.array([2], 'int64')), arr)
+    assert L.array_length(arr).numpy()[0] == 3
+    np.testing.assert_allclose(L.array_read(arr, 2).numpy(), [2.0])
+
+
+def test_reexports_present():
+    for n in ('temporal_shift', 'pixel_shuffle', 'gather_tree',
+              'sampled_softmax_with_cross_entropy', 'npair_loss'):
+        assert callable(getattr(L, n))
