@@ -294,8 +294,11 @@ def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
             "label_length — the LoD calling convention has no analogue "
             "in static-shape TPU tensors")
     from ..nn import functional as F
-    return F.ctc_loss(input, label, input_length, label_length, blank=blank,
-                      reduction='none').unsqueeze(-1)
+    out = F.ctc_loss(input, label, input_length, label_length, blank=blank,
+                     reduction='none')
+    if norm_by_times:
+        out = out / input_length.astype('float32')
+    return out.unsqueeze(-1)
 
 
 def kldiv_loss(x, target, reduction='mean', name=None):
@@ -350,7 +353,8 @@ def rank_loss(label, left, right, name=None):
 
     def fn(lv, a, b):
         d = a - b
-        return jnp.log1p(jnp.exp(d)) - lv * d
+        # stable softplus(d) = max(d, 0) + log1p(exp(-|d|))
+        return jnp.maximum(d, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(d))) - lv * d
 
     return apply_op(fn, (_t(label), _t(left), _t(right)))
 
@@ -425,6 +429,19 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
     return cols.transpose([0, 2, 1])
 
 
+def _op_param(shape, attr, default_init, name):
+    """Create a Parameter for a function-style op, honoring ParamAttr
+    (initializer/name/trainable/regularizer) like static.nn.fc does."""
+    import jax.numpy as jnp
+    from ..core.tensor import Parameter
+    from ..nn.initializer import ParamAttr
+    a = ParamAttr._to_attr(attr)
+    init = a.initializer or default_init
+    value = jnp.asarray(init(list(shape), dtype='float32'))
+    return Parameter(value, name=a.name or name, trainable=a.trainable,
+                     regularizer=a.regularizer)
+
+
 def row_conv(input, future_context_size, param_attr=None, act=None):
     """Lookahead (row) convolution over (B, T, D): each step mixes the next
     ``future_context_size`` frames per-feature (fluid/layers/nn.py
@@ -437,8 +454,7 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
     x = _t(input)
     D = x.shape[-1]
     k = future_context_size + 1
-    w = Parameter(jnp.asarray(XavierUniform()([k, D], dtype='float32')),
-                  name='row_conv_w')
+    w = _op_param([k, D], param_attr, XavierUniform(), 'row_conv_w')
 
     def fn(v, wv):
         pad = jnp.pad(v, ((0, 0), (0, k - 1), (0, 0)))
@@ -524,10 +540,12 @@ def add_position_encoding(input, alpha, beta, name=None):
 
     def fn(v):
         B, T, D = v.shape
+        n_sin = (D + 1) // 2          # odd D: sin half gets the extra col
         pos = jnp.arange(T, dtype=jnp.float32)[:, None]
-        i = jnp.arange(D // 2, dtype=jnp.float32)[None, :]
+        i = jnp.arange(n_sin, dtype=jnp.float32)[None, :]
         angle = pos / jnp.power(10000.0, 2 * i / D)
-        enc = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+        enc = jnp.concatenate([jnp.sin(angle),
+                               jnp.cos(angle[:, :D - n_sin])], axis=-1)
         return alpha * v + beta * enc[None, :, :].astype(v.dtype)
 
     return apply_op(fn, (_t(input),))
@@ -543,10 +561,9 @@ def bilinear_tensor_product(x, y, size, act=None, name=None,
     from ..tensor._helpers import _t
     xt, yt = _t(x), _t(y)
     dx, dy = xt.shape[-1], yt.shape[-1]
-    w = Parameter(jnp.asarray(XavierUniform()([size, dx, dy],
-                                              dtype='float32')),
-                  name='bilinear_w')
-    b = Parameter(jnp.zeros((size,), jnp.float32), name='bilinear_b')
+    from ..nn.initializer import Constant
+    w = _op_param([size, dx, dy], param_attr, XavierUniform(), 'bilinear_w')
+    b = _op_param([size], bias_attr, Constant(0.0), 'bilinear_b')
 
     def fn(xv, yv, wv, bv):
         return jnp.einsum('bi,kij,bj->bk', xv, wv, yv) + bv
@@ -566,7 +583,9 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
     import jax.numpy as jnp
     from ..nn.layer.rnn import LSTMCell
     hidden = hidden_t_prev.shape[-1]
-    cell = LSTMCell(x_t.shape[-1], hidden)
+    cell = LSTMCell(x_t.shape[-1], hidden,
+                    weight_ih_attr=param_attr, weight_hh_attr=param_attr,
+                    bias_ih_attr=bias_attr, bias_hh_attr=bias_attr)
     if forget_bias:
         b = cell.bias_ih._value
         cell.bias_ih._inplace_value(
@@ -578,12 +597,39 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
 def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
              activation='tanh', gate_activation='sigmoid',
              origin_mode=False):
-    """One GRU step (fluid/layers/nn.py gru_unit): returns (h, reset_h, h)
-    — gate internals collapse to the new hidden in this dense rebuild."""
-    from ..nn.layer.rnn import GRUCell
-    cell = GRUCell(input.shape[-1], size // 3)
-    out, h = cell(input, hidden)
-    return h, h, h
+    """One GRU step with the fluid contract: ``input`` is ALREADY the
+    FC-projected gate pre-activation of width 3*frame (the classic recipe
+    is ``fc(x, size*3)`` -> ``gru_unit``); only the hidden->gates weight
+    [frame, 3*frame] lives here. Returns (hidden_new, reset_hidden_prev,
+    gate)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op
+    from ..nn.initializer import XavierUniform, Constant
+    from ..tensor._helpers import _t
+    frame = size // 3
+    w = _op_param([frame, 3 * frame], param_attr, XavierUniform(),
+                  'gru_unit_w')
+    b = _op_param([3 * frame], bias_attr, Constant(0.0), 'gru_unit_b')
+    gate_act = getattr(jax.nn, gate_activation)
+    act = getattr(jnp, activation) if hasattr(jnp, activation) \
+        else getattr(jax.nn, activation)
+
+    def fn(xv, hv, wv, bv):
+        xg = xv + bv
+        x_ur, x_c = xg[:, :2 * frame], xg[:, 2 * frame:]
+        h_ur = hv @ wv[:, :2 * frame]
+        ur = gate_act(x_ur + h_ur)
+        u, r = ur[:, :frame], ur[:, frame:]
+        reset_h = r * hv
+        c = act(x_c + reset_h @ wv[:, 2 * frame:])
+        if origin_mode:
+            h_new = (1.0 - u) * c + u * hv
+        else:
+            h_new = u * c + (1.0 - u) * hv
+        return h_new, reset_h, jnp.concatenate([u, r, c], axis=-1)
+
+    return apply_op(fn, (_t(input), _t(hidden), w, b), n_outputs=3)
 
 
 def create_array(dtype='float32'):

@@ -146,8 +146,16 @@ class TestParamOps:
         c = _t(np.zeros((2, 3), 'float32'))
         h1, c1 = L.lstm_unit(x, h, c)
         assert tuple(h1.shape) == (2, 3) and tuple(c1.shape) == (2, 3)
-        gh, _, _ = L.gru_unit(x, _t(np.zeros((2, 3), 'float32')), size=9)
+        # gru_unit takes the PRE-PROJECTED input (width 3*frame)
+        xg = _t(np.random.default_rng(3).standard_normal(
+            (2, 9)).astype('float32'))
+        gh, reset_h, gate = L.gru_unit(xg, _t(np.zeros((2, 3), 'float32')),
+                                       size=9)
         assert tuple(gh.shape) == (2, 3)
+        assert tuple(reset_h.shape) == (2, 3)
+        assert tuple(gate.shape) == (2, 9)
+        # zero hidden -> reset_h must be exactly zero
+        np.testing.assert_allclose(reset_h.numpy(), 0.0, atol=1e-7)
 
 
 def test_array_ops():
@@ -164,3 +172,32 @@ def test_reexports_present():
     for n in ('temporal_shift', 'pixel_shuffle', 'gather_tree',
               'sampled_softmax_with_cross_entropy', 'npair_loss'):
         assert callable(getattr(L, n))
+
+
+def test_rank_loss_stable_for_large_gaps():
+    lab = _t(np.array([[1.0]], 'float32'))
+    out = L.rank_loss(lab, _t(np.array([[100.0]], 'float32')),
+                      _t(np.array([[0.0]], 'float32')))
+    assert np.isfinite(out.numpy()).all()
+    np.testing.assert_allclose(out.numpy(), [[0.0]], atol=1e-4)
+
+
+def test_add_position_encoding_odd_dim():
+    x = _t(np.zeros((1, 4, 7), 'float32'))
+    out = L.add_position_encoding(x, alpha=1.0, beta=1.0)
+    assert tuple(out.shape) == (1, 4, 7)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_warpctc_norm_by_times():
+    logits = _t(np.random.default_rng(1)
+                .standard_normal((8, 2, 5)).astype('float32'))
+    labels = _t(np.array([[1, 2], [3, 4]], 'int64'))
+    il = _t(np.array([8, 4], 'int64'))
+    ll = _t(np.array([2, 2], 'int64'))
+    plain = L.warpctc(logits, labels, input_length=il, label_length=ll)
+    normed = L.warpctc(logits, labels, input_length=il, label_length=ll,
+                       norm_by_times=True)
+    np.testing.assert_allclose(normed.numpy(),
+                               plain.numpy() / np.array([[8.0], [4.0]]),
+                               rtol=1e-6)
