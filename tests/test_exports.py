@@ -1,0 +1,25 @@
+"""Every paddle_tpu module imports and every __all__ entry resolves."""
+import importlib
+import pkgutil
+
+import paddle_tpu
+
+
+def test_all_modules_import_and_exports_resolve():
+    bad = []
+    # onerror: a package whose __init__ raises must land in `bad` via our
+    # own import below, not abort the walk mid-iteration
+    for m in pkgutil.walk_packages(paddle_tpu.__path__,
+                                   prefix='paddle_tpu.',
+                                   onerror=lambda name: None):
+        if 'libpaddle_tpu_native' in m.name:   # ctypes .so, not a module
+            continue
+        try:
+            mod = importlib.import_module(m.name)
+        except Exception as e:
+            bad.append((m.name, 'import', repr(e)))
+            continue
+        for attr in getattr(mod, '__all__', []):
+            if not hasattr(mod, attr):
+                bad.append((m.name, 'missing __all__ entry', attr))
+    assert not bad, bad
