@@ -44,6 +44,7 @@ from . import sysconfig
 from .framework import save, load, in_dynamic_mode, enable_static, disable_static, in_static_mode
 from .hapi.model import Model
 from .hapi.model_summary import summary
+from .hapi import callbacks
 from .nn.initializer import ParamAttr
 from .utils.profiler import profiler
 from . import version
