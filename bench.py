@@ -223,14 +223,166 @@ def _resnet50_accel_ips():
     return bench_resnet50(batch=256, steps=10, warmup=2)
 
 
-def main():
-    import jax
+def _tail_json(text):
+    """Last stdout line that parses as a bench JSON object."""
+    for line in reversed((text or '').strip().splitlines()):
+        line = line.strip()
+        if line.startswith('{'):
+            try:
+                obj = json.loads(line)
+            except Exception:
+                continue
+            if isinstance(obj, dict) and 'metric' in obj:
+                return obj
+    return None
 
-    on_accel = jax.default_backend() not in ('cpu',)
+
+def _load_hermetic():
+    """Load paddle_tpu/utils/hermetic.py BY PATH: importing the package
+    would run paddle_tpu.__init__, which initializes the JAX backend —
+    and hangs this parent forever on a wedged TPU tunnel."""
+    import importlib.util
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, 'paddle_tpu', 'utils', 'hermetic.py')
+    spec = importlib.util.spec_from_file_location('_hermetic', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _clean_cpu_env():
+    """Env for a CPU-only child: axon site dir stripped from PYTHONPATH so
+    the interpreter starts instantly even when the TPU tunnel is wedged."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return _load_hermetic().clean_cpu_env(extra_path=[here])
+
+
+def _run_child(mode, model, timeout_s):
+    """Run `bench.py --child <mode> <model>`; return (json_obj, err_str)."""
+    import subprocess
+    env = _clean_cpu_env() if mode == 'cpu' else dict(os.environ)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), '--child', mode,
+             model],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ''
+        out = out.decode('utf-8', 'replace') if isinstance(out, bytes) else out
+        obj = _tail_json(out)
+        if obj is not None:
+            return obj, None
+        return None, f"{mode} child timed out after {timeout_s:.0f}s"
+    except Exception as e:
+        return None, f"{mode} child failed to launch: {e!r}"
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-4000:])
+    obj = _tail_json(proc.stdout)
+    if obj is None:
+        return None, (f"{mode} child rc={proc.returncode}, no JSON line; "
+                      f"stderr tail: {(proc.stderr or '')[-500:]}")
+    return obj, None
+
+
+def _probe_backend(timeout_s):
+    """Probe jax backend init in a THROWAWAY subprocess (it can hang forever
+    on a wedged TPU tunnel — round-3 failure mode).
+
+    Returns (status, detail) with status one of 'accel' (an accelerator
+    backend came up), 'cpu' (conclusive: this machine resolves to the CPU
+    backend — retrying is pointless), 'error' (init failed/hung — worth one
+    retry)."""
+    import subprocess
+    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    try:
+        proc = subprocess.run([sys.executable, '-c', code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return 'error', f"backend probe hung (> {timeout_s:.0f}s): " \
+                        "tunnel wedged"
+    except Exception as e:
+        return 'error', f"backend probe failed to launch: {e!r}"
+    for line in (proc.stdout or '').splitlines():
+        if line.startswith('BACKEND='):
+            backend = line.split('=', 1)[1].strip()
+            return ('cpu' if backend == 'cpu' else 'accel'), \
+                f"backend={backend}"
+    return 'error', (f"backend probe rc={proc.returncode}: "
+                     f"{(proc.stderr or '')[-300:]}")
+
+
+def main():
+    """Fail-proof orchestrator: NEVER initializes jax in this process (a
+    wedged axon tunnel blocks backend init forever), always prints exactly
+    one parseable JSON line, even when the TPU is unreachable.
+
+    Plan: probe backend init in a throwaway subprocess (bounded, retried
+    once) -> run the accelerator bench in a bounded subprocess -> on any
+    failure fall back to a CPU-smoke subprocess with the axon site dir
+    stripped -> on total failure print an error JSON line.
+    """
     model = sys.argv[1].lstrip('-').replace('model=', '') \
         if len(sys.argv) > 1 else 'bert'
     if model not in ('bert', 'resnet50'):
-        raise SystemExit(f"unknown model {model!r}: choose bert or resnet50")
+        print(json.dumps({
+            "metric": "bench_error", "value": 0.0, "unit": "none",
+            "vs_baseline": 0.0,
+            "error": f"unknown model {model!r}: choose bert or resnet50"}))
+        return
+    probe_s = float(os.environ.get('PADDLE_TPU_PROBE_TIMEOUT', '240'))
+    bench_s = float(os.environ.get('PADDLE_TPU_BENCH_TIMEOUT', '2400'))
+    # one global deadline across all stages so the worst-case sequential
+    # chain can never outlive the driver's own timeout (round-3 rc=124);
+    # 600s is always reserved for the CPU-fallback child
+    total_s = float(os.environ.get('PADDLE_TPU_BENCH_TOTAL_BUDGET', '3000'))
+    deadline = time.monotonic() + total_s
+    remaining = lambda: deadline - time.monotonic()  # noqa: E731
+    errors = []
+
+    status, detail = _probe_backend(min(probe_s, max(remaining() - 660, 10)))
+    if status == 'error':
+        errors.append(detail)
+        if remaining() > 700:
+            time.sleep(20)
+            status, detail = _probe_backend(
+                min(probe_s, max(remaining() - 660, 10)))
+            if status == 'error':
+                errors.append(detail)
+    if status == 'accel':
+        obj, err = _run_child('accel', model,
+                              min(bench_s, max(remaining() - 620, 10)))
+        if obj is not None:
+            print(json.dumps(obj))
+            return
+        errors.append(err)
+    obj, err = _run_child('cpu', model, min(900, max(remaining() - 10, 10)))
+    if obj is not None:
+        if errors:
+            obj['error'] = 'tpu unavailable, cpu smoke fallback: ' + \
+                ' | '.join(errors)
+        print(json.dumps(obj))
+        return
+    errors.append(err)
+    print(json.dumps({
+        "metric": "bench_error", "value": 0.0, "unit": "none",
+        "vs_baseline": 0.0, "error": ' | '.join(e for e in errors if e)}))
+
+
+def _child_main(mode, model):
+    import jax
+
+    try:
+        on_accel = jax.default_backend() not in ('cpu',)
+    except Exception as e:
+        print(f"backend init failed: {e!r}", file=sys.stderr)
+        sys.exit(3)
+    if mode == 'accel' and not on_accel:
+        # jax fell back to CPU after the parent's probe saw an accelerator:
+        # hard-fail so the orchestrator reports the annotated fallback
+        # instead of publishing smoke numbers as the accelerator result
+        print("accel child resolved to CPU backend", file=sys.stderr)
+        sys.exit(3)
     if not on_accel and model == 'resnet50':
         ips = bench_resnet50(batch=4, steps=2, warmup=1)  # CPU smoke
         print(json.dumps({
@@ -302,4 +454,8 @@ def main():
 
 
 if __name__ == '__main__':
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == '--child':
+        _child_main(sys.argv[2] if len(sys.argv) > 2 else 'cpu',
+                    sys.argv[3] if len(sys.argv) > 3 else 'bert')
+    else:
+        main()

@@ -1,0 +1,37 @@
+"""Hermetic child-process environments for the driver harness.
+
+The axon TPU plugin installs a sitecustomize (under a `.axon*` site dir on
+PYTHONPATH) that dials the TPU relay at interpreter startup; when the tunnel
+is wedged, every child that inherits it hangs before running a line of user
+code. CPU-only children must drop that site dir and pin JAX_PLATFORMS=cpu
+BEFORE jax initializes.
+
+Shared by bench.py and __graft_entry__.py so the two drivers can't diverge.
+IMPORTANT: those parents must load this file BY PATH (see load_hermetic in
+bench.py) — `import paddle_tpu.utils.hermetic` would run the package
+__init__, which initializes the JAX backend and hangs on a wedged tunnel.
+This module therefore imports nothing beyond the stdlib.
+"""
+import os
+
+
+def clean_cpu_env(extra_path=None, base_env=None):
+    """Environment for a CPU-only child interpreter.
+
+    Strips `.axon*` site dirs from PYTHONPATH (matching the path component,
+    not a bare substring — '/home/jaxon/libs' must survive) and pins the CPU
+    backend. `extra_path` entries are prepended to PYTHONPATH.
+    """
+    env = dict(os.environ if base_env is None else base_env)
+    kept = []
+    for p in env.get('PYTHONPATH', '').split(os.pathsep):
+        if not p:
+            continue
+        parts = os.path.normpath(p).split(os.sep)
+        if any(seg.startswith('.axon') for seg in parts):
+            continue
+        kept.append(p)
+    pre = list(extra_path or [])
+    env['PYTHONPATH'] = os.pathsep.join(pre + kept)
+    env['JAX_PLATFORMS'] = 'cpu'
+    return env
