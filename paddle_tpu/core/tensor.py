@@ -368,14 +368,27 @@ def set_capture_watch(w):
     return prev
 
 
+_FORCE_SYMBOLIC = [False]
+
+
+def force_symbolic_capture(flag):
+    """Route EVERY apply_op through the symbolic handler (used by the
+    classic control-flow class bodies, whose ops must be captured even when
+    all inputs are concrete constants). Returns the previous flag."""
+    prev = _FORCE_SYMBOLIC[0]
+    _FORCE_SYMBOLIC[0] = bool(flag)
+    return prev
+
+
 def apply_op(fn, tensors, n_outputs=1, differentiable=True):
     """Run a pure fn over tensor payloads; record on the tape if needed.
 
     ``tensors`` are the differentiable positional inputs; every non-tensor
     argument must already be closed over in ``fn``.
     """
-    if _SYMBOLIC_HANDLER[0] is not None and any(
-            getattr(t, '_symbolic', False) for t in tensors):
+    if _SYMBOLIC_HANDLER[0] is not None and (
+            _FORCE_SYMBOLIC[0] or
+            any(getattr(t, '_symbolic', False) for t in tensors)):
         return _SYMBOLIC_HANDLER[0](fn, tensors, n_outputs, differentiable)
     if _CAPTURE_WATCH.w is not None:
         _CAPTURE_WATCH.w.note_inputs(tensors)

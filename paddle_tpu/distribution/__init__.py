@@ -9,7 +9,8 @@ from ..core.tensor import Tensor, apply_op
 from ..core import rng as _rng
 from ..tensor._helpers import _t, _shape
 
-__all__ = ['Distribution', 'Uniform', 'Normal', 'Categorical']
+__all__ = ['Distribution', 'Uniform', 'Normal', 'Categorical',
+           'MultivariateNormalDiag']
 
 
 class Distribution:
@@ -141,3 +142,57 @@ class Categorical(Distribution):
                 (jax.nn.log_softmax(a, -1) - jax.nn.log_softmax(b, -1)),
                 axis=-1),
             (self.logits, other.logits))
+
+
+class MultivariateNormalDiag(Distribution):
+    """Multivariate normal with diagonal covariance. Parity:
+    /root/reference/python/paddle/fluid/layers/distributions.py:531 —
+    loc is [k], scale is the [k, k] diagonal matrix. The 1.8 reference
+    implements entropy and kl_divergence; sample/log_prob added here for
+    completeness (diagonal Gaussian)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc).astype('float32')
+        self.scale = _t(scale).astype('float32')
+
+    def _diag(self, sv):
+        return jnp.diagonal(sv, axis1=-2, axis2=-1)
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+        shape = tuple(shape)
+
+        def fn(m, s):
+            d = self._diag(s)
+            full = shape + m.shape
+            return m + jnp.sqrt(d) * jax.random.normal(key, full,
+                                                       dtype=m.dtype)
+        return apply_op(fn, (self.loc, self.scale), differentiable=False)
+
+    def log_prob(self, value):
+        def fn(v, m, s):
+            d = self._diag(s)
+            k = m.shape[-1]
+            quad = jnp.sum((v - m) ** 2 / d, axis=-1)
+            return -0.5 * (quad + k * math.log(2 * math.pi) +
+                           jnp.sum(jnp.log(d), axis=-1))
+        return apply_op(fn, (_t(value), self.loc, self.scale))
+
+    def entropy(self):
+        def fn(m, s):
+            d = self._diag(s)
+            k = m.shape[-1]
+            return 0.5 * (k * (1.0 + math.log(2 * math.pi)) +
+                          jnp.sum(jnp.log(d), axis=-1))
+        return apply_op(fn, (self.loc, self.scale))
+
+    def kl_divergence(self, other):
+        def fn(m1, s1, m2, s2):
+            d1 = self._diag(s1)
+            d2 = self._diag(s2)
+            k = m1.shape[-1]
+            return 0.5 * (jnp.sum(d1 / d2, axis=-1) +
+                          jnp.sum((m2 - m1) ** 2 / d2, axis=-1) - k +
+                          jnp.sum(jnp.log(d2), axis=-1) -
+                          jnp.sum(jnp.log(d1), axis=-1))
+        return apply_op(fn, (self.loc, self.scale, other.loc, other.scale))

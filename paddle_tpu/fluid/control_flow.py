@@ -1,0 +1,834 @@
+"""Classic 1.8 control-flow classes over the closure IR.
+
+Parity: /root/reference/python/paddle/fluid/layers/control_flow.py
+(Print:214, StaticRNN:449, While:971, Switch:2603, IfElse:2761,
+DynamicRNN:2939, Assert, reorder_lod_tensor_by_rank).
+
+TPU-first design: the reference builds sub-blocks in ProgramDesc executed by
+C++ while/conditional ops with scope-level variable mutation. Here each class
+captures its body's Operators from the Program's op list into a TEMPLATE,
+removes them, and appends ONE composite Operator that runs the template under
+lax.while_loop / lax.switch / lax.scan. In-place mutation (the classic
+`increment(in_place=True)` / `less_than(cond=...)` / `assign(output=...)`
+pattern every 1.8 While script uses) is expressed by appending an Operator
+whose output IS the existing Variable — the Executor's env is keyed by
+variable identity, so downstream ops (and the next loop iteration) see the
+updated slot.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..tensor._helpers import _t
+from ..static.graph import (Variable, Operator, current_capture_program)
+
+
+# --------------------------------------------------------------------------
+# raw-op plumbing
+# --------------------------------------------------------------------------
+
+def _prog():
+    p = current_capture_program()
+    if p is None:
+        raise RuntimeError(
+            "classic control-flow classes are Static Graph APIs: use them "
+            "under paddle.enable_static() / program_guard (the imperative "
+            "forms cond/while_loop work eagerly)")
+    return p
+
+
+def _append_raw(fn, inputs, outputs, type='jax_op'):
+    """Append an Operator with EXPLICIT output Variables (possibly existing
+    ones — that's the in-place write-back path)."""
+    block = _prog().global_block
+    op = Operator(fn, list(inputs), list(outputs), type=type)
+    block.ops.append(op)
+    return op
+
+
+def _as_var(x):
+    """Wrap a concrete Tensor as a concrete-backed Variable in the current
+    block — through the block's concrete cache, so every read and
+    write-back of the same tensor shares ONE env slot."""
+    if isinstance(x, Variable):
+        return x
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    return _prog().global_block.concrete_var(x)
+
+
+class _CapturedBlock:
+    """Capture ops appended inside a `with` region, then pop them.
+
+    Forces symbolic capture for the region: classic 1.8 bodies mostly
+    operate on fill_constant results, which are concrete outside a body —
+    their ops must still be recorded to replay per iteration."""
+
+    def __init__(self):
+        self.ops = []
+
+    def __enter__(self):
+        from ..core.tensor import force_symbolic_capture
+        self._block = _prog().global_block
+        self._start = len(self._block.ops)
+        self._prev_force = force_symbolic_capture(True)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        from ..core.tensor import force_symbolic_capture
+        force_symbolic_capture(self._prev_force)
+        if exc_type is None:
+            self.ops = self._block.ops[self._start:]
+            del self._block.ops[self._start:]
+        return False
+
+
+def _template_frontier(ops):
+    """Input Variables a template reads that it does not itself produce
+    first (reads-before-writes included: a loop body both reads and writes
+    its carried slots)."""
+    produced = set()
+    frontier, seen = [], set()
+    for op in ops:
+        for v in op.inputs:
+            if id(v) not in produced and id(v) not in seen:
+                seen.add(id(v))
+                frontier.append(v)
+        for v in op.outputs:
+            produced.add(id(v))
+    return frontier
+
+
+def _run_template(ops, env):
+    """Interpret template ops over an id(var)->value env (the loop-body
+    analogue of executor._interpret_ops; concrete fallbacks included)."""
+    for op in ops:
+        args = []
+        for v in op.inputs:
+            if id(v) in env:
+                args.append(env[id(v)])
+            elif v.concrete is not None:
+                args.append(v.concrete._value)
+            else:
+                raise RuntimeError(
+                    f"control-flow template: var {v.name} unavailable")
+        res = op.fn(*args)
+        if op.n_outputs == 1:
+            env[id(op.outputs[0])] = res
+        else:
+            for ov, r in zip(op.outputs, res):
+                env[id(ov)] = r
+    return env
+
+
+def _write_set(ops):
+    """Variables a template writes that existed BEFORE it (loop-carried /
+    externally visible slots): outputs also read as frontier inputs, or
+    outputs bound to a pre-existing concrete tensor (the _append_raw
+    write-back path — plain SSA ops never produce concrete-backed
+    outputs)."""
+    frontier = {id(v): v for v in _template_frontier(ops)}
+    out, seen = [], set()
+    for op in ops:
+        for v in op.outputs:
+            if id(v) in seen:
+                continue
+            if id(v) in frontier or v.concrete is not None:
+                seen.add(id(v))
+                out.append(v)
+    return out
+
+
+# --------------------------------------------------------------------------
+# in-place-capable writer ops (the classic While toolkit)
+# --------------------------------------------------------------------------
+
+def increment(x, value=1.0, in_place=True):
+    """1.8 increment: bumps x by value, in place by default
+    (control_flow.py increment)."""
+    prog = current_capture_program()
+    if prog is not None and in_place:
+        xv = _as_var(x)
+
+        def fn(v):
+            return v + jnp.asarray(value, v.dtype)
+        _append_raw(fn, [xv], [xv], type='increment')
+        return xv
+    from ..tensor.math import increment as _inc
+    if in_place and isinstance(x, Tensor) and not getattr(
+            x, '_symbolic', False):
+        x._inplace_value(x._value + jnp.asarray(value, x._value.dtype))
+        return x
+    return _inc(x, value)
+
+
+def _cmp_writer(jfn, name):
+    def op(x, y, cond=None, name=None):
+        if cond is not None and current_capture_program() is not None:
+            xv, yv = _as_var(_t(x)), _as_var(_t(y))
+            cv = _as_var(cond)
+
+            def fn(a, b):
+                return jfn(a, b).reshape(tuple(cv._value.shape)) \
+                    .astype(cv._value.dtype)
+            _append_raw(fn, [xv, yv], [cv], type=name)
+            return cv
+        out = apply_op(lambda a, b: jfn(a, b), (_t(x), _t(y)),
+                       differentiable=False)
+        if cond is not None:
+            # eager write-back: the classic `less_than(i, n, cond=cond)`
+            # idiom must update cond in place outside static capture too
+            cond._inplace_value(
+                out._value.reshape(tuple(cond._value.shape))
+                .astype(cond._value.dtype))
+            return cond
+        return out
+    op.__name__ = name
+    return op
+
+
+less_than = _cmp_writer(lambda a, b: a < b, 'less_than')
+less_equal = _cmp_writer(lambda a, b: a <= b, 'less_equal')
+greater_than = _cmp_writer(lambda a, b: a > b, 'greater_than')
+greater_equal = _cmp_writer(lambda a, b: a >= b, 'greater_equal')
+equal = _cmp_writer(lambda a, b: a == b, 'equal')
+not_equal = _cmp_writer(lambda a, b: a != b, 'not_equal')
+
+
+def assign(input, output=None):
+    """assign with the 1.8 output= write-back form."""
+    if output is not None and current_capture_program() is not None:
+        iv = input if isinstance(input, Variable) else _as_var(_t(input))
+        ov = _as_var(output)
+        _append_raw(lambda v: v.astype(ov._value.dtype).reshape(
+            tuple(ov._value.shape)), [iv], [ov], type='assign')
+        return ov
+    from ..tensor.creation import assign as _assign
+    if output is not None:
+        out = _assign(input)
+        output._inplace_value(out._value)
+        return output
+    return _assign(input)
+
+
+def array_write(x, i, array=None):
+    from .layers import array_write as _aw
+    return _aw(x, i, array)
+
+
+# --------------------------------------------------------------------------
+# While
+# --------------------------------------------------------------------------
+
+class While:
+    """1.8 While (control_flow.py:971): `with while_op.block():` captures
+    the body; the composite op runs it under lax.while_loop with the
+    written slots as carry."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        if not isinstance(cond, Tensor):
+            raise TypeError("While cond must be a (bool) tensor/Variable")
+        self.cond = _as_var(cond)
+        self._cap = None
+
+    class _Guard:
+        def __init__(self, w):
+            self.w = w
+            self.cap = _CapturedBlock()
+
+        def __enter__(self):
+            self.cap.__enter__()
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            self.cap.__exit__(exc_type, exc, tb)
+            if exc_type is None:
+                self.w._finalize(self.cap.ops)
+            return False
+
+    def block(self):
+        return While._Guard(self)
+
+    def _finalize(self, body_ops):
+        cond = self.cond
+        writes = _write_set(body_ops)
+        if not any(v is cond for v in writes):
+            # a While whose body never updates cond never terminates
+            writes = [cond] + writes
+        frontier = _template_frontier(body_ops)
+        # composite inputs: frontier plus current cond value
+        in_vars, seen = [], set()
+        for v in [cond] + frontier:
+            if id(v) not in seen:
+                seen.add(id(v))
+                in_vars.append(v)
+        carry_vars = writes
+        carry_idx = {id(v): i for i, v in enumerate(carry_vars)}
+
+        def composite(*vals):
+            base_env = dict(zip([id(v) for v in in_vars], vals))
+            init = []
+            for v in carry_vars:
+                if id(v) in base_env:
+                    init.append(base_env[id(v)])
+                elif v.concrete is not None:
+                    init.append(v.concrete._value)
+                else:
+                    raise RuntimeError(
+                        f"While: carried var {v.name} has no initial value")
+
+            def cond_fn(carry):
+                return jnp.all(carry[carry_idx[id(cond)]] != 0)
+
+            def body_fn(carry):
+                env = dict(base_env)
+                for v, c in zip(carry_vars, carry):
+                    env[id(v)] = c
+                env = _run_template(body_ops, env)
+                return tuple(env[id(v)] for v in carry_vars)
+
+            out = jax.lax.while_loop(cond_fn, body_fn, tuple(init))
+            return out if len(carry_vars) > 1 else out[0]
+
+        _append_raw(composite, in_vars, carry_vars, type='while')
+
+
+# --------------------------------------------------------------------------
+# Switch
+# --------------------------------------------------------------------------
+
+class Switch:
+    """1.8 Switch (control_flow.py:2603): first true case wins, else
+    default. Branch bodies typically assign into persistable vars; the
+    composite runs the selected branch via lax.switch."""
+
+    def __init__(self, name=None):
+        self._cases = []       # (cond_var, ops)
+        self._default = None   # ops
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._finalize()
+        return False
+
+    class _Case:
+        def __init__(self, sw, cond):
+            self.sw = sw
+            self.cond = cond
+            self.cap = _CapturedBlock()
+
+        def __enter__(self):
+            self.cap.__enter__()
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            self.cap.__exit__(exc_type, exc, tb)
+            if exc_type is None:
+                if self.cond is None:
+                    self.sw._default = self.cap.ops
+                else:
+                    self.sw._cases.append((self.cond, self.cap.ops))
+            return False
+
+    def case(self, condition):
+        # a concrete-Tensor cond (e.g. less_than over fill_constants,
+        # evaluated eagerly outside any captured block) must still become a
+        # program slot the composite can read
+        return Switch._Case(self, _as_var(condition))
+
+    def default(self):
+        return Switch._Case(self, None)
+
+    def _finalize(self):
+        branches = [ops for _, ops in self._cases]
+        if self._default is not None:
+            branches.append(self._default)
+        # frontier/write-set must be per-branch unions: a concatenated view
+        # would hide branch B's read of a var branch A writes
+        writes, wseen = [], set()
+        frontier, fseen = [], set()
+        for ops in branches:
+            for v in _write_set(ops):
+                if id(v) not in wseen:
+                    wseen.add(id(v))
+                    writes.append(v)
+            for v in _template_frontier(ops):
+                if id(v) not in fseen:
+                    fseen.add(id(v))
+                    frontier.append(v)
+        if not writes:
+            raise ValueError(
+                "Switch: no branch writes into a pre-existing variable "
+                "(assign(value, output=var) / increment(in_place=True)); "
+                "the branch bodies would be silently dropped — write the "
+                "branch result into a var created before the Switch")
+        cond_vars = [c for c, _ in self._cases]
+        in_vars, seen = [], set()
+        for v in cond_vars + frontier + writes:
+            if id(v) not in seen:
+                seen.add(id(v))
+                in_vars.append(v)
+        n_cases = len(cond_vars)
+        has_default = self._default is not None
+
+        def composite(*vals):
+            env = dict(zip([id(v) for v in in_vars], vals))
+            conds = jnp.stack(
+                [jnp.all(env[id(c)] != 0) for c in cond_vars])
+            # first true cond; if none and a default exists, pick it
+            idx = jnp.argmax(conds)
+            took = jnp.any(conds)
+            if has_default:
+                idx = jnp.where(took, idx, n_cases)
+
+            def make_branch(ops):
+                def run(args):
+                    benv = dict(env)
+                    benv = _run_template(ops, benv)
+                    return tuple(benv.get(id(v), env.get(id(v)))
+                                 for v in writes)
+                return run
+
+            def identity(args):
+                return tuple(env[id(v)] for v in writes)
+
+            fns = [make_branch(ops) for ops in branches]
+            if not has_default:
+                fns.append(identity)       # no case taken: keep old values
+                idx = jnp.where(took, idx, n_cases)
+            out = jax.lax.switch(idx, fns, ())
+            return out if len(writes) > 1 else out[0]
+
+        _append_raw(composite, in_vars, writes, type='switch')
+
+
+# --------------------------------------------------------------------------
+# IfElse
+# --------------------------------------------------------------------------
+
+class IfElse:
+    """1.8 IfElse (control_flow.py:2761): per-ROW branch selection on a
+    (N, 1) bool cond. TPU-first redesign: the reference physically
+    partitions rows into true/false subsets and merges; XLA needs static
+    shapes, so both branch bodies compute on ALL rows and ie() merges with
+    where(cond) — identical merged values, original row order preserved."""
+
+    OUT_IF_ELSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.cond = cond
+        self._outs = {True: [], False: []}
+        self._in_branch = None
+
+    class _Branch:
+        def __init__(self, ie, flag):
+            self.ie = ie
+            self.flag = flag
+
+        def __enter__(self):
+            self.ie._in_branch = self.flag
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            self.ie._in_branch = None
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, True)
+
+    def false_block(self):
+        return IfElse._Branch(self, False)
+
+    def input(self, x):
+        if self._in_branch is None:
+            raise RuntimeError("IfElse.input() outside a branch block")
+        return x
+
+    def output(self, *outs):
+        if self._in_branch is None:
+            raise RuntimeError("IfElse.output() outside a branch block")
+        self._outs[self._in_branch].extend(outs)
+
+    def __call__(self):
+        ts, fs = self._outs[True], self._outs[False]
+        if len(ts) != len(fs):
+            raise ValueError(
+                f"IfElse: true block registered {len(ts)} outputs, false "
+                f"block {len(fs)} — they must match")
+        merged = []
+        for tv, fv in zip(ts, fs):
+            def fn(c, a, b):
+                keep = (c != 0).reshape(
+                    (-1,) + (1,) * (a.ndim - 1)).astype(bool)
+                return jnp.where(keep, a, b)
+            merged.append(apply_op(fn, (_t(self.cond), _t(tv), _t(fv))))
+        return merged
+
+
+# --------------------------------------------------------------------------
+# StaticRNN
+# --------------------------------------------------------------------------
+
+class StaticRNN:
+    """1.8 StaticRNN (control_flow.py:449): inputs are TIME-MAJOR
+    (T, B, ...); the `with rnn.step()` body is captured once and run over
+    the T steps by lax.scan inside one composite op."""
+
+    def __init__(self, name=None):
+        self._cap = None
+        self._seq_vars = []       # (placeholder, sequence var)
+        self._memories = []       # [placeholder, init_var_or_value]
+        self._updates = {}        # id(placeholder) -> new var
+        self._outputs = []        # per-step output vars
+        self._results = None
+        self.seq_len = None
+
+    class _StepGuard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+            self.cap = _CapturedBlock()
+
+        def __enter__(self):
+            self.cap.__enter__()
+            self.rnn._active_cap = self.cap
+            return self
+
+        def __exit__(self, exc_type, exc, tb):
+            self.cap.__exit__(exc_type, exc, tb)
+            self.rnn._active_cap = None
+            if exc_type is None:
+                self.rnn._finalize(self.cap.ops)
+            return False
+
+    def step(self):
+        return StaticRNN._StepGuard(self)
+
+    def _hoist(self, build):
+        """Run `build()` and move the ops it appended OUT of the step
+        template, to just before the capture region (per-sequence
+        preprocessing like DynamicRNN's batch->time transpose must execute
+        once in the outer program, not per step)."""
+        cap = getattr(self, '_active_cap', None)
+        if cap is None:
+            return build()
+        from ..core.tensor import force_symbolic_capture
+        block = _prog().global_block
+        n0 = len(block.ops)
+        prev = force_symbolic_capture(False)
+        try:
+            out = build()
+        finally:
+            force_symbolic_capture(prev)
+        moved = block.ops[n0:]
+        del block.ops[n0:]
+        block.ops[cap._start:cap._start] = moved
+        cap._start += len(moved)
+        return out
+
+    def _placeholder(self, shape, dtype, name):
+        block = _prog().global_block
+        v = Variable(jax.ShapeDtypeStruct(tuple(shape), dtype), name=name)
+        v.stop_gradient = True
+        block.vars[v.name] = v
+        return v
+
+    def step_input(self, x):
+        if self.seq_len is None:
+            self.seq_len = int(x.shape[0])
+        elif int(x.shape[0]) != self.seq_len:
+            raise ValueError("StaticRNN: inputs disagree on seq_len")
+        ph = self._placeholder(x.shape[1:], x._value.dtype,
+                               f'{x.name}@step')
+        self._seq_vars.append((ph, x))
+        return ph
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is not None:
+            ph = self._placeholder(init.shape, init._value.dtype,
+                                   f'{init.name}@mem')
+            self._memories.append([ph, init])
+            return ph
+        if shape is None or batch_ref is None:
+            raise ValueError("StaticRNN.memory: need init or "
+                             "(shape, batch_ref)")
+        B = int(batch_ref.shape[0])
+        dims = tuple(B if int(s) == -1 else int(s) for s in shape)
+        ph = self._placeholder(dims, jnp.float32, 'rnn_mem')
+        self._memories.append([ph, float(init_value)])
+        return ph
+
+    def update_memory(self, mem, var):
+        self._updates[id(mem)] = var
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _finalize(self, body_ops):
+        if not self._outputs:
+            raise ValueError("StaticRNN: no step_output registered")
+        T = self.seq_len
+        seq_phs = [ph for ph, _ in self._seq_vars]
+        seq_vars = [x for _, x in self._seq_vars]
+        mem_phs = [m[0] for m in self._memories]
+        mem_inits = [m[1] for m in self._memories]
+        updates = self._updates
+        outputs = self._outputs
+
+        frontier = _template_frontier(body_ops)
+        internal = set(id(v) for v in seq_phs + mem_phs)
+        ext = [v for v in frontier if id(v) not in internal]
+        init_vars = [m for m in mem_inits if isinstance(m, Variable)]
+        in_vars, seen = [], set()
+        for v in seq_vars + init_vars + ext:
+            if id(v) not in seen:
+                seen.add(id(v))
+                in_vars.append(v)
+
+        def composite(*vals):
+            env0 = dict(zip([id(v) for v in in_vars], vals))
+            mems0 = []
+            for ph, init in zip(mem_phs, mem_inits):
+                if isinstance(init, Variable):
+                    mems0.append(env0[id(init)])
+                else:
+                    mems0.append(jnp.full(tuple(ph._value.shape), init,
+                                          ph._value.dtype))
+            xs = tuple(env0[id(v)] for v in seq_vars)
+
+            def step_fn(mems, x_t):
+                env = dict(env0)
+                for ph, m in zip(mem_phs, mems):
+                    env[id(ph)] = m
+                for ph, xt in zip(seq_phs, x_t):
+                    env[id(ph)] = xt
+                env = _run_template(body_ops, env)
+                new_mems = tuple(
+                    env[id(updates[id(ph)])] if id(ph) in updates
+                    else env[id(ph)] for ph in mem_phs)
+                outs = tuple(env[id(o)] for o in outputs)
+                return new_mems, outs
+
+            _, stacked = jax.lax.scan(step_fn, tuple(mems0), xs, length=T)
+            return stacked if len(outputs) > 1 else stacked[0]
+
+        out_vars = []
+        block = _prog().global_block
+        for o in outputs:
+            ov = Variable(jax.ShapeDtypeStruct((T,) + tuple(o._value.shape),
+                                               o._value.dtype))
+            ov.stop_gradient = False
+            block.vars[ov.name] = ov
+            out_vars.append(ov)
+        op = _append_raw(composite, in_vars, out_vars, type='static_rnn')
+        for ov in out_vars:
+            ov.op = op
+        self._results = out_vars
+
+    def __call__(self):
+        if self._results is None:
+            raise RuntimeError("StaticRNN called before its step block")
+        return self._results[0] if len(self._results) == 1 \
+            else self._results
+
+
+# --------------------------------------------------------------------------
+# DynamicRNN
+# --------------------------------------------------------------------------
+
+class DynamicRNN(StaticRNN):
+    """1.8 DynamicRNN (control_flow.py:2939): variable-length batches. The
+    reference sorts/shrinks by LoD; the dense redesign takes BATCH-MAJOR
+    (B, T, ...) padded inputs (+ optional lengths via step_input's `level`
+    replacement argument) and runs the same scan with a validity mask:
+    past a row's length the memories stop advancing and step outputs are
+    zeroed — numerically identical to the reference's shrinking."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._lengths = None
+        self._statics = []
+
+    def block(self):
+        return StaticRNN._StepGuard(self)
+
+    def step_input(self, x, level=0, length=None):
+        if length is not None and self._lengths is None:
+            self._lengths = length
+        # batch-major -> time-major for the scan (hoisted: runs once in the
+        # outer program, not inside the per-step template)
+        from ..tensor.manipulation import transpose
+        xt = self._hoist(
+            lambda: transpose(x, [1, 0] + list(range(2, x.ndim))))
+        if self.seq_len is None:
+            self.seq_len = int(xt.shape[0])
+        ph = self._placeholder(xt.shape[1:], xt._value.dtype,
+                               f'{x.name}@step')
+        self._seq_vars.append((ph, xt))
+        return ph
+
+    def static_input(self, x):
+        self._statics.append(x)
+        return x
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               need_reorder=False, dtype='float32'):
+        if init is not None:
+            return super().memory(init=init)
+        if shape is None:
+            raise ValueError("DynamicRNN.memory: need init or shape")
+        if batch_ref is None and self._seq_vars:
+            batch_ref = self._seq_vars[0][0]
+        B = int(batch_ref.shape[0])
+        dims = (B,) + tuple(int(s) for s in shape)
+        ph = self._placeholder(dims, jnp.float32, 'drnn_mem')
+        self._memories.append([ph, float(init_value)])
+        return ph
+
+    def _finalize(self, body_ops):
+        lengths = self._lengths
+        if lengths is None:
+            super()._finalize(body_ops)
+            # back to batch-major
+            self._results = [self._to_batch_major(v) for v in self._results]
+            return
+        # masked scan: wrap the parent composite with per-step validity
+        T = self.seq_len
+        seq_phs = [ph for ph, _ in self._seq_vars]
+        seq_vars = [x for _, x in self._seq_vars]
+        mem_phs = [m[0] for m in self._memories]
+        mem_inits = [m[1] for m in self._memories]
+        updates = self._updates
+        outputs = self._outputs
+        frontier = _template_frontier(body_ops)
+        internal = set(id(v) for v in seq_phs + mem_phs)
+        ext = [v for v in frontier if id(v) not in internal]
+        init_vars = [m for m in mem_inits if isinstance(m, Variable)]
+        len_var = _as_var(_t(lengths)) if not isinstance(lengths, Variable) \
+            else lengths
+        in_vars, seen = [], set()
+        for v in seq_vars + init_vars + ext + [len_var]:
+            if id(v) not in seen:
+                seen.add(id(v))
+                in_vars.append(v)
+
+        def composite(*vals):
+            env0 = dict(zip([id(v) for v in in_vars], vals))
+            lens = env0[id(len_var)].astype(jnp.int32).reshape(-1)
+            mems0 = []
+            for ph, init in zip(mem_phs, mem_inits):
+                if isinstance(init, Variable):
+                    mems0.append(env0[id(init)])
+                else:
+                    mems0.append(jnp.full(tuple(ph._value.shape), init,
+                                          ph._value.dtype))
+            xs = tuple(env0[id(v)] for v in seq_vars)
+
+            def step_fn(carry, inp):
+                mems, t = carry
+                x_t = inp
+                env = dict(env0)
+                for ph, m in zip(mem_phs, mems):
+                    env[id(ph)] = m
+                for ph, xt in zip(seq_phs, x_t):
+                    env[id(ph)] = xt
+                env = _run_template(body_ops, env)
+                alive = (t < lens)
+
+                def msk(new, old):
+                    m = alive.reshape((-1,) + (1,) * (new.ndim - 1))
+                    return jnp.where(m, new, old)
+                new_mems = tuple(
+                    msk(env[id(updates[id(ph)])], old)
+                    if id(ph) in updates else old
+                    for ph, old in zip(mem_phs, mems))
+                outs = tuple(
+                    msk(env[id(o)], jnp.zeros_like(env[id(o)]))
+                    for o in outputs)
+                return (new_mems, t + 1), outs
+
+            (_, _), stacked = jax.lax.scan(
+                step_fn, (tuple(mems0), jnp.asarray(0, jnp.int32)), xs,
+                length=T)
+            return stacked if len(outputs) > 1 else stacked[0]
+
+        out_vars = []
+        block = _prog().global_block
+        for o in outputs:
+            ov = Variable(jax.ShapeDtypeStruct((T,) + tuple(o._value.shape),
+                                               o._value.dtype))
+            ov.stop_gradient = False
+            block.vars[ov.name] = ov
+            out_vars.append(ov)
+        op = _append_raw(composite, in_vars, out_vars, type='dynamic_rnn')
+        for ov in out_vars:
+            ov.op = op
+        self._results = [self._to_batch_major(v) for v in out_vars]
+
+    def _to_batch_major(self, v):
+        from ..tensor.manipulation import transpose
+        return transpose(v, [1, 0] + list(range(2, v.ndim)))
+
+
+# --------------------------------------------------------------------------
+# Print / Assert / reorder
+# --------------------------------------------------------------------------
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase='both'):
+    """Debug print op (control_flow.py:214): passes `input` through and
+    prints its value at execution time (jax.debug.print under jit)."""
+    msg = message or ''
+    name = getattr(input, 'name', 'var')
+    state = {'n': 0}
+
+    def host_print(v):
+        # counted HERE, at execution time: the op is traced once but this
+        # callback fires on every run, so first_n gates executions (the
+        # reference semantics), not traces
+        if first_n < 0 or state['n'] < first_n:
+            state['n'] += 1
+            head = f"{msg} {name if print_tensor_name else ''}".strip()
+            if print_tensor_shape:
+                head += f" shape={tuple(v.shape)}"
+            if print_tensor_type:
+                head += f" dtype={v.dtype}"
+            print(head + f" value={np.asarray(v)}")
+
+    def fn(v):
+        jax.debug.callback(host_print, v)
+        return v
+
+    return apply_op(fn, (_t(input),))
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    """Runtime assertion (control_flow.py Assert): checks cond at execution
+    time via checkify-style host callback."""
+    def fn(c):
+        def host_check(cv):
+            if not np.all(cv):
+                raise AssertionError(
+                    f"paddle Assert failed (cond={np.asarray(cv)})")
+            return np.asarray(cv)
+        return jax.pure_callback(
+            host_check, jax.ShapeDtypeStruct(tuple(c.shape), c.dtype), c)
+
+    return apply_op(fn, (_t(cond),), differentiable=False)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """The reference reorders LoD sequences by a rank table built from
+    lengths; dense padded batches carry no LoD order, so this is an
+    identity on the payload (documented divergence)."""
+    return x

@@ -1,0 +1,121 @@
+"""fluid.layers io op tail: py_reader family.
+
+Parity: /root/reference/python/paddle/fluid/layers/io.py (data/py_reader:
+~520, create_py_reader_by_data:~700, read_file, double_buffer, load).
+
+TPU-first divergence: the reference's py_reader is a C++ BlockingQueue op
+pair (enqueue on a reader thread, dequeue inside the Program) driving
+exception-terminated `while True: exe.run()` loops. Here a PyReader is a
+host-side iterator bound to static data placeholders: `read_file` returns
+the placeholders and `next_feed()` yields the feed dict for Executor.run —
+feeding stays explicit because XLA programs take inputs as arguments rather
+than popping queues. The DataLoader stack (io/dataloader.py) owns
+prefetch/double-buffering.
+"""
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+
+
+class PyReader:
+    """Host-side reader bound to static data placeholders."""
+
+    def __init__(self, shapes, dtypes, names=None, capacity=64,
+                 use_double_buffer=True):
+        from ..static.graph import data as static_data
+        self.capacity = capacity
+        self._gen = None
+        self._iter = None
+        self._vars = []
+        for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+            name = (names[i] if names else f'_py_reader_{id(self)}_{i}')
+            shape = [(-1 if (s is None or s == -1) else int(s))
+                     for s in shape]
+            self._vars.append(static_data(name, shape, dtype=dtype))
+
+    # -- reader decoration (reference API names) --
+    def decorate_paddle_reader(self, reader, places=None):
+        self._gen = reader
+        return self
+
+    decorate_sample_list_generator = decorate_paddle_reader
+    decorate_batch_generator = decorate_paddle_reader
+    decorate_tensor_provider = decorate_paddle_reader
+
+    def start(self):
+        if self._gen is None:
+            raise RuntimeError("py_reader: no reader decorated")
+        self._iter = iter(self._gen())
+
+    def reset(self):
+        self._iter = None
+
+    def next_feed(self):
+        """The dense replacement for the in-graph dequeue: returns the feed
+        dict for the next batch, or None at end of data."""
+        if self._iter is None:
+            self.start()
+        try:
+            batch = next(self._iter)
+        except StopIteration:
+            self._iter = None
+            return None
+        feed = {}
+        for var, arr in zip(self._vars, batch):
+            feed[var.name] = np.asarray(arr)
+        return feed
+
+    def __iter__(self):
+        self.start()
+        while True:
+            feed = self.next_feed()
+            if feed is None:
+                return
+            yield feed
+
+
+def py_reader(capacity=64, shapes=None, dtypes=None, lod_levels=None,
+              name=None, use_double_buffer=True):
+    names = None
+    if name:
+        names = [f"{name}_{i}" for i in range(len(shapes))]
+    return PyReader(shapes, dtypes, names=names, capacity=capacity,
+                    use_double_buffer=use_double_buffer)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    r = PyReader.__new__(PyReader)
+    r.capacity = capacity
+    r._gen = None
+    r._iter = None
+    r._vars = list(feed_list)
+    return r
+
+
+def read_file(reader):
+    """Returns the reader's data Variables (the dense analogue of the
+    in-graph read op)."""
+    vs = reader._vars
+    return vs[0] if len(vs) == 1 else tuple(vs)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Device prefetch is owned by the DataLoader/prefetch-ring layer;
+    in-graph double buffering is an identity here."""
+    return reader
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load a saved numpy payload into the tensor `out` in place
+    (fluid/layers/io.py load op)."""
+    arr = np.load(file_path, allow_pickle=False)
+    if hasattr(arr, 'files'):   # npz: take the first entry
+        arr = arr[arr.files[0]]
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    target = out.concrete if getattr(out, 'concrete', None) is not None \
+        else out
+    import jax.numpy as jnp
+    target._inplace_value(jnp.asarray(arr).astype(target._value.dtype))
+    return out

@@ -4,6 +4,8 @@ Parity: python/paddle/fluid/layers/*. Maps onto the tensor/nn.functional
 implementations; works in both eager and static-capture modes because every
 op funnels through core.tensor.apply_op.
 """
+import builtins
+
 from ..tensor import *  # noqa
 from ..tensor.math import (elementwise_add, elementwise_sub, elementwise_mul,
                            elementwise_div, elementwise_max, elementwise_min,
@@ -132,6 +134,18 @@ def switch_case(branch_index, branch_fns, default=None):
 from ..vision.ops import (iou_similarity, box_coder, prior_box,  # noqa: E402,F401
                           density_prior_box, anchor_generator, yolo_box,
                           multiclass_nms, roi_align, box_clip, nms)
+
+# detection TRAINING suite (parity: detection.py:110-3954 + nn.py roi/
+# deformable ops) — vision.detection_train
+from ..vision.detection_train import (  # noqa: E402,F401
+    bipartite_match, target_assign, ssd_loss, detection_output,
+    rpn_target_assign, retinanet_target_assign, sigmoid_focal_loss,
+    yolov3_loss, matrix_nms, locality_aware_nms, polygon_box_transform,
+    generate_proposals, generate_proposal_labels, generate_mask_labels,
+    retinanet_detection_output, distribute_fpn_proposals,
+    collect_fpn_proposals, box_decoder_and_assign, multi_box_head,
+    roi_perspective_transform, roi_pool, psroi_pool, prroi_pool,
+    deformable_conv, deformable_roi_pooling)
 
 # CRF stack (parity: fluid/layers/nn.py linear_chain_crf/crf_decoding)
 from ..nn.functional.crf import linear_chain_crf, crf_decoding  # noqa: E402,F401
@@ -461,7 +475,7 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
         # explicit accumulation: the module-level `from ..tensor import *`
         # shadows builtins.sum with the tensor reduction
         out = pad[:, 0:v.shape[1], :] * wv[0]
-        for i in range(1, k):
+        for i in builtins.range(1, k):
             out = out + pad[:, i:i + v.shape[1], :] * wv[i]
         return out
 
@@ -630,6 +644,77 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
         return h_new, reset_h, jnp.concatenate([u, r, c], axis=-1)
 
     return apply_op(fn, (_t(input), _t(hidden), w, b), n_outputs=3)
+
+
+# -- classic 1.8 tails (round-4 completions) ---------------------------------
+
+from .layers_tail import (  # noqa: E402,F401
+    cos_sim, conv3d, pool3d, adaptive_pool2d, adaptive_pool3d, instance_norm,
+    inplace_abn, data_norm, group_norm, spectral_norm, conv2d_transpose,
+    conv3d_transpose, reduce_prod, reduce_all, reduce_any, l2_normalize,
+    lrn, dice_loss, image_resize, image_resize_short, resize_linear,
+    resize_bilinear, resize_trilinear, resize_nearest, random_crop, mean_iou,
+    crop_tensor, selu, elu, relu6, swish, prelu, brelu, soft_relu, pad2d,
+    unique_with_counts, uniform_random_batch_size_like, gaussian_random,
+    sampling_id, gaussian_random_batch_size_like, size, clip_by_norm,
+    maxout, affine_channel, similarity_focus, hash, grid_sampler,
+    merge_selected_rows, get_tensor_from_selected_rows, py_func,
+    continuous_value_model, filter_by_instag, hard_swish, mish,
+    lod_reset, lod_append, autoincreased_step_counter,
+    create_parameter, create_global_var, tensor_array_to_tensor,
+    fill_constant_batch_size_like, has_inf, has_nan, range,
+    mse_loss, center_loss, nce, hsigmoid, teacher_student_sigmoid_loss)
+
+from .sequence_tail import (  # noqa: E402,F401
+    sequence_conv, sequence_first_step, sequence_last_step, sequence_slice,
+    sequence_expand_as, sequence_reshape, sequence_scatter,
+    sequence_enumerate)
+
+from ..nn.functional import sequence_pad, sequence_unpad  # noqa: E402,F401
+
+from .rnn_tail import (RNNCell, GRUCell, LSTMCell, rnn,  # noqa: E402,F401
+                       birnn, dynamic_gru, dynamic_lstmp)
+
+from .lr_schedules import (noam_decay, exponential_decay,  # noqa: E402,F401
+                           natural_exp_decay, inverse_time_decay,
+                           polynomial_decay, piecewise_decay, cosine_decay,
+                           linear_lr_warmup)
+
+from ..distribution import (Uniform, Normal, Categorical,  # noqa: E402,F401
+                            MultivariateNormalDiag)
+
+from .io_ops import (py_reader, create_py_reader_by_data,  # noqa: E402,F401
+                     read_file, double_buffer, load)
+
+def embedding(input, size=None, weight=None, is_sparse=False,
+              is_distributed=False, padding_idx=None, param_attr=None,
+              dtype='float32', name=None):
+    """Dual-form embedding: the 1.8 `size=[vocab, dim]` static form
+    (fluid/layers/nn.py embedding) creates the table; the 2.x `weight=`
+    functional form looks up an existing one."""
+    from ..core.tensor import Tensor as _Tensor
+    from ..nn import functional as F
+    if weight is None and isinstance(size, _Tensor):
+        # functional form called positionally: embedding(ids, weight_tensor)
+        size, weight = None, size
+    if weight is not None:
+        return F.embedding(input, weight, padding_idx=padding_idx)
+    if size is None:
+        raise ValueError("embedding: pass size=[vocab, dim] (1.8 form) or "
+                         "weight= (functional form)")
+    return static_embedding(input, size, is_sparse=is_sparse,
+                            padding_idx=padding_idx, param_attr=param_attr,
+                            dtype=dtype)
+
+
+# classic control-flow classes; their increment/assign/less_than (etc.)
+# overrides add the 1.8 in-place/cond= write-back forms, so they must win
+# over the plain tensor-lib re-exports above
+from .control_flow import (While, Switch, IfElse, StaticRNN,  # noqa: E402,F401
+                           DynamicRNN, Print, Assert,
+                           reorder_lod_tensor_by_rank,
+                           increment, assign, less_than, less_equal,
+                           greater_than, greater_equal, equal, not_equal)
 
 
 def create_array(dtype='float32'):

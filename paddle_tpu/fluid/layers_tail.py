@@ -1,0 +1,1026 @@
+"""Classic fluid.layers op tail: the 1.8-era nn.py / tensor.py / loss.py
+names not covered by the 2.x-style functional library.
+
+Parity citations (all /root/reference/python/paddle/fluid/layers unless
+noted): nn.py (cos_sim:1142, conv3d:2292, pool3d:2971, adaptive_pool2d:3366,
+adaptive_pool3d:3483, instance_norm:3102, data_norm:3183, group_norm:4061,
+spectral_norm:4175, conv2d_transpose:4292, conv3d_transpose:4529,
+reduce_prod:5200, reduce_all:5263, reduce_any:5320, l2_normalize:5530,
+lrn:6966, dice_loss:7052, image_resize:7112, resize_bilinear:7648,
+resize_trilinear:7783, resize_nearest:7916, image_resize_short:8035,
+random_crop:8583, mean_iou:8519, relu6:9928, pow:9969, hard_sigmoid,
+swish:10098, prelu:10182, brelu:10251, soft_relu:10302, selu, elu,
+pad2d:9395, unique_with_counts, uniform_random_batch_size_like:10797,
+gaussian_random:10877, sampling_id:10960 (+operators/sampling_id_op.h),
+gaussian_random_batch_size_like:11009, size:12200, clip_by_norm:12304,
+maxout, affine_channel:13133, similarity_focus:13221
+(+operators/similarity_focus_op.h), hash:13370, grid_sampler:13421,
+py_func:13509, continuous_value_model (+operators/cvm_op.h),
+filter_by_instag, hard_swish:14112, mish:14172, merge_selected_rows,
+get_tensor_from_selected_rows, autoincreased_step_counter:7008, lod_reset,
+lod_append, inplace_abn); tensor.py (create_parameter:65,
+create_global_var:125, tensor_array_to_tensor:236,
+fill_constant_batch_size_like:700, has_inf/has_nan, range);
+loss.py (center_loss:54, nce:671, hsigmoid:886, mse_loss,
+teacher_student_sigmoid_loss:1496 + operators/teacher_student_sigmoid_loss_op.h).
+
+TPU-first design notes: every op funnels through core.tensor.apply_op so it
+works eagerly, under to_static tracing, and under static Program capture.
+LoD-era ops take dense padded tensors (+ lengths where the reference used
+LoD); host-dynamic ops (unique_with_counts, filter_by_instag) are eager-only
+because XLA requires static shapes.
+"""
+import builtins
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter, apply_op, to_tensor
+from ..tensor._helpers import _t
+from ..core.dtypes import convert_dtype
+
+
+def _op_param(shape, attr, default_init, name, dtype='float32'):
+    """Create a Parameter for a function-style op honoring ParamAttr."""
+    from ..nn.initializer import ParamAttr
+    a = ParamAttr._to_attr(attr)
+    init = a.initializer or default_init
+    value = jnp.asarray(init(list(shape), dtype=dtype))
+    return Parameter(value, name=a.name or name, trainable=a.trainable,
+                     regularizer=a.regularizer)
+
+
+def _act(out, act):
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+# --------------------------------------------------------------------------
+# nn.py: norm / conv / pool static-style layers
+# --------------------------------------------------------------------------
+
+def cos_sim(X, Y):
+    """Cosine similarity along dim 1, output (N, 1) (nn.py:1142)."""
+    def fn(xv, yv):
+        num = (xv * yv).sum(axis=1, keepdims=True)
+        den = jnp.sqrt((xv * xv).sum(axis=1, keepdims=True)) * \
+            jnp.sqrt((yv * yv).sum(axis=1, keepdims=True))
+        return num / jnp.maximum(den, 1e-12)
+    return apply_op(fn, (_t(X), _t(Y)))
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    from .. import nn as _nn
+    in_ch = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = _nn.Conv3D(in_ch, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    return _act(layer(input), act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format='NCHW'):
+    from .. import nn as _nn
+    in_ch = input.shape[1] if data_format == 'NCHW' else input.shape[-1]
+    if filter_size is None:
+        raise ValueError("conv2d_transpose: filter_size inference from "
+                         "output_size is not supported; pass filter_size")
+    layer = _nn.Conv2DTranspose(in_ch, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups,
+                                weight_attr=param_attr, bias_attr=bias_attr,
+                                data_format=data_format)
+    out = layer(input, output_size=output_size)
+    return _act(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format='NCDHW'):
+    from .. import nn as _nn
+    in_ch = input.shape[1] if data_format == 'NCDHW' else input.shape[-1]
+    if filter_size is None:
+        raise ValueError("conv3d_transpose: pass filter_size explicitly")
+    layer = _nn.Conv3DTranspose(in_ch, num_filters, filter_size,
+                                stride=stride, padding=padding,
+                                dilation=dilation, groups=groups,
+                                weight_attr=param_attr, bias_attr=bias_attr,
+                                data_format=data_format)
+    out = layer(input, output_size=output_size)
+    return _act(out, act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format="NCDHW"):
+    from ..nn import functional as F
+    if global_pooling:
+        return F.global_pool(input, 'avg' if pool_type == 'avg' else 'max',
+                             data_format)
+    fn = F.max_pool3d if pool_type == "max" else F.avg_pool3d
+    return fn(input, pool_size, pool_stride, pool_padding,
+              ceil_mode=ceil_mode, data_format=data_format)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    from ..nn import functional as F
+    if pool_type == "max":
+        if require_index:
+            return F.adaptive_max_pool2d(input, pool_size,
+                                         return_mask=True)
+        return F.adaptive_max_pool2d(input, pool_size)
+    return F.adaptive_avg_pool2d(input, pool_size)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    from ..nn import functional as F
+    if pool_type == "max":
+        if require_index:
+            return F.adaptive_max_pool3d(input, pool_size,
+                                         return_mask=True)
+        return F.adaptive_max_pool3d(input, pool_size)
+    return F.adaptive_avg_pool3d(input, pool_size)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn as _nn
+    ch = input.shape[1]
+    cls = {3: _nn.InstanceNorm1D, 4: _nn.InstanceNorm2D,
+           5: _nn.InstanceNorm3D}[input.ndim]
+    layer = cls(ch, epsilon=epsilon, weight_attr=param_attr,
+                bias_attr=bias_attr)
+    return layer(input)
+
+
+def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+                param_attr=None, bias_attr=None, data_layout='NCHW',
+                name=None, act_alpha=1.0, **kwargs):
+    """Activated batch norm (nn.py inplace_abn): BN + activation. XLA fuses
+    the pair anyway, so "in-place" is purely a memory note here."""
+    from ..static.nn import batch_norm as _bn
+    out = _bn(input, momentum=momentum, epsilon=epsilon,
+              param_attr=param_attr, bias_attr=bias_attr,
+              data_layout=data_layout, is_test=is_test)
+    if act == 'leaky_relu':
+        from ..nn import functional as F
+        return F.leaky_relu(out, negative_slope=act_alpha)
+    if act == 'elu':
+        from ..nn import functional as F
+        return F.elu(out, alpha=act_alpha)
+    return _act(out, act)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout='NCHW', in_place=False, name=None, moving_mean_name=None,
+              moving_variance_name=None, do_model_average_for_mean_and_var=True,
+              slot_dim=-1, sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """CTR data normalization (nn.py:3183): learned batch statistics
+    accumulators (batch_size/batch_sum/batch_square_sum) normalize x to
+    zero-mean unit-variance; unlike batch_norm there are no scale/shift by
+    default and the statistics ARE the parameters."""
+    D = input.shape[-1]
+    from ..nn.initializer import Constant
+    pa = param_attr if isinstance(param_attr, dict) else {}
+    bsize = _op_param([D], pa.get('batch_size', None), Constant(1e4),
+                      'data_norm_batch_size')
+    bsum = _op_param([D], pa.get('batch_sum', None), Constant(0.0),
+                     'data_norm_batch_sum')
+    bsqs = _op_param([D], pa.get('batch_square_sum', None), Constant(1e4),
+                     'data_norm_batch_square_sum')
+
+    def fn(xv, n, s, sq):
+        # reference data_norm_op.cc:302: mean = sum/size, scale =
+        # sqrt(size / square_sum) — NO mean-square correction
+        mean = s / n
+        scale = jnp.sqrt(n / jnp.maximum(sq, epsilon))
+        return (xv - mean) * scale
+
+    out = apply_op(fn, (_t(input), bsize, bsum, bsqs))
+    return _act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout='NCHW', name=None):
+    from .. import nn as _nn
+    ch = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    layer = _nn.GroupNorm(groups, ch, epsilon=epsilon,
+                          weight_attr=param_attr, bias_attr=bias_attr,
+                          data_format=data_layout)
+    return _act(layer(input), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization of a weight tensor (nn.py:4175): returns
+    weight / sigma_max estimated by power iteration. The u/v vectors are
+    re-initialized deterministically per call (seeded by shape) — the
+    reference keeps persistable u/v; with power_iters iterations from a
+    fixed start the estimate is deterministic and convergent."""
+    w = _t(weight)
+    h = w.shape[dim]
+
+    def fn(wv):
+        wm = jnp.moveaxis(wv, dim, 0).reshape(h, -1)
+        key = jax.random.PRNGKey(h * 2654435761 % (2**31))
+        u = jax.random.normal(key, (h,), wm.dtype)
+        v = None
+        for _ in builtins.range(max(power_iters, 1)):
+            v = wm.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = wm @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ wm @ v
+        return wv / sigma
+
+    return apply_op(fn, (w,))
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format='NCHW'):
+    from ..nn import functional as F
+    return F.local_response_norm(input, n, alpha=alpha, beta=beta, k=k,
+                                 data_format=data_format)
+
+
+# --------------------------------------------------------------------------
+# nn.py: reductions / elementwise tails
+# --------------------------------------------------------------------------
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    from ..tensor.math import prod as _prod
+    return _prod(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    def fn(v):
+        return jnp.all(v, axis=tuple(dim) if isinstance(dim, (list, tuple))
+                       else dim, keepdims=keep_dim)
+    return apply_op(fn, (_t(input),), differentiable=False)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    def fn(v):
+        return jnp.any(v, axis=tuple(dim) if isinstance(dim, (list, tuple))
+                       else dim, keepdims=keep_dim)
+    return apply_op(fn, (_t(input),), differentiable=False)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    def fn(v):
+        ssum = jnp.sum(v * v, axis=axis, keepdims=True)
+        return v / jnp.sqrt(jnp.maximum(ssum, epsilon))
+    return apply_op(fn, (_t(x),))
+
+
+def size(input):
+    """Number of elements as a scalar int tensor (nn.py:12200; int32 here
+    — the x64-disabled TPU-first dtype divergence)."""
+    def fn(v):
+        return jnp.asarray(int(np.prod(v.shape)) if v.shape else 1,
+                           jnp.int32)
+    return apply_op(fn, (_t(input),), differentiable=False)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    def fn(v):
+        norm = jnp.sqrt(jnp.sum(v * v))
+        return v * (max_norm / jnp.maximum(norm, max_norm))
+    return apply_op(fn, (_t(x),))
+
+
+def affine_channel(x, scale=None, bias=None, data_layout='NCHW', act=None,
+                   name=None):
+    """Per-channel x*scale + bias (nn.py:13133)."""
+    nchw = (data_layout == 'NCHW' and x.ndim == 4)
+
+    def fn(v, sv, bv):
+        if nchw:
+            sv = sv.reshape(1, -1, 1, 1)
+            bv = bv.reshape(1, -1, 1, 1)
+        return v * sv + bv
+
+    return _act(apply_op(fn, (_t(x), _t(scale), _t(bias))), act)
+
+
+# --------------------------------------------------------------------------
+# nn.py: activations with 1.8 signatures
+# --------------------------------------------------------------------------
+
+def selu(x, scale=None, alpha=None, name=None):
+    kw = {}
+    if scale is not None:
+        kw['scale'] = scale
+    if alpha is not None:
+        kw['alpha'] = alpha
+    from ..nn import functional as F
+    return F.selu(x, **kw)
+
+
+def elu(x, alpha=1.0, name=None):
+    from ..nn import functional as F
+    return F.elu(x, alpha=alpha)
+
+
+def relu6(x, threshold=6.0, name=None):
+    def fn(v):
+        return jnp.clip(v, 0.0, threshold)
+    return apply_op(fn, (_t(x),))
+
+
+def swish(x, beta=1.0, name=None):
+    def fn(v):
+        return v * jax.nn.sigmoid(beta * v)
+    return apply_op(fn, (_t(x),))
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    """PReLU with learned alpha; mode in {'all','channel','element'}
+    (nn.py:10182)."""
+    from ..nn.initializer import Constant
+    if mode == 'all':
+        shape = [1]
+    elif mode == 'channel':
+        shape = [x.shape[1]]
+    elif mode == 'element':
+        shape = list(x.shape[1:])
+    else:
+        raise ValueError(f"prelu mode {mode!r}")
+    alpha = _op_param(shape, param_attr, Constant(0.25), 'prelu_alpha')
+
+    def fn(v, av):
+        if mode == 'channel' and v.ndim > 2:
+            av = av.reshape((1, -1) + (1,) * (v.ndim - 2))
+        return jnp.where(v > 0, v, av * v)
+
+    return apply_op(fn, (_t(x), alpha))
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    def fn(v):
+        return jnp.clip(v, t_min, t_max)
+    return apply_op(fn, (_t(x),))
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    def fn(v):
+        return jnp.log1p(jnp.exp(jnp.clip(v, -threshold, threshold)))
+    return apply_op(fn, (_t(x),))
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    def fn(v):
+        return v * jnp.clip(v + offset, 0.0, threshold) / scale
+    return apply_op(fn, (_t(x),))
+
+
+def mish(x, threshold=20.0, name=None):
+    from ..nn import functional as F
+    return F.mish(x)
+
+
+def maxout(x, groups, name=None, axis=1):
+    from ..nn import functional as F
+    return F.maxout(x, groups, axis=axis)
+
+
+# --------------------------------------------------------------------------
+# nn.py: resize family
+# --------------------------------------------------------------------------
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample='BILINEAR', actual_shape=None, align_corners=True,
+                 align_mode=1, data_format='NCHW'):
+    from ..nn import functional as F
+    mode = {'BILINEAR': 'bilinear', 'TRILINEAR': 'trilinear',
+            'NEAREST': 'nearest', 'BICUBIC': 'bicubic',
+            'LINEAR': 'linear'}[resample.upper()]
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode=mode, align_corners=align_corners,
+                         align_mode=align_mode, data_format=data_format)
+
+
+def image_resize_short(input, out_short_len, resample='BILINEAR'):
+    H, W = input.shape[2], input.shape[3]
+    short, = [min(H, W)]
+    ratio = out_short_len / short
+    return image_resize(input, out_shape=[int(round(H * ratio)),
+                                          int(round(W * ratio))],
+                        resample=resample)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  align_corners=True, align_mode=1, data_format='NCW'):
+    from ..nn import functional as F
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode='linear', align_corners=align_corners,
+                         align_mode=align_mode, data_format=data_format)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format='NCHW'):
+    return image_resize(input, out_shape, scale, name, 'BILINEAR',
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format='NCDHW'):
+    return image_resize(input, out_shape, scale, name, 'TRILINEAR',
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format='NCHW'):
+    return image_resize(input, out_shape, scale, name, 'NEAREST',
+                        actual_shape, align_corners, 1, data_format)
+
+
+# --------------------------------------------------------------------------
+# nn.py: vision misc
+# --------------------------------------------------------------------------
+
+def random_crop(x, shape, seed=None):
+    """Per-sample random crop to `shape` (excludes batch dim; nn.py:8583)."""
+    from ..core.rng import next_key
+    key = next_key() if seed is None else jax.random.PRNGKey(int(seed))
+
+    def fn(v):
+        B = v.shape[0]
+        starts = []
+        for d in builtins.range(1, v.ndim):
+            maxs = v.shape[d] - shape[d - 1]
+            dkey = jax.random.fold_in(key, d)
+            starts.append(jax.random.randint(dkey, (B,), 0, maxs + 1))
+
+        def crop_one(sample, st):
+            return jax.lax.dynamic_slice(sample, tuple(st), tuple(shape))
+        return jax.vmap(crop_one)(v, jnp.stack(starts, axis=1))
+
+    return apply_op(fn, (_t(x),), differentiable=False)
+
+
+def mean_iou(input, label, num_classes):
+    """Mean IoU over classes; returns (mean_iou, out_wrong, out_correct)
+    (nn.py:8519)."""
+    def fn(pv, lv):
+        p = pv.reshape(-1).astype(jnp.int32)
+        t = lv.reshape(-1).astype(jnp.int32)
+        correct_mask = (p == t)
+        out_correct = jnp.zeros(num_classes, jnp.int32).at[
+            jnp.where(correct_mask, t, num_classes)].add(
+                1, mode='drop', indices_are_sorted=False)
+        out_wrong = jnp.zeros(num_classes, jnp.int32).at[
+            jnp.where(~correct_mask, t, num_classes)].add(1, mode='drop')
+        out_wrong = out_wrong + jnp.zeros(num_classes, jnp.int32).at[
+            jnp.where(~correct_mask, p, num_classes)].add(1, mode='drop')
+        denom = out_wrong + out_correct
+        valid = denom > 0
+        iou = jnp.where(valid, out_correct / jnp.maximum(denom, 1), 0.0)
+        miou = iou.sum() / jnp.maximum(valid.sum(), 1)
+        return miou.astype(jnp.float32), out_wrong, out_correct
+
+    return apply_op(fn, (_t(input), _t(label)), n_outputs=3,
+                    differentiable=False)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """Crop x to `shape` starting at `offsets` (nn.py crop_tensor)."""
+    xs = _t(x)
+    if offsets is None:
+        offsets = [0] * xs.ndim
+    shape = [xs.shape[i] if (s is None or s == -1) else int(s)
+             for i, s in enumerate(shape)]
+
+    def fn(v):
+        return jax.lax.dynamic_slice(v, tuple(int(o) for o in offsets),
+                                     tuple(shape))
+    return apply_op(fn, (xs,))
+
+
+def pad2d(input, paddings=[0, 0, 0, 0], mode='constant', pad_value=0.0,
+          data_format="NCHW", name=None):
+    """paddings = [top, bottom, left, right] (nn.py pad2d)."""
+    t, b, l, r = [int(p) for p in paddings]
+    jmode = {'constant': 'constant', 'reflect': 'reflect',
+             'edge': 'edge'}[mode]
+
+    def fn(v):
+        if data_format == "NCHW":
+            pads = [(0, 0), (0, 0), (t, b), (l, r)]
+        else:
+            pads = [(0, 0), (t, b), (l, r), (0, 0)]
+        if jmode == 'constant':
+            return jnp.pad(v, pads, constant_values=pad_value)
+        return jnp.pad(v, pads, mode=jmode)
+
+    return apply_op(fn, (_t(input),))
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """Similarity-focus mask (operators/similarity_focus_op.h): for each
+    sample and each selected channel along `axis`, greedily mark per-row and
+    per-column maxima of the (A, B) slice; output is a {0,1} mask of the
+    input's shape, broadcast over `axis`."""
+    if axis not in (1, 2, 3):
+        raise ValueError("similarity_focus: axis must be 1, 2 or 3")
+
+    def fn(v):
+        x = jnp.moveaxis(v, axis, 1)          # (N, C, A, B)
+        sel = x[:, jnp.asarray(indexes, jnp.int32)]   # (N, K, A, B)
+        N, K, A, B = sel.shape
+
+        def one_slice(s):
+            # greedy: iterate min(A,B) times, pick the global max not in a
+            # used row/col, mark it
+            def body(carry, _):
+                used_r, used_c, mask = carry
+                neg = jnp.where(used_r[:, None] | used_c[None, :],
+                                -jnp.inf, s)
+                flat = jnp.argmax(neg)
+                r, c = flat // B, flat % B
+                mask = mask.at[r, c].set(1.0)
+                return (used_r.at[r].set(True), used_c.at[c].set(True),
+                        mask), None
+            init = (jnp.zeros(A, bool), jnp.zeros(B, bool),
+                    jnp.zeros((A, B), jnp.float32))
+            (ur, uc, mask), _ = jax.lax.scan(body, init, None,
+                                             length=min(A, B))
+            return mask
+
+        masks = jax.vmap(jax.vmap(one_slice))(sel)     # (N, K, A, B)
+        merged = masks.max(axis=1)                     # (N, A, B)
+        out = jnp.broadcast_to(merged[:, None], x.shape).astype(v.dtype)
+        return jnp.moveaxis(out, 1, axis)
+
+    return apply_op(fn, (_t(input),), differentiable=False)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """Deterministic feature hashing of int rows into [0, hash_size)
+    (nn.py:13370). Divergence: the reference uses xxhash over raw bytes; we
+    use a multiply-shift hash family (same contract: num_hash deterministic
+    buckets per row)."""
+    def fn(v):
+        x = v.astype(jnp.uint32)
+        row = jnp.zeros(x.shape[:-1], jnp.uint32)
+        for j in builtins.range(x.shape[-1]):
+            row = row * jnp.uint32(1000003) + x[..., j]
+        seeds = (jnp.arange(1, num_hash + 1, dtype=jnp.uint32) *
+                 jnp.uint32(2654435761))
+        h = row[..., None] * seeds
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(2246822519)
+        h = h ^ (h >> 13)
+        return (h % jnp.uint32(hash_size)).astype(jnp.int32)
+
+    return apply_op(fn, (_t(input),), differentiable=False)
+
+
+def grid_sampler(x, grid, name=None):
+    from ..nn import functional as F
+    return F.grid_sample(x, grid)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """CTR CVM op (operators/cvm_op.h): first two columns are show/click;
+    use_cvm=True keeps width D with log-transformed counters, False strips
+    them (width D-2)."""
+    def fn(xv, cv):
+        if use_cvm:
+            c0 = jnp.log(xv[:, 0:1] + 1)
+            c1 = jnp.log(xv[:, 1:2] + 1) - c0
+            return jnp.concatenate([c0, c1, xv[:, 2:]], axis=1)
+        return xv[:, 2:]
+    return apply_op(fn, (_t(input), _t(cvm)))
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod, out_val_if_empty=0):
+    """Filter rows whose tag set intersects filter_tag (eager-only: output
+    row count is data-dependent, which XLA cannot express). Returns
+    (filtered rows, kept row indices, loss_weight)."""
+    iv = np.asarray(_t(ins).numpy())
+    tv = np.asarray(_t(ins_tag).numpy()).reshape(len(iv), -1)
+    fv = set(np.asarray(_t(filter_tag).numpy()).reshape(-1).tolist())
+    keep = [i for i in builtins.range(len(iv))
+            if fv.intersection(tv[i].tolist())]
+    if keep:
+        out = iv[keep]
+        lw = np.ones((len(keep), 1), np.float32)
+    else:
+        out = np.full((1,) + iv.shape[1:], out_val_if_empty, iv.dtype)
+        lw = np.zeros((1, 1), np.float32)
+        keep = [0]
+    return (to_tensor(out), to_tensor(np.asarray(keep, np.int32)),
+            to_tensor(lw))
+
+
+def unique_with_counts(x, dtype='int32'):
+    """Eager-only (dynamic output shape): returns (unique, index, count)
+    like the reference (out, index-of-each-input, counts)."""
+    xv = np.asarray(_t(x).numpy()).reshape(-1)
+    uniq, inv, counts = np.unique(xv, return_inverse=True,
+                                  return_counts=True)
+    dt = convert_dtype(dtype)
+    return (to_tensor(uniq), to_tensor(inv.astype(dt)),
+            to_tensor(counts.astype(dt)))
+
+
+# --------------------------------------------------------------------------
+# nn.py: random families
+# --------------------------------------------------------------------------
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype='float32',
+                    name=None):
+    from ..tensor.random import gaussian
+    return gaussian(shape, mean=mean, std=std, dtype=dtype)
+
+
+def uniform_random_batch_size_like(input, shape, dtype='float32',
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    from ..tensor.random import uniform
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype='float32'):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return gaussian_random(shape, mean=mean, std=std, seed=seed, dtype=dtype)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype='float32'):
+    """Inverse-CDF sampling over probability rows
+    (operators/sampling_id_op.h): r ~ U[min,max); id = first j with
+    cumsum(row)[j] > r."""
+    from ..core.rng import next_key
+    key = next_key() if not seed else jax.random.PRNGKey(int(seed))
+
+    def fn(pv):
+        B, C = pv.shape
+        r = jax.random.uniform(key, (B,), pv.dtype, min, max)
+        cum = jnp.cumsum(pv, axis=1)
+        idx = jnp.sum(cum < r[:, None], axis=1)
+        return jnp.clip(idx, 0, C - 1).astype(jnp.int32)
+
+    return apply_op(fn, (_t(x),), differentiable=False)
+
+
+# --------------------------------------------------------------------------
+# nn.py: SelectedRows / LoD bridge no-ops + step counter + py_func
+# --------------------------------------------------------------------------
+
+def merge_selected_rows(x, name=None):
+    """SelectedRows don't exist in the dense TPU design (sparse grads are
+    dense rows): identity."""
+    return x
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return x
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """LoD is represented as explicit lengths/masks in the dense design;
+    resetting LoD metadata is an identity on the payload."""
+    return x
+
+
+def lod_append(x, level):
+    return x
+
+
+_step_counters = {}
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Global step counter (nn.py:7008). TPU-first divergence: the counter
+    lives host-side (a python int advanced once per call) instead of as a
+    graph-resident persistable var — schedulers read it between steps, so
+    the observable sequence matches."""
+    name = counter_name or '@STEP_COUNTER@'
+    val = _step_counters.get(name, begin - step) + step
+    _step_counters[name] = val
+    return to_tensor(np.asarray([val], np.int32))
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Call an arbitrary python function as an op (nn.py:13509). Works under
+    jit via jax.pure_callback; `out` is a template tensor (or list) giving
+    the output shapes/dtypes. backward_func, if given, supplies the VJP the
+    same way."""
+    xs = [x] if isinstance(x, Tensor) else list(x)
+    outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), convert_dtype(
+        np.dtype(o.dtype).name)) for o in outs]
+    n = len(shapes)
+
+    def host_fn(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return tuple(np.asarray(r, s.dtype) for r, s in zip(res, shapes))
+
+    if backward_func is None:
+        def fn(*vals):
+            res = jax.pure_callback(host_fn, tuple(shapes), *vals)
+            return res[0] if n == 1 else tuple(res)
+        return apply_op(fn, tuple(_t(v) for v in xs), n_outputs=n,
+                        differentiable=False)
+
+    in_shapes = [jax.ShapeDtypeStruct(tuple(v.shape),
+                                      convert_dtype(np.dtype(v.dtype).name))
+                 for v in xs]
+
+    def bwd_host(*vals):
+        res = backward_func(*[np.asarray(v) for v in vals])
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return tuple(np.asarray(r, s.dtype) for r, s in zip(res, in_shapes))
+
+    @jax.custom_vjp
+    def core(*vals):
+        res = jax.pure_callback(host_fn, tuple(shapes), *vals)
+        return res[0] if n == 1 else tuple(res)
+
+    def core_fwd(*vals):
+        return core(*vals), vals
+
+    def core_bwd(vals, g):
+        gs = (g,) if n == 1 else tuple(g)
+        grads = jax.pure_callback(bwd_host, tuple(in_shapes), *vals, *gs)
+        return tuple(grads)
+
+    core.defvjp(core_fwd, core_bwd)
+    return apply_op(core, tuple(_t(v) for v in xs), n_outputs=n)
+
+
+# --------------------------------------------------------------------------
+# tensor.py tail
+# --------------------------------------------------------------------------
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.initializer import Constant, XavierUniform
+    default = default_initializer or (Constant(0.0) if is_bias
+                                      else XavierUniform())
+    return _op_param(shape, attr, default, name or 'param',
+                     dtype=np.dtype(convert_dtype(dtype)).name)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    from ..core.tensor import Parameter
+    v = jnp.full(tuple(int(s) for s in shape), value, convert_dtype(dtype))
+    p = Parameter(v, name=name or 'global_var', trainable=False)
+    p.stop_gradient = True
+    return p
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Concat/stack a LoDTensorArray (a python list here); returns
+    (tensor, per-element sizes)."""
+    from ..tensor.manipulation import concat, stack
+    arr = [t for t in input if t is not None]
+    sizes = np.asarray([t.shape[axis] if not use_stack else 1
+                        for t in arr], np.int32)
+    out = stack(arr, axis=axis) if use_stack else concat(arr, axis=axis)
+    return out, to_tensor(sizes)
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    from ..tensor.creation import full
+    return full(shape, value, dtype=dtype)
+
+
+def has_inf(x):
+    def fn(v):
+        return jnp.isinf(v).any()
+    return apply_op(fn, (_t(x),), differentiable=False)
+
+
+def has_nan(x):
+    def fn(v):
+        return jnp.isnan(v).any()
+    return apply_op(fn, (_t(x),), differentiable=False)
+
+
+def range(start, end, step, dtype, name=None):
+    from ..tensor.creation import arange
+    return arange(start, end, step, dtype=dtype)
+
+
+# --------------------------------------------------------------------------
+# loss.py tail
+# --------------------------------------------------------------------------
+
+def mse_loss(input, label):
+    def fn(iv, lv):
+        return jnp.mean((iv - lv) ** 2)
+    return apply_op(fn, (_t(input), _t(label)))
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """1 - 2*|X∩Y| / (|X|+|Y|), label one-hot over the last dim
+    (nn.py:7052)."""
+    C = input.shape[-1]
+
+    def fn(iv, lv):
+        lab = jax.nn.one_hot(lv.astype(jnp.int32).squeeze(-1), C,
+                             dtype=iv.dtype)
+        red = tuple(np.arange(1, iv.ndim))
+        inse = jnp.sum(iv * lab, axis=red)
+        denom = jnp.sum(iv, axis=red) + jnp.sum(lab, axis=red)
+        return jnp.mean(1.0 - (2.0 * inse + epsilon) / (denom + epsilon))
+
+    return apply_op(fn, (_t(input), _t(label)))
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """Exact piecewise kernel from
+    operators/teacher_student_sigmoid_loss_op.h (label encodes clk and the
+    optional teacher score: {-2, -1, [0, 2]})."""
+    def fn(xv, lv):
+        # forward uses RAW x — the reference applies the soft_max bounds
+        # only in the gradient kernel (teacher_student_sigmoid_loss_op.h)
+        x = xv
+        sp = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        student0 = sp                 # clk=0 student ce
+        student1 = sp - x             # clk=1 student ce
+        lvf = lv.astype(x.dtype)
+        case_m2 = student0
+        case_m1 = student1
+        case_0 = student0 + sp - x * lvf
+        case_1 = student1 + sp - x * (lvf - 1.0)
+        out = jnp.where(lvf < -1.0, case_m2,
+                        jnp.where(lvf < 0.0, case_m1,
+                                  jnp.where(lvf < 1.0, case_0, case_1)))
+        return out
+
+    return apply_op(fn, (_t(input), _t(label)))
+
+
+def center_loss(input, label, num_classes, alpha, param_attr,
+                update_center=True):
+    """0.5*||x - center_{y}||^2 per sample, (N,1) (loss.py:54). Centers are
+    a non-trainable parameter; in eager mode they are updated in place with
+    the reference's rule (diff averaged by class count, scaled by alpha)."""
+    from ..nn.initializer import XavierUniform
+    D = input.shape[1]
+    centers = _op_param([num_classes, D], param_attr, XavierUniform(),
+                        'center_loss_centers')
+    centers.stop_gradient = True
+    centers.trainable = False
+
+    x = _t(input)
+    lab = _t(label)
+
+    def fn(xv, lv, cv):
+        idx = lv.astype(jnp.int32).reshape(-1)
+        c = cv[idx]
+        return 0.5 * jnp.sum((xv - c) ** 2, axis=1, keepdims=True)
+
+    out = apply_op(fn, (x, lab, centers))
+
+    if update_center and not getattr(x, '_symbolic', False) and \
+            not isinstance(x._value, jax.core.Tracer):
+        a = float(alpha.item()) if isinstance(alpha, Tensor) else float(alpha)
+        xv, lv, cv = x._value, lab._value, centers._value
+        idx = lv.astype(jnp.int32).reshape(-1)
+        diff = cv[idx] - xv
+        counts = jnp.zeros(num_classes, xv.dtype).at[idx].add(1.0)
+        upd = jnp.zeros_like(cv).at[idx].add(diff)
+        upd = upd / (1.0 + counts)[:, None]
+        centers._inplace_value(cv - a * upd)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss, (N,1) (loss.py:671): binary
+    logistic regression of the true class against num_neg_samples sampled
+    noise classes. Samplers: uniform / log_uniform / custom_dist."""
+    from ..nn.initializer import XavierUniform, Constant
+    from ..core.rng import next_key
+    D = input.shape[1]
+    num_neg = int(num_neg_samples or 10)
+    weight = _op_param([num_total_classes, D], param_attr, XavierUniform(),
+                       'nce_weight')
+    bias = _op_param([num_total_classes], bias_attr, Constant(0.0),
+                     'nce_bias')
+    key = jax.random.PRNGKey(int(seed)) if seed else next_key()
+
+    if sampler == "custom_dist":
+        probs = jnp.asarray(np.asarray(custom_dist, np.float32))
+        probs = probs / probs.sum()
+        logq = jnp.log(jnp.maximum(probs, 1e-20))
+    elif sampler == "log_uniform":
+        ranks = jnp.arange(num_total_classes, dtype=jnp.float32)
+        probs = jnp.log1p(1.0 / (ranks + 1.0)) / math.log(
+            num_total_classes + 1.0)
+        logq = jnp.log(jnp.maximum(probs, 1e-20))
+    else:
+        probs = None
+        logq = jnp.full((num_total_classes,),
+                        -math.log(num_total_classes), jnp.float32)
+
+    def fn(xv, lv, wv, bv):
+        B = xv.shape[0]
+        if probs is None:
+            negs = jax.random.randint(key, (B, num_neg), 0,
+                                      num_total_classes)
+        else:
+            negs = jax.random.categorical(
+                key, jnp.log(jnp.maximum(probs, 1e-20)),
+                shape=(B, num_neg))
+        pos = lv.astype(jnp.int32).reshape(B, 1)
+        ids = jnp.concatenate([pos, negs], axis=1)        # (B, 1+K)
+        w = wv[ids]                                       # (B, 1+K, D)
+        logits = jnp.einsum('bd,bkd->bk', xv, w) + bv[ids]
+        # subtract log-expected-count under the sampler (NCE correction)
+        logits = logits - (logq[ids] + math.log(num_neg))
+        sp = jnp.maximum(logits, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        pos_loss = sp[:, 0] - logits[:, 0]                # -log sigmoid(s+)
+        neg_loss = sp[:, 1:].sum(axis=1)                  # -log sigmoid(-s-)
+        return (pos_loss + neg_loss)[:, None]
+
+    return apply_op(fn, (_t(input), _t(label), weight, bias))
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid loss, (N,1) (loss.py:886). Default mode uses a
+    complete binary tree in heap order (leaf of class c at node c +
+    num_classes, codes from the bit path) — same as the reference's
+    non-custom tree; is_custom takes padded path_table/path_code (-1 pads).
+    """
+    from ..nn.initializer import XavierUniform, Constant
+    D = input.shape[1]
+    n_nodes = num_classes - 1
+    weight = _op_param([max(n_nodes, 1), D], param_attr, XavierUniform(),
+                       'hsigmoid_w')
+    bias = _op_param([max(n_nodes, 1)], bias_attr, Constant(0.0),
+                     'hsigmoid_b')
+    depth = max(int(math.ceil(math.log2(max(num_classes, 2)))), 1)
+
+    if is_custom:
+        pt = _t(path_table)
+        pc = _t(path_code)
+
+        def fn(xv, lv, wv, bv, ptv, pcv):
+            nodes = ptv.astype(jnp.int32)
+            codes = pcv.astype(xv.dtype)
+            valid = (nodes >= 0)
+            nid = jnp.maximum(nodes, 0)
+            s = jnp.einsum('bd,bkd->bk', xv, wv[nid]) + bv[nid]
+            sgn = 1.0 - 2.0 * codes          # code 0 -> +1, 1 -> -1
+            z = sgn * s
+            sp = jnp.maximum(-z, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(z)))
+            return jnp.where(valid, sp, 0.0).sum(axis=1, keepdims=True)
+
+        return apply_op(fn, (_t(input), _t(label), weight, bias, pt, pc))
+
+    def fn(xv, lv, wv, bv):
+        leaf = lv.astype(jnp.int32).reshape(-1) + num_classes   # heap id
+        losses = jnp.zeros((xv.shape[0],), xv.dtype)
+        node = leaf
+        for _ in builtins.range(depth):
+            code = (node % 2).astype(xv.dtype)   # right child -> 1
+            parent = node // 2
+            valid = parent >= 1
+            nid = jnp.clip(parent - 1, 0, max(n_nodes - 1, 0))
+            s = jnp.einsum('bd,bd->b', xv, wv[nid]) + bv[nid]
+            sgn = 1.0 - 2.0 * code
+            z = sgn * s
+            sp = jnp.maximum(-z, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(z)))
+            losses = losses + jnp.where(valid, sp, 0.0)
+            node = parent
+        return losses[:, None]
+
+    return apply_op(fn, (_t(input), _t(label), weight, bias))

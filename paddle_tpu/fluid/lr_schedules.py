@@ -1,0 +1,113 @@
+"""fluid.layers learning-rate decay functions.
+
+Parity: python/paddle/fluid/layers/learning_rate_scheduler.py (noam_decay:44,
+exponential_decay:93, natural_exp_decay:145, inverse_time_decay:198,
+polynomial_decay:251, piecewise_decay:318, cosine_decay:380,
+linear_lr_warmup:417).
+
+TPU-first divergence: the reference builds these as ops on a global step
+variable inside the Program; here each returns an `LRScheduler` whose
+`.step()` advances the step counter — our optimizers (eager and jitted
+functional_update alike) read the scheduler each step, so the decay curve is
+identical without graph-resident counter ops.
+"""
+import math
+
+from ..optimizer.lr import LRScheduler, NoamDecay, PiecewiseDecay
+
+__all__ = ['noam_decay', 'exponential_decay', 'natural_exp_decay',
+           'inverse_time_decay', 'polynomial_decay', 'piecewise_decay',
+           'cosine_decay', 'linear_lr_warmup']
+
+
+class _StepFnDecay(LRScheduler):
+    """Scheduler computing lr as an arbitrary function of the step count."""
+
+    def __init__(self, fn, learning_rate):
+        self._fn = fn
+        super().__init__(learning_rate=learning_rate)
+
+    def get_lr(self):
+        return float(self._fn(max(self.last_epoch, 0)))
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    return NoamDecay(d_model, warmup_steps, learning_rate=learning_rate)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    def fn(step):
+        t = step / decay_steps
+        if staircase:
+            t = math.floor(t)
+        return learning_rate * (decay_rate ** t)
+    return _StepFnDecay(fn, learning_rate)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    def fn(step):
+        t = step / decay_steps
+        if staircase:
+            t = math.floor(t)
+        return learning_rate * math.exp(-decay_rate * t)
+    return _StepFnDecay(fn, learning_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    def fn(step):
+        t = step / decay_steps
+        if staircase:
+            t = math.floor(t)
+        return learning_rate / (1.0 + decay_rate * t)
+    return _StepFnDecay(fn, learning_rate)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    def fn(step):
+        if cycle:
+            div = math.ceil(step / decay_steps) if step > 0 else 1.0
+            steps = decay_steps * max(div, 1.0)
+        else:
+            steps = decay_steps
+            step = min(step, decay_steps)
+        frac = (1.0 - step / steps) ** power
+        return (learning_rate - end_learning_rate) * frac + end_learning_rate
+    return _StepFnDecay(fn, learning_rate)
+
+
+def piecewise_decay(boundaries, values):
+    return PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    def fn(step):
+        epoch = math.floor(step / step_each_epoch)
+        return learning_rate * 0.5 * (math.cos(epoch * math.pi / epochs) + 1)
+    return _StepFnDecay(fn, learning_rate)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    base = learning_rate
+
+    def fn(step):
+        if step < warmup_steps:
+            return start_lr + (end_lr - start_lr) * step / warmup_steps
+        if isinstance(base, LRScheduler):
+            return base.last_lr
+        return float(base)
+    wrapped = _StepFnDecay(
+        fn, end_lr if isinstance(base, LRScheduler) else base)
+
+    if isinstance(base, LRScheduler):
+        # advance the wrapped schedule in lockstep after warmup
+        orig_step = wrapped.step
+
+        def step(epoch=None):
+            base.step(epoch)
+            orig_step(epoch)
+        wrapped.step = step
+    return wrapped

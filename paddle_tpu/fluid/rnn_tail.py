@@ -1,0 +1,304 @@
+"""fluid.layers rnn tail: RNNCell/GRUCell/LSTMCell classes, rnn/birnn
+drivers, dynamic_gru / dynamic_lstmp.
+
+Parity: /root/reference/python/paddle/fluid/layers/rnn.py (RNNCell:59,
+GRUCell:226, LSTMCell:324, rnn:434, birnn:651, dynamic_lstmp:2502,
+dynamic_gru:2721).
+
+TPU-first notes: the generic `rnn()` driver runs the (arbitrary python)
+cell.call per step — under to_static tracing XLA unrolls it; the fixed-math
+dynamic_gru/dynamic_lstmp lower to lax.scan via rnn_scan. Sequence masking
+follows the reference's _maybe_copy rule (rnn.py:511): past a row's
+sequence_length the state stops advancing and emitted outputs are zeroed.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..tensor._helpers import _t
+
+
+class RNNCell:
+    """Base class: subclasses implement call(inputs, states) -> (out,
+    new_states) (rnn.py:59)."""
+
+    def call(self, inputs, states):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states):
+        return self.call(inputs, states)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError(
+            "state_shape not defined for this cell")
+
+    def get_initial_states(self, batch_ref, shape=None, dtype='float32',
+                           init_value=0.0, batch_dim_idx=0):
+        from ..tensor.creation import full
+        shapes = self.state_shape if shape is None else shape
+        B = batch_ref.shape[batch_dim_idx]
+
+        def build(s):
+            dims = [B] + [int(d) for d in (s if isinstance(s, (list, tuple))
+                                           else [s])]
+            return full(dims, init_value, dtype=dtype)
+        if isinstance(shapes, (list, tuple)) and shapes and \
+                isinstance(shapes[0], (list, tuple)):
+            return [build(s) for s in shapes]
+        return build(shapes)
+
+
+class GRUCell(RNNCell):
+    """fluid GRUCell (rnn.py:226): weights are created lazily on the first
+    call (the reference's BasicGRUUnit does the same)."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, dtype="float32",
+                 name="GRUCell"):
+        self.hidden_size = hidden_size
+        self.param_attr = param_attr
+        self.bias_attr = bias_attr
+        self.gate_activation = gate_activation or 'sigmoid'
+        self.activation = activation or 'tanh'
+        self._cell = None
+
+    def _build(self, input_size):
+        from ..nn.layer.rnn import GRUCell as _NNGRUCell
+        self._cell = _NNGRUCell(input_size, self.hidden_size,
+                                weight_ih_attr=self.param_attr,
+                                weight_hh_attr=self.param_attr,
+                                bias_ih_attr=self.bias_attr,
+                                bias_hh_attr=self.bias_attr)
+
+    def call(self, inputs, states):
+        if self._cell is None:
+            self._build(inputs.shape[-1])
+        out, new_h = self._cell(inputs, states)
+        return out, new_h
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class LSTMCell(RNNCell):
+    """fluid LSTMCell (rnn.py:324): call returns (h, [h, c])."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, forget_bias=1.0,
+                 dtype="float32", name="LSTMCell"):
+        self.hidden_size = hidden_size
+        self.param_attr = param_attr
+        self.bias_attr = bias_attr
+        self.forget_bias = forget_bias
+        self._cell = None
+
+    def _build(self, input_size):
+        from ..nn.layer.rnn import LSTMCell as _NNLSTMCell
+        self._cell = _NNLSTMCell(input_size, self.hidden_size,
+                                 weight_ih_attr=self.param_attr,
+                                 weight_hh_attr=self.param_attr,
+                                 bias_ih_attr=self.bias_attr,
+                                 bias_hh_attr=self.bias_attr)
+        if self.forget_bias and self._cell.bias_ih is not None:
+            b = self._cell.bias_ih._value
+            h = self.hidden_size
+            self._cell.bias_ih._inplace_value(
+                b.at[h:2 * h].add(jnp.asarray(self.forget_bias, b.dtype)))
+
+    def call(self, inputs, states):
+        if self._cell is None:
+            self._build(inputs.shape[-1])
+        h, c = states
+        out, (new_h, new_c) = self._cell(inputs, (h, c))
+        return out, [new_h, new_c]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+
+def _mask_state(new, old, keep):
+    """_maybe_copy (rnn.py:511): advance state only for rows still inside
+    their sequence."""
+    import jax.tree_util as jtu
+    flat_new, tree = jtu.tree_flatten(new)
+    flat_old = jtu.tree_leaves(old)
+    out = []
+    for n, o in zip(flat_new, flat_old):
+        nv, ov = _t(n), _t(o)
+
+        def fn(a, b, k):
+            m = k.reshape((-1,) + (1,) * (a.ndim - 1)).astype(a.dtype)
+            return a * m + b * (1 - m)
+        out.append(apply_op(fn, (nv, ov, _t(keep))))
+    return jtu.tree_unflatten(tree, out)
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run `cell` over the time dim of `inputs` (rnn.py:434). Returns
+    (outputs, final_states)."""
+    from ..tensor.manipulation import stack
+    x = inputs
+    if time_major:
+        x = x.transpose([1, 0] + list(range(2, x.ndim)))
+    B, T = x.shape[0], x.shape[1]
+    states = initial_states if initial_states is not None \
+        else cell.get_initial_states(x)
+    lens = None
+    if sequence_length is not None:
+        lens = _t(sequence_length)
+    outs = []
+    steps = range(T - 1, -1, -1) if is_reverse else range(T)
+    for t in steps:
+        xt = x[:, t]
+        out, new_states = cell.call(xt, states)
+        if lens is not None:
+            def keep_fn(lv):
+                return (jnp.asarray(t) < lv.astype(jnp.int32).reshape(-1))
+            keep = apply_op(keep_fn, (lens,), differentiable=False)
+            new_states = _mask_state(new_states, states, keep)
+
+            def zfn(o, k):
+                m = k.reshape((-1,) + (1,) * (o.ndim - 1)).astype(o.dtype)
+                return o * m
+            out = apply_op(zfn, (_t(out), _t(keep)))
+        states = new_states
+        outs.append(out)
+    if is_reverse:
+        outs = outs[::-1]
+    outputs = stack(outs, axis=1 if not time_major else 0)
+    return outputs, states
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    """Forward + backward rnn, outputs concatenated on the last axis
+    (rnn.py:651)."""
+    from ..tensor.manipulation import concat
+    init_fw = init_bw = None
+    if initial_states is not None:
+        init_fw, init_bw = initial_states
+    out_fw, st_fw = rnn(cell_fw, inputs, init_fw, sequence_length,
+                        time_major=time_major)
+    out_bw, st_bw = rnn(cell_bw, inputs, init_bw, sequence_length,
+                        time_major=time_major, is_reverse=True)
+    return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation='sigmoid',
+                candidate_activation='tanh', h_0=None, origin_mode=False):
+    """Single GRU layer over pre-projected gates (rnn.py:2721): input is
+    (B, T, 3*size) (the classic recipe projects with fc first); the
+    recurrent weight [size, 3*size] lives here. Returns (B, T, size)."""
+    from .layers_tail import _op_param
+    from ..nn.initializer import XavierUniform, Constant
+    from ..tensor.creation import zeros
+    x = _t(input)
+    B, T = x.shape[0], x.shape[1]
+    w = _op_param([size, 3 * size], param_attr, XavierUniform(),
+                  'dynamic_gru_w')
+    b = _op_param([3 * size], bias_attr, Constant(0.0), 'dynamic_gru_b')
+    h0 = _t(h_0) if h_0 is not None else zeros([B, size], 'float32')
+    gact = getattr(jax.nn, gate_activation)
+    cact = getattr(jnp, candidate_activation, None) or \
+        getattr(jax.nn, candidate_activation)
+
+    def fn(xv, wv, bv, hv):
+        xs = xv[:, ::-1] if is_reverse else xv
+
+        def step(h, xt):
+            g = xt + bv
+            x_ur, x_c = g[:, :2 * size], g[:, 2 * size:]
+            ur = gact(x_ur + h @ wv[:, :2 * size])
+            u, r = ur[:, :size], ur[:, size:]
+            c = cact(x_c + (r * h) @ wv[:, 2 * size:])
+            h_new = (1.0 - u) * c + u * h if origin_mode \
+                else u * c + (1.0 - u) * h
+            return h_new, h_new
+
+        _, hs = jax.lax.scan(step, hv, jnp.swapaxes(xs, 0, 1))
+        hs = jnp.swapaxes(hs, 0, 1)
+        return hs[:, ::-1] if is_reverse else hs
+
+    return apply_op(fn, (x, w, b, h0))
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation='sigmoid', cell_activation='tanh',
+                  candidate_activation='tanh', proj_activation='tanh',
+                  dtype='float32', name=None, h_0=None, c_0=None,
+                  cell_clip=None, proj_clip=None):
+    """LSTMP (projected LSTM, rnn.py:2502): input is pre-projected
+    (B, T, 4*size); recurrent weight [proj_size, 4*size]; projection
+    [size, proj_size]. Gate packing i, f, c~, o (the reference lstmp op's
+    order). Returns (projection (B, T, proj_size), cell (B, T, size))."""
+    from .layers_tail import _op_param
+    from ..nn.initializer import XavierUniform, Constant
+    from ..tensor.creation import zeros
+    x = _t(input)
+    B, T = x.shape[0], x.shape[1]
+    hidden = size // 4
+    w = _op_param([proj_size, 4 * hidden], param_attr, XavierUniform(),
+                  'dynamic_lstmp_w')
+    wproj = _op_param([hidden, proj_size], param_attr, XavierUniform(),
+                      'dynamic_lstmp_w_proj')
+    n_bias = 7 * hidden if use_peepholes else 4 * hidden
+    b = _op_param([n_bias], bias_attr, Constant(0.0), 'dynamic_lstmp_b')
+    h0 = _t(h_0) if h_0 is not None else zeros([B, proj_size], 'float32')
+    c0 = _t(c_0) if c_0 is not None else zeros([B, hidden], 'float32')
+    gact = getattr(jax.nn, gate_activation)
+    cellact = getattr(jnp, cell_activation, None) or \
+        getattr(jax.nn, cell_activation)
+    candact = getattr(jnp, candidate_activation, None) or \
+        getattr(jax.nn, candidate_activation)
+    projact = getattr(jnp, proj_activation, None) or \
+        getattr(jax.nn, proj_activation)
+
+    def fn(xv, wv, wp, bv, hv, cv):
+        xs = xv[:, ::-1] if is_reverse else xv
+        bias = bv[:4 * hidden]
+        if use_peepholes:
+            w_ic = bv[4 * hidden:5 * hidden]
+            w_fc = bv[5 * hidden:6 * hidden]
+            w_oc = bv[6 * hidden:]
+
+        def step(carry, xt):
+            h, c = carry
+            g = xt + h @ wv + bias
+            gi = g[:, :hidden]
+            gf = g[:, hidden:2 * hidden]
+            gc = g[:, 2 * hidden:3 * hidden]
+            go = g[:, 3 * hidden:]
+            if use_peepholes:
+                i = gact(gi + c * w_ic)
+                f = gact(gf + c * w_fc)
+            else:
+                i = gact(gi)
+                f = gact(gf)
+            c_new = f * c + i * candact(gc)
+            if cell_clip is not None:
+                c_new = jnp.clip(c_new, -cell_clip, cell_clip)
+            if use_peepholes:
+                o = gact(go + c_new * w_oc)
+            else:
+                o = gact(go)
+            h_cell = o * cellact(c_new)
+            r = projact(h_cell @ wp)
+            if proj_clip is not None:
+                r = jnp.clip(r, -proj_clip, proj_clip)
+            return (r, c_new), (r, c_new)
+
+        _, (rs, cs) = jax.lax.scan(step, (hv, cv), jnp.swapaxes(xs, 0, 1))
+        rs = jnp.swapaxes(rs, 0, 1)
+        cs = jnp.swapaxes(cs, 0, 1)
+        if is_reverse:
+            rs, cs = rs[:, ::-1], cs[:, ::-1]
+        return rs, cs
+
+    return apply_op(fn, (x, w, wproj, b, h0, c0), n_outputs=2)

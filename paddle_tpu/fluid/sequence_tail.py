@@ -1,0 +1,187 @@
+"""sequence_* op tail as masked-dense TPU ops.
+
+Parity: /root/reference/python/paddle/fluid/layers/sequence_lod.py
+(sequence_conv:44, sequence_slice:550, sequence_expand_as:774,
+sequence_reshape:1083, sequence_scatter:1145, sequence_enumerate:1235,
+sequence_first_step/sequence_last_step).
+
+TPU-first divergence: LoD ragged batches are dense padded (B, T, ...)
+tensors plus an optional integer `length` (B,) argument replacing the LoD
+level — static shapes for XLA. Where a reference op's output length is
+data-dependent (expand_as), the dense op keeps the padded time dim and the
+caller tracks new lengths.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply_op
+from ..tensor._helpers import _t
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None, length=None):
+    """Context-window convolution over time (sequence_lod.py:44): each step
+    t sees rows [t + padding_start, t + padding_start + filter_size), zero
+    outside the sequence; then a dense projection to num_filters."""
+    from .layers_tail import _op_param, _act
+    from ..nn.initializer import XavierUniform, Constant
+    x = _t(input)
+    B, T, D = x.shape
+    if filter_stride != 1:
+        raise ValueError("sequence_conv: filter_stride must be 1 "
+                         "(reference restriction)")
+    start = -int(filter_size // 2) if padding_start is None \
+        else int(padding_start)
+    w = _op_param([filter_size * D, num_filters], param_attr,
+                  XavierUniform(), 'sequence_conv_w')
+    tensors = [x, w]
+    if bias_attr is not False:
+        tensors.append(_op_param([num_filters], bias_attr, Constant(0.0),
+                                 'sequence_conv_b'))
+    if length is not None:
+        tensors.append(_t(length))
+
+    def fn(xv, wv, *rest):
+        rest = list(rest)
+        bv = rest.pop(0) if bias_attr is not False else None
+        if length is not None:
+            lens = rest.pop(0).astype(jnp.int32).reshape(-1)
+            mask = (jnp.arange(T)[None, :] < lens[:, None])
+            xv = jnp.where(mask[:, :, None], xv, 0.0)
+        cols = []
+        for k in range(filter_size):
+            off = start + k
+            shifted = jnp.roll(xv, -off, axis=1)
+            t_idx = jnp.arange(T) + off
+            ok = (t_idx >= 0) & (t_idx < T)
+            if length is not None:
+                ok = ok[None, :] & (t_idx[None, :] < lens[:, None])
+            else:
+                ok = jnp.broadcast_to(ok[None, :], (xv.shape[0], T))
+            cols.append(jnp.where(ok[:, :, None], shifted, 0.0))
+        ctx = jnp.concatenate(cols, axis=-1)        # (B, T, k*D)
+        out = ctx @ wv
+        if bv is not None:
+            out = out + bv
+        return out
+
+    return _act(apply_op(fn, tuple(tensors)), act)
+
+
+def sequence_first_step(input, length=None):
+    from ..nn.functional import sequence_pool
+    return sequence_pool(input, 'first', length=length)
+
+
+def sequence_last_step(input, length=None):
+    from ..nn.functional import sequence_pool
+    return sequence_pool(input, 'last', length=length)
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-sequence window (sequence_lod.py:550): out[i, j] =
+    input[i, offset_i + j] for j < length_i, zero-padded to the input's
+    time dim."""
+    x = _t(input)
+    B, T = x.shape[0], x.shape[1]
+
+    def fn(xv, ov, lv):
+        off = ov.astype(jnp.int32).reshape(-1)
+        ln = lv.astype(jnp.int32).reshape(-1)
+        j = jnp.arange(T)
+        src = jnp.clip(off[:, None] + j[None, :], 0, T - 1)   # (B, T)
+        gathered = jnp.take_along_axis(
+            xv, src.reshape(B, T, *([1] * (xv.ndim - 2))), axis=1)
+        keep = j[None, :] < ln[:, None]
+        return jnp.where(keep.reshape(B, T, *([1] * (xv.ndim - 2))),
+                         gathered, 0)
+
+    return apply_op(fn, (x, _t(offset), _t(length)))
+
+
+def sequence_expand_as(x, y, y_length=None, name=None):
+    """Row i of x expanded (tiled) along a new time dim to match y's i-th
+    sequence length (sequence_lod.py:774). Dense form: output is
+    (B, Ty, ...) with positions beyond y_length_i zeroed."""
+    xv_ = _t(x)
+    yv_ = _t(y)
+    Ty = yv_.shape[1]
+    tensors = [xv_]
+    if y_length is not None:
+        tensors.append(_t(y_length))
+
+    def fn(xv, *rest):
+        out = jnp.broadcast_to(xv[:, None], (xv.shape[0], Ty) + xv.shape[1:])
+        if rest:
+            lens = rest[0].astype(jnp.int32).reshape(-1)
+            keep = jnp.arange(Ty)[None, :] < lens[:, None]
+            out = jnp.where(keep.reshape(keep.shape + (1,) * (xv.ndim - 1)),
+                            out, 0)
+        return out
+
+    return apply_op(fn, tuple(tensors))
+
+
+def sequence_reshape(input, new_dim):
+    """(B, T, D) -> (B, T*D/new_dim, new_dim) per-sequence reshape
+    (sequence_lod.py:1083)."""
+    x = _t(input)
+    B, T, D = x.shape
+    if (T * D) % new_dim:
+        raise ValueError(
+            f"sequence_reshape: T*D={T * D} not divisible by {new_dim}")
+
+    def fn(v):
+        return v.reshape(B, T * D // new_dim, new_dim)
+
+    return apply_op(fn, (x,))
+
+
+def sequence_scatter(input, index, updates, length=None, name=None):
+    """out = input; out[i, index[i, j]] += updates[i, j] for valid j
+    (sequence_lod.py:1145; the reference scatters flat LoD rows — here
+    index/updates are per-batch-row padded, masked by `length`)."""
+    x = _t(input)
+    tensors = [x, _t(index), _t(updates)]
+    if length is not None:
+        tensors.append(_t(length))
+
+    def fn(xv, iv, uv, *rest):
+        idx = iv.astype(jnp.int32)
+        if rest:
+            lens = rest[0].astype(jnp.int32).reshape(-1)
+            keep = jnp.arange(idx.shape[1])[None, :] < lens[:, None]
+            uv = jnp.where(keep, uv, 0)
+
+        def one(row, ridx, rupd):
+            return row.at[ridx].add(rupd)
+        return jax.vmap(one)(xv, idx, uv)
+
+    return apply_op(fn, tuple(tensors))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None,
+                       length=None):
+    """(B, T) ids -> (B, T, win_size) sliding windows, padded with
+    pad_value past each sequence end (sequence_lod.py:1235)."""
+    x = _t(input)
+    B, T = x.shape[0], x.shape[1]
+    tensors = [x]
+    if length is not None:
+        tensors.append(_t(length))
+
+    def fn(v, *rest):
+        lens = rest[0].astype(jnp.int32).reshape(-1) if rest \
+            else jnp.full((B,), T, jnp.int32)
+        outs = []
+        j = jnp.arange(T)
+        for k in range(win_size):
+            t_idx = jnp.clip(j + k, 0, T - 1)
+            col = v[:, t_idx]
+            ok = (j + k)[None, :] < lens[:, None]
+            outs.append(jnp.where(ok, col, pad_value))
+        return jnp.stack(outs, axis=-1)
+
+    return apply_op(fn, tuple(tensors), differentiable=False)

@@ -142,6 +142,11 @@ class Executor:
         if isinstance(f, str):
             name = f.split('@')[0]
             return program.global_block.var(name)
+        if isinstance(f, Tensor):
+            # concrete tensor (e.g. a create_global_var Parameter a Switch
+            # branch assigns into): fetch through its cached block Variable
+            # so in-graph writes to its slot are visible
+            return program.global_block.concrete_var(f)
         raise TypeError(f"bad fetch entry {f!r}")
 
     def _program_params(self, program):

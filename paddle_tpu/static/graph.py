@@ -89,6 +89,23 @@ class Block:
         self.vars[v.name] = v
         return v
 
+    def concrete_var(self, t):
+        """The ONE Variable wrapping a concrete Tensor in this block —
+        cached by tensor identity so every read and in-place write-back of
+        the same tensor shares a single env slot (the classic control-flow
+        classes rely on this invariant)."""
+        cache = getattr(self, '_concrete_cache', None)
+        if cache is None:
+            cache = self._concrete_cache = {}
+        v = cache.get(id(t))
+        if v is None:
+            v = Variable(jax.ShapeDtypeStruct(tuple(t.shape),
+                                              t._value.dtype),
+                         name=getattr(t, 'name', None), concrete=t)
+            self.vars[v.name] = v
+            cache[id(t)] = v
+        return v
+
 
 class Program:
     """Parity: fluid.Program. Captured op list + feed/fetch metadata."""
@@ -195,11 +212,8 @@ def _symbolic_apply(fn, tensors, n_outputs, differentiable):
             ins.append(t)
         elif isinstance(t, Tensor):
             # concrete tensor (e.g. a Parameter created eagerly): wrap as a
-            # persistable var bound to it
-            v = Variable(jax.ShapeDtypeStruct(tuple(t.shape), t.dtype),
-                         name=getattr(t, 'name', None), concrete=t)
-            block.vars[v.name] = v
-            ins.append(v)
+            # persistable var bound to it, via the block's identity cache
+            ins.append(block.concrete_var(t))
         else:
             arr = jnp.asarray(t)
             c = Tensor(arr)

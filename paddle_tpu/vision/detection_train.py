@@ -1,0 +1,1411 @@
+"""Classic detection TRAINING ops (SSD / YOLOv3 / Faster-RCNN era),
+TPU-first masked-dense.
+
+Parity: /root/reference/python/paddle/fluid/layers/detection.py
+(bipartite_match:1317, target_assign:1402, ssd_loss:1517,
+detection_output:620, rpn_target_assign:310, retinanet_target_assign:110,
+sigmoid_focal_loss:559, yolov3_loss:1003, matrix_nms:3542,
+locality_aware_nms:3438, generate_proposals:2887,
+generate_proposal_labels:2464, generate_mask_labels:2606,
+polygon_box_transform:957, retinanet_detection_output:3679,
+distribute_fpn_proposals:3857, collect_fpn_proposals:3954,
+box_decoder_and_assign:3790, multi_box_head:2042) and the C++ kernels under
+/root/reference/paddle/fluid/operators/detection/ (bipartite_match_op.cc,
+mine_hard_examples_op.cc, yolov3_loss_op.h, matrix_nms_op.cc,
+polygon_box_transform_op.cc, sigmoid_focal_loss_op.*).
+
+TPU-first redesign: LoD ground-truth batches become dense padded
+(B, G, ...) tensors — a gt row is VALID iff its label >= 0 (or its box has
+positive area, matching yolov3_loss_op.h GtValid). Dynamic-size outputs
+(sampled fg/bg sets, per-level FPN splits) become fixed-size padded tensors
+plus counts/weights. Host-side sampling generators
+(generate_proposal_labels / generate_mask_labels) run eagerly in numpy —
+the reference also pins those ops to CPU.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, to_tensor
+from ..tensor._helpers import _t
+from .ops import _pairwise_iou, box_coder, multiclass_nms, _nms_single
+
+__all__ = [
+    'bipartite_match', 'target_assign', 'ssd_loss', 'detection_output',
+    'rpn_target_assign', 'retinanet_target_assign', 'sigmoid_focal_loss',
+    'yolov3_loss', 'matrix_nms', 'locality_aware_nms', 'polygon_box_transform',
+    'generate_proposals', 'generate_proposal_labels', 'generate_mask_labels',
+    'retinanet_detection_output', 'distribute_fpn_proposals',
+    'collect_fpn_proposals', 'box_decoder_and_assign', 'multi_box_head',
+    'roi_perspective_transform', 'roi_pool', 'psroi_pool', 'prroi_pool',
+    'deformable_conv', 'deformable_roi_pooling',
+]
+
+
+# ---------------------------------------------------------------------------
+# matching / target assignment
+# ---------------------------------------------------------------------------
+
+def _bipartite_match_single(dist, valid_rows):
+    """Greedy bipartite match (bipartite_match_op.cc BipartiteMatch): pick
+    the global max repeatedly, retiring its row and column. dist: (G, P);
+    valid_rows: (G,) bool. Returns (match (P,), matched_dist (P,))."""
+    G, P = dist.shape
+    NEG = jnp.asarray(-1e30, dist.dtype)
+    d0 = jnp.where(valid_rows[:, None], dist, NEG)
+
+    def body(carry, _):
+        d, match, mdist = carry
+        flat = jnp.argmax(d)
+        g, p = flat // P, flat % P
+        best = d[g, p]
+        ok = best > NEG / 2
+        match = jnp.where(ok, match.at[p].set(g.astype(jnp.int32)), match)
+        mdist = jnp.where(ok, mdist.at[p].set(dist[g, p]), mdist)
+        d = jnp.where(ok, d.at[g, :].set(NEG).at[:, p].set(NEG), d)
+        return (d, match, mdist), None
+
+    init = (d0, jnp.full((P,), -1, jnp.int32), jnp.zeros((P,), dist.dtype))
+    (d, match, mdist), _ = jax.lax.scan(body, init, None, length=G)
+    return match, mdist
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (detection.py:1317). dist_matrix:
+    (B, G, P) or (G, P) similarity; returns (match_indices (B, P) int32
+    with -1 for unmatched, matched_distance (B, P)). match_type
+    'per_prediction' additionally matches any unmatched column to its
+    argmax row when that distance >= dist_threshold (default 0.5)."""
+    d = _t(dist_matrix)
+    squeeze = d.ndim == 2
+    thr = 0.5 if dist_threshold is None else float(dist_threshold)
+    per_pred = match_type == 'per_prediction'
+
+    def fn(dv):
+        if dv.ndim == 2:
+            dv = dv[None]
+
+        def one(dmat):
+            valid = jnp.any(dmat > 0, axis=1)
+            match, mdist = _bipartite_match_single(dmat, valid)
+            if per_pred:
+                best_row = jnp.argmax(
+                    jnp.where(valid[:, None], dmat, -jnp.inf), axis=0)
+                best_val = jnp.max(
+                    jnp.where(valid[:, None], dmat, -jnp.inf), axis=0)
+                extra = (match < 0) & (best_val >= thr)
+                match = jnp.where(extra, best_row.astype(jnp.int32), match)
+                mdist = jnp.where(extra, best_val, mdist)
+            return match, mdist
+
+        m, md = jax.vmap(one)(dv)
+        return m, md
+
+    m, md = apply_op(fn, (d,), n_outputs=2, differentiable=False)
+    if squeeze:
+        return m, md
+    return m, md
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """Gather targets by match indices (detection.py:1402). input:
+    (B, G, K) per-image candidate rows; matched_indices: (B, P) row index
+    or -1. out[b, p] = input[b, match[b, p]] (mismatch_value rows where
+    unmatched), weight 1.0 where matched else 0.0. negative_indices
+    (B, P) bool/0-1 mask (dense replacement of the reference's LoD neg-index
+    list) forces weight 1 with mismatch_value content."""
+    x = _t(input)
+    mi = _t(matched_indices)
+    mm = 0.0 if mismatch_value is None else float(mismatch_value)
+    tensors = [x, mi]
+    if negative_indices is not None:
+        tensors.append(_t(negative_indices))
+
+    def fn(xv, mv, *rest):
+        midx = mv.astype(jnp.int32)
+        matched = midx >= 0
+        safe = jnp.maximum(midx, 0)
+        out = jnp.take_along_axis(xv, safe[..., None], axis=1)
+        out = jnp.where(matched[..., None], out,
+                        jnp.asarray(mm, xv.dtype))
+        w = matched.astype(xv.dtype)[..., None]
+        if rest:
+            neg = rest[0] != 0
+            w = jnp.maximum(w, neg.astype(xv.dtype)[..., None])
+        return out, w
+
+    return apply_op(fn, tuple(tensors), n_outputs=2, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# SSD loss
+# ---------------------------------------------------------------------------
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type='per_prediction',
+             mining_type='max_negative', normalize=True, sample_size=None):
+    """Full SSD multibox loss (detection.py:1517): IoU -> bipartite match ->
+    hard-negative mining (mine_hard_examples_op.cc max_negative) -> smooth-L1
+    loc + softmax conf, normalized by the number of matched priors.
+
+    Dense contract: gt_box (B, G, 4) normalized xyxy padded with zero-area
+    rows; gt_label (B, G) or (B, G, 1) padded with -1. location
+    (B, P, 4), confidence (B, P, C), prior_box (P, 4). Returns (B, 1).
+    """
+    if mining_type != 'max_negative':
+        raise ValueError("Only mining_type='max_negative' is supported "
+                         "(same restriction as the reference)")
+    loc = _t(location)
+    conf = _t(confidence)
+    gb = _t(gt_box)
+    gl = _t(gt_label)
+    pb = _t(prior_box)
+    pbv = _t(prior_box_var) if prior_box_var is not None else None
+    thr = overlap_threshold if overlap_threshold is not None else 0.5
+
+    tensors = [loc, conf, gb, gl, pb] + ([pbv] if pbv is not None else [])
+
+    def fn(locv, confv, gbv, glv, pbv_, *rest):
+        varv = rest[0] if rest else None
+        B, P, C = confv.shape
+        glv = glv.reshape(B, -1).astype(jnp.int32)
+        G = glv.shape[1]
+        area = (gbv[..., 2] - gbv[..., 0]) * (gbv[..., 3] - gbv[..., 1])
+        valid = (glv >= 0) & (area > 0)
+
+        def one(loc_i, conf_i, gt_i, lab_i, val_i):
+            iou = jnp.where(val_i[:, None],
+                            _pairwise_iou(gt_i, pbv_), 0.0)   # (G, P)
+            match, mdist = _bipartite_match_single(iou, val_i)
+            if match_type == 'per_prediction':
+                best_row = jnp.argmax(
+                    jnp.where(val_i[:, None], iou, -jnp.inf), axis=0)
+                best_val = jnp.max(
+                    jnp.where(val_i[:, None], iou, -jnp.inf), axis=0)
+                extra = (match < 0) & (best_val >= thr)
+                match = jnp.where(extra, best_row.astype(jnp.int32), match)
+                mdist = jnp.where(extra, best_val, mdist)
+            pos = match >= 0
+            n_pos = pos.sum()
+
+            # conf loss vs target labels (background where unmatched)
+            safe = jnp.maximum(match, 0)
+            t_label = jnp.where(pos, lab_i[safe], background_label)
+            logp = jax.nn.log_softmax(conf_i, axis=-1)
+            conf_l = -jnp.take_along_axis(logp, t_label[:, None],
+                                          axis=1)[:, 0]          # (P,)
+
+            # hard negative mining: candidates are unmatched priors with
+            # matched_dist < neg_overlap, ranked by conf loss
+            neg_cand = (~pos) & (mdist < neg_overlap)
+            n_neg = jnp.minimum(
+                (n_pos * neg_pos_ratio).astype(jnp.int32),
+                neg_cand.sum().astype(jnp.int32))
+            if sample_size is not None:
+                n_neg = jnp.minimum(n_neg, int(sample_size))
+            cand_loss = jnp.where(neg_cand, conf_l, -jnp.inf)
+            order = jnp.argsort(-cand_loss)
+            rank = jnp.zeros((P,), jnp.int32).at[order].set(
+                jnp.arange(P, dtype=jnp.int32))
+            neg_sel = neg_cand & (rank < n_neg)
+
+            conf_w = pos.astype(locv.dtype) + neg_sel.astype(locv.dtype)
+
+            # loc loss: smooth-L1 vs encoded gt offsets on positives
+            gt_m = gt_i[safe]                                    # (P, 4)
+            pw = pbv_[:, 2] - pbv_[:, 0]
+            ph = pbv_[:, 3] - pbv_[:, 1]
+            px = (pbv_[:, 0] + pbv_[:, 2]) * 0.5
+            py = (pbv_[:, 1] + pbv_[:, 3]) * 0.5
+            gw = jnp.maximum(gt_m[:, 2] - gt_m[:, 0], 1e-9)
+            gh = jnp.maximum(gt_m[:, 3] - gt_m[:, 1], 1e-9)
+            gx = (gt_m[:, 0] + gt_m[:, 2]) * 0.5
+            gy = (gt_m[:, 1] + gt_m[:, 3]) * 0.5
+            v = varv if varv is not None else \
+                jnp.full((P, 4), 1.0, locv.dtype)
+            t0 = (gx - px) / jnp.maximum(pw, 1e-9) / v[:, 0]
+            t1 = (gy - py) / jnp.maximum(ph, 1e-9) / v[:, 1]
+            t2 = jnp.log(gw / jnp.maximum(pw, 1e-9)) / v[:, 2]
+            t3 = jnp.log(gh / jnp.maximum(ph, 1e-9)) / v[:, 3]
+            target = jnp.stack([t0, t1, t2, t3], axis=1)
+            diff = loc_i - target
+            ad = jnp.abs(diff)
+            sl1 = jnp.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5).sum(1)
+            loc_w = pos.astype(locv.dtype)
+
+            total = (conf_loss_weight * conf_l * conf_w +
+                     loc_loss_weight * sl1 * loc_w)
+            loss_i = total.sum()
+            if normalize:
+                loss_i = loss_i / jnp.maximum(loc_w.sum(), 1.0)
+            return loss_i
+
+        losses = jax.vmap(one)(locv, confv, gbv, glv, valid)
+        return losses[:, None]
+
+    return apply_op(fn, tuple(tensors))
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """Decode + multiclass NMS (detection.py:620). loc: (B, P, 4) deltas;
+    scores: (B, P, C); returns the padded (B, keep_top_k, 6) NMS output
+    (+ counts via multiclass_nms contract)."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type='decode_center_size', axis=0)
+    from ..tensor.manipulation import transpose
+    sc = transpose(scores, [0, 2, 1])       # (B, C, P)
+    return multiclass_nms(decoded, sc, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label,
+                          nms_eta=nms_eta, return_index=return_index)
+
+
+# ---------------------------------------------------------------------------
+# focal loss + RPN / RetinaNet target assign
+# ---------------------------------------------------------------------------
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    """Per-element focal loss (sigmoid_focal_loss_op.h): x (N, C) logits,
+    label (N, 1) in [0, C] (0 = background; class c hits column c-1),
+    normalized by fg_num. Returns (N, C)."""
+    def fn(xv, lv, fg):
+        N, C = xv.shape
+        lab = lv.reshape(-1).astype(jnp.int32)
+        c_idx = jnp.arange(1, C + 1)[None, :]
+        t = (lab[:, None] == c_idx).astype(xv.dtype)
+        p = jax.nn.sigmoid(xv)
+        ce = jnp.maximum(xv, 0.0) - xv * t + jnp.log1p(
+            jnp.exp(-jnp.abs(xv)))
+        p_t = p * t + (1.0 - p) * (1.0 - t)
+        a_t = alpha * t + (1.0 - alpha) * (1.0 - t)
+        loss = a_t * ((1.0 - p_t) ** gamma) * ce
+        return loss / jnp.maximum(fg.astype(xv.dtype).reshape(()), 1.0)
+
+    return apply_op(fn, (_t(x), _t(label), _t(fg_num)))
+
+
+def _label_anchors(anchors, gt, valid_gt, pos_thr, neg_thr):
+    """Shared anchor labeling: 1 fg / 0 bg / -1 ignore, plus matched gt
+    index. Every gt's best anchor is forced fg (the rpn_target_assign
+    rule)."""
+    iou = jnp.where(valid_gt[:, None], _pairwise_iou(gt, anchors), 0.0)
+    best_gt = jnp.argmax(iou, axis=0)                  # per anchor
+    best_iou = jnp.max(iou, axis=0)
+    labels = jnp.full((anchors.shape[0],), -1, jnp.int32)
+    labels = jnp.where(best_iou < neg_thr, 0, labels)
+    labels = jnp.where(best_iou >= pos_thr, 1, labels)
+    # force each valid gt's argmax anchor to fg
+    gt_best_anchor = jnp.argmax(iou, axis=1)           # (G,)
+    force = jnp.zeros((anchors.shape[0],), bool).at[gt_best_anchor].set(
+        valid_gt)
+    labels = jnp.where(force, 1, labels)
+    return labels, best_gt.astype(jnp.int32), best_iou
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN anchor target assignment (detection.py:310). Dense contract:
+    one image — bbox_pred (A, 4), cls_logits (A, 1), anchor_box (A, 4),
+    gt_boxes (G, 4) zero-area-padded. Returns fixed-size
+    (score_pred (S, 1), loc_pred (S, 4), score_target (S, 1),
+    loc_target (S, 4), bbox_inside_weight (S, 4)) with
+    S = rpn_batch_size_per_im; rows beyond the sampled count have zero
+    weight. Sampling is deterministic top-ranked (use_random is accepted
+    but maps to deterministic selection — seeded subsample on TPU would
+    recompile per seed)."""
+    bp = _t(bbox_pred)
+    cl = _t(cls_logits)
+    an = _t(anchor_box)
+    gb = _t(gt_boxes)
+    S = int(rpn_batch_size_per_im)
+
+    def fn(bpv, clv, anv, gbv):
+        A = anv.shape[0]
+        area = (gbv[:, 2] - gbv[:, 0]) * (gbv[:, 3] - gbv[:, 1])
+        valid = area > 0
+        labels, matched, best_iou = _label_anchors(
+            anv, gbv, valid, rpn_positive_overlap, rpn_negative_overlap)
+        n_fg_cap = int(rpn_fg_fraction * S)
+        fg = labels == 1
+        bg = labels == 0
+        # rank fg by IoU desc, bg by IoU asc; take caps
+        fg_order = jnp.argsort(-jnp.where(fg, best_iou, -jnp.inf))
+        n_fg = jnp.minimum(fg.sum(), n_fg_cap).astype(jnp.int32)
+        bg_order = jnp.argsort(jnp.where(bg, best_iou, jnp.inf))
+        n_bg = jnp.minimum(bg.sum().astype(jnp.int32), S - n_fg)
+
+        slots = jnp.arange(S)
+        take_fg = slots < n_fg
+        idx = jnp.where(take_fg, fg_order[jnp.minimum(slots, A - 1)],
+                        bg_order[jnp.minimum(
+                            jnp.maximum(slots - n_fg, 0), A - 1)])
+        used = slots < (n_fg + n_bg)
+        sel_lab = jnp.where(take_fg, 1, 0)
+
+        score_pred = clv[idx]
+        loc_pred = bpv[idx]
+        score_tgt = sel_lab[:, None].astype(jnp.int32)
+        # loc targets: encode matched gt vs anchor (center-size)
+        a = anv[idx]
+        g = gbv[jnp.clip(matched[idx], 0, gbv.shape[0] - 1)]
+        aw = jnp.maximum(a[:, 2] - a[:, 0], 1e-9)
+        ah = jnp.maximum(a[:, 3] - a[:, 1], 1e-9)
+        ax = (a[:, 0] + a[:, 2]) * 0.5
+        ay = (a[:, 1] + a[:, 3]) * 0.5
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-9)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-9)
+        gx = (g[:, 0] + g[:, 2]) * 0.5
+        gy = (g[:, 1] + g[:, 3]) * 0.5
+        loc_tgt = jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                             jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+        w = (take_fg & used).astype(bpv.dtype)[:, None]
+        inside_w = jnp.broadcast_to(w, (S, 4))
+        loc_tgt = loc_tgt * w
+        return score_pred, loc_pred, score_tgt, loc_tgt, inside_w
+
+    return apply_op(fn, (bp, cl, an, gb), n_outputs=5,
+                    differentiable=False)
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None, im_info=None,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """RetinaNet target assignment (detection.py:110): every anchor is
+    used (no subsample); returns (score_pred, loc_pred, score_target,
+    loc_target, bbox_inside_weight, fg_num). score_target is the CLASS id
+    (0 bg, 1..K fg); anchors in the ignore band get weight 0 via
+    bbox_inside_weight's first column semantics — here all A rows are kept
+    (dense) with inside weights zeroed for non-fg."""
+    bp, cl, an, gb, glab = (_t(bbox_pred), _t(cls_logits), _t(anchor_box),
+                            _t(gt_boxes), _t(gt_labels))
+
+    def fn(bpv, clv, anv, gbv, glv):
+        A = anv.shape[0]
+        area = (gbv[:, 2] - gbv[:, 0]) * (gbv[:, 3] - gbv[:, 1])
+        valid = area > 0
+        labels, matched, best_iou = _label_anchors(
+            anv, gbv, valid, positive_overlap, negative_overlap)
+        fg = labels == 1
+        cls_t = jnp.where(fg, glv.reshape(-1)[
+            jnp.clip(matched, 0, glv.size - 1)].astype(jnp.int32), 0)
+        a = anv
+        g = gbv[jnp.clip(matched, 0, gbv.shape[0] - 1)]
+        aw = jnp.maximum(a[:, 2] - a[:, 0], 1e-9)
+        ah = jnp.maximum(a[:, 3] - a[:, 1], 1e-9)
+        ax = (a[:, 0] + a[:, 2]) * 0.5
+        ay = (a[:, 1] + a[:, 3]) * 0.5
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-9)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-9)
+        gx = (g[:, 0] + g[:, 2]) * 0.5
+        gy = (g[:, 1] + g[:, 3]) * 0.5
+        loc_t = jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                           jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+        w = fg.astype(bpv.dtype)[:, None]
+        fg_num = fg.sum().astype(jnp.int32).reshape(1, 1)
+        return (clv, bpv, cls_t[:, None], loc_t * w,
+                jnp.broadcast_to(w, (A, 4)), fg_num)
+
+    return apply_op(fn, (bp, cl, an, gb, glab), n_outputs=6,
+                    differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# YOLOv3 loss (vectorized port of yolov3_loss_op.h)
+# ---------------------------------------------------------------------------
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (yolov3_loss_op.h, exact algorithm):
+    x (B, M*(5+K), H, W); gt_box (B, G, 4) cxcywh normalized, zero-area
+    padded; gt_label (B, G) int. Returns per-image loss (B,)."""
+    xv_ = _t(x)
+    gb = _t(gt_box)
+    gl = _t(gt_label)
+    anchors = [int(a) for a in anchors]
+    anchor_mask = [int(a) for a in anchor_mask]
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    K = int(class_num)
+    tensors = [xv_, gb, gl]
+    if gt_score is not None:
+        tensors.append(_t(gt_score))
+
+    def fn(xv, gbv, glv, *rest):
+        B, C, H, W = xv.shape
+        input_size = downsample_ratio * H
+        scale = scale_x_y
+        bias = -0.5 * (scale - 1.0)
+        G = gbv.shape[1]
+        score = rest[0] if rest else jnp.ones((B, G), xv.dtype)
+        x5 = xv.reshape(B, mask_num, 5 + K, H, W)
+        glv = glv.reshape(B, G).astype(jnp.int32)
+
+        anc = jnp.asarray(anchors, xv.dtype).reshape(an_num, 2)
+        mask_anc = anc[jnp.asarray(anchor_mask)]           # (M, 2)
+
+        if use_label_smooth:
+            sw = min(1.0 / K, 1.0 / 40)
+            pos_l, neg_l = 1.0 - sw, sw
+        else:
+            pos_l, neg_l = 1.0, 0.0
+
+        def sce(z, t):
+            return jnp.maximum(z, 0.0) - z * t + jnp.log1p(
+                jnp.exp(-jnp.abs(z)))
+
+        def one(xi, gti, labi, sci):
+            valid = (gti[:, 2] > 1e-6) & (gti[:, 3] > 1e-6)
+            # --- decode all predicted boxes (M, H, W) ---
+            gx = jnp.arange(W, dtype=xi.dtype)[None, None, :]
+            gy = jnp.arange(H, dtype=xi.dtype)[None, :, None]
+            px = (gx + jax.nn.sigmoid(xi[:, 0]) * scale + bias) / W
+            py = (gy + jax.nn.sigmoid(xi[:, 1]) * scale + bias) / H
+            pw = jnp.exp(xi[:, 2]) * mask_anc[:, 0][:, None, None] \
+                / input_size
+            ph = jnp.exp(xi[:, 3]) * mask_anc[:, 1][:, None, None] \
+                / input_size
+
+            # IoU of every pred vs every gt (cxcywh)
+            def iou_cxcywh(x1, y1, w1, h1, x2, y2, w2, h2):
+                iw = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2) - \
+                    jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)
+                ih = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2) - \
+                    jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+                inter = jnp.where((iw < 0) | (ih < 0), 0.0, iw * ih)
+                return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+            ious = jax.vmap(
+                lambda g: iou_cxcywh(px, py, pw, ph,
+                                     g[0], g[1], g[2], g[3]))(gti)  # (G,M,H,W)
+            ious = jnp.where(valid[:, None, None, None], ious, 0.0)
+            best_iou = ious.max(axis=0)                     # (M, H, W)
+            ignore = best_iou > ignore_thresh
+
+            # --- per-gt best anchor over ALL anchors (shifted IoU) ---
+            inter_w = jnp.minimum(anc[None, :, 0] / input_size,
+                                  gti[:, None, 2])
+            inter_h = jnp.minimum(anc[None, :, 1] / input_size,
+                                  gti[:, None, 3])
+            inter = inter_w * inter_h
+            union = (anc[None, :, 0] * anc[None, :, 1] / input_size ** 2 +
+                     (gti[:, 2] * gti[:, 3])[:, None] - inter)
+            an_iou = inter / jnp.maximum(union, 1e-10)       # (G, an_num)
+            best_n = jnp.argmax(an_iou, axis=1)              # (G,)
+            mask_lookup = jnp.full((an_num,), -1, jnp.int32)
+            for mi, a in enumerate(anchor_mask):
+                mask_lookup = mask_lookup.at[a].set(mi)
+            mask_idx = mask_lookup[best_n]                   # (G,)
+            resp = valid & (mask_idx >= 0)
+
+            gi = jnp.clip((gti[:, 0] * W).astype(jnp.int32), 0, W - 1)
+            gj = jnp.clip((gti[:, 1] * H).astype(jnp.int32), 0, H - 1)
+            mi_safe = jnp.clip(mask_idx, 0, mask_num - 1)
+
+            # gather predictions at responsible cells (G, 5+K)
+            pred = x5_i = xi[mi_safe, :, gj, gi]             # (G, 5+K)
+            tx = gti[:, 0] * W - gi
+            ty = gti[:, 1] * H - gj
+            tw = jnp.log(jnp.maximum(
+                gti[:, 2] * input_size, 1e-9) /
+                anc[jnp.clip(best_n, 0, an_num - 1), 0])
+            th = jnp.log(jnp.maximum(
+                gti[:, 3] * input_size, 1e-9) /
+                anc[jnp.clip(best_n, 0, an_num - 1), 1])
+            lscale = (2.0 - gti[:, 2] * gti[:, 3]) * sci
+            loc = (sce(pred[:, 0], tx) + sce(pred[:, 1], ty) +
+                   jnp.abs(pred[:, 2] - tw) + jnp.abs(pred[:, 3] - th))
+            loc_loss = jnp.where(resp, loc * lscale, 0.0).sum()
+
+            cls_t = (jnp.arange(K)[None, :] ==
+                     labi[:, None]).astype(xi.dtype)
+            cls_t = cls_t * pos_l + (1 - cls_t) * neg_l
+            cls = sce(pred[:, 5:], cls_t).sum(axis=1)
+            cls_loss = jnp.where(resp, cls * sci, 0.0).sum()
+
+            # objness mask: score at responsible cells, -1 at ignored
+            obj = jnp.zeros((mask_num, H, W), xi.dtype)
+            obj = jnp.where(ignore, -1.0, obj)
+            obj = obj.at[mi_safe, gj, gi].set(
+                jnp.where(resp, sci, obj[mi_safe, gj, gi]))
+            po = xi[:, 4]
+            obj_loss = jnp.where(
+                obj > 1e-5, sce(po, 1.0) * obj,
+                jnp.where(obj > -0.5, sce(po, 0.0), 0.0)).sum()
+
+            return loc_loss + cls_loss + obj_loss
+
+        return jax.vmap(one)(x5, gbv, glv, score)
+
+    return apply_op(fn, tuple(tensors))
+
+
+# ---------------------------------------------------------------------------
+# matrix / locality-aware NMS, polygon transform
+# ---------------------------------------------------------------------------
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (matrix_nms_op.cc / SOLOv2): per class, sort by score,
+    decay_j = min_i f(iou_ij)/f(max-overlap_i); suppression is a score
+    decay instead of a hard drop. bboxes (B, M, 4); scores (B, C, M).
+    Returns padded (B, keep_top_k, 6) [label, score, x1 y1 x2 y2] with -1
+    pad rows, plus valid counts (B,)."""
+    bb = _t(bboxes)
+    sc = _t(scores)
+
+    def fn(bv, sv):
+        B, M, _ = bv.shape
+        C = sv.shape[1]
+        k = min(nms_top_k, M)
+
+        def per_image(boxes, scores_cm):
+            if background_label >= 0:
+                scores_cm = scores_cm.at[background_label].set(-jnp.inf)
+
+            def per_class(s_c):
+                order = jnp.argsort(-s_c)[:k]
+                s = s_c[order]
+                b = boxes[order]
+                live = s > score_threshold
+                iou = _pairwise_iou(b, b)
+                tri = jnp.tril(jnp.ones((k, k), bool), -1)  # i < j pairs
+                iou = jnp.where(tri.T, iou, 0.0)            # iou[i, j], i<j
+                max_over = jnp.max(iou, axis=0)             # per j: max iou
+                comp = jnp.max(iou * tri.T, axis=0)
+                # per i: its own max overlap with any higher-scored box
+                iou_cmax = jnp.max(jnp.where(tri, iou.T, 0.0), axis=1)
+                if use_gaussian:
+                    decay = jnp.exp(-(iou ** 2 - iou_cmax[:, None] ** 2)
+                                    / gaussian_sigma)
+                else:
+                    decay = (1.0 - iou) / jnp.maximum(
+                        1.0 - iou_cmax[:, None], 1e-10)
+                decay = jnp.where(tri.T, decay, jnp.inf)
+                decay_j = jnp.min(decay, axis=0)
+                decay_j = jnp.where(jnp.isinf(decay_j), 1.0, decay_j)
+                new_s = jnp.where(live, s * decay_j, -jnp.inf)
+                new_s = jnp.where(new_s > post_threshold, new_s, -jnp.inf)
+                return new_s, b
+
+            cls_scores, cls_boxes = jax.vmap(per_class)(scores_cm)
+            flat_s = cls_scores.reshape(-1)
+            flat_b = cls_boxes.reshape(-1, 4)
+            labels = jnp.repeat(jnp.arange(C), k)
+            kk = min(keep_top_k, flat_s.shape[0])
+            top = jnp.argsort(-flat_s)[:kk]
+            s = flat_s[top]
+            ok = jnp.isfinite(s)
+            out = jnp.concatenate([
+                jnp.where(ok, labels[top], -1).astype(bv.dtype)[:, None],
+                jnp.where(ok, s, -1.0)[:, None],
+                jnp.where(ok[:, None], flat_b[top], -1.0)], axis=1)
+            return out, ok.sum().astype(jnp.int32)
+
+        return jax.vmap(per_image)(bv, sv)
+
+    out, counts = apply_op(fn, (bb, sc), n_outputs=2, differentiable=False)
+    if return_rois_num:
+        return out, counts
+    return out
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """Locality-aware NMS (EAST text detection, detection.py:3438): boxes
+    overlapping above the threshold are first MERGED by score-weighted
+    average, then standard multiclass NMS runs. Dense redesign: each box is
+    merged with all boxes it overlaps (one pass), then NMS."""
+    bb = _t(bboxes)
+    sc = _t(scores)
+
+    def fn(bv, sv):
+        def per_image(boxes, scores_cm):
+            s = jnp.max(scores_cm, axis=0)               # (M,)
+            iou = _pairwise_iou(boxes, boxes)
+            near = (iou >= nms_threshold) & (s[None, :] > score_threshold)
+            w = jnp.where(near, s[None, :], 0.0)
+            denom = jnp.maximum(w.sum(axis=1, keepdims=True), 1e-10)
+            merged = (w @ boxes) / denom
+            keep_orig = s[:, None] <= 0
+            return jnp.where(keep_orig, boxes, merged)
+
+        merged = jax.vmap(per_image)(bv, sv)
+        return merged
+
+    merged = apply_op(fn, (bb, sc), differentiable=False)
+    return multiclass_nms(merged, sc, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, normalized=normalized,
+                          nms_eta=nms_eta, background_label=background_label)
+
+
+def polygon_box_transform(input, name=None):
+    """Offset-to-coordinate transform (polygon_box_transform_op.cc): for
+    channel c at (h, w): out = (w if c even else h) * 4 - in."""
+    def fn(v):
+        B, C, H, W = v.shape
+        widx = jnp.arange(W, dtype=v.dtype)[None, None, None, :]
+        hidx = jnp.arange(H, dtype=v.dtype)[None, None, :, None]
+        even = (jnp.arange(C) % 2 == 0)[None, :, None, None]
+        base = jnp.where(even, widx * 4.0, hidx * 4.0)
+        return base - v
+
+    return apply_op(fn, (_t(input),))
+
+
+# ---------------------------------------------------------------------------
+# proposal generation (RPN) + FPN routing
+# ---------------------------------------------------------------------------
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=True, name=None):
+    """RPN proposal generation (detection.py:2887): decode anchor deltas,
+    clip to image, drop tiny boxes, top-k, NMS. Dense contract: scores
+    (B, A, H, W); bbox_deltas (B, 4A, H, W); anchors/variances (H, W, A, 4)
+    or (A', 4). Returns (rois (B, post_nms_top_n, 4), roi_probs
+    (B, post_nms_top_n, 1)[, rois_num (B,)]) — fixed shape, zero rows past
+    each image's count."""
+    sc = _t(scores)
+    bd = _t(bbox_deltas)
+    im = _t(im_info)
+    an = _t(anchors)
+    va = _t(variances)
+
+    def fn(sv, dv, imv, anv, vav):
+        B = sv.shape[0]
+        A4 = anv.reshape(-1, 4)
+        V4 = vav.reshape(-1, 4)
+        N = A4.shape[0]
+        pre = min(pre_nms_top_n, N)
+        post = min(post_nms_top_n, pre)
+
+        def one(s_i, d_i, info):
+            s = s_i.transpose(1, 2, 0).reshape(-1)       # (H*W*A,)
+            d = d_i.reshape(-1, 4, *d_i.shape[1:3]) if False else \
+                d_i.transpose(1, 2, 0).reshape(-1, 4)
+            # decode center-size with variances
+            aw = A4[:, 2] - A4[:, 0] + 1.0
+            ah = A4[:, 3] - A4[:, 1] + 1.0
+            ax = A4[:, 0] + aw * 0.5
+            ay = A4[:, 1] + ah * 0.5
+            cx = V4[:, 0] * d[:, 0] * aw + ax
+            cy = V4[:, 1] * d[:, 1] * ah + ay
+            w = jnp.exp(jnp.minimum(V4[:, 2] * d[:, 2],
+                                    math.log(1000.0 / 16))) * aw
+            h = jnp.exp(jnp.minimum(V4[:, 3] * d[:, 3],
+                                    math.log(1000.0 / 16))) * ah
+            boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                               cx + w / 2, cy + h / 2], axis=1)
+            H_im, W_im = info[0], info[1]
+            boxes = jnp.stack([
+                jnp.clip(boxes[:, 0], 0, W_im - 1),
+                jnp.clip(boxes[:, 1], 0, H_im - 1),
+                jnp.clip(boxes[:, 2], 0, W_im - 1),
+                jnp.clip(boxes[:, 3], 0, H_im - 1)], axis=1)
+            ms = min_size * info[2]
+            keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms) &
+                    (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+            s = jnp.where(keep, s, -jnp.inf)
+            top = jnp.argsort(-s)[:pre]
+            tb, ts = boxes[top], s[top]
+            order, alive = _nms_single(tb, ts, nms_thresh, post,
+                                       -jnp.inf, False)
+            rb = jnp.where(alive[:, None], tb[order], 0.0)
+            rs = jnp.where(alive, ts[order], 0.0)
+            return rb, rs[:, None], alive.sum().astype(jnp.int32)
+
+        rois, probs, counts = jax.vmap(one)(sv, dv, imv)
+        return rois, probs, counts
+
+    rois, probs, counts = apply_op(fn, (sc, bd, im, an, va), n_outputs=3,
+                                   differentiable=False)
+    if return_rois_num:
+        return rois, probs, counts
+    return rois, probs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Route RoIs to FPN levels by scale (detection.py:3857):
+    level = floor(log2(sqrt(area) / refer_scale + 1e-6)) + refer_level,
+    clipped to [min_level, max_level]. Dense: returns one (R, 4) tensor per
+    level with non-member rows zeroed, a per-level mask-count list, and the
+    restore index (R, 1) mapping sorted-by-level order back to input."""
+    fr = _t(fpn_rois)
+    n_levels = max_level - min_level + 1
+
+    def fn(rv):
+        R = rv.shape[0]
+        area = jnp.maximum((rv[:, 2] - rv[:, 0]) *
+                           (rv[:, 3] - rv[:, 1]), 0.0)
+        scale = jnp.sqrt(area)
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        outs = []
+        for L in range(min_level, max_level + 1):
+            m = (lvl == L)
+            outs.append(jnp.where(m[:, None], rv, 0.0))
+            outs.append(m.sum().astype(jnp.int32))
+        order = jnp.argsort(lvl, stable=True)
+        restore = jnp.zeros((R,), jnp.int32).at[order].set(
+            jnp.arange(R, dtype=jnp.int32))
+        outs.append(restore[:, None])
+        return tuple(outs)
+
+    res = apply_op(fn, (fr,), n_outputs=2 * n_levels + 1,
+                   differentiable=False)
+    multi_rois = [res[2 * i] for i in range(n_levels)]
+    counts = [res[2 * i + 1] for i in range(n_levels)]
+    restore_ind = res[-1]
+    if rois_num is not None:
+        return multi_rois, restore_ind, counts
+    return multi_rois, restore_ind
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Concat per-level RoIs and keep the global top-k by score
+    (detection.py:3954). Dense: returns (post_nms_top_n, 4) (+ count)."""
+    rois = [_t(r) for r in multi_rois]
+    scores = [_t(s) for s in multi_scores]
+
+    def fn(*vals):
+        n = len(vals) // 2
+        rv = jnp.concatenate(vals[:n], axis=0)
+        sv = jnp.concatenate([v.reshape(-1) for v in vals[n:]], axis=0)
+        k = min(post_nms_top_n, sv.shape[0])
+        top = jnp.argsort(-sv)[:k]
+        return rv[top], sv[top][:, None]
+
+    return apply_op(fn, tuple(rois + scores), n_outputs=2,
+                    differentiable=False)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    """Per-class box decode + best-class assignment (detection.py:3790).
+    prior_box (P, 4); target_box (P, 4*C) per-class deltas; box_score
+    (P, C). Returns (decoded (P, 4*C), assigned (P, 4))."""
+    pb = _t(prior_box)
+    pv = _t(prior_box_var)
+    tb = _t(target_box)
+    bs = _t(box_score)
+
+    def fn(p, v, t, s):
+        P = p.shape[0]
+        C = s.shape[1]
+        pw = p[:, 2] - p[:, 0] + 1.0
+        ph = p[:, 3] - p[:, 1] + 1.0
+        px = p[:, 0] + pw * 0.5
+        py = p[:, 1] + ph * 0.5
+        d = t.reshape(P, C, 4)
+        cx = v[:, None, 0] * d[:, :, 0] * pw[:, None] + px[:, None]
+        cy = v[:, None, 1] * d[:, :, 1] * ph[:, None] + py[:, None]
+        w = jnp.exp(jnp.minimum(v[:, None, 2] * d[:, :, 2], box_clip)) \
+            * pw[:, None]
+        h = jnp.exp(jnp.minimum(v[:, None, 3] * d[:, :, 3], box_clip)) \
+            * ph[:, None]
+        dec = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1], axis=2)
+        best = jnp.argmax(s[:, 1:], axis=1) + 1   # skip background col 0
+        assigned = jnp.take_along_axis(
+            dec, best[:, None, None].repeat(4, 2), axis=1)[:, 0]
+        return dec.reshape(P, 4 * C), assigned
+
+    return apply_op(fn, (pb, pv, tb, bs), n_outputs=2,
+                    differentiable=False)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet inference output (detection.py:3679): decode each FPN
+    level's deltas vs its anchors, concat levels, class-wise NMS. bboxes /
+    scores / anchors are per-level lists; returns the padded multiclass_nms
+    output."""
+    from ..tensor.manipulation import concat, transpose
+    decoded = []
+    for bb, an in zip(bboxes, anchors):
+        dec = box_coder(an, [1.0, 1.0, 1.0, 1.0], bb,
+                        code_type='decode_center_size', axis=0)
+        decoded.append(dec)
+    all_boxes = concat(decoded, axis=1)                 # (B, sumA, 4)
+    all_scores = concat(list(scores), axis=1)           # (B, sumA, C)
+    sc = transpose(all_scores, [0, 2, 1])
+    return multiclass_nms(all_boxes, sc, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, nms_eta=nms_eta,
+                          background_label=-1)
+
+
+# ---------------------------------------------------------------------------
+# host-side sampling generators (reference pins these to CPU too)
+# ---------------------------------------------------------------------------
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """Fast R-CNN training ROI sampling (detection.py:2464). EAGER host op
+    (dynamic sampling; the reference's generate_proposal_labels_op is
+    CPU-only as well). One image: rpn_rois (R, 4), gt_* dense padded.
+    Returns fixed-size (rois (S, 4), labels_int32 (S, 1), bbox_targets
+    (S, 4*class_nums), bbox_inside_weights, bbox_outside_weights) with
+    S = batch_size_per_im; unused rows zero."""
+    rois = np.asarray(_t(rpn_rois).numpy())
+    gtc = np.asarray(_t(gt_classes).numpy()).reshape(-1)
+    gtb = np.asarray(_t(gt_boxes).numpy()).reshape(-1, 4)
+    valid = (gtb[:, 2] - gtb[:, 0]) * (gtb[:, 3] - gtb[:, 1]) > 0
+    gtb, gtc = gtb[valid], gtc[valid]
+    S = int(batch_size_per_im)
+    rng = np.random.RandomState(0 if not use_random else None)
+
+    allr = np.concatenate([rois, gtb], axis=0) if len(gtb) else rois
+    if len(gtb):
+        x11, y11 = allr[:, 0:1], allr[:, 1:2]
+        x12, y12 = allr[:, 2:3], allr[:, 3:4]
+        x21, y21 = gtb[:, 0], gtb[:, 1]
+        x22, y22 = gtb[:, 2], gtb[:, 3]
+        iw = np.minimum(x12, x22[None, :]) - np.maximum(x11, x21[None, :])
+        ih = np.minimum(y12, y22[None, :]) - np.maximum(y11, y21[None, :])
+        inter = np.clip(iw, 0, None) * np.clip(ih, 0, None)
+        a1 = (x12 - x11) * (y12 - y11)
+        a2 = ((x22 - x21) * (y22 - y21))[None, :]
+        iou = inter / np.maximum(a1 + a2 - inter, 1e-10)
+        max_iou = iou.max(axis=1)
+        argmax = iou.argmax(axis=1)
+    else:
+        max_iou = np.zeros(len(allr))
+        argmax = np.zeros(len(allr), np.int64)
+
+    fg = np.where(max_iou >= fg_thresh)[0]
+    bg = np.where((max_iou < bg_thresh_hi) & (max_iou >= bg_thresh_lo))[0]
+    n_fg = min(int(fg_fraction * S), len(fg))
+    n_bg = min(S - n_fg, len(bg))
+    if use_random:
+        fg = rng.permutation(fg)
+        bg = rng.permutation(bg)
+    sel = np.concatenate([fg[:n_fg], bg[:n_bg]])
+
+    out_rois = np.zeros((S, 4), np.float32)
+    labels = np.zeros((S, 1), np.int32)
+    targets = np.zeros((S, 4 * class_nums), np.float32)
+    in_w = np.zeros_like(targets)
+    for i, r in enumerate(sel):
+        out_rois[i] = allr[r]
+        if i < n_fg and len(gtb):
+            g = argmax[r]
+            cls = int(gtc[g]) if not is_cls_agnostic else 1
+            labels[i] = cls
+            rw = max(allr[r, 2] - allr[r, 0], 1e-9)
+            rh = max(allr[r, 3] - allr[r, 1], 1e-9)
+            rx = allr[r, 0] + rw * 0.5
+            ry = allr[r, 1] + rh * 0.5
+            gw = max(gtb[g, 2] - gtb[g, 0], 1e-9)
+            gh = max(gtb[g, 3] - gtb[g, 1], 1e-9)
+            gx = gtb[g, 0] + gw * 0.5
+            gy = gtb[g, 1] + gh * 0.5
+            t = np.array([(gx - rx) / rw / bbox_reg_weights[0],
+                          (gy - ry) / rh / bbox_reg_weights[1],
+                          np.log(gw / rw) / bbox_reg_weights[2],
+                          np.log(gh / rh) / bbox_reg_weights[3]],
+                         np.float32)
+            targets[i, 4 * cls:4 * cls + 4] = t
+            in_w[i, 4 * cls:4 * cls + 4] = 1.0
+    out_w = (in_w > 0).astype(np.float32)
+    return (to_tensor(out_rois), to_tensor(labels), to_tensor(targets),
+            to_tensor(in_w), to_tensor(out_w))
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """Mask R-CNN mask-target rasterization (detection.py:2606). EAGER host
+    op. gt_segms: (G, P, 2) polygon points (dense-padded; NaN/zero rows
+    ignored). Returns (mask_rois (S, 4), roi_has_mask_int32 (S, 1),
+    mask_int32 (S, num_classes * resolution**2))."""
+    rois_np = np.asarray(_t(rois).numpy())
+    labs = np.asarray(_t(labels_int32).numpy()).reshape(-1)
+    segs = np.asarray(_t(gt_segms).numpy())
+    S = len(rois_np)
+    res = int(resolution)
+    masks = np.zeros((S, num_classes * res * res), np.int32)
+    has = np.zeros((S, 1), np.int32)
+    for i in range(S):
+        c = int(labs[i])
+        if c <= 0:
+            continue
+        has[i] = 1
+        x1, y1, x2, y2 = rois_np[i]
+        if x2 <= x1 or y2 <= y1 or len(segs) == 0:
+            continue
+        poly = segs[min(i, len(segs) - 1)].reshape(-1, 2)
+        poly = poly[np.isfinite(poly).all(axis=1)]
+        if len(poly) < 3:
+            continue
+        ys = (np.arange(res) + 0.5) / res * (y2 - y1) + y1
+        xs = (np.arange(res) + 0.5) / res * (x2 - x1) + x1
+        gx, gy = np.meshgrid(xs, ys)
+        inside = _points_in_poly(gx.ravel(), gy.ravel(), poly)
+        masks[i, c * res * res:(c + 1) * res * res] = \
+            inside.astype(np.int32)
+    return to_tensor(rois_np), to_tensor(has), to_tensor(masks)
+
+
+def _points_in_poly(px, py, poly):
+    """Even-odd rule point-in-polygon (host)."""
+    n = len(poly)
+    inside = np.zeros(len(px), bool)
+    j = n - 1
+    for i in range(n):
+        xi, yi = poly[i]
+        xj, yj = poly[j]
+        crosses = ((yi > py) != (yj > py)) & \
+            (px < (xj - xi) * (py - yi) / (yj - yi + 1e-12) + xi)
+        inside ^= crosses
+        j = i
+    return inside
+
+
+# ---------------------------------------------------------------------------
+# SSD head builder
+# ---------------------------------------------------------------------------
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD multibox head (detection.py:2042): per feature map, a prior_box
+    + 3x3 conv loc/conf heads; outputs concatenated across maps. Returns
+    (mbox_locs (B, P, 4), mbox_confs (B, P, C), boxes (P, 4),
+    variances (P, 4))."""
+    from ..static.nn import conv2d as _conv2d
+    from ..tensor.manipulation import concat, transpose, reshape
+    from .ops import prior_box as _prior_box
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # the reference's ratio interpolation (detection.py:2198)
+        min_sizes, max_sizes = [], []
+        step = int(math.floor((max_ratio - min_ratio) /
+                              max(n_layer - 2, 1)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        mn = min_sizes[i] if not isinstance(min_sizes[i], (list, tuple)) \
+            else min_sizes[i]
+        mx = max_sizes[i] if max_sizes else None
+        box, var = _prior_box(
+            feat, image, [mn] if not isinstance(mn, list) else mn,
+            [mx] if (mx and not isinstance(mx, list)) else mx,
+            ar, variance, flip, clip,
+            steps=[steps[i], steps[i]] if steps else [0.0, 0.0],
+            offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        n_boxes = int(np.prod(box.shape[:-1]))
+        n_per_cell = n_boxes // (feat.shape[2] * feat.shape[3])
+        loc = _conv2d(feat, n_per_cell * 4, kernel_size, stride=stride,
+                      padding=pad)
+        conf = _conv2d(feat, n_per_cell * num_classes, kernel_size,
+                       stride=stride, padding=pad)
+        locs.append(reshape(transpose(loc, [0, 2, 3, 1]),
+                            [loc.shape[0], -1, 4]))
+        confs.append(reshape(transpose(conf, [0, 2, 3, 1]),
+                             [conf.shape[0], -1, num_classes]))
+        boxes_l.append(reshape(box, [-1, 4]))
+        vars_l.append(reshape(var, [-1, 4]))
+    return (concat(locs, axis=1), concat(confs, axis=1),
+            concat(boxes_l, axis=0), concat(vars_l, axis=0))
+
+
+# ---------------------------------------------------------------------------
+# RoI pooling family + deformable ops
+# ---------------------------------------------------------------------------
+
+def _roi_batch_idx(rois_num, R):
+    if rois_num is None:
+        return None
+    return _t(rois_num)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    """RoI MAX pooling (nn.py:6860 / roi_pool_op): input (B, C, H, W);
+    rois (R, 4) absolute xyxy; rois_num (B,) rois per image. Quantized bin
+    edges (rounded), max within each bin — the Fast R-CNN original.
+    Returns (R, C, ph, pw)."""
+    x = _t(input)
+    r = _t(rois)
+    R = r.shape[0]
+    ph, pw = int(pooled_height), int(pooled_width)
+    rn = _roi_batch_idx(rois_num, R)
+
+    def fn(xv, rv, *rest):
+        B, C, H, W = xv.shape
+        if rest:
+            bounds = jnp.cumsum(rest[0].astype(jnp.int32))
+            bidx = jnp.searchsorted(bounds, jnp.arange(R, dtype=jnp.int32),
+                                    side='right').astype(jnp.int32)
+        else:
+            bidx = jnp.zeros((R,), jnp.int32)
+
+        def one(roi, b):
+            x1 = jnp.round(roi[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+            rw = jnp.maximum(x2 - x1 + 1, 1)
+            rh = jnp.maximum(y2 - y1 + 1, 1)
+            img = xv[b]                                     # (C, H, W)
+            yy = jnp.arange(H)
+            xx = jnp.arange(W)
+
+            def bin_val(py, px):
+                hs = y1 + (py * rh) // ph
+                he = y1 + ((py + 1) * rh + ph - 1) // ph
+                ws = x1 + (px * rw) // pw
+                we = x1 + ((px + 1) * rw + pw - 1) // pw
+                hs = jnp.clip(hs, 0, H)
+                he = jnp.clip(he, 0, H)
+                ws = jnp.clip(ws, 0, W)
+                we = jnp.clip(we, 0, W)
+                m = ((yy[:, None] >= hs) & (yy[:, None] < he) &
+                     (xx[None, :] >= ws) & (xx[None, :] < we))
+                empty = ~m.any()
+                v = jnp.where(m[None], img, -jnp.inf).max(axis=(1, 2))
+                return jnp.where(empty, 0.0, v)
+
+            grid = jnp.stack([jnp.stack([bin_val(py, px)
+                                         for px in range(pw)], axis=-1)
+                              for py in range(ph)], axis=-2)
+            return grid                                      # (C, ph, pw)
+
+        return jax.vmap(one)(rv, bidx)
+
+    tensors = (x, r) + ((rn,) if rn is not None else ())
+    return apply_op(fn, tensors)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """Position-sensitive RoI AVG pooling (nn.py:13732 / R-FCN): input
+    channels = output_channels * ph * pw; bin (i, j) of output channel c
+    averages input channel c*ph*pw + i*pw + j over that bin. Returns
+    (R, output_channels, ph, pw)."""
+    x = _t(input)
+    r = _t(rois)
+    R = r.shape[0]
+    ph, pw = int(pooled_height), int(pooled_width)
+    oc = int(output_channels)
+    rn = _roi_batch_idx(rois_num, R)
+
+    def fn(xv, rv, *rest):
+        B, C, H, W = xv.shape
+        if rest:
+            bounds = jnp.cumsum(rest[0].astype(jnp.int32))
+            bidx = jnp.searchsorted(bounds, jnp.arange(R, dtype=jnp.int32),
+                                    side='right').astype(jnp.int32)
+        else:
+            bidx = jnp.zeros((R,), jnp.int32)
+
+        def one(roi, b):
+            x1 = jnp.round(roi[0]) * spatial_scale
+            y1 = jnp.round(roi[1]) * spatial_scale
+            x2 = jnp.round(roi[2] + 1.0) * spatial_scale
+            y2 = jnp.round(roi[3] + 1.0) * spatial_scale
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            img = xv[b]
+            yy = jnp.arange(H)
+            xx = jnp.arange(W)
+
+            def bin_val(py, px):
+                hs = jnp.floor(y1 + py * rh / ph).astype(jnp.int32)
+                he = jnp.ceil(y1 + (py + 1) * rh / ph).astype(jnp.int32)
+                ws = jnp.floor(x1 + px * rw / pw).astype(jnp.int32)
+                we = jnp.ceil(x1 + (px + 1) * rw / pw).astype(jnp.int32)
+                hs = jnp.clip(hs, 0, H)
+                he = jnp.clip(he, 0, H)
+                ws = jnp.clip(ws, 0, W)
+                we = jnp.clip(we, 0, W)
+                m = ((yy[:, None] >= hs) & (yy[:, None] < he) &
+                     (xx[None, :] >= ws) & (xx[None, :] < we))
+                cnt = jnp.maximum(m.sum(), 1)
+                chans = jnp.arange(oc) * (ph * pw) + py * pw + px
+                sel = img[chans]                            # (oc, H, W)
+                return jnp.where(m[None], sel, 0.0).sum(axis=(1, 2)) / cnt
+
+            grid = jnp.stack([jnp.stack([bin_val(py, px)
+                                         for px in range(pw)], axis=-1)
+                              for py in range(ph)], axis=-2)
+            return grid                                     # (oc, ph, pw)
+
+        return jax.vmap(one)(rv, bidx)
+
+    tensors = (x, r) + ((rn,) if rn is not None else ())
+    return apply_op(fn, tensors)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """Precise RoI pooling (nn.py prroi_pool): exact bilinear-integral
+    average per bin. Computed with a dense 4x4-per-bin integration grid —
+    converges to the closed-form integral and stays fully differentiable
+    (the op's main point vs quantized roi_pool)."""
+    from .ops import roi_align
+    return roi_align(input, rois, pooled_height, pooled_width,
+                     spatial_scale, sampling_ratio=4,
+                     rois_num=batch_roi_nums)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    """Perspective-warp quadrilateral rois to a fixed rectangle
+    (detection.py:3100, OCR east): rois (R, 8) four corner points.
+    Bilinear sampling along the homography from the output rectangle to
+    the roi quad. Returns (R, C, th, tw) (+ mask/matrix outputs omitted:
+    the dense caller uses the warped patches)."""
+    x = _t(input)
+    r = _t(rois)
+    th, tw = int(transformed_height), int(transformed_width)
+
+    def fn(xv, rv):
+        B, C, H, W = xv.shape
+
+        def one(roi):
+            pts = roi.reshape(4, 2) * spatial_scale   # tl, tr, br, bl
+            u = (jnp.arange(tw, dtype=xv.dtype) + 0.5) / tw
+            v = (jnp.arange(th, dtype=xv.dtype) + 0.5) / th
+            uu, vv = jnp.meshgrid(u, v)               # (th, tw)
+            top = pts[0][None, None] * (1 - uu[..., None]) + \
+                pts[1][None, None] * uu[..., None]
+            bot = pts[3][None, None] * (1 - uu[..., None]) + \
+                pts[2][None, None] * uu[..., None]
+            p = top * (1 - vv[..., None]) + bot * vv[..., None]
+            px, py = p[..., 0], p[..., 1]
+            px = jnp.clip(px, 0.0, W - 1.0)
+            py = jnp.clip(py, 0.0, H - 1.0)
+            x0 = jnp.floor(px).astype(jnp.int32)
+            y0 = jnp.floor(py).astype(jnp.int32)
+            x1 = jnp.minimum(x0 + 1, W - 1)
+            y1 = jnp.minimum(y0 + 1, H - 1)
+            wx = px - x0
+            wy = py - y0
+            img = xv[0]
+            g = lambda yi, xi: img[:, yi, xi]          # (C, th, tw)
+            return (g(y0, x0) * ((1 - wy) * (1 - wx))[None] +
+                    g(y0, x1) * ((1 - wy) * wx)[None] +
+                    g(y1, x0) * (wy * (1 - wx))[None] +
+                    g(y1, x1) * (wy * wx)[None])
+
+        return jax.vmap(one)(rv)
+
+    return apply_op(fn, (x, r))
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    """Deformable convolution v1/v2 (nn.py:14234) as a dense offset-gather:
+    for each output position and kernel tap, bilinear-sample the input at
+    (base + dilation*tap + offset), multiply by the modulation mask (v2),
+    then contract with the weights — one big matmul for the MXU instead of
+    the reference's im2col + GEMM CUDA kernel.
+
+    input (B, Cin, H, W); offset (B, 2*dg*kh*kw, Hout, Wout) packed
+    [y0, x0, y1, x1, ...]; mask (B, dg*kh*kw, Hout, Wout) (modulated=True).
+    """
+    from ..fluid.layers_tail import _op_param
+    from ..nn.initializer import XavierUniform, Constant
+    x = _t(input)
+    off = _t(offset)
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    kh, kw = int(ks[0]), int(ks[1])
+    s = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    p = padding if isinstance(padding, (list, tuple)) \
+        else (padding, padding)
+    d = dilation if isinstance(dilation, (list, tuple)) \
+        else (dilation, dilation)
+    Cin = x.shape[1]
+    w = _op_param([num_filters, Cin // groups, kh, kw], param_attr,
+                  XavierUniform(), 'deformable_conv_w')
+    tensors = [x, off, w]
+    if bias_attr is not False:
+        tensors.append(_op_param([num_filters], bias_attr, Constant(0.0),
+                                 'deformable_conv_b'))
+    if modulated:
+        if mask is None:
+            raise ValueError("modulated deformable_conv (v2) needs mask")
+        tensors.append(_t(mask))
+
+    def fn2(xv, ov, wv, *rest):
+        rest = list(rest)
+        bv = rest.pop(0) if bias_attr is not False else None
+        mv = rest.pop(0) if modulated else None
+        B = xv.shape[0]
+        outs = []
+        for b in range(B):
+            outs.append(_deform_one(xv[b], ov[b], wv,
+                                    None if mv is None else mv[b],
+                                    kh, kw, s, p, d, groups))
+        out = jnp.stack(outs)
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    return apply_op(fn2, tuple(tensors))
+
+
+def _deform_one(img, off, wv, msk, kh, kw, s, p, d, groups):
+    """Deformable conv for ONE image (see deformable_conv)."""
+    C, H, W = img.shape
+    # the offset tensor is authoritative for the output spatial dims
+    # (reference contract: offset is (2*dg*kh*kw, Hout, Wout))
+    Ho, Wo = off.shape[-2], off.shape[-1]
+    dg = off.shape[0] // (2 * kh * kw)
+    cpg = C // dg
+    oy = jnp.arange(Ho) * s[0] - p[0]
+    ox = jnp.arange(Wo) * s[1] - p[1]
+    ky = jnp.arange(kh) * d[0]
+    kx = jnp.arange(kw) * d[1]
+    off = off.reshape(dg, kh * kw, 2, Ho, Wo)
+    sy = (oy[None, None, :, None] + ky[None, :, None, None]
+          ).reshape(1, kh, 1, Ho, 1) + 0.0
+    sy = jnp.broadcast_to(sy, (dg, kh, kw, Ho, Wo)) + \
+        off[:, :, 0].reshape(dg, kh, kw, Ho, Wo)
+    sx = (ox[None, None, None, :] + kx[None, None, :, None]
+          ).reshape(1, 1, kw, 1, Wo)
+    sx = jnp.broadcast_to(sx, (dg, kh, kw, Ho, Wo)) + \
+        off[:, :, 1].reshape(dg, kh, kw, Ho, Wo)
+    inb = (sy > -1.0) & (sy < H) & (sx > -1.0) & (sx < W)
+    syc = jnp.clip(sy, 0.0, H - 1.0)
+    sxc = jnp.clip(sx, 0.0, W - 1.0)
+    y0 = jnp.floor(syc).astype(jnp.int32)
+    x0 = jnp.floor(sxc).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = syc - y0
+    wx = sxc - x0
+
+    flat = img.reshape(dg, cpg, H * W)
+
+    def sample(yi, xi):
+        idx = (yi * W + xi).reshape(dg, 1, -1)
+        out = jnp.take_along_axis(flat, jnp.broadcast_to(
+            idx, (dg, cpg, idx.shape[-1])), axis=2)
+        return out.reshape(C, kh, kw, Ho, Wo)
+
+    def rep(a):
+        return jnp.broadcast_to(a[:, None], (dg, cpg) + a.shape[1:]) \
+            .reshape(C, kh, kw, Ho, Wo)
+
+    v = (sample(y0, x0) * rep((1 - wy) * (1 - wx)) +
+         sample(y0, x1) * rep((1 - wy) * wx) +
+         sample(y1, x0) * rep(wy * (1 - wx)) +
+         sample(y1, x1) * rep(wy * wx))
+    v = v * rep(inb.astype(v.dtype))
+    if msk is not None:
+        v = v * rep(msk.reshape(dg, kh, kw, Ho, Wo))
+    if groups == 1:
+        return jnp.einsum('cklhw,fckl->fhw', v, wv)
+    Fg = wv.shape[0] // groups
+    vg = v.reshape(groups, C // groups, kh, kw, Ho, Wo)
+    wg = wv.reshape(groups, Fg, C // groups, kh, kw)
+    return jnp.einsum('gcklhw,gfckl->gfhw', vg, wg).reshape(-1, Ho, Wo)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    """Deformable RoI pooling (nn.py:14391): average-pool each bin at
+    trans-shifted positions via bilinear sampling. input (B, C, H, W);
+    rois (R, 4); trans (R, 2, ph, pw) normalized bin shifts."""
+    x = _t(input)
+    r = _t(rois)
+    tr = _t(trans)
+    ph, pw = int(pooled_height), int(pooled_width)
+    spp = max(int(sample_per_part), 1)
+
+    def fn2(xv, rv, tv):
+        B, C, H, W = xv.shape
+
+        def one(roi, t):
+            x1 = roi[0] * spatial_scale - 0.5
+            y1 = roi[1] * spatial_scale - 0.5
+            x2 = (roi[2] + 1.0) * spatial_scale - 0.5
+            y2 = (roi[3] + 1.0) * spatial_scale - 0.5
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bin_w = rw / pw
+            bin_h = rh / ph
+            img = xv[0]
+            outs = []
+            for py in range(ph):
+                row = []
+                for px in range(pw):
+                    dy = 0.0 if no_trans else t[0, py, px] * trans_std * rh
+                    dx = 0.0 if no_trans else t[1, py, px] * trans_std * rw
+                    sub = (jnp.arange(spp, dtype=xv.dtype) + 0.5) / spp
+                    yy = y1 + (py + sub) * bin_h + dy
+                    xx = x1 + (px + sub) * bin_w + dx
+                    yy = jnp.clip(yy, 0.0, H - 1.0)
+                    xx = jnp.clip(xx, 0.0, W - 1.0)
+                    y0 = jnp.floor(yy).astype(jnp.int32)
+                    x0 = jnp.floor(xx).astype(jnp.int32)
+                    y1i = jnp.minimum(y0 + 1, H - 1)
+                    x1i = jnp.minimum(x0 + 1, W - 1)
+                    wy = yy - y0
+                    wx = xx - x0
+                    g = lambda yi, xi: img[:, yi, :][:, :, xi]
+                    v = (g(y0, x0) * ((1 - wy)[:, None] *
+                                      (1 - wx)[None, :])[None] +
+                         g(y0, x1i) * ((1 - wy)[:, None] *
+                                       wx[None, :])[None] +
+                         g(y1i, x0) * (wy[:, None] *
+                                       (1 - wx)[None, :])[None] +
+                         g(y1i, x1i) * (wy[:, None] * wx[None, :])[None])
+                    row.append(v.mean(axis=(1, 2)))
+                outs.append(jnp.stack(row, axis=-1))
+            return jnp.stack(outs, axis=-2)       # (C, ph, pw)
+
+        return jax.vmap(one)(rv, tv)
+
+    return apply_op(fn2, (x, r, tr))

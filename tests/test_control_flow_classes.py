@@ -1,0 +1,221 @@
+"""Classic 1.8 control-flow classes (While/Switch/IfElse/StaticRNN/
+DynamicRNN/Print/Assert) running verbatim-style scripts through Executor."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as layers
+import paddle_tpu.static as static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+class TestWhile:
+    def test_counter_loop(self, static_mode):
+        """The canonical 1.8 While example (control_flow.py:992)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            i = layers.fill_constant(shape=[1], dtype='int32', value=0)
+            loop_len = layers.fill_constant(shape=[1], dtype='int32',
+                                            value=10)
+            cond = layers.less_than(x=i, y=loop_len)
+            while_op = layers.While(cond=cond)
+            with while_op.block():
+                i = layers.increment(x=i, value=1, in_place=True)
+                layers.less_than(x=i, y=loop_len, cond=cond)
+            exe = static.Executor()
+            out = exe.run(prog, fetch_list=[i])
+        assert int(out[0][0]) == 10
+
+    def test_accumulator_loop(self, static_mode):
+        """Loop-carried float accumulation via assign(output=...)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            i = layers.fill_constant(shape=[1], dtype='int32', value=0)
+            n = layers.fill_constant(shape=[1], dtype='int32', value=5)
+            acc = layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+            cond = layers.less_than(x=i, y=n)
+            w = layers.While(cond=cond)
+            with w.block():
+                new_acc = acc + 2.5
+                layers.assign(new_acc, output=acc)
+                i = layers.increment(x=i, value=1, in_place=True)
+                layers.less_than(x=i, y=n, cond=cond)
+            exe = static.Executor()
+            out = exe.run(prog, fetch_list=[acc])
+        np.testing.assert_allclose(out[0], [12.5])
+
+
+class TestSwitch:
+    def test_lr_switch(self, static_mode):
+        """The canonical Switch use: piecewise value by global step."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            lr = layers.create_global_var(shape=[1], value=0.0,
+                                          dtype='float32', persistable=True,
+                                          name='sw_lr')
+            step = static.data('step', [1], 'float32')
+            one = layers.fill_constant([1], 'float32', 1.0)
+            two = layers.fill_constant([1], 'float32', 2.0)
+            with layers.Switch() as switch:
+                with switch.case(layers.less_than(step, one)):
+                    layers.assign(layers.fill_constant([1], 'float32', 0.1),
+                                  output=lr)
+                with switch.case(layers.less_than(step, two)):
+                    layers.assign(layers.fill_constant([1], 'float32', 0.05),
+                                  output=lr)
+                with switch.default():
+                    layers.assign(layers.fill_constant([1], 'float32', 0.01),
+                                  output=lr)
+            exe = static.Executor()
+            for s, expect in [(0.5, 0.1), (1.5, 0.05), (5.0, 0.01)]:
+                out = exe.run(prog, feed={'step': np.array([s], np.float32)},
+                              fetch_list=[lr])
+                np.testing.assert_allclose(out[0], [expect], rtol=1e-6)
+
+
+class TestIfElse:
+    def test_rowwise_branches(self, static_mode):
+        """The reference's doc example: x>y rows minus 10, others plus 10
+        (control_flow.py:2779)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data('x', [4, 1], 'float32')
+            y = static.data('y', [4, 1], 'float32')
+            cond = layers.greater_than(x, y)
+            ie = layers.IfElse(cond)
+            with ie.true_block():
+                out_1 = ie.input(x)
+                out_1 = out_1 - 10
+                ie.output(out_1)
+            with ie.false_block():
+                out_1 = ie.input(x)
+                out_1 = out_1 + 10
+                ie.output(out_1)
+            merged = ie()[0]
+            exe = static.Executor()
+            out = exe.run(
+                prog,
+                feed={'x': np.array([[3], [1], [-2], [-3]], np.float32),
+                      'y': np.zeros((4, 1), np.float32)},
+                fetch_list=[merged])
+        np.testing.assert_allclose(out[0].reshape(-1), [-7, -9, 8, 7])
+
+
+class TestStaticRNN:
+    def test_accumulating_rnn(self, static_mode):
+        """StaticRNN whose memory accumulates step inputs: final outputs
+        are prefix sums (verifiable analytically)."""
+        T, B, D = 4, 2, 3
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data('x', [T, B, D], 'float32')
+            rnn = layers.StaticRNN()
+            with rnn.step():
+                word = rnn.step_input(x)
+                prev = rnn.memory(shape=[-1, D], batch_ref=word)
+                hidden = prev + word
+                rnn.update_memory(prev, hidden)
+                rnn.step_output(hidden)
+            result = rnn()
+            exe = static.Executor()
+            xv = np.random.RandomState(0).randn(T, B, D).astype(np.float32)
+            out = exe.run(prog, feed={'x': xv}, fetch_list=[result])
+        np.testing.assert_allclose(out[0], np.cumsum(xv, axis=0), rtol=1e-5)
+
+    def test_rnn_with_fc(self, static_mode):
+        """The docstring-style recipe: fc over [word, prev] per step."""
+        T, B, D, H = 3, 2, 4, 5
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data('x', [T, B, D], 'float32')
+            rnn = layers.StaticRNN()
+            with rnn.step():
+                word = rnn.step_input(x)
+                prev = rnn.memory(shape=[-1, H], batch_ref=word)
+                joint = layers.concat([word, prev], axis=1)
+                hidden = layers.fc(joint, size=H, activation='relu')
+                rnn.update_memory(prev, hidden)
+                rnn.step_output(hidden)
+            result = rnn()
+            exe = static.Executor()
+            xv = np.random.RandomState(0).randn(T, B, D).astype(np.float32)
+            out = exe.run(prog, feed={'x': xv}, fetch_list=[result])
+        assert out[0].shape == (T, B, H)
+        assert np.isfinite(out[0]).all()
+        assert (out[0] >= 0).all()        # relu
+
+
+class TestDynamicRNN:
+    def test_masked_lengths(self, static_mode):
+        """DynamicRNN freezes memories and zeroes outputs past each row's
+        length (the dense analogue of LoD shrinking)."""
+        B, T, D = 2, 4, 3
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data('x', [B, T, D], 'float32')
+            lens = static.data('lens', [B], 'int32')
+            drnn = layers.DynamicRNN()
+            with drnn.block():
+                w = drnn.step_input(x, length=lens)
+                prev = drnn.memory(shape=[D])
+                h = prev + w
+                drnn.update_memory(prev, h)
+                drnn.output(h)
+            res = drnn()
+            exe = static.Executor()
+            xv = np.ones((B, T, D), np.float32)
+            lv = np.array([2, 4], np.int32)
+            out = exe.run(prog, feed={'x': xv, 'lens': lv},
+                          fetch_list=[res])
+        o = out[0]
+        assert o.shape == (B, T, D)
+        np.testing.assert_allclose(o[0, :2], np.cumsum(xv[0, :2], 0))
+        np.testing.assert_allclose(o[0, 2:], 0.0)        # past length
+        np.testing.assert_allclose(o[1], np.cumsum(xv[1], 0))
+
+
+class TestPrintAssert:
+    def test_print_passthrough(self, static_mode):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data('x', [2], 'float32')
+            y = layers.Print(x, message='dbg') * 2.0
+            exe = static.Executor()
+            out = exe.run(prog, feed={'x': np.array([1.0, 2.0], np.float32)},
+                          fetch_list=[y])
+        np.testing.assert_allclose(out[0], [2.0, 4.0])
+
+    def test_assert_raises(self):
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        with pytest.raises(Exception):
+            layers.Assert(x > 1.0)
+
+    def test_assert_passes(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        layers.Assert(x > 1.0)   # no raise
+
+    def test_reorder_identity(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        out = layers.reorder_lod_tensor_by_rank(x, None)
+        np.testing.assert_allclose(out.numpy(), x.numpy())
+
+
+class TestEagerWriterOps:
+    def test_increment_eager_inplace(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        layers.increment(x, 2.0)
+        np.testing.assert_allclose(x.numpy(), [3.0])
+
+    def test_cmp_eager(self):
+        a = paddle.to_tensor(np.array([1.0], np.float32))
+        b = paddle.to_tensor(np.array([2.0], np.float32))
+        assert bool(layers.less_than(a, b).numpy()[0])
+        assert not bool(layers.greater_than(a, b).numpy()[0])
+        assert bool(layers.not_equal(a, b).numpy()[0])

@@ -1,0 +1,517 @@
+"""Numpy-reference tests for the classic detection TRAINING suite."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid.layers as L
+from paddle_tpu.core.tensor import to_tensor
+
+
+def t(x, dtype=np.float32):
+    return to_tensor(np.asarray(x, dtype=dtype))
+
+
+class TestBipartiteMatch:
+    def test_greedy_matching(self):
+        # hand-verifiable: global max first, rows/cols retired
+        dist = np.array([[[0.9, 0.1, 0.3],
+                          [0.8, 0.7, 0.2]]], np.float32)   # (1, G=2, P=3)
+        m, md = L.bipartite_match(t(dist))
+        m, md = m.numpy()[0], md.numpy()[0]
+        # gt0 takes prior0 (0.9); gt1 then takes prior1 (0.7)
+        np.testing.assert_array_equal(m, [0, 1, -1])
+        np.testing.assert_allclose(md, [0.9, 0.7, 0.0], rtol=1e-6)
+
+    def test_per_prediction_extra_matches(self):
+        dist = np.array([[[0.9, 0.6, 0.3]]], np.float32)    # one gt
+        m, _ = L.bipartite_match(t(dist), match_type='per_prediction',
+                                 dist_threshold=0.5)
+        # prior0 matched greedily; prior1 also >= 0.5 -> matched to gt0
+        np.testing.assert_array_equal(m.numpy()[0], [0, 0, -1])
+
+    def test_padded_gt_rows_ignored(self):
+        dist = np.array([[[0.9, 0.8], [0.0, 0.0]]], np.float32)
+        m, _ = L.bipartite_match(t(dist))
+        assert m.numpy()[0][0] == 0          # only the valid row matches
+
+
+class TestTargetAssign:
+    def test_gather_and_weights(self):
+        x = np.arange(12, dtype=np.float32).reshape(1, 3, 4)
+        mi = np.array([[2, -1, 0]], np.int32)
+        out, w = L.target_assign(t(x), t(mi, np.int32), mismatch_value=7.0)
+        np.testing.assert_allclose(out.numpy()[0, 0], x[0, 2])
+        np.testing.assert_allclose(out.numpy()[0, 1], [7.0] * 4)
+        np.testing.assert_allclose(out.numpy()[0, 2], x[0, 0])
+        np.testing.assert_allclose(w.numpy()[0].reshape(-1), [1, 0, 1])
+
+
+class TestSSDLoss:
+    def test_loss_positive_and_backprop(self):
+        rs = np.random.RandomState(0)
+        B, P, C, G = 2, 8, 4, 3
+        prior = np.sort(rs.rand(P, 4).astype(np.float32), axis=1)
+        loc = paddle.to_tensor(rs.randn(B, P, 4).astype(np.float32))
+        conf = paddle.to_tensor(rs.randn(B, P, C).astype(np.float32))
+        loc.stop_gradient = False
+        conf.stop_gradient = False
+        gt_box = np.tile(prior[None, :G] * 0.9 + 0.05, (B, 1, 1))
+        gt_label = rs.randint(1, C, (B, G)).astype(np.int64)
+        loss = L.ssd_loss(loc, conf, t(gt_box), t(gt_label, np.int64),
+                          t(prior))
+        assert loss.shape == [B, 1]
+        assert (loss.numpy() > 0).all()
+        loss.sum().backward()
+        assert np.isfinite(conf.grad.numpy()).all()
+        assert np.abs(conf.grad.numpy()).sum() > 0
+
+    def test_perfect_predictions_lower_loss(self):
+        rs = np.random.RandomState(1)
+        B, P, C = 1, 6, 3
+        prior = np.sort(rs.rand(P, 4).astype(np.float32), axis=1)
+        gt_box = prior[None, :2].copy()
+        gt_label = np.array([[1, 2]], np.int64)
+        # confident-correct confidences vs random
+        good_conf = np.full((B, P, C), -6.0, np.float32)
+        good_conf[:, :, 0] = 6.0          # background everywhere
+        loc0 = np.zeros((B, P, 4), np.float32)
+        bad_conf = rs.randn(B, P, C).astype(np.float32)
+        l_good = L.ssd_loss(t(loc0), t(good_conf), t(gt_box),
+                            t(gt_label, np.int64), t(prior))
+        l_bad = L.ssd_loss(t(loc0), t(bad_conf), t(gt_box),
+                           t(gt_label, np.int64), t(prior))
+        # good conf is wrong on the 2 matched priors but right on
+        # negatives; the loss must still be finite and differ
+        assert np.isfinite(l_good.numpy()).all()
+        assert not np.allclose(l_good.numpy(), l_bad.numpy())
+
+
+class TestFocalLoss:
+    def test_matches_numpy_reference(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(6, 3).astype(np.float32)
+        lab = rs.randint(0, 4, (6, 1)).astype(np.int32)
+        fg = np.array([2], np.int32)
+        out = L.sigmoid_focal_loss(t(x), t(lab, np.int32),
+                                   t(fg, np.int32)).numpy()
+        # numpy reference (sigmoid_focal_loss_op.h)
+        gamma, alpha = 2.0, 0.25
+        p = 1 / (1 + np.exp(-x))
+        ref = np.zeros_like(x)
+        for i in range(6):
+            for c in range(3):
+                tgt = 1.0 if lab[i, 0] == c + 1 else 0.0
+                ce = max(x[i, c], 0) - x[i, c] * tgt + \
+                    np.log1p(np.exp(-abs(x[i, c])))
+                p_t = p[i, c] * tgt + (1 - p[i, c]) * (1 - tgt)
+                a_t = alpha * tgt + (1 - alpha) * (1 - tgt)
+                ref[i, c] = a_t * (1 - p_t) ** gamma * ce / max(fg[0], 1)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+
+class TestRPNTargetAssign:
+    def test_shapes_and_fg_selection(self):
+        rs = np.random.RandomState(0)
+        A, S = 20, 8
+        anchors = np.sort(rs.rand(A, 4) * 10, axis=1).astype(np.float32)
+        gt = anchors[:2].copy()               # two perfect-overlap gts
+        bbox_pred = rs.randn(A, 4).astype(np.float32)
+        cls_logits = rs.randn(A, 1).astype(np.float32)
+        sp, lp, st, lt, iw = L.rpn_target_assign(
+            t(bbox_pred), t(cls_logits), t(anchors),
+            t(np.ones((A, 4), np.float32)), t(gt),
+            rpn_batch_size_per_im=S)
+        assert sp.shape == [S, 1] and lp.shape == [S, 4]
+        assert st.shape == [S, 1] and lt.shape == [S, 4]
+        assert iw.shape == [S, 4]
+        st_np = st.numpy().reshape(-1)
+        assert st_np[:2].sum() >= 2           # the 2 exact-match anchors fg
+        # fg rows have ~zero loc targets (gt == anchor)
+        fg_rows = iw.numpy()[:, 0] > 0
+        np.testing.assert_allclose(lt.numpy()[fg_rows], 0.0, atol=1e-5)
+
+
+class TestRetinanetTargetAssign:
+    def test_all_anchor_output(self):
+        rs = np.random.RandomState(0)
+        A = 12
+        anchors = np.sort(rs.rand(A, 4) * 10, axis=1).astype(np.float32)
+        gt = anchors[:1].copy()
+        glab = np.array([[3]], np.int32)
+        outs = L.retinanet_target_assign(
+            t(rs.randn(A, 4)), t(rs.randn(A, 2)), t(anchors),
+            t(np.ones((A, 4), np.float32)), t(gt), t(glab, np.int32))
+        sp, lp, st, lt, iw, fg_num = outs
+        assert st.shape == [A, 1]
+        assert int(fg_num.numpy()[0, 0]) >= 1
+        assert int(st.numpy()[0, 0]) == 3     # fg anchor carries class id
+
+
+class TestYolov3Loss:
+    def _numpy_ref(self, x, gt_box, gt_label, anchors, mask, K,
+                   ignore_thresh, ds):
+        """Direct port of yolov3_loss_op.h for the test."""
+        B, C, H, W = x.shape
+        an_num = len(anchors) // 2
+        mn = len(mask)
+        input_size = ds * H
+        sw = min(1.0 / K, 1.0 / 40)
+        pos_l, neg_l = 1.0 - sw, sw
+
+        def sce(z, tv):
+            return max(z, 0) - z * tv + np.log1p(np.exp(-abs(z)))
+
+        def sig(z):
+            return 1 / (1 + np.exp(-z))
+
+        x5 = x.reshape(B, mn, 5 + K, H, W)
+        loss = np.zeros(B)
+        for i in range(B):
+            obj_mask = np.zeros((mn, H, W))
+            valid = [(gt_box[i, tt, 2] > 1e-6 and gt_box[i, tt, 3] > 1e-6)
+                     for tt in range(gt_box.shape[1])]
+            for j in range(mn):
+                for k in range(H):
+                    for l in range(W):
+                        px = (l + sig(x5[i, j, 0, k, l])) / W
+                        py = (k + sig(x5[i, j, 1, k, l])) / H
+                        pw = np.exp(x5[i, j, 2, k, l]) * \
+                            anchors[2 * mask[j]] / input_size
+                        ph = np.exp(x5[i, j, 3, k, l]) * \
+                            anchors[2 * mask[j] + 1] / input_size
+                        best = 0.0
+                        for tt in range(gt_box.shape[1]):
+                            if not valid[tt]:
+                                continue
+                            g = gt_box[i, tt]
+                            iw = min(px + pw / 2, g[0] + g[2] / 2) - \
+                                max(px - pw / 2, g[0] - g[2] / 2)
+                            ih = min(py + ph / 2, g[1] + g[3] / 2) - \
+                                max(py - ph / 2, g[1] - g[3] / 2)
+                            inter = 0.0 if iw < 0 or ih < 0 else iw * ih
+                            u = pw * ph + g[2] * g[3] - inter
+                            if inter / u > best:
+                                best = inter / u
+                        if best > ignore_thresh:
+                            obj_mask[j, k, l] = -1
+            for tt in range(gt_box.shape[1]):
+                if not valid[tt]:
+                    continue
+                g = gt_box[i, tt]
+                gi, gj = int(g[0] * W), int(g[1] * H)
+                best_iou, best_n = 0, 0
+                for a in range(an_num):
+                    aw = anchors[2 * a] / input_size
+                    ah = anchors[2 * a + 1] / input_size
+                    iw = min(aw, g[2])
+                    ih = min(ah, g[3])
+                    inter = iw * ih
+                    u = aw * ah + g[2] * g[3] - inter
+                    if inter / u > best_iou:
+                        best_iou, best_n = inter / u, a
+                if best_n not in mask:
+                    continue
+                mi = mask.index(best_n)
+                tx = g[0] * W - gi
+                ty = g[1] * H - gj
+                tw = np.log(g[2] * input_size / anchors[2 * best_n])
+                th = np.log(g[3] * input_size / anchors[2 * best_n + 1])
+                sc = 2.0 - g[2] * g[3]
+                loss[i] += sce(x5[i, mi, 0, gj, gi], tx) * sc
+                loss[i] += sce(x5[i, mi, 1, gj, gi], ty) * sc
+                loss[i] += abs(x5[i, mi, 2, gj, gi] - tw) * sc
+                loss[i] += abs(x5[i, mi, 3, gj, gi] - th) * sc
+                obj_mask[mi, gj, gi] = 1.0
+                lab = gt_label[i, tt]
+                for c in range(K):
+                    loss[i] += sce(x5[i, mi, 5 + c, gj, gi],
+                                   pos_l if c == lab else neg_l)
+            for j in range(mn):
+                for k in range(H):
+                    for l in range(W):
+                        o = obj_mask[j, k, l]
+                        if o > 1e-5:
+                            loss[i] += sce(x5[i, j, 4, k, l], 1.0) * o
+                        elif o > -0.5:
+                            loss[i] += sce(x5[i, j, 4, k, l], 0.0)
+        return loss
+
+    def test_matches_numpy_port(self):
+        rs = np.random.RandomState(0)
+        B, H, W, K = 2, 4, 4, 3
+        anchors = [10, 13, 16, 30, 33, 23]
+        mask = [0, 1]
+        C = len(mask) * (5 + K)
+        x = (rs.randn(B, C, H, W) * 0.5).astype(np.float32)
+        gt_box = np.zeros((B, 3, 4), np.float32)
+        gt_box[0, 0] = [0.3, 0.4, 0.2, 0.25]
+        gt_box[1, 0] = [0.7, 0.2, 0.1, 0.1]
+        gt_box[1, 1] = [0.2, 0.8, 0.3, 0.2]
+        gt_label = np.zeros((B, 3), np.int32)
+        gt_label[0, 0] = 1
+        gt_label[1, 0] = 2
+        gt_label[1, 1] = 0
+        out = L.yolov3_loss(t(x), t(gt_box), t(gt_label, np.int32),
+                            anchors, mask, K, ignore_thresh=0.5,
+                            downsample_ratio=8).numpy()
+        ref = self._numpy_ref(x, gt_box, gt_label, anchors, mask, K,
+                              0.5, 8)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    def test_backprop(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(
+            (rs.randn(1, 16, 4, 4) * 0.5).astype(np.float32))
+        x.stop_gradient = False
+        gt_box = np.array([[[0.5, 0.5, 0.3, 0.3]]], np.float32)
+        loss = L.yolov3_loss(x, t(gt_box), t([[1]], np.int32),
+                             [10, 13, 16, 30], [0, 1], 3, 0.5, 8)
+        loss.sum().backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+class TestMatrixNMS:
+    def test_decay_suppresses_overlaps(self):
+        # two heavy-overlap boxes + one distant box, one class
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]       # class 1 (0 = background)
+        out, counts = L.matrix_nms(t(boxes), t(scores),
+                                   score_threshold=0.1,
+                                   post_threshold=0.0, nms_top_k=3,
+                                   keep_top_k=3)
+        o = out.numpy()[0]
+        assert int(counts.numpy()[0]) == 3
+        # top box keeps its score; the overlapped one is decayed below it
+        top = o[o[:, 1].argsort()[::-1]]
+        np.testing.assert_allclose(top[0, 1], 0.9, rtol=1e-5)
+        assert top[1, 1] < 0.8               # decayed (0.7 distant or 0.8*d)
+
+    def test_gaussian_mode_runs(self):
+        boxes = np.random.RandomState(0).rand(1, 5, 4).astype(np.float32)
+        boxes[..., 2:] += 1.0
+        scores = np.random.RandomState(1).rand(1, 2, 5).astype(np.float32)
+        out, counts = L.matrix_nms(t(boxes), t(scores), 0.05, 0.0, 5, 5,
+                                   use_gaussian=True)
+        assert out.shape == [1, 5, 6]
+
+
+class TestProposals:
+    def test_generate_proposals_shapes(self):
+        rs = np.random.RandomState(0)
+        B, A, H, W = 1, 3, 4, 4
+        scores = rs.rand(B, A, H, W).astype(np.float32)
+        deltas = (rs.randn(B, 4 * A, H, W) * 0.1).astype(np.float32)
+        im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+        anchors = np.sort(rs.rand(H, W, A, 4) * 32, axis=-1) \
+            .astype(np.float32)
+        var = np.ones((H, W, A, 4), np.float32)
+        rois, probs, counts = L.generate_proposals(
+            t(scores), t(deltas), t(im_info), t(anchors), t(var),
+            pre_nms_top_n=20, post_nms_top_n=10, nms_thresh=0.7)
+        assert rois.shape == [B, 10, 4]
+        assert probs.shape == [B, 10, 1]
+        assert int(counts.numpy()[0]) > 0
+        r = rois.numpy()[0]
+        assert (r >= 0).all() and (r <= 31).all()
+
+    def test_generate_proposal_labels_host(self):
+        rs = np.random.RandomState(0)
+        rois = np.sort(rs.rand(30, 4) * 50, axis=1).astype(np.float32)
+        gt_boxes = rois[:3] + 0.5
+        gt_classes = np.array([1, 2, 3], np.int32)
+        outs = L.generate_proposal_labels(
+            t(rois), t(gt_classes, np.int32),
+            t(np.zeros(3, np.int32), np.int32), t(gt_boxes),
+            t(np.array([[50, 50, 1.0]], np.float32)),
+            batch_size_per_im=16, class_nums=5, use_random=False)
+        srois, labels, targets, inw, outw = outs
+        assert srois.shape == [16, 4]
+        assert targets.shape == [16, 20]
+        labs = labels.numpy().reshape(-1)
+        assert (labs > 0).sum() >= 1          # some fg sampled
+        # fg rows put targets in their class slot
+        fg0 = np.where(labs > 0)[0][0]
+        c = labs[fg0]
+        assert np.abs(inw.numpy()[fg0, 4 * c:4 * c + 4]).sum() == 4
+
+    def test_generate_mask_labels_host(self):
+        rois = np.array([[0, 0, 10, 10]], np.float32)
+        labels = np.array([[2]], np.int32)
+        square = np.array([[[2, 2], [8, 2], [8, 8], [2, 8]]], np.float32)
+        mrois, has, masks = L.generate_mask_labels(
+            None, None, None, t(square), t(rois), t(labels, np.int32),
+            num_classes=3, resolution=4)
+        assert int(has.numpy()[0, 0]) == 1
+        m = masks.numpy().reshape(3, 4, 4)
+        assert m[2].sum() > 0 and m[0].sum() == 0 and m[1].sum() == 0
+
+
+class TestFPNRouting:
+    def test_distribute_and_restore(self):
+        rois = np.array([[0, 0, 20, 20],      # small -> low level
+                         [0, 0, 300, 300],    # large -> high level
+                         [0, 0, 30, 30]], np.float32)
+        multi, restore = L.distribute_fpn_proposals(
+            t(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        assert len(multi) == 4
+        lvl2 = multi[0].numpy()
+        assert np.abs(lvl2[0]).sum() > 0      # roi0 at level 2
+        assert np.abs(lvl2[1]).sum() == 0     # roi1 not at level 2
+        r = restore.numpy().reshape(-1)
+        assert sorted(r.tolist()) == [0, 1, 2]
+
+    def test_collect_topk(self):
+        r1 = np.array([[0, 0, 1, 1], [0, 0, 2, 2]], np.float32)
+        r2 = np.array([[0, 0, 3, 3]], np.float32)
+        s1 = np.array([[0.2], [0.9]], np.float32)
+        s2 = np.array([[0.5]], np.float32)
+        rois, scores = L.collect_fpn_proposals(
+            [t(r1), t(r2)], [t(s1), t(s2)], 2, 3, post_nms_top_n=2)
+        np.testing.assert_allclose(scores.numpy().reshape(-1), [0.9, 0.5])
+        np.testing.assert_allclose(rois.numpy()[0], [0, 0, 2, 2])
+
+
+class TestMiscDetection:
+    def test_polygon_box_transform_exact(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 4, 2, 3).astype(np.float32)
+        out = L.polygon_box_transform(t(x)).numpy()
+        for c in range(4):
+            for h in range(2):
+                for w in range(3):
+                    base = w * 4 if c % 2 == 0 else h * 4
+                    np.testing.assert_allclose(out[0, c, h, w],
+                                               base - x[0, c, h, w],
+                                               rtol=1e-5)
+
+    def test_detection_output_pipeline(self):
+        rs = np.random.RandomState(0)
+        P, C = 6, 3
+        prior = np.sort(rs.rand(P, 4), axis=1).astype(np.float32)
+        var = np.full((P, 4), 0.1, np.float32)
+        loc = (rs.randn(1, P, 4) * 0.1).astype(np.float32)
+        scores = rs.rand(1, P, C).astype(np.float32)
+        out, counts = L.detection_output(t(loc), t(scores), t(prior),
+                                         t(var))
+        assert out.shape[2] == 6
+
+    def test_box_decoder_and_assign(self):
+        prior = np.array([[0, 0, 10, 10]], np.float32)
+        var = np.ones((1, 4), np.float32)
+        tb = np.zeros((1, 8), np.float32)     # 2 classes, zero deltas
+        score = np.array([[0.1, 0.9]], np.float32)
+        dec, assigned = L.box_decoder_and_assign(
+            t(prior), t(var), t(tb), t(score), box_clip=4.135)
+        # zero deltas decode back to the prior (center-size w/ +1 conv)
+        np.testing.assert_allclose(assigned.numpy()[0],
+                                   [0, 0, 10, 10], atol=1e-4)
+
+    def test_locality_aware_nms_runs(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [40, 40, 50, 50]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        out, counts = L.locality_aware_nms(t(boxes), t(scores), 0.1,
+                                           nms_top_k=3, keep_top_k=3)
+        assert out.shape == [1, 3, 6]
+
+    def test_multi_box_head_builds(self):
+        rs = np.random.RandomState(0)
+        f1 = t(rs.randn(1, 8, 8, 8).astype(np.float32))
+        f2 = t(rs.randn(1, 8, 4, 4).astype(np.float32))
+        img = t(rs.randn(1, 3, 64, 64).astype(np.float32))
+        locs, confs, boxes, vars_ = L.multi_box_head(
+            [f1, f2], img, base_size=64, num_classes=4,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90)
+        assert locs.shape[2] == 4
+        assert confs.shape[2] == 4
+        assert boxes.shape[0] == locs.shape[1]
+        assert vars_.shape == boxes.shape
+
+
+class TestRoiPoolFamily:
+    def test_roi_pool_exact_max(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        out = L.roi_pool(t(x), t(rois), 2, 2, 1.0).numpy()
+        # quantized bins: [[max of rows 0-1 cols 0-1, ...]]
+        np.testing.assert_allclose(out[0, 0],
+                                   [[5, 7], [13, 15]])
+
+    def test_psroi_pool_exact(self):
+        # C = oc*ph*pw = 1*2*2; each bin reads its own channel
+        x = np.zeros((1, 4, 4, 4), np.float32)
+        for c in range(4):
+            x[0, c] = c + 1
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        out = L.psroi_pool(t(x), t(rois), output_channels=1,
+                           spatial_scale=1.0, pooled_height=2,
+                           pooled_width=2).numpy()
+        np.testing.assert_allclose(out[0, 0], [[1, 2], [3, 4]])
+
+    def test_prroi_pool_smooth(self):
+        x = np.ones((1, 2, 6, 6), np.float32)
+        rois = np.array([[1.0, 1.0, 4.0, 4.0]], np.float32)
+        out = L.prroi_pool(t(x), t(rois), 1.0, 2, 2).numpy()
+        np.testing.assert_allclose(out, np.ones_like(out), rtol=1e-5)
+
+    def test_roi_perspective_transform(self):
+        x = np.random.RandomState(0).rand(1, 1, 8, 8).astype(np.float32)
+        # axis-aligned quad == crop
+        rois = np.array([[1, 1, 5, 1, 5, 5, 1, 5]], np.float32)
+        out = L.roi_perspective_transform(t(x), t(rois), 4, 4).numpy()
+        assert out.shape == (1, 1, 4, 4)
+        assert np.isfinite(out).all()
+
+
+class TestDeformable:
+    def test_zero_offset_equals_regular_conv(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 2, 5, 5).astype(np.float32)
+        kh = kw = 3
+        offset = np.zeros((1, 2 * kh * kw, 5, 5), np.float32)
+        mask = np.ones((1, kh * kw, 5, 5), np.float32)
+        from paddle_tpu.nn.initializer import Assign
+        w = rs.randn(3, 2, 3, 3).astype(np.float32)
+        out = L.deformable_conv(t(x), t(offset), t(mask), 3, 3,
+                                padding=1, param_attr=Assign(w),
+                                bias_attr=False).numpy()
+        # numpy direct conv with zero padding
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        ref = np.zeros((1, 3, 5, 5), np.float32)
+        for f in range(3):
+            for i in range(5):
+                for j in range(5):
+                    ref[0, f, i, j] = (
+                        xp[0, :, i:i + 3, j:j + 3] * w[f]).sum()
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_modulation_mask_scales(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 1, 4, 4).astype(np.float32)
+        offset = np.zeros((1, 8, 3, 3), np.float32)
+        from paddle_tpu.nn.initializer import Assign
+        w = np.ones((1, 1, 2, 2), np.float32)
+        full = L.deformable_conv(t(x), t(offset),
+                                 t(np.ones((1, 4, 3, 3), np.float32)),
+                                 1, 2, param_attr=Assign(w),
+                                 bias_attr=False).numpy()
+        half = L.deformable_conv(t(x), t(offset),
+                                 t(np.full((1, 4, 3, 3), 0.5,
+                                           np.float32)),
+                                 1, 2, param_attr=Assign(w),
+                                 bias_attr=False).numpy()
+        np.testing.assert_allclose(half, full * 0.5, rtol=1e-4)
+
+    def test_deformable_roi_pooling_no_trans(self):
+        x = np.ones((1, 2, 6, 6), np.float32)
+        rois = np.array([[0, 0, 5, 5]], np.float32)
+        trans = np.zeros((1, 2, 2, 2), np.float32)
+        out = L.deformable_roi_pooling(
+            t(x), t(rois), t(trans), no_trans=True, pooled_height=2,
+            pooled_width=2, sample_per_part=2).numpy()
+        np.testing.assert_allclose(out, np.ones_like(out), rtol=1e-5)

@@ -1,0 +1,514 @@
+"""Numeric tests for the round-4 classic fluid.layers op tail."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid.layers as L
+from paddle_tpu.core.tensor import to_tensor
+
+
+def t(x, dtype=None):
+    return to_tensor(np.asarray(x, dtype=dtype))
+
+
+class TestMiscNN:
+    def test_cos_sim(self):
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        y = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        out = L.cos_sim(t(x), t(y)).numpy()
+        ref = (x * y).sum(1, keepdims=True) / (
+            np.linalg.norm(x, axis=1, keepdims=True) *
+            np.linalg.norm(y, axis=1, keepdims=True))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_reduce_prod_all_any(self):
+        x = np.array([[1., 2.], [3., 4.]], np.float32)
+        np.testing.assert_allclose(L.reduce_prod(t(x)).numpy(), 24.0)
+        b = np.array([[True, False], [True, True]])
+        assert bool(L.reduce_all(t(b), dim=1).numpy()[1])
+        assert not bool(L.reduce_all(t(b), dim=1).numpy()[0])
+        assert bool(L.reduce_any(t(b), dim=1).numpy()[0])
+
+    def test_l2_normalize(self):
+        x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+        out = L.l2_normalize(t(x), axis=1).numpy()
+        np.testing.assert_allclose(np.linalg.norm(out, axis=1),
+                                   np.ones(3), rtol=1e-5)
+
+    def test_clip_by_norm(self):
+        x = np.array([3.0, 4.0], np.float32)     # norm 5
+        out = L.clip_by_norm(t(x), 1.0).numpy()
+        np.testing.assert_allclose(np.linalg.norm(out), 1.0, rtol=1e-5)
+        out2 = L.clip_by_norm(t(x), 10.0).numpy()
+        np.testing.assert_allclose(out2, x)      # under the cap: unchanged
+
+    def test_size_has_inf_nan(self):
+        x = np.zeros((2, 3, 4), np.float32)
+        assert int(L.size(t(x)).numpy()) == 24
+        assert not bool(L.has_inf(t(x)).numpy())
+        x[0, 0, 0] = np.inf
+        assert bool(L.has_inf(t(x)).numpy())
+        x[0, 0, 0] = np.nan
+        assert bool(L.has_nan(t(x)).numpy())
+
+    def test_affine_channel(self):
+        x = np.random.RandomState(0).randn(2, 3, 4, 4).astype(np.float32)
+        s = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([0.5, 0.0, -0.5], np.float32)
+        out = L.affine_channel(t(x), t(s), t(b)).numpy()
+        ref = x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_activations_18_signatures(self):
+        x = np.linspace(-3, 3, 13).astype(np.float32)
+        np.testing.assert_allclose(L.relu6(t(x), threshold=4.0).numpy(),
+                                   np.clip(x, 0, 4), rtol=1e-6)
+        np.testing.assert_allclose(L.brelu(t(x), 1.0, 2.0).numpy(),
+                                   np.clip(x, 1, 2), rtol=1e-6)
+        np.testing.assert_allclose(
+            L.swish(t(x), beta=2.0).numpy(),
+            x / (1 + np.exp(-2 * x)), rtol=1e-5)
+        np.testing.assert_allclose(
+            L.hard_swish(t(x)).numpy(),
+            x * np.clip(x + 3, 0, 6) / 6, rtol=1e-5)
+        np.testing.assert_allclose(
+            L.soft_relu(t(x), threshold=40.0).numpy(),
+            np.log1p(np.exp(x)), rtol=1e-5)
+
+    def test_prelu_modes(self):
+        x = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+        out = L.prelu(t(x), 'all').numpy()
+        ref = np.where(x > 0, x, 0.25 * x)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        out_c = L.prelu(t(x), 'channel').numpy()
+        np.testing.assert_allclose(out_c, ref, rtol=1e-5)
+
+    def test_pad2d(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        out = L.pad2d(t(x), [1, 0, 0, 2], pad_value=5.0).numpy()
+        assert out.shape == (1, 1, 3, 4)
+        assert out[0, 0, 0, 0] == 5.0 and out[0, 0, 1, 0] == 1.0
+
+    def test_resize_family(self):
+        x = np.random.RandomState(0).rand(1, 2, 4, 4).astype(np.float32)
+        out = L.resize_nearest(t(x), out_shape=[8, 8]).numpy()
+        assert out.shape == (1, 2, 8, 8)
+        out2 = L.resize_bilinear(t(x), out_shape=[2, 2]).numpy()
+        assert out2.shape == (1, 2, 2, 2)
+        out3 = L.image_resize_short(t(x), 8).numpy()
+        assert out3.shape == (1, 2, 8, 8)
+
+    def test_mean_iou(self):
+        pred = np.array([0, 1, 1, 2], np.int32)
+        lab = np.array([0, 1, 2, 2], np.int32)
+        miou, wrong, correct = L.mean_iou(t(pred), t(lab), 3)
+        # class0: iou 1; class1: tp=1 fp=1 fn=0 -> 1/2; class2: tp=1 fp=0
+        # fn=1 -> 1/2
+        np.testing.assert_allclose(float(miou.numpy()),
+                                   (1 + 0.5 + 0.5) / 3, rtol=1e-5)
+
+    def test_crop_tensor(self):
+        x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        out = L.crop_tensor(t(x), shape=[1, 2, 2], offsets=[1, 1, 2]).numpy()
+        np.testing.assert_allclose(out, x[1:2, 1:3, 2:4])
+
+    def test_spectral_norm_sigma(self):
+        rs = np.random.RandomState(0)
+        w = rs.randn(6, 4).astype(np.float32)
+        out = L.spectral_norm(t(w), power_iters=50).numpy()
+        # largest singular value of the output must be ~1
+        assert abs(np.linalg.svd(out)[1][0] - 1.0) < 1e-3
+
+    def test_hash_deterministic(self):
+        x = np.array([[1, 2], [1, 2], [3, 4]], np.int64)
+        h1 = L.hash(t(x), hash_size=100, num_hash=2).numpy()
+        h2 = L.hash(t(x), hash_size=100, num_hash=2).numpy()
+        np.testing.assert_array_equal(h1, h2)
+        assert h1.shape == (3, 2)
+        np.testing.assert_array_equal(h1[0], h1[1])
+        assert (h1 >= 0).all() and (h1 < 100).all()
+
+    def test_unique_with_counts(self):
+        x = np.array([2, 3, 3, 1, 5, 3], np.int64)
+        uniq, index, count = L.unique_with_counts(t(x))
+        np.testing.assert_array_equal(uniq.numpy(), [1, 2, 3, 5])
+        np.testing.assert_array_equal(count.numpy(), [1, 1, 3, 1])
+
+    def test_continuous_value_model(self):
+        x = np.array([[1.0, 2.0, 5.0, 6.0]], np.float32)
+        cvm = np.array([[1.0, 1.0]], np.float32)
+        keep = L.continuous_value_model(t(x), t(cvm), True).numpy()
+        assert keep.shape == (1, 4)
+        np.testing.assert_allclose(keep[0, 0], np.log(2.0), rtol=1e-5)
+        np.testing.assert_allclose(keep[0, 1], np.log(3.0) - np.log(2.0),
+                                   rtol=1e-5)
+        strip = L.continuous_value_model(t(x), t(cvm), False).numpy()
+        np.testing.assert_allclose(strip, [[5.0, 6.0]])
+
+    def test_similarity_focus(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 3, 2, 2).astype(np.float32)
+        out = L.similarity_focus(t(x), axis=1, indexes=[0]).numpy()
+        assert out.shape == x.shape
+        assert set(np.unique(out)).issubset({0.0, 1.0})
+        # mask is identical across the focused axis
+        np.testing.assert_array_equal(out[:, 0], out[:, 1])
+
+    def test_sampling_id_range(self):
+        probs = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], np.float32)
+        ids = L.sampling_id(t(probs)).numpy()
+        np.testing.assert_array_equal(ids, [1, 0])
+
+    def test_random_crop_shape(self):
+        x = np.random.RandomState(0).rand(4, 8, 8).astype(np.float32)
+        out = L.random_crop(t(x), shape=[5, 5]).numpy()
+        assert out.shape == (4, 5, 5)
+
+    def test_py_func_with_backward(self):
+        def forward(a):
+            return a * a
+
+        def backward(a, g):
+            return 2.0 * a * g
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        template = paddle.to_tensor(np.zeros(3, np.float32))
+        y = L.py_func(forward, x, template, backward_func=backward)
+        np.testing.assert_allclose(y.numpy(), [1.0, 4.0, 9.0])
+        s = y.sum()
+        s.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+    def test_grid_sampler_alias(self):
+        x = np.random.RandomState(0).rand(1, 1, 3, 3).astype(np.float32)
+        grid = np.zeros((1, 3, 3, 2), np.float32)
+        out = L.grid_sampler(t(x), t(grid)).numpy()
+        assert out.shape == (1, 1, 3, 3)
+
+
+class TestStaticStyleLayers:
+    def test_conv3d_pool3d(self):
+        x = t(np.random.RandomState(0).randn(1, 2, 4, 6, 6)
+              .astype(np.float32))
+        out = L.conv3d(x, 3, 3, padding=1)
+        assert list(out.shape) == [1, 3, 4, 6, 6]
+        p = L.pool3d(out, 2, 'max', 2)
+        assert list(p.shape) == [1, 3, 2, 3, 3]
+
+    def test_conv2d_transpose(self):
+        x = t(np.random.RandomState(0).randn(1, 2, 4, 4).astype(np.float32))
+        out = L.conv2d_transpose(x, 3, filter_size=2, stride=2)
+        assert list(out.shape) == [1, 3, 8, 8]
+
+    def test_adaptive_pools(self):
+        x = t(np.random.RandomState(0).randn(1, 2, 6, 6).astype(np.float32))
+        assert list(L.adaptive_pool2d(x, 3, 'avg').shape) == [1, 2, 3, 3]
+        x3 = t(np.random.RandomState(0).randn(1, 2, 4, 6, 6)
+               .astype(np.float32))
+        assert list(L.adaptive_pool3d(x3, 2, 'max').shape) == [1, 2, 2, 2, 2]
+
+    def test_norm_layers(self):
+        x = t(np.random.RandomState(0).randn(2, 4, 5, 5).astype(np.float32))
+        out = L.instance_norm(x).numpy()
+        np.testing.assert_allclose(out.mean(axis=(2, 3)),
+                                   np.zeros((2, 4)), atol=1e-4)
+        g = L.group_norm(x, groups=2).numpy()
+        assert g.shape == (2, 4, 5, 5)
+        a = L.inplace_abn(x, act='relu')
+        assert float(a.numpy().min()) >= 0.0
+
+    def test_data_norm(self):
+        x = t(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+        out = L.data_norm(x)
+        # default stats: mean 0, scale sqrt(1e4/1e4)=1 -> identity
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-4)
+
+    def test_lrn(self):
+        x = t(np.random.RandomState(0).randn(1, 8, 4, 4).astype(np.float32))
+        assert L.lrn(x).shape == [1, 8, 4, 4]
+
+
+class TestTensorTail:
+    def test_create_parameter_global_var(self):
+        p = L.create_parameter([3, 4], 'float32')
+        assert list(p.shape) == [3, 4]
+        g = L.create_global_var([2], 7.0, 'float32')
+        np.testing.assert_allclose(g.numpy(), [7.0, 7.0])
+
+    def test_fill_constant_batch_size_like(self):
+        ref = t(np.zeros((5, 3), np.float32))
+        out = L.fill_constant_batch_size_like(ref, [-1, 7], 'float32', 2.5)
+        assert list(out.shape) == [5, 7]
+        assert float(out.numpy()[0, 0]) == 2.5
+
+    def test_tensor_array_to_tensor(self):
+        arr = [t(np.ones((2, 2), np.float32)),
+               t(np.zeros((2, 3), np.float32))]
+        out, sizes = L.tensor_array_to_tensor(arr, axis=1)
+        assert list(out.shape) == [2, 5]
+        np.testing.assert_array_equal(sizes.numpy(), [2, 3])
+
+    def test_range(self):
+        np.testing.assert_array_equal(L.range(0, 10, 3, 'int32').numpy(),
+                                      [0, 3, 6, 9])
+
+    def test_autoincreased_step_counter(self):
+        a = int(L.autoincreased_step_counter('t_ctr').numpy()[0])
+        b = int(L.autoincreased_step_counter('t_ctr').numpy()[0])
+        assert b == a + 1
+
+
+class TestLossTail:
+    def test_mse_dice(self):
+        x = np.array([[0.5], [1.5]], np.float32)
+        y = np.array([[1.0], [1.0]], np.float32)
+        np.testing.assert_allclose(L.mse_loss(t(x), t(y)).numpy(), 0.25,
+                                   rtol=1e-6)
+        pred = np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)
+        lab = np.array([[0], [1]], np.int64)
+        d = float(L.dice_loss(t(pred), t(lab)).numpy())
+        assert 0.0 < d < 0.2
+
+    def test_teacher_student_exact(self):
+        x = np.array([[0.5], [0.5], [0.5], [0.5]], np.float32)
+        lab = np.array([[-2.0], [-1.0], [0.3], [1.4]], np.float32)
+        out = L.teacher_student_sigmoid_loss(t(x), t(lab)).numpy()
+        sp = max(0.5, 0) + np.log1p(np.exp(-0.5))
+        exp = [sp, sp - 0.5, sp + sp - 0.5 * 0.3,
+               (sp - 0.5) + sp - 0.5 * 0.4]
+        np.testing.assert_allclose(out.reshape(-1), exp, rtol=1e-5)
+
+    def test_center_loss_updates(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 8).astype(np.float32)
+        lab = np.array([[0], [1], [0], [2]], np.int64)
+        loss = L.center_loss(t(x), t(lab), num_classes=3, alpha=0.1,
+                             param_attr=None, update_center=True)
+        assert loss.shape == [4, 1]
+        assert (loss.numpy() >= 0).all()
+
+    def test_nce_runs_and_backprops(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(6, 16).astype(np.float32))
+        x.stop_gradient = False
+        lab = t(rs.randint(0, 50, (6, 1)), np.int64)
+        loss = L.nce(x, lab, num_total_classes=50, num_neg_samples=5,
+                     seed=7)
+        assert loss.shape == [6, 1]
+        loss.sum().backward()
+        assert x.grad is not None
+        # log_uniform sampler path
+        l2 = L.nce(paddle.to_tensor(rs.randn(6, 16).astype(np.float32)),
+                   lab, 50, num_neg_samples=5, sampler='log_uniform',
+                   seed=7)
+        assert np.isfinite(l2.numpy()).all()
+
+    def test_hsigmoid_default_tree(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(5, 8).astype(np.float32))
+        x.stop_gradient = False
+        lab = t(rs.randint(0, 10, (5, 1)), np.int64)
+        loss = L.hsigmoid(x, lab, num_classes=10)
+        assert loss.shape == [5, 1]
+        assert (loss.numpy() > 0).all()
+        loss.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_hsigmoid_custom_path(self):
+        rs = np.random.RandomState(1)
+        x = t(rs.randn(3, 4), np.float32)
+        lab = t(np.zeros((3, 1)), np.int64)
+        pt = t(np.array([[0, 1, -1]] * 3), np.int64)
+        pc = t(np.array([[0, 1, 0]] * 3), np.int64)
+        loss = L.hsigmoid(x, lab, num_classes=4, path_table=pt,
+                          path_code=pc, is_custom=True)
+        assert loss.shape == [3, 1]
+        assert np.isfinite(loss.numpy()).all()
+
+
+class TestSequenceTail:
+    def test_sequence_conv_identity_kernel(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 3).astype(np.float32)
+        from paddle_tpu.nn.initializer import Assign
+        # kernel that copies the center row -> output == input
+        w = np.zeros((9, 3), np.float32)
+        w[3:6] = np.eye(3)
+        out = L.sequence_conv(t(x), 3, filter_size=3,
+                              param_attr=Assign(w), bias_attr=False)
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-5)
+
+    def test_sequence_slice(self):
+        x = np.arange(24).reshape(2, 4, 3).astype(np.float32)
+        out = L.sequence_slice(t(x), t([[1], [0]], np.int64),
+                               t([[2], [3]], np.int64)).numpy()
+        np.testing.assert_allclose(out[0, :2], x[0, 1:3])
+        np.testing.assert_allclose(out[0, 2:], 0)
+        np.testing.assert_allclose(out[1, :3], x[1, :3])
+
+    def test_sequence_expand_as(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        y = np.zeros((2, 3, 2), np.float32)
+        out = L.sequence_expand_as(t(x), t(y),
+                                   y_length=t([2, 3], np.int64)).numpy()
+        np.testing.assert_allclose(out[0, 0], [1, 2])
+        np.testing.assert_allclose(out[0, 1], [1, 2])
+        np.testing.assert_allclose(out[0, 2], [0, 0])   # masked
+        np.testing.assert_allclose(out[1, 2], [3, 4])
+
+    def test_sequence_reshape(self):
+        x = np.arange(12).reshape(1, 2, 6).astype(np.float32)
+        out = L.sequence_reshape(t(x), 3).numpy()
+        assert out.shape == (1, 4, 3)
+        np.testing.assert_allclose(out.reshape(-1), x.reshape(-1))
+
+    def test_sequence_scatter(self):
+        x = np.zeros((2, 5), np.float32)
+        idx = np.array([[0, 2], [1, 1]], np.int64)
+        upd = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        out = L.sequence_scatter(t(x), t(idx), t(upd)).numpy()
+        np.testing.assert_allclose(out[0], [1, 0, 2, 0, 0])
+        np.testing.assert_allclose(out[1], [0, 7, 0, 0, 0])
+
+    def test_sequence_enumerate(self):
+        x = np.array([[1, 2, 3]], np.int64)
+        out = L.sequence_enumerate(t(x), 2,
+                                   length=t([3], np.int64)).numpy()
+        np.testing.assert_array_equal(out[0, 0], [1, 2])
+        np.testing.assert_array_equal(out[0, 2], [3, 0])
+
+    def test_first_last_step(self):
+        x = np.arange(12).reshape(2, 3, 2).astype(np.float32)
+        first = L.sequence_first_step(t(x)).numpy()
+        last = L.sequence_last_step(t(x),
+                                    length=t([2, 3], np.int64)).numpy()
+        np.testing.assert_allclose(first, x[:, 0])
+        np.testing.assert_allclose(last[0], x[0, 1])
+        np.testing.assert_allclose(last[1], x[1, 2])
+
+
+class TestRNNTail:
+    def test_rnn_lstm_cell(self):
+        rs = np.random.RandomState(0)
+        cell = L.LSTMCell(hidden_size=6)
+        x = t(rs.randn(3, 4, 5), np.float32)
+        out, states = L.rnn(cell, x)
+        assert list(out.shape) == [3, 4, 6]
+        assert list(states[0].shape) == [3, 6]
+
+    def test_rnn_sequence_length_freezes_state(self):
+        rs = np.random.RandomState(0)
+        cell = L.GRUCell(hidden_size=4)
+        x = t(rs.randn(2, 5, 3), np.float32)
+        out, h = L.rnn(cell, x, sequence_length=t([2, 5], np.int64))
+        # outputs past the length are zeroed
+        np.testing.assert_allclose(out.numpy()[0, 2:], 0.0, atol=1e-7)
+        assert np.abs(out.numpy()[1, 2:]).sum() > 0
+
+    def test_birnn(self):
+        rs = np.random.RandomState(0)
+        out, _ = L.birnn(L.GRUCell(4), L.GRUCell(4),
+                         t(rs.randn(2, 3, 5), np.float32))
+        assert list(out.shape) == [2, 3, 8]
+
+    def test_dynamic_gru_shapes(self):
+        rs = np.random.RandomState(0)
+        x = t(rs.randn(2, 6, 12), np.float32)    # pre-projected 3*size
+        out = L.dynamic_gru(x, 4)
+        assert list(out.shape) == [2, 6, 4]
+        rev = L.dynamic_gru(x, 4, is_reverse=True)
+        assert list(rev.shape) == [2, 6, 4]
+
+    def test_dynamic_lstmp(self):
+        rs = np.random.RandomState(0)
+        x = t(rs.randn(2, 5, 16), np.float32)    # 4*hidden, hidden=4
+        proj, cell = L.dynamic_lstmp(x, 16, proj_size=3)
+        assert list(proj.shape) == [2, 5, 3]
+        assert list(cell.shape) == [2, 5, 4]
+
+
+class TestLRDecays:
+    def test_exponential_decay_curve(self):
+        s = L.exponential_decay(0.1, decay_steps=10, decay_rate=0.5)
+        lrs = [s.last_lr]
+        for _ in range(10):
+            s.step()
+            lrs.append(s.last_lr)
+        np.testing.assert_allclose(lrs[10], 0.05, rtol=1e-6)
+
+    def test_piecewise_and_warmup(self):
+        s = L.piecewise_decay([3, 6], [1.0, 0.5, 0.1])
+        vals = []
+        for _ in range(7):
+            vals.append(s.last_lr)
+            s.step()
+        assert vals[0] == 1.0 and vals[4] == 0.5 and vals[6] == 0.1
+        w = L.linear_lr_warmup(0.1, warmup_steps=5, start_lr=0.0,
+                               end_lr=0.1)
+        w_lrs = [w.last_lr]
+        for _ in range(5):
+            w.step()
+            w_lrs.append(w.last_lr)
+        np.testing.assert_allclose(w_lrs[-1], 0.1, rtol=1e-6)
+        assert w_lrs[1] < 0.05
+
+    def test_polynomial_and_cosine(self):
+        p = L.polynomial_decay(1.0, 10, end_learning_rate=0.0, power=1.0)
+        for _ in range(5):
+            p.step()
+        np.testing.assert_allclose(p.last_lr, 0.5, rtol=1e-5)
+        c = L.cosine_decay(1.0, step_each_epoch=1, epochs=10)
+        c.step(5)
+        np.testing.assert_allclose(c.last_lr,
+                                   0.5 * (np.cos(np.pi / 2) + 1), atol=1e-6)
+
+
+class TestDistributionsTail:
+    def test_mvn_diag(self):
+        loc = np.array([0.0, 0.0], np.float32)
+        scale = np.diag([1.0, 4.0]).astype(np.float32)
+        d = L.MultivariateNormalDiag(t(loc), t(scale))
+        ent = float(d.entropy().numpy())
+        ref_ent = 0.5 * (2 * (1 + np.log(2 * np.pi)) + np.log(4.0))
+        np.testing.assert_allclose(ent, ref_ent, rtol=1e-5)
+        d2 = L.MultivariateNormalDiag(t(np.array([1.0, 0.0], np.float32)),
+                                      t(scale))
+        kl = float(d.kl_divergence(d2).numpy())
+        assert kl > 0
+        same = float(d.kl_divergence(d).numpy())
+        np.testing.assert_allclose(same, 0.0, atol=1e-6)
+
+    def test_fluid_distribution_aliases(self):
+        n = L.Normal(t(0.0), t(1.0))
+        assert np.isfinite(float(n.entropy().numpy()))
+
+
+class TestPyReader:
+    def test_py_reader_roundtrip(self):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                reader = L.py_reader(capacity=4, shapes=[[-1, 2], [-1, 1]],
+                                     dtypes=['float32', 'int64'])
+                xv, yv = L.read_file(reader)
+
+                def gen():
+                    for i in range(3):
+                        yield (np.full((4, 2), i, np.float32),
+                               np.full((4, 1), i, np.int64))
+                reader.decorate_paddle_reader(gen)
+                feeds = list(reader)
+                assert len(feeds) == 3
+                assert feeds[1][xv.name][0, 0] == 1.0
+        finally:
+            paddle.disable_static()
+
+    def test_load_op(self, tmp_path):
+        arr = np.arange(4, dtype=np.float32)
+        np.save(tmp_path / "w.npy", arr)
+        target = paddle.to_tensor(np.zeros(4, np.float32))
+        L.load(target, str(tmp_path / "w.npy"))
+        np.testing.assert_allclose(target.numpy(), arr)
