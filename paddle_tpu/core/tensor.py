@@ -380,16 +380,19 @@ def force_symbolic_capture(flag):
     return prev
 
 
-def apply_op(fn, tensors, n_outputs=1, differentiable=True):
+def apply_op(fn, tensors, n_outputs=1, differentiable=True, eval_fn=None):
     """Run a pure fn over tensor payloads; record on the tape if needed.
 
     ``tensors`` are the differentiable positional inputs; every non-tensor
-    argument must already be closed over in ``fn``.
+    argument must already be closed over in ``fn``. ``eval_fn``, if given,
+    is the op's test-mode variant (same arity/outputs) — recorded on static
+    Operators so Program.clone(for_test=True) can swap it in.
     """
     if _SYMBOLIC_HANDLER[0] is not None and (
             _FORCE_SYMBOLIC[0] or
             any(getattr(t, '_symbolic', False) for t in tensors)):
-        return _SYMBOLIC_HANDLER[0](fn, tensors, n_outputs, differentiable)
+        return _SYMBOLIC_HANDLER[0](fn, tensors, n_outputs, differentiable,
+                                    eval_fn)
     if _CAPTURE_WATCH.w is not None:
         _CAPTURE_WATCH.w.note_inputs(tensors)
     tensors = tuple(t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))

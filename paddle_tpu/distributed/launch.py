@@ -1,29 +1,181 @@
 """Launch helpers. Parity: python/paddle/distributed/launch.py + spawn.py.
 
-On TPU, single-process SPMD drives all local chips, so spawn() simply runs the
-function in-process after mesh init; multi-host pods use init_distributed()
-(jax.distributed) with one process per host (documented divergence from the
-reference's one-proc-per-GPU).
+TPU-first execution model: ONE process drives all local chips via SPMD
+(mesh + pjit), so the reference's one-process-per-GPU launcher maps to two
+real modes here:
+
+- in-process (default, backend='tpu'): spawn() runs the function once after
+  mesh init — the function's collectives span every local chip already.
+- multi-process (nprocs > 1, or backend='cpu'): spawn() REALLY forks
+  `nprocs` interpreter processes, each with the reference's trainer env
+  (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_CURRENT_ENDPOINT) and a
+  CPU backend pin, and runs func(*args) in each — the process-isolation
+  semantics 1.8 scripts expect from spawn (per-rank data pipelines,
+  parameter servers, launch tests).
+
+Multi-host pods use init_distributed() (jax.distributed) with one process
+per host.
 """
+import multiprocessing as mp
+import os
+import pickle
+import tempfile
+
 from . import env
 
+__all__ = ['spawn', 'launch', 'get_cluster_and_pod']
 
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    if not env.is_initialized():
-        env.init_parallel_env()
-    result = func(*args)
-    class _Ctx:
-        def join(self):
-            return result
-    return _Ctx()
+
+def _worker(rank, nprocs, func, args, result_dir):
+    os.environ['PADDLE_TRAINER_ID'] = str(rank)
+    os.environ['PADDLE_TRAINERS_NUM'] = str(nprocs)
+    os.environ['FLAGS_selected_gpus'] = str(rank)
+    os.environ['PADDLE_CURRENT_ENDPOINT'] = f"127.0.0.1:{6170 + rank}"
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    path = os.path.join(result_dir, f"result_{rank}.pkl")
+    # results travel via files (atomic rename), not an mp.Queue — queue FDs
+    # are unreliable under sandboxed/spawn-restricted environments
+    try:
+        result = func(*args)
+        payload = ('ok', result)
+    except BaseException as e:  # surface the failure to the parent
+        payload = ('error', repr(e))
+        with open(path + '.tmp', 'wb') as f:
+            pickle.dump(payload, f)
+        os.replace(path + '.tmp', path)
+        raise
+    with open(path + '.tmp', 'wb') as f:
+        pickle.dump(payload, f)
+    os.replace(path + '.tmp', path)
+
+
+class _Context:
+    def __init__(self, procs, result_dir, result=None):
+        self.processes = procs
+        self._result_dir = result_dir
+        self._result = result
+        self._joined = None
+
+    def join(self, timeout=None):
+        if not self.processes:
+            return self._result
+        if self._joined is not None:
+            # spawn(join=True) already joined internally; the caller's own
+            # join() must see the same results (the files are consumed and
+            # the tempdir removed on the first pass)
+            return self._joined
+        for p in self.processes:
+            p.join(timeout)
+        alive = [i for i, p in enumerate(self.processes) if p.is_alive()]
+        if alive:
+            raise RuntimeError(
+                f"spawn: ranks {alive} still running after "
+                f"join(timeout={timeout}) — terminate them or join "
+                "without a timeout")
+        results = {}
+        err = None
+        for rank in range(len(self.processes)):
+            path = os.path.join(self._result_dir, f"result_{rank}.pkl")
+            if not os.path.exists(path):
+                continue
+            with open(path, 'rb') as f:
+                status, payload = pickle.load(f)
+            if status == 'error' and err is None:
+                err = f"spawn: rank {rank} failed: {payload}"
+            results[rank] = payload if status == 'ok' else None
+        import shutil
+        shutil.rmtree(self._result_dir, ignore_errors=True)
+        if err:
+            raise RuntimeError(err)
+        bad = [p.exitcode for p in self.processes if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawn: worker exit codes {bad}")
+        self._joined = [results.get(r) for r in range(len(self.processes))]
+        return self._joined
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
+          **options):
+    """Run func on nprocs workers (spawn.py parity; see module docstring
+    for the TPU execution model)."""
+    if nprocs in (-1, 0, 1) and backend in (None, 'tpu', 'xla'):
+        if not env.is_initialized():
+            env.init_parallel_env()
+        result = func(*args)
+        return _Context([], None, result)
+
+    n = max(int(nprocs), 1)
+    ctx = mp.get_context('spawn')
+    result_dir = tempfile.mkdtemp(prefix='paddle_tpu_spawn_')
+    procs = []
+    # the rank env + CPU backend pin must be in place BEFORE each child
+    # starts: the spawn child imports paddle_tpu (backend init!) while
+    # unpickling the target, long before _worker's own env writes run
+    saved = {k: os.environ.get(k)
+             for k in ('PADDLE_TRAINER_ID', 'PADDLE_TRAINERS_NUM',
+                       'PADDLE_CURRENT_ENDPOINT', 'JAX_PLATFORMS')}
+    try:
+        for rank in range(n):
+            os.environ['PADDLE_TRAINER_ID'] = str(rank)
+            os.environ['PADDLE_TRAINERS_NUM'] = str(n)
+            os.environ['PADDLE_CURRENT_ENDPOINT'] = \
+                f"127.0.0.1:{6170 + rank}"
+            os.environ['JAX_PLATFORMS'] = 'cpu'
+            p = ctx.Process(target=_worker,
+                            args=(rank, n, func, args, result_dir),
+                            daemon=daemon)
+            p.start()
+            procs.append(p)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    context = _Context(procs, result_dir)
+    if join:
+        context.join()
+    return context
 
 
 def launch():
-    raise SystemExit(
-        "paddle_tpu: use `python your_script.py` directly — single-process "
-        "SPMD drives all local TPU chips; multi-host pods: set "
-        "coordinator_address and call distributed.init_distributed().")
+    """`python -m paddle_tpu.distributed.launch [--nproc_per_node N]
+    script.py args...` — run a training script under the spawn env
+    (launch.py parity; one process per rank, CPU backend per worker when
+    N > 1)."""
+    import argparse
+    import runpy
+    import subprocess
+    import sys
+
+    parser = argparse.ArgumentParser('paddle_tpu.distributed.launch')
+    parser.add_argument('--nproc_per_node', type=int, default=1)
+    parser.add_argument('script')
+    parser.add_argument('script_args', nargs=argparse.REMAINDER)
+    ns = parser.parse_args()
+
+    if ns.nproc_per_node <= 1:
+        sys.argv = [ns.script] + ns.script_args
+        runpy.run_path(ns.script, run_name='__main__')
+        return
+
+    procs = []
+    for rank in range(ns.nproc_per_node):
+        child = dict(os.environ)
+        child['PADDLE_TRAINER_ID'] = str(rank)
+        child['PADDLE_TRAINERS_NUM'] = str(ns.nproc_per_node)
+        child['PADDLE_CURRENT_ENDPOINT'] = f"127.0.0.1:{6170 + rank}"
+        child.setdefault('JAX_PLATFORMS', 'cpu')
+        procs.append(subprocess.Popen(
+            [sys.executable, ns.script] + ns.script_args, env=child))
+    rcs = [p.wait() for p in procs]
+    if any(rcs):
+        raise SystemExit(f"launch: worker exit codes {rcs}")
 
 
 def get_cluster_and_pod(*a, **k):
     return None, None
+
+
+if __name__ == '__main__':
+    launch()

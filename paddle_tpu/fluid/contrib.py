@@ -1,0 +1,119 @@
+"""fluid.contrib utilities.
+
+Parity: /root/reference/python/paddle/fluid/contrib/ —
+memory_usage_calc.py:46 (memory_usage), model_stat.py:40 (summary),
+op_frequence.py:23 (op_freq_statistic), extend_optimizer/
+(extend_with_decoupled_weight_decay), decoder/ (beam-search machinery; the
+TPU-first decode stack in nn.decode replaces its StateCell design — aliased
+here). mixed_precision lives in paddle_tpu.amp; slim in paddle_tpu.slim;
+reader decorators in paddle_tpu.reader.
+"""
+from collections import Counter, OrderedDict
+
+import numpy as np
+
+__all__ = ['memory_usage', 'summary', 'op_freq_statistic',
+           'extend_with_decoupled_weight_decay']
+
+_DTYPE_BYTES = {'float64': 8, 'int64': 8, 'complex64': 8, 'complex128': 16,
+                'float32': 4, 'int32': 4, 'float16': 2, 'bfloat16': 2,
+                'int16': 2, 'uint16': 2, 'int8': 1, 'uint8': 1, 'bool': 1}
+
+
+def memory_usage(program, batch_size):
+    """Estimated activation+parameter memory of a Program in MB
+    (memory_usage_calc.py:46): sum over block vars of element count x
+    dtype width, with data vars' batch dim scaled to batch_size."""
+    # batch-dim propagation: static.data collapses dynamic dims to 1, and
+    # every downstream activation inherits that 1 on dim 0 — scale ANY var
+    # whose dim 0 equals a feed's collapsed batch dim (the reference
+    # rescales every var carrying a -1 dim)
+    batch_collapsed = set()
+    for var in program.global_block.vars.values():
+        if getattr(var, 'is_data', False):
+            dyn = set(getattr(var, '_dynamic_dims', ()))
+            if 0 in dyn and var.shape:
+                batch_collapsed.add(int(var.shape[0]))
+    total = 0.0
+    for var in program.global_block.vars.values():
+        shape = list(var.shape)
+        is_param = getattr(var, 'concrete', None) is not None and \
+            var.concrete.__class__.__name__ == 'Parameter'
+        if shape and not is_param and int(shape[0]) in batch_collapsed:
+            shape[0] = batch_size
+        n = float(np.prod(shape)) if shape else 1.0
+        width = _DTYPE_BYTES.get(np.dtype(var.dtype).name, 4)
+        total += n * width
+    mb = total / (1024.0 ** 2)
+    return mb
+
+
+def summary(main_prog):
+    """Per-op parameter/memory summary of a Program (model_stat.py:40):
+    prints and returns rows of (op type, param count, output elems)."""
+    rows = []
+    total_params = 0
+    for op in main_prog.global_block.ops:
+        n_params = 0
+        for v in op.inputs:
+            conc = getattr(v, 'concrete', None)
+            if conc is not None and conc.__class__.__name__ == 'Parameter':
+                n_params += int(np.prod(v.shape)) if v.shape else 1
+        out_elems = sum(int(np.prod(o.shape)) if o.shape else 1
+                        for o in op.outputs)
+        total_params += n_params
+        rows.append((op.type, n_params, out_elems))
+    width = max((len(r[0]) for r in rows), default=4)
+    print(f"{'op':<{width}}  params   out_elems")
+    for ty, p, o in rows:
+        print(f"{ty:<{width}}  {p:<8} {o}")
+    print(f"total params: {total_params}")
+    return rows
+
+
+def op_freq_statistic(program):
+    """Op-type frequency Counter over a Program (op_frequence.py:23)."""
+    uni_op_freq = Counter(op.type for op in program.global_block.ops)
+    adj_op_freq = Counter()
+    prev = None
+    for op in program.global_block.ops:
+        if prev is not None:
+            adj_op_freq[f"{prev}->{op.type}"] += 1
+        prev = op.type
+    return (OrderedDict(uni_op_freq.most_common()),
+            OrderedDict(adj_op_freq.most_common()))
+
+
+def extend_with_decoupled_weight_decay(base_optimizer_cls):
+    """Wrap an optimizer class with decoupled weight decay
+    (extend_optimizer/extend_optimizer_with_weight_decay.py): returns a
+    subclass whose constructor takes weight_decay= and applies
+    p -= lr * wd * p after the base update (the AdamW rule)."""
+
+    class DecoupledWeightDecay(base_optimizer_cls):
+        def __init__(self, *args, weight_decay=0.0, **kwargs):
+            self._coeff = weight_decay
+            super().__init__(*args, **kwargs)
+
+        def step(self):
+            super().step()
+            if not self._coeff:
+                return
+            lr = self.get_lr() if hasattr(self, 'get_lr') else 0.0
+            from ..core import autograd
+            params = getattr(self, '_parameter_list', None) or \
+                getattr(self, '_parameters', [])
+            with autograd.no_grad():
+                for p in params:
+                    if getattr(p, 'trainable', True):
+                        p._inplace_value(
+                            p._value - lr * self._coeff * p._value)
+
+    DecoupledWeightDecay.__name__ = (base_optimizer_cls.__name__ +
+                                     'DecoupledWeightDecay')
+    return DecoupledWeightDecay
+
+
+# decoder/: the 1.8 contrib beam-search machinery is superseded by the
+# dense decode stack; alias the entry points reference scripts import
+from ..nn.decode import BeamSearchDecoder, dynamic_decode  # noqa: E402,F401

@@ -547,7 +547,11 @@ class StaticRNN:
         return ph
 
     def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
-               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+               init_batch_dim_idx=0, ref_batch_dim_idx=None):
+        """ref_batch_dim_idx: which dim of batch_ref is the batch. The
+        reference defaults to 1 (an LoD-era layout artifact); here step
+        placeholders are batch-major, so the default reads dim 0 — pass an
+        explicit index to override."""
         if init is not None:
             ph = self._placeholder(init.shape, init._value.dtype,
                                    f'{init.name}@mem')
@@ -556,9 +560,15 @@ class StaticRNN:
         if shape is None or batch_ref is None:
             raise ValueError("StaticRNN.memory: need init or "
                              "(shape, batch_ref)")
-        B = int(batch_ref.shape[0])
-        dims = tuple(B if int(s) == -1 else int(s) for s in shape)
-        ph = self._placeholder(dims, jnp.float32, 'rnn_mem')
+        ref_idx = 0 if ref_batch_dim_idx is None else int(ref_batch_dim_idx)
+        B = int(batch_ref.shape[ref_idx])
+        dims = [int(s) for s in shape]
+        bidx = int(init_batch_dim_idx)
+        if -1 in dims:
+            dims[dims.index(-1)] = B
+        elif 0 <= bidx < len(dims):
+            dims[bidx] = B
+        ph = self._placeholder(tuple(dims), jnp.float32, 'rnn_mem')
         self._memories.append([ph, float(init_value)])
         return ph
 

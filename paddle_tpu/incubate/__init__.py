@@ -2,5 +2,6 @@
 from . import checkpoint
 from ..distributed import fleet
 
+from . import complex
 from . import custom_op
 from .custom_op import register_op

@@ -1,0 +1,14 @@
+"""incubate.complex: complex-tensor op namespace.
+
+Parity: /root/reference/python/paddle/incubate/complex/ (tensor/math.py,
+linalg.py, manipulation.py). TPU-first divergence: the reference carries a
+ComplexVariable of two real tensors because fluid had no complex dtype;
+here complex64/complex128 are NATIVE jax dtypes, so these functions are the
+regular ops — the namespace exists so reference scripts import unchanged.
+"""
+from . import tensor
+from .tensor import (elementwise_add, elementwise_sub, elementwise_mul,
+                     elementwise_div, kron, trace, sum, matmul, reshape,
+                     transpose)
+
+__all__ = tensor.__all__

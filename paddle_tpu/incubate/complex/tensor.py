@@ -1,0 +1,9 @@
+"""Complex tensor ops (native complex dtypes; see package docstring)."""
+from ...tensor.math import (kron, trace, sum, matmul)
+from ...tensor.math import (elementwise_add, elementwise_sub,
+                            elementwise_mul, elementwise_div)
+from ...tensor.manipulation import reshape, transpose
+
+__all__ = ['elementwise_add', 'elementwise_sub', 'elementwise_mul',
+           'elementwise_div', 'kron', 'trace', 'sum', 'matmul',
+           'reshape', 'transpose']

@@ -345,16 +345,56 @@ def save(layer, path, input_spec=None, **configs):
     meta = {'class': type(layer).__name__}
     if input_spec is not None:
         try:
-            def fwd(*vals):
+            # portable jax.export with the layer state as ARGUMENTS (not
+            # baked constants) so TranslatedLayer.forward can run the
+            # executable against its reloaded .pdparams in a fresh process
+            # (parity: fluid/dygraph/io.py:546 TranslatedLayer runs the
+            # loaded program)
+            from ..nn.layer_base import functional_call
+            # exported state == exactly what .pdparams stores
+            # (state_dict(): params + PERSISTABLE buffers — exporting a
+            # non-persistable buffer would KeyError at reload)
+            all_state = {k: (v._value if isinstance(v, Tensor) else
+                             jnp.asarray(np.asarray(v)))
+                         for k, v in layer.state_dict().items()}
+            state_names = sorted(all_state)
+
+            def fwd(state_vals, *ins):
+                st = dict(zip(state_names, state_vals))
                 with autograd.no_grad():
-                    out = layer(*[Tensor(v) for v in vals])
+                    out, _ = functional_call(
+                        layer, st, *[Tensor(v) for v in ins])
+                if isinstance(out, (tuple, list)):
+                    return tuple(o._value if isinstance(o, Tensor) else o
+                                 for o in out)
                 return out._value if isinstance(out, Tensor) else out
-            shapes = [jax.ShapeDtypeStruct(tuple(abs(d) for d in s.shape),
-                                           s.dtype) for s in input_spec]
-            lowered = jax.jit(fwd).lower(*shapes)
-            meta['stablehlo'] = lowered.as_text()
+
+            scope = jax.export.SymbolicScope()
+            in_specs = []
+            for i, s in enumerate(input_spec):
+                dims = []
+                for j, d in enumerate(s.shape):
+                    if d is None or int(d) < 0:
+                        # dim 0 shares one batch symbol across inputs
+                        dims.append('batch' if j == 0
+                                    else 'b%d_%d' % (i, j))
+                    else:
+                        dims.append(str(d))
+                shape = jax.export.symbolic_shape(','.join(dims),
+                                                  scope=scope)
+                in_specs.append(jax.ShapeDtypeStruct(shape, s.dtype))
+            state_specs = [
+                jax.ShapeDtypeStruct(tuple(np.shape(all_state[n])),
+                                     all_state[n].dtype)
+                for n in state_names]
+            exported = jax.export.export(jax.jit(fwd))(state_specs,
+                                                       *in_specs)
+            meta['exported'] = {'blob': bytes(exported.serialize()),
+                                'state_names': state_names}
+            meta['stablehlo'] = exported.mlir_module()
             meta['input_shapes'] = [list(s.shape) for s in input_spec]
-            meta['input_dtypes'] = [str(np.dtype(s.dtype)) for s in input_spec]
+            meta['input_dtypes'] = [str(np.dtype(s.dtype))
+                                    for s in input_spec]
         except Exception as e:  # export is best-effort
             meta['export_error'] = str(e)
     with open(path + '.pdmodel', 'wb') as f:
@@ -392,10 +432,32 @@ class TranslatedLayer(Layer):
         return dict(self._state)
 
     def forward(self, *args, **kwargs):
-        raise RuntimeError(
-            "TranslatedLayer from jit.load carries weights + exported HLO; "
-            "rebuild the model class and set_state_dict(layer.state_dict()) "
-            "to run it (executable reload is a planned feature).")
+        exported = self._meta.get('exported')
+        if exported is None:
+            raise RuntimeError(
+                "TranslatedLayer: this model was saved without input_spec "
+                "(export error: %s) — re-save with jit.save(layer, path, "
+                "input_spec=[...]) to get a runnable reload, or rebuild "
+                "the model class and set_state_dict()."
+                % self._meta.get('export_error', 'none recorded'))
+        if getattr(self, '_exec', None) is None:
+            self._exec = jax.export.deserialize(bytearray(exported['blob']))
+        state_vals = []
+        for n in exported['state_names']:
+            v = self._state[n]
+            state_vals.append(v._value if isinstance(v, Tensor)
+                              else jnp.asarray(np.asarray(v)))
+        in_dtypes = [np.dtype(d) for d in
+                     self._meta.get('input_dtypes',
+                                    ['float32'] * len(args))]
+        vals = [a._value if isinstance(a, Tensor)
+                else jnp.asarray(np.asarray(a, dt))
+                for a, dt in zip(args, in_dtypes)]
+        out = self._exec.call(state_vals, *vals)
+        if isinstance(out, (tuple, list)):
+            outs = type(out)(Tensor(o) for o in out)
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor(out)
 
 
 class InputSpec:

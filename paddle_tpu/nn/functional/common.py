@@ -92,7 +92,13 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         if mode == "upscale_in_train":
             return jnp.where(keep, v / (1.0 - p), jnp.zeros_like(v))
         return jnp.where(keep, v, jnp.zeros_like(v))
-    return apply_op(fn, (x,))
+
+    # test-mode variant for Program.clone(for_test=True)
+    if mode == "upscale_in_train":
+        eval_fn = lambda v: v  # noqa: E731
+    else:
+        eval_fn = lambda v: v * (1 - p)  # noqa: E731
+    return apply_op(fn, (x,), eval_fn=eval_fn)
 
 
 def dropout2d(x, p=0.5, training=True, data_format='NCHW', name=None):

@@ -79,10 +79,30 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             new_rm = momentum * m0 + (1 - momentum) * mean.astype(m0.dtype)
             new_rv = momentum * v0 + (1 - momentum) * (var * unbias).astype(v0.dtype)
             return out, new_rm, new_rv
-        out, new_rm, new_rv = apply_op(fn, tuple(tensors), n_outputs=3)
-        with _no_grad():
-            rm._inplace_value(new_rm._value)
-            rv._inplace_value(new_rv._value)
+
+        def eval_fn(v, *rest):
+            # test-mode variant (Program.clone(for_test=True)): normalize
+            # with the running stats, leave them unchanged
+            wb, (m0, v0) = rest[:-2], rest[-2:]
+            inv = 1.0 / jnp.sqrt(v0.astype(jnp.float32).reshape(shp) +
+                                 epsilon)
+            out = ((v.astype(jnp.float32) -
+                    m0.astype(jnp.float32).reshape(shp)) * inv) \
+                .astype(v.dtype)
+            if wb:
+                out = out * wb[0].reshape(shp) + wb[1].reshape(shp)
+            return out, m0, v0
+
+        out, new_rm, new_rv = apply_op(fn, tuple(tensors), n_outputs=3,
+                                       eval_fn=eval_fn)
+        if not getattr(new_rm, '_symbolic', False):
+            with _no_grad():
+                rm._inplace_value(new_rm._value)
+                rv._inplace_value(new_rv._value)
+        # static capture: the buffers keep their concrete payloads (writing
+        # a symbolic aval into them would poison every later read);
+        # running-stat advancement across Executor.run calls is a
+        # documented divergence of the static path
         return out
 
     tensors += [rm, rv]

@@ -32,12 +32,22 @@ class ExecutionStrategy:
 
 
 class CompiledProgram:
+    """Parity: fluid/compiler.py CompiledProgram. with_data_parallel turns
+    on REAL mesh execution: the Executor compiles the program with feeds
+    sharded over a 1-D 'data' mesh spanning the visible devices and params
+    replicated — XLA inserts the gradient all-reduce (the reference's
+    ParallelExecutor + NCCL allreduce path) from the shardings."""
+
     def __init__(self, program_or_graph, build_strategy=None):
         self._program = program_or_graph
         self._build_strategy = build_strategy
+        self._dp = False
+        self._dp_places = None
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, places=None):
+        self._dp = True
+        self._dp_places = places
         return self
 
     @property

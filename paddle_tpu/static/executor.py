@@ -7,6 +7,8 @@ once under jax.jit — XLA fuses/schedules it. Training programs (after
 optimizer.minimize) compile forward+backward+update into the same program,
 with jax.grad providing what append_backward provides in the reference.
 """
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -111,12 +113,13 @@ class Executor:
         param_names = [v.name for v in params]
         param_vals = [v.concrete._value for v in params]
 
+        dp = bool(getattr(program, '_dp', False))
         key = (program._fingerprint, tuple(feed_names),
                tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
-               tuple(v.name for v in fetch_vars), train_spec is not None)
+               tuple(v.name for v in fetch_vars), train_spec is not None, dp)
         if key not in self._cache:
             self._cache[key] = self._compile(program, feed_names, fetch_vars,
-                                             param_names, train_spec)
+                                             param_names, train_spec, dp=dp)
         compiled = self._cache[key]
         if train_spec is not None:
             optimizer = train_spec[1]
@@ -152,7 +155,8 @@ class Executor:
     def _program_params(self, program):
         return _program_params(program)
 
-    def _compile(self, program, feed_names, fetch_vars, param_names, train_spec):
+    def _compile(self, program, feed_names, fetch_vars, param_names,
+                 train_spec, dp=False):
         ops = program.global_block.ops
 
         def interpret(env):
@@ -162,8 +166,29 @@ class Executor:
         feed_vars = [block.var(n) for n in feed_names]
         params = self._program_params(program)
 
+        # data-parallel compile (CompiledProgram.with_data_parallel): feeds
+        # shard over a 1-D 'data' mesh, params/opt-state replicate; XLA
+        # derives the grad all-reduce from the shardings — numerics match
+        # the single-device run on the concatenated batch exactly
+        jit_kwargs = {}
+        if dp:
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec as P)
+            devs = jax.devices()
+            mesh = Mesh(np.asarray(devs), ('data',))
+            feed_sh = NamedSharding(mesh, P('data'))
+            repl = NamedSharding(mesh, P())
+            n_feed = len(feed_vars)
+            n_param = len(params)
+            if train_spec is None:
+                jit_kwargs['in_shardings'] = ([feed_sh] * n_feed,
+                                              [repl] * n_param)
+            else:
+                jit_kwargs['in_shardings'] = ([feed_sh] * n_feed,
+                                              [repl] * n_param, repl)
+
         if train_spec is None:
-            @jax.jit
+            @functools.partial(jax.jit, **jit_kwargs)
             def run(feed_vals, param_vals):
                 env = {}
                 for v, val in zip(feed_vars, feed_vals):
@@ -176,7 +201,7 @@ class Executor:
 
         loss_var, optimizer = train_spec
 
-        @jax.jit
+        @functools.partial(jax.jit, **jit_kwargs)
         def train_run(feed_vals, param_vals, opt_state):
             def loss_fn(pvals):
                 env = {}

@@ -52,14 +52,18 @@ class Variable(Tensor):
 
 
 class Operator:
-    __slots__ = ('fn', 'inputs', 'outputs', 'n_outputs', 'type')
+    __slots__ = ('fn', 'inputs', 'outputs', 'n_outputs', 'type', 'eval_fn')
 
-    def __init__(self, fn, inputs, outputs, type='jax_op'):
+    def __init__(self, fn, inputs, outputs, type='jax_op', eval_fn=None):
         self.fn = fn
         self.inputs = inputs
         self.outputs = outputs
         self.n_outputs = len(outputs)
         self.type = type
+        # test-mode variant (same arity/outputs): swapped in by
+        # Program.clone(for_test=True) so a training capture of dropout/BN
+        # gets true eval semantics (parity: the reference rewrites is_test)
+        self.eval_fn = eval_fn
 
 
 class Block:
@@ -137,9 +141,22 @@ class Program:
         return list(self.global_block.vars.values())
 
     def clone(self, for_test=False):
-        import copy
         p = Program.__new__(Program)
-        p.blocks = self.blocks  # shared capture (parity-sufficient)
+        if for_test:
+            # genuine eval semantics: ops carrying a test-mode variant
+            # (dropout/BN capture one) are swapped; Variables are shared so
+            # feeds/fetches/params keep their identity slots
+            nb = Block(p, 0)
+            nb.vars = self.global_block.vars
+            nb._concrete_cache = getattr(self.global_block,
+                                         '_concrete_cache', {})
+            nb.ops = [op if op.eval_fn is None else
+                      Operator(op.eval_fn, op.inputs, op.outputs,
+                               type=op.type + '_eval')
+                      for op in self.global_block.ops]
+            p.blocks = [nb]
+        else:
+            p.blocks = self.blocks  # shared capture
         p.random_seed = self.random_seed
         p._train_spec = None if for_test else self._train_spec
         p._fingerprint = next(_var_counter)
@@ -200,7 +217,7 @@ def current_capture_program():
     return None
 
 
-def _symbolic_apply(fn, tensors, n_outputs, differentiable):
+def _symbolic_apply(fn, tensors, n_outputs, differentiable, eval_fn=None):
     """The apply_op hook: append an Operator; infer shapes via eval_shape."""
     prog = current_capture_program()
     if prog is None:
@@ -234,7 +251,8 @@ def _symbolic_apply(fn, tensors, n_outputs, differentiable):
         ov.stop_gradient = stop
         block.vars[ov.name] = ov
         outs.append(ov)
-    op = Operator(fn, ins, outs, type=getattr(fn, '__name__', 'jax_op'))
+    op = Operator(fn, ins, outs, type=getattr(fn, '__name__', 'jax_op'),
+                  eval_fn=eval_fn)
     for ov in outs:
         ov.op = op
     block.ops.append(op)

@@ -1,10 +1,18 @@
 """paddle.static.nn: graph-building layer functions.
 
-Parity: python/paddle/fluid/layers/nn.py's fc/conv2d/... — here thin wrappers
-that instantiate the SAME nn.Layer modules under static capture (the
-apply_op chokepoint records their ops into the Program).
+Parity: python/paddle/static/nn/__init__.py (the 21-name surface) +
+python/paddle/fluid/layers/nn.py's fc/conv2d/... — thin wrappers that
+instantiate the SAME nn.Layer modules under static capture (the apply_op
+chokepoint records their ops into the Program).
 """
 from .. import nn as _nn
+
+__all__ = ['fc', 'batch_norm', 'embedding', 'bilinear_tensor_product',
+           'conv2d', 'conv2d_transpose', 'conv3d', 'conv3d_transpose',
+           'create_parameter', 'crf_decoding', 'data_norm',
+           'deformable_conv', 'group_norm', 'hsigmoid', 'instance_norm',
+           'layer_norm', 'multi_box_head', 'nce', 'prelu', 'row_conv',
+           'spectral_norm']
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
@@ -53,3 +61,19 @@ def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
     layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
                           sparse=is_sparse, weight_attr=param_attr)
     return layer(input)
+
+
+# the rest of the 21-name static.nn surface: aliases over the classic
+# fluid.layers implementations (imported lazily at module bottom to avoid
+# the fluid.layers <-> static.nn import cycle)
+def __getattr__(name):
+    _aliases = {'bilinear_tensor_product', 'conv2d_transpose', 'conv3d',
+                'conv3d_transpose', 'create_parameter', 'crf_decoding',
+                'data_norm', 'deformable_conv', 'group_norm', 'hsigmoid',
+                'instance_norm', 'layer_norm', 'multi_box_head', 'nce',
+                'prelu', 'row_conv', 'spectral_norm'}
+    if name in _aliases:
+        from ..fluid import layers as _L
+        return getattr(_L, name)
+    raise AttributeError(f"module 'paddle.static.nn' has no attribute "
+                         f"{name!r}")

@@ -9,3 +9,12 @@ from .seq2seq import Seq2SeqTransformer
 from .word2vec import SkipGram, Word2Vec
 from .lm import LSTMLanguageModel
 from .._native.tokenizer import Tokenizer
+from .layers import (RNNCell, BasicLSTMCell, BasicGRUCell, RNN,
+                     BidirectionalRNN, StackedRNNCell, StackedLSTMCell,
+                     LSTM, BidirectionalLSTM, StackedGRUCell, GRU,
+                     BidirectionalGRU, DynamicDecode, BeamSearchDecoder,
+                     Conv1dPoolLayer, CNNEncoder, MultiHeadAttention, FFN,
+                     TransformerEncoderLayer, TransformerEncoder,
+                     TransformerDecoderLayer, TransformerDecoder,
+                     TransformerCell, TransformerBeamSearchDecoder,
+                     LinearChainCRF, CRFDecoding, SequenceTagging)

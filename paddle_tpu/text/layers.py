@@ -1,0 +1,899 @@
+"""paddle.text layer zoo: the reusable seq-modeling layer library.
+
+Parity: /root/reference/python/paddle/text/text.py (RNNCell:67,
+BasicLSTMCell:186, BasicGRUCell:321, RNN:476, StackedRNNCell:639,
+StackedLSTMCell:734, LSTM:886, BidirectionalRNN:1006,
+BidirectionalLSTM:1144, StackedGRUCell:1337, GRU:1470,
+BidirectionalGRU:1581, DynamicDecode:1762, Conv1dPoolLayer:1980,
+CNNEncoder:2109, TransformerCell:2252, TransformerBeamSearchDecoder:2421,
+PrePostProcessLayer:2609, MultiHeadAttention:2687, FFN:2900,
+TransformerEncoderLayer:2957, TransformerEncoder:3061,
+TransformerDecoderLayer:3170, TransformerDecoder:3314, LinearChainCRF:3506,
+CRFDecoding:3655, SequenceTagging:3832).
+
+TPU-first notes: recurrences lower through the nn cell machinery
+(lax.scan); CRF layers wrap the log-space scan + Viterbi functionals; the
+beam-search adapters reuse nn.decode's preallocated-buffer while_loop design
+(caches are fixed-shape, so `var_dim_in_state` is accepted for API parity
+but nothing needs to grow).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn import Linear, Embedding, LayerList, Dropout, LayerNorm
+from ..nn import functional as F
+from ..nn.layer.rnn import LSTMCell as _NNLSTMCell, GRUCell as _NNGRUCell
+from ..nn.decode import (BeamSearchDecoder, dynamic_decode)
+from ..tensor.manipulation import concat, stack, transpose
+
+__all__ = [
+    'RNNCell', 'BasicLSTMCell', 'BasicGRUCell', 'RNN', 'BidirectionalRNN',
+    'StackedRNNCell', 'StackedLSTMCell', 'LSTM', 'BidirectionalLSTM',
+    'StackedGRUCell', 'GRU', 'BidirectionalGRU', 'DynamicDecode',
+    'BeamSearchDecoder', 'Conv1dPoolLayer', 'CNNEncoder',
+    'MultiHeadAttention', 'FFN', 'TransformerEncoderLayer',
+    'TransformerEncoder', 'TransformerDecoderLayer', 'TransformerDecoder',
+    'TransformerCell', 'TransformerBeamSearchDecoder', 'LinearChainCRF',
+    'CRFDecoding', 'SequenceTagging',
+]
+
+
+class RNNCell(Layer):
+    """Base cell: forward(inputs, states) -> (outputs, new_states)
+    (text.py:67)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype='float32',
+                           init_value=0.0, batch_dim_idx=0):
+        from ..tensor.creation import full
+        shapes = self.state_shape if shape is None else shape
+        B = batch_ref.shape[batch_dim_idx]
+
+        def build(s):
+            dims = [B] + [int(d) for d in
+                          (s if isinstance(s, (list, tuple)) else [s])]
+            return full(dims, init_value, dtype=dtype)
+
+        if isinstance(shapes, (list, tuple)) and shapes and \
+                isinstance(shapes[0], (list, tuple)):
+            return [build(s) for s in shapes]
+        return build(shapes)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError
+
+
+class BasicLSTMCell(RNNCell):
+    """Single LSTM cell with forget-gate bias (text.py:186)."""
+
+    def __init__(self, input_size, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype='float32'):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self._cell = _NNLSTMCell(input_size, hidden_size,
+                                 weight_ih_attr=param_attr,
+                                 weight_hh_attr=param_attr,
+                                 bias_ih_attr=bias_attr,
+                                 bias_hh_attr=bias_attr)
+        if forget_bias and self._cell.bias_ih is not None:
+            b = self._cell.bias_ih._value
+            h = hidden_size
+            self._cell.bias_ih._inplace_value(
+                b.at[h:2 * h].add(jnp.asarray(forget_bias, b.dtype)))
+
+    def forward(self, inputs, states):
+        h, c = states
+        out, (nh, nc) = self._cell(inputs, (h, c))
+        return out, [nh, nc]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+
+class BasicGRUCell(RNNCell):
+    """Single GRU cell (text.py:321)."""
+
+    def __init__(self, input_size, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype='float32'):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self._cell = _NNGRUCell(input_size, hidden_size,
+                                weight_ih_attr=param_attr,
+                                weight_hh_attr=param_attr,
+                                bias_ih_attr=bias_attr,
+                                bias_hh_attr=bias_attr)
+
+    def forward(self, inputs, states):
+        out, nh = self._cell(inputs, states)
+        return out, nh
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class RNN(Layer):
+    """Drive a cell over the time dim (text.py:476)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ..fluid.rnn_tail import rnn as _rnn_drive
+        cell = self.cell
+        # adapt Layer-style cells to the fluid driver's call protocol
+        class _Adapter:
+            def call(self, x, s):
+                return cell(x, s)
+
+            def get_initial_states(self, x):
+                return cell.get_initial_states(x)
+        outs, states = _rnn_drive(_Adapter(), inputs, initial_states,
+                                  sequence_length,
+                                  time_major=self.time_major,
+                                  is_reverse=self.is_reverse, **kwargs)
+        return outs, states
+
+
+class StackedRNNCell(RNNCell):
+    """Stack cells into one multi-layer cell (text.py:639)."""
+
+    def __init__(self, cells):
+        super().__init__()
+        self.cells = LayerList(cells)
+
+    def forward(self, inputs, states, **kwargs):
+        new_states = []
+        out = inputs
+        for cell, s in zip(self.cells, states):
+            out, ns = cell(out, s)
+            new_states.append(ns)
+        return out, new_states
+
+    def get_initial_states(self, batch_ref, **kw):
+        return [c.get_initial_states(batch_ref, **kw) for c in self.cells]
+
+    @staticmethod
+    def stack_param_attr(param_attr, n):
+        return [param_attr] * n
+
+
+class StackedLSTMCell(StackedRNNCell):
+    """num_layers LSTM cells with inter-layer dropout (text.py:734)."""
+
+    def __init__(self, input_size, hidden_size, gate_activation=None,
+                 activation=None, forget_bias=1.0, num_layers=1,
+                 dropout=0.0, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        cells = []
+        for i in range(num_layers):
+            cells.append(BasicLSTMCell(
+                input_size if i == 0 else hidden_size, hidden_size,
+                param_attr, bias_attr, gate_activation, activation,
+                forget_bias, dtype))
+        super().__init__(cells)
+        self.dropout = dropout
+        self.num_layers = num_layers
+
+    def forward(self, inputs, states):
+        new_states = []
+        out = inputs
+        for i, (cell, s) in enumerate(zip(self.cells, states)):
+            out, ns = cell(out, s)
+            if self.dropout and i < self.num_layers - 1 and self.training:
+                out = F.dropout(out, p=self.dropout)
+            new_states.append(ns)
+        return out, new_states
+
+
+class StackedGRUCell(StackedRNNCell):
+    """num_layers GRU cells with inter-layer dropout (text.py:1337)."""
+
+    def __init__(self, input_size, hidden_size, gate_activation=None,
+                 activation=None, num_layers=1, dropout=0.0,
+                 param_attr=None, bias_attr=None, dtype="float32"):
+        cells = []
+        for i in range(num_layers):
+            cells.append(BasicGRUCell(
+                input_size if i == 0 else hidden_size, hidden_size,
+                param_attr, bias_attr, gate_activation, activation, dtype))
+        super().__init__(cells)
+        self.dropout = dropout
+        self.num_layers = num_layers
+
+    def forward(self, inputs, states):
+        new_states = []
+        out = inputs
+        for i, (cell, s) in enumerate(zip(self.cells, states)):
+            out, ns = cell(out, s)
+            if self.dropout and i < self.num_layers - 1 and self.training:
+                out = F.dropout(out, p=self.dropout)
+            new_states.append(ns)
+        return out, new_states
+
+
+class LSTM(Layer):
+    """Multi-layer LSTM over sequences (text.py:886)."""
+
+    def __init__(self, input_size, hidden_size, gate_activation=None,
+                 activation=None, forget_bias=1.0, num_layers=1,
+                 dropout=0.0, is_reverse=False, time_major=False,
+                 param_attr=None, bias_attr=None, dtype='float32'):
+        super().__init__()
+        self.cell = StackedLSTMCell(input_size, hidden_size,
+                                    gate_activation, activation,
+                                    forget_bias, num_layers, dropout,
+                                    param_attr, bias_attr, dtype)
+        self.rnn = RNN(self.cell, is_reverse, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return self.rnn(inputs, initial_states, sequence_length)
+
+
+class GRU(Layer):
+    """Multi-layer GRU over sequences (text.py:1470)."""
+
+    def __init__(self, input_size, hidden_size, gate_activation=None,
+                 activation=None, num_layers=1, dropout=0.0,
+                 is_reverse=False, time_major=False, param_attr=None,
+                 bias_attr=None, dtype='float32'):
+        super().__init__()
+        self.cell = StackedGRUCell(input_size, hidden_size,
+                                   gate_activation, activation, num_layers,
+                                   dropout, param_attr, bias_attr, dtype)
+        self.rnn = RNN(self.cell, is_reverse, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return self.rnn(inputs, initial_states, sequence_length)
+
+
+class BidirectionalRNN(Layer):
+    """Forward + backward cells, outputs merged (text.py:1006)."""
+
+    def __init__(self, cell_fw, cell_bw, merge_mode='concat',
+                 time_major=False, cell_cls=None, **kwargs):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.merge_mode = merge_mode
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            init_fw = init_bw = None
+        elif isinstance(initial_states, (list, tuple)) and \
+                len(initial_states) == 2:
+            init_fw, init_bw = initial_states
+        else:
+            init_fw = init_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, init_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, init_bw, sequence_length)
+        if self.merge_mode == 'concat':
+            out = concat([out_fw, out_bw], axis=-1)
+        elif self.merge_mode == 'sum':
+            out = out_fw + out_bw
+        elif self.merge_mode == 'ave':
+            out = (out_fw + out_bw) * 0.5
+        elif self.merge_mode == 'mul':
+            out = out_fw * out_bw
+        elif self.merge_mode == 'zip':
+            out = (out_fw, out_bw)
+        else:
+            out = (out_fw, out_bw)
+        return out, (st_fw, st_bw)
+
+
+class BidirectionalLSTM(Layer):
+    """(text.py:1144). merge_each_layer=False runs one bi-RNN over the
+    whole stacked cell; True merges per layer."""
+
+    def __init__(self, input_size, hidden_size, gate_activation=None,
+                 activation=None, forget_bias=1.0, num_layers=1,
+                 dropout=0.0, merge_mode='concat', merge_each_layer=False,
+                 time_major=False, param_attr=None, bias_attr=None,
+                 dtype='float32'):
+        super().__init__()
+        self.merge_each_layer = merge_each_layer
+        if not merge_each_layer:
+            cf = StackedLSTMCell(input_size, hidden_size, gate_activation,
+                                 activation, forget_bias, num_layers,
+                                 dropout, param_attr, bias_attr, dtype)
+            cb = StackedLSTMCell(input_size, hidden_size, gate_activation,
+                                 activation, forget_bias, num_layers,
+                                 dropout, param_attr, bias_attr, dtype)
+            self.birnn = BidirectionalRNN(cf, cb, merge_mode, time_major)
+        else:
+            self.layers = LayerList()
+            for i in range(num_layers):
+                in_sz = input_size if i == 0 else (
+                    hidden_size * 2 if merge_mode == 'concat'
+                    else hidden_size)
+                cf = BasicLSTMCell(in_sz, hidden_size, param_attr,
+                                   bias_attr, gate_activation, activation,
+                                   forget_bias, dtype)
+                cb = BasicLSTMCell(in_sz, hidden_size, param_attr,
+                                   bias_attr, gate_activation, activation,
+                                   forget_bias, dtype)
+                self.layers.append(BidirectionalRNN(cf, cb, merge_mode,
+                                                    time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if not self.merge_each_layer:
+            return self.birnn(inputs, initial_states, sequence_length)
+        out = inputs
+        states = []
+        for layer in self.layers:
+            out, st = layer(out, None, sequence_length)
+            states.append(st)
+        return out, states
+
+
+class BidirectionalGRU(Layer):
+    """(text.py:1581)."""
+
+    def __init__(self, input_size, hidden_size, gate_activation=None,
+                 activation=None, forget_bias=1.0, num_layers=1,
+                 dropout=0.0, merge_mode='concat', merge_each_layer=False,
+                 time_major=False, param_attr=None, bias_attr=None,
+                 dtype='float32'):
+        super().__init__()
+        self.merge_each_layer = merge_each_layer
+        if not merge_each_layer:
+            cf = StackedGRUCell(input_size, hidden_size, gate_activation,
+                                activation, num_layers, dropout,
+                                param_attr, bias_attr, dtype)
+            cb = StackedGRUCell(input_size, hidden_size, gate_activation,
+                                activation, num_layers, dropout,
+                                param_attr, bias_attr, dtype)
+            self.birnn = BidirectionalRNN(cf, cb, merge_mode, time_major)
+        else:
+            self.layers = LayerList()
+            for i in range(num_layers):
+                in_sz = input_size if i == 0 else (
+                    hidden_size * 2 if merge_mode == 'concat'
+                    else hidden_size)
+                cf = BasicGRUCell(in_sz, hidden_size, param_attr,
+                                  bias_attr, gate_activation, activation,
+                                  dtype)
+                cb = BasicGRUCell(in_sz, hidden_size, param_attr,
+                                  bias_attr, gate_activation, activation,
+                                  dtype)
+                self.layers.append(BidirectionalRNN(cf, cb, merge_mode,
+                                                    time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if not self.merge_each_layer:
+            return self.birnn(inputs, initial_states, sequence_length)
+        out = inputs
+        states = []
+        for layer in self.layers:
+            out, st = layer(out, None, sequence_length)
+            states.append(st)
+        return out, states
+
+
+class DynamicDecode(Layer):
+    """Layer wrapper over dynamic_decode (text.py:1762)."""
+
+    def __init__(self, decoder, max_step_num=None, output_time_major=False,
+                 impute_finished=False, is_test=False, return_length=False):
+        super().__init__()
+        self.decoder = decoder
+        self.max_step_num = max_step_num
+        self.output_time_major = output_time_major
+        self.impute_finished = impute_finished
+        self.is_test = is_test
+        self.return_length = return_length
+
+    def forward(self, inits=None, **kwargs):
+        return dynamic_decode(self.decoder, inits,
+                              max_step_num=self.max_step_num,
+                              output_time_major=self.output_time_major,
+                              impute_finished=self.impute_finished,
+                              is_test=self.is_test,
+                              return_length=self.return_length, **kwargs)
+
+
+class Conv1dPoolLayer(Layer):
+    """conv1d + pool1d block (text.py:1980)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, pool_size,
+                 conv_stride=1, pool_stride=1, conv_padding=0,
+                 pool_padding=0, act=None, pool_type='max',
+                 global_pooling=False, dilation=1, groups=None,
+                 ceil_mode=False, exclusive=True, use_cudnn=False,
+                 param_attr=None, bias_attr=None):
+        super().__init__()
+        from .. import nn as _nn
+        self.conv = _nn.Conv1D(num_channels, num_filters, filter_size,
+                               stride=conv_stride, padding=conv_padding,
+                               dilation=dilation, groups=groups or 1,
+                               weight_attr=param_attr, bias_attr=bias_attr)
+        self.act = act
+        self.pool_type = pool_type
+        self.pool_size = pool_size
+        self.pool_stride = pool_stride
+        self.pool_padding = pool_padding
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+
+    def forward(self, input):
+        out = self.conv(input)
+        if self.act:
+            out = getattr(F, self.act)(out)
+        if self.global_pooling:
+            return F.global_pool(out, 'avg' if self.pool_type == 'avg'
+                                 else 'max', 'NCL')
+        fn = F.max_pool1d if self.pool_type == 'max' else F.avg_pool1d
+        return fn(out, self.pool_size, self.pool_stride, self.pool_padding,
+                  ceil_mode=self.ceil_mode)
+
+
+class CNNEncoder(Layer):
+    """Parallel Conv1dPoolLayers, outputs concatenated on the channel axis
+    (text.py:2109)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, pool_size,
+                 num_layers=1, conv_stride=1, pool_stride=1,
+                 conv_padding=0, pool_padding=0, act=None, pool_type='max',
+                 global_pooling=False, use_cudnn=False):
+        super().__init__()
+
+        def listify(v):
+            return v if isinstance(v, (list, tuple)) else [v] * num_layers
+        self.convs = LayerList([
+            Conv1dPoolLayer(nc, nf, fs, ps, conv_stride=cs,
+                            pool_stride=pst, conv_padding=cp,
+                            pool_padding=pp, act=a, pool_type=pt,
+                            global_pooling=global_pooling)
+            for nc, nf, fs, ps, cs, pst, cp, pp, a, pt in zip(
+                listify(num_channels), listify(num_filters),
+                listify(filter_size), listify(pool_size),
+                listify(conv_stride), listify(pool_stride),
+                listify(conv_padding), listify(pool_padding),
+                listify(act), listify(pool_type))])
+
+    def forward(self, input):
+        outs = [conv(input) for conv in self.convs]
+        return concat(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# transformer family (pre/post-process command style)
+# ---------------------------------------------------------------------------
+
+class PrePostProcessLayer(Layer):
+    """Apply a command string: a=residual add, n=layer norm, d=dropout
+    (text.py:2609)."""
+
+    def __init__(self, process_cmd, d_model, dropout_rate=0.1):
+        super().__init__()
+        self.process_cmd = process_cmd
+        self.dropout_rate = dropout_rate
+        self.norms = LayerList([LayerNorm([d_model])
+                                for c in process_cmd if c == 'n'])
+
+    def forward(self, x, residual=None):
+        ni = 0
+        for cmd in self.process_cmd:
+            if cmd == 'a':
+                x = x + residual if residual is not None else x
+            elif cmd == 'n':
+                x = self.norms[ni](x)
+                ni += 1
+            elif cmd == 'd':
+                if self.dropout_rate and self.training:
+                    x = F.dropout(x, p=self.dropout_rate)
+        return x
+
+
+class MultiHeadAttention(Layer):
+    """Q/K/V projection attention with optional cache (text.py:2687)."""
+
+    def __init__(self, d_key, d_value, d_model, n_head, dropout_rate=0.1):
+        super().__init__()
+        self.n_head = n_head
+        self.d_key = d_key
+        self.d_value = d_value
+        self.q_fc = Linear(d_model, d_key * n_head, bias_attr=False)
+        self.k_fc = Linear(d_model, d_key * n_head, bias_attr=False)
+        self.v_fc = Linear(d_model, d_value * n_head, bias_attr=False)
+        self.proj_fc = Linear(d_value * n_head, d_model, bias_attr=False)
+        self.dropout_rate = dropout_rate
+
+    def _prepare_qkv(self, queries, keys, values, cache=None):
+        if keys is None:
+            keys, values = queries, queries
+        q = self.q_fc(queries)
+        k = self.k_fc(keys)
+        v = self.v_fc(values)
+
+        def split_heads(x, d):
+            B, T = x.shape[0], x.shape[1]
+            return transpose(x.reshape([B, T, self.n_head, d]),
+                             [0, 2, 1, 3])
+        q = split_heads(q, self.d_key)
+        k = split_heads(k, self.d_key)
+        v = split_heads(v, self.d_value)
+        if cache is not None:
+            k = concat([cache['k'], k], axis=2)
+            v = concat([cache['v'], v], axis=2)
+            cache['k'], cache['v'] = k, v
+        return q, k, v
+
+    def forward(self, queries, keys=None, values=None, attn_bias=None,
+                cache=None):
+        q, k, v = self._prepare_qkv(queries, keys, values, cache)
+        product = (q @ transpose(k, [0, 1, 3, 2])) * \
+            (self.d_key ** -0.5)
+        if attn_bias is not None:
+            product = product + attn_bias
+        weights = F.softmax(product, axis=-1)
+        if self.dropout_rate and self.training:
+            weights = F.dropout(weights, p=self.dropout_rate)
+        out = weights @ v
+        B, T = out.shape[0], out.shape[2]
+        out = transpose(out, [0, 2, 1, 3]).reshape(
+            [B, T, self.n_head * self.d_value])
+        return self.proj_fc(out)
+
+    def cal_kv(self, keys, values):
+        """Precompute cross-attention K/V (static cache)."""
+        k = self.k_fc(keys)
+        v = self.v_fc(values)
+
+        def split_heads(x, d):
+            B, T = x.shape[0], x.shape[1]
+            return transpose(x.reshape([B, T, self.n_head, d]),
+                             [0, 2, 1, 3])
+        return split_heads(k, self.d_key), split_heads(v, self.d_value)
+
+
+class FFN(Layer):
+    """Position-wise feed-forward (text.py:2900)."""
+
+    def __init__(self, d_inner_hid, d_model, dropout_rate=0.1,
+                 fc1_act="relu"):
+        super().__init__()
+        self.fc1 = Linear(d_model, d_inner_hid)
+        self.fc2 = Linear(d_inner_hid, d_model)
+        self.fc1_act = fc1_act
+        self.dropout_rate = dropout_rate
+
+    def forward(self, x):
+        hidden = getattr(F, self.fc1_act)(self.fc1(x))
+        if self.dropout_rate and self.training:
+            hidden = F.dropout(hidden, p=self.dropout_rate)
+        return self.fc2(hidden)
+
+
+class TransformerEncoderLayer(Layer):
+    """(text.py:2957)."""
+
+    def __init__(self, n_head, d_key, d_value, d_model, d_inner_hid,
+                 prepostprocess_dropout=0.1, attention_dropout=0.1,
+                 relu_dropout=0.1, preprocess_cmd="n", postprocess_cmd="da",
+                 ffn_fc1_act="relu"):
+        super().__init__()
+        self.preprocesser1 = PrePostProcessLayer(preprocess_cmd, d_model,
+                                                 prepostprocess_dropout)
+        self.self_attn = MultiHeadAttention(d_key, d_value, d_model, n_head,
+                                            attention_dropout)
+        self.postprocesser1 = PrePostProcessLayer(postprocess_cmd, d_model,
+                                                  prepostprocess_dropout)
+        self.preprocesser2 = PrePostProcessLayer(preprocess_cmd, d_model,
+                                                 prepostprocess_dropout)
+        self.ffn = FFN(d_inner_hid, d_model, relu_dropout, ffn_fc1_act)
+        self.postprocesser2 = PrePostProcessLayer(postprocess_cmd, d_model,
+                                                  prepostprocess_dropout)
+
+    def forward(self, enc_input, attn_bias=None):
+        attn_output = self.self_attn(self.preprocesser1(enc_input), None,
+                                     None, attn_bias)
+        attn_output = self.postprocesser1(attn_output, enc_input)
+        ffn_output = self.ffn(self.preprocesser2(attn_output))
+        return self.postprocesser2(ffn_output, attn_output)
+
+
+class TransformerEncoder(Layer):
+    """(text.py:3061)."""
+
+    def __init__(self, n_layer, n_head, d_key, d_value, d_model,
+                 d_inner_hid, prepostprocess_dropout=0.1,
+                 attention_dropout=0.1, relu_dropout=0.1,
+                 preprocess_cmd="n", postprocess_cmd="da",
+                 ffn_fc1_act="relu"):
+        super().__init__()
+        self.encoder_layers = LayerList([
+            TransformerEncoderLayer(n_head, d_key, d_value, d_model,
+                                    d_inner_hid, prepostprocess_dropout,
+                                    attention_dropout, relu_dropout,
+                                    preprocess_cmd, postprocess_cmd,
+                                    ffn_fc1_act)
+            for _ in range(n_layer)])
+        self.processer = PrePostProcessLayer(preprocess_cmd, d_model,
+                                             prepostprocess_dropout)
+
+    def forward(self, enc_input, attn_bias=None):
+        for layer in self.encoder_layers:
+            enc_input = layer(enc_input, attn_bias)
+        return self.processer(enc_input)
+
+
+class TransformerDecoderLayer(Layer):
+    """(text.py:3170)."""
+
+    def __init__(self, n_head, d_key, d_value, d_model, d_inner_hid,
+                 prepostprocess_dropout=0.1, attention_dropout=0.1,
+                 relu_dropout=0.1, preprocess_cmd="n", postprocess_cmd="da",
+                 ffn_fc1_act="relu"):
+        super().__init__()
+        self.preprocesser1 = PrePostProcessLayer(preprocess_cmd, d_model,
+                                                 prepostprocess_dropout)
+        self.self_attn = MultiHeadAttention(d_key, d_value, d_model,
+                                            n_head, attention_dropout)
+        self.postprocesser1 = PrePostProcessLayer(postprocess_cmd, d_model,
+                                                  prepostprocess_dropout)
+        self.preprocesser2 = PrePostProcessLayer(preprocess_cmd, d_model,
+                                                 prepostprocess_dropout)
+        self.cross_attn = MultiHeadAttention(d_key, d_value, d_model,
+                                             n_head, attention_dropout)
+        self.postprocesser2 = PrePostProcessLayer(postprocess_cmd, d_model,
+                                                  prepostprocess_dropout)
+        self.preprocesser3 = PrePostProcessLayer(preprocess_cmd, d_model,
+                                                 prepostprocess_dropout)
+        self.ffn = FFN(d_inner_hid, d_model, relu_dropout, ffn_fc1_act)
+        self.postprocesser3 = PrePostProcessLayer(postprocess_cmd, d_model,
+                                                  prepostprocess_dropout)
+
+    def forward(self, dec_input, enc_output, self_attn_bias=None,
+                cross_attn_bias=None, cache=None):
+        self_attn_output = self.self_attn(
+            self.preprocesser1(dec_input), None, None, self_attn_bias,
+            cache)
+        self_attn_output = self.postprocesser1(self_attn_output, dec_input)
+        cross_attn_output = self.cross_attn(
+            self.preprocesser2(self_attn_output), enc_output, enc_output,
+            cross_attn_bias)
+        cross_attn_output = self.postprocesser2(cross_attn_output,
+                                                self_attn_output)
+        ffn_output = self.ffn(self.preprocesser3(cross_attn_output))
+        return self.postprocesser3(ffn_output, cross_attn_output)
+
+
+class TransformerDecoder(Layer):
+    """(text.py:3314)."""
+
+    def __init__(self, n_layer, n_head, d_key, d_value, d_model,
+                 d_inner_hid, prepostprocess_dropout=0.1,
+                 attention_dropout=0.1, relu_dropout=0.1,
+                 preprocess_cmd="n", postprocess_cmd="da",
+                 ffn_fc1_act="relu"):
+        super().__init__()
+        self.decoder_layers = LayerList([
+            TransformerDecoderLayer(n_head, d_key, d_value, d_model,
+                                    d_inner_hid, prepostprocess_dropout,
+                                    attention_dropout, relu_dropout,
+                                    preprocess_cmd, postprocess_cmd,
+                                    ffn_fc1_act)
+            for _ in range(n_layer)])
+        self.processer = PrePostProcessLayer(preprocess_cmd, d_model,
+                                             prepostprocess_dropout)
+
+    def forward(self, dec_input, enc_output, self_attn_bias=None,
+                cross_attn_bias=None, caches=None):
+        for i, layer in enumerate(self.decoder_layers):
+            dec_input = layer(dec_input, enc_output, self_attn_bias,
+                              cross_attn_bias,
+                              None if caches is None else caches[i])
+        return self.processer(dec_input)
+
+    def prepare_static_cache(self, enc_output):
+        return [{'static_k': k, 'static_v': v}
+                for k, v in (layer.cross_attn.cal_kv(enc_output, enc_output)
+                             for layer in self.decoder_layers)]
+
+    def prepare_incremental_cache(self, enc_output):
+        B = enc_output.shape[0]
+        from ..core.tensor import to_tensor
+        n_head = self.decoder_layers[0].self_attn.n_head
+        d_key = self.decoder_layers[0].self_attn.d_key
+        d_value = self.decoder_layers[0].self_attn.d_value
+        return [{'k': to_tensor(np.zeros((B, n_head, 0, d_key),
+                                         np.float32)),
+                 'v': to_tensor(np.zeros((B, n_head, 0, d_value),
+                                         np.float32))}
+                for _ in self.decoder_layers]
+
+
+class TransformerCell(RNNCell):
+    """Wrap a TransformerDecoder as a step cell producing logits
+    (text.py:2252). states are the per-layer incremental caches."""
+
+    def __init__(self, decoder, embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.decoder = decoder
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def forward(self, inputs, states=None, enc_output=None,
+                trg_slf_attn_bias=None, trg_src_attn_bias=None,
+                static_caches=[]):
+        word, position = inputs
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(word, position)
+        else:
+            inp = word
+        if states is not None and static_caches:
+            caches = [dict(inc, **st) for inc, st in zip(states,
+                                                         static_caches)]
+        else:
+            caches = states
+        out = self.decoder(inp, enc_output, trg_slf_attn_bias,
+                           trg_src_attn_bias, caches)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        if out.ndim == 3 and out.shape[1] == 1:
+            out = out.squeeze(1)
+        new_states = [{'k': c['k'], 'v': c['v']} for c in caches] \
+            if caches else states
+        return out, new_states
+
+
+class TransformerBeamSearchDecoder(BeamSearchDecoder):
+    """Beam search adapted to transformer caches (text.py:2421).
+
+    TPU-first: nn.decode's beam machinery already carries nested cache
+    states through the while_loop; the transformer quirks handled here are
+    the [B*beam, 1] 2-D step inputs and the growing cache dim —
+    `var_dim_in_state` marks it (kept for API parity; the dense design
+    reindexes the whole cache by beam, which is correct for any dim)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 var_dim_in_state):
+        super().__init__(cell, start_token, end_token, beam_size)
+        self.var_dim_in_state = var_dim_in_state
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        return BeamSearchDecoder.tile_beam_merge_with_batch(x, beam_size)
+
+    def step(self, time, inputs, states, **kwargs):
+        # transformer cells take 2-D [B*beam, 1] word ids + positions
+        if getattr(inputs, 'ndim', 2) == 1:
+            inputs = inputs.unsqueeze(-1)
+        pos = None
+        if 'trg_pos' not in kwargs:
+            from ..core.tensor import apply_op
+            pos = apply_op(
+                lambda v: jnp.full(v.shape, time, jnp.int32),
+                (inputs,), differentiable=False)
+        cell_states = states.cell_states
+        outputs, next_cell_states = self.cell(
+            (inputs, pos), cell_states, **kwargs)
+        beam_state = self._beam_search_step(time, outputs, states,
+                                            next_cell_states)
+        return beam_state
+
+
+# ---------------------------------------------------------------------------
+# CRF layers + SequenceTagging
+# ---------------------------------------------------------------------------
+
+class LinearChainCRF(Layer):
+    """CRF NLL cost layer holding the transition parameter (text.py:3506);
+    transition is [(size+2), size] (rows 0/1 = start/stop)."""
+
+    def __init__(self, size, param_attr=None, dtype='float32'):
+        super().__init__()
+        from ..nn.initializer import ParamAttr, Normal
+        a = ParamAttr._to_attr(param_attr)
+        init = a.initializer or Normal(0.0, 0.1)
+        from ..core.tensor import Parameter
+        self.transition = Parameter(
+            jnp.asarray(init([size + 2, size], dtype=dtype)),
+            name=a.name or 'crf_transition')
+        self.add_parameter('transition', self.transition)
+
+    @property
+    def weight(self):
+        return self.transition
+
+    def forward(self, input, label, length):
+        return F.linear_chain_crf(input, label, self.transition,
+                                  length=length)
+
+
+class CRFDecoding(Layer):
+    """Viterbi decoding layer sharing the CRF transition (text.py:3655)."""
+
+    def __init__(self, size, param_attr=None, dtype='float32'):
+        super().__init__()
+        from ..nn.initializer import ParamAttr, Normal
+        a = ParamAttr._to_attr(param_attr)
+        init = a.initializer or Normal(0.0, 0.1)
+        from ..core.tensor import Parameter
+        self.transition = Parameter(
+            jnp.asarray(init([size + 2, size], dtype=dtype)),
+            name=a.name or 'crfw')
+        self.add_parameter('transition', self.transition)
+
+    @property
+    def weight(self):
+        return self.transition
+
+    def forward(self, input, length, label=None):
+        return F.crf_decoding(input, self.transition, length=length,
+                              label=label)
+
+
+class _GRUEncoder(Layer):
+    """Stacked (bi-)GRU encoder used by SequenceTagging (text.py:3773)."""
+
+    def __init__(self, input_dim, grnn_hidden_dim, init_bound,
+                 num_layers=1, is_bidirection=False):
+        super().__init__()
+        self.num_layers = num_layers
+        self.is_bidirection = is_bidirection
+        self.gru_list = LayerList()
+        from ..nn.initializer import Uniform
+        attr = None
+        for i in range(num_layers):
+            in_dim = input_dim if i == 0 else (
+                grnn_hidden_dim * 2 if is_bidirection else grnn_hidden_dim)
+            if is_bidirection:
+                self.gru_list.append(BidirectionalGRU(
+                    in_dim, grnn_hidden_dim, num_layers=1))
+            else:
+                self.gru_list.append(GRU(in_dim, grnn_hidden_dim,
+                                         num_layers=1))
+
+    def forward(self, input_feature, h0=None):
+        out = input_feature
+        for gru in self.gru_list:
+            out, _ = gru(out)
+        return out
+
+
+class SequenceTagging(Layer):
+    """BiGRU-CRF sequence tagging network (text.py:3832): embedding ->
+    stacked bi-GRU -> emission fc -> CRF. forward(word, lengths, target):
+    with target returns (crf_cost, decoded); else decoded paths."""
+
+    def __init__(self, vocab_size, num_labels, word_emb_dim=128,
+                 grnn_hidden_dim=128, emb_learning_rate=0.1,
+                 crf_learning_rate=0.1, bigru_num=2, init_bound=0.1):
+        super().__init__()
+        self.word_embedding = Embedding(vocab_size, word_emb_dim)
+        self.gru_encoder = _GRUEncoder(word_emb_dim, grnn_hidden_dim,
+                                       init_bound, num_layers=bigru_num,
+                                       is_bidirection=True)
+        self.fc = Linear(grnn_hidden_dim * 2, num_labels)
+        self.linear_chain_crf = LinearChainCRF(num_labels)
+        self.crf_decoding = CRFDecoding(num_labels)
+        # decoding shares the training transition (the reference ties them
+        # through the shared crfw parameter)
+        self.crf_decoding.transition = self.linear_chain_crf.transition
+
+    def forward(self, word, lengths, target=None):
+        emb = self.word_embedding(word)
+        enc = self.gru_encoder(emb)
+        emission = self.fc(enc)
+        if target is not None:
+            crf_cost = self.linear_chain_crf(emission, target, lengths)
+            decoded = F.crf_decoding(
+                emission, self.linear_chain_crf.transition, length=lengths)
+            return crf_cost, decoded
+        return F.crf_decoding(
+            emission, self.linear_chain_crf.transition, length=lengths)

@@ -1,0 +1,86 @@
+"""incubate.complex namespace, fluid.contrib utilities, real spawn."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+class TestIncubateComplex:
+    def test_namespace_ops(self):
+        import paddle_tpu.incubate.complex as C
+        a = paddle.to_tensor(np.array([1 + 2j, 3 - 1j], np.complex64))
+        b = paddle.to_tensor(np.array([2 - 1j, 1 + 1j], np.complex64))
+        out = C.elementwise_mul(a, b).numpy()
+        np.testing.assert_allclose(
+            out, np.array([1 + 2j, 3 - 1j]) * np.array([2 - 1j, 1 + 1j]),
+            rtol=1e-6)
+        m = paddle.to_tensor(
+            np.array([[1 + 1j, 0], [0, 2 - 1j]], np.complex64))
+        np.testing.assert_allclose(C.trace(m).numpy(), 3 + 0j, rtol=1e-6)
+        mm = C.matmul(m, m).numpy()
+        np.testing.assert_allclose(mm, m.numpy() @ m.numpy(), rtol=1e-6)
+
+
+class TestContrib:
+    def test_memory_usage_and_stats(self):
+        from paddle_tpu.fluid import contrib
+        paddle.enable_static()
+        try:
+            p = static.Program()
+            with static.program_guard(p):
+                x = static.data('x', [None, 4], 'float32')
+                h = static.nn.fc(x, 8)
+                y = static.nn.fc(h, 2)
+            mb = contrib.memory_usage(p, batch_size=32)
+            assert mb > 0
+            rows = contrib.summary(p)
+            total_params = sum(r[1] for r in rows)
+            assert total_params == (4 * 8 + 8) + (8 * 2 + 2)
+            uni, adj = contrib.op_freq_statistic(p)
+            assert sum(uni.values()) == len(p.global_block.ops)
+        finally:
+            paddle.disable_static()
+
+    def test_extend_with_decoupled_weight_decay(self):
+        from paddle_tpu.fluid import contrib
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.core.tensor import Parameter
+        SGDW = contrib.extend_with_decoupled_weight_decay(opt.SGD)
+        p = Parameter(np.ones(3, np.float32))
+        o = SGDW(learning_rate=0.1, parameters=[p], weight_decay=0.01)
+        (p * p).sum().backward()
+        o.step()
+        expect = (1 - 0.1 * 2) * (1 - 0.1 * 0.01)
+        np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5)
+
+
+def _rank_fn(scale):
+    rank = int(os.environ.get('PADDLE_TRAINER_ID', '0'))
+    return rank * scale
+
+
+class TestSpawn:
+    def test_inprocess_default(self):
+        import paddle_tpu.distributed as dist
+        ctx = dist.spawn(lambda: 41 + 1)
+        assert ctx.join() == 42
+
+    @pytest.mark.skipif(sys.platform == 'win32', reason='posix only')
+    def test_multiprocess_real_ranks(self):
+        import paddle_tpu.distributed as dist
+        ctx = dist.spawn(_rank_fn, args=(10,), nprocs=2, backend='cpu')
+        results = ctx.join()
+        assert results == [0, 10]
+
+    def test_multiprocess_error_propagates(self):
+        import paddle_tpu.distributed as dist
+        with pytest.raises(RuntimeError, match="spawn"):
+            dist.spawn(_boom, nprocs=2, backend='cpu')
+
+
+def _boom():
+    raise ValueError("worker failure")

@@ -1,0 +1,75 @@
+"""jit.save -> jit.load roundtrip where TranslatedLayer.forward EXECUTES
+(VERDICT r3 item 5): save in one process, load+run in a fresh process."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as jit
+from paddle_tpu.jit import InputSpec
+
+
+def _build(seed=0):
+    paddle.seed(seed)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+    net.eval()
+    return net
+
+
+def test_translated_layer_forward_same_process(tmp_path):
+    net = _build()
+    path = str(tmp_path / "model")
+    jit.save(net, path, input_spec=[InputSpec([None, 8], 'float32')])
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    want = net(paddle.to_tensor(x)).numpy()
+    loaded = jit.load(path)
+    got = loaded(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # symbolic batch dim: a different batch size runs without re-save
+    x2 = np.random.RandomState(1).randn(7, 8).astype(np.float32)
+    got2 = loaded(paddle.to_tensor(x2)).numpy()
+    np.testing.assert_allclose(got2, net(paddle.to_tensor(x2)).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_translated_layer_forward_fresh_process(tmp_path):
+    net = _build()
+    path = str(tmp_path / "model")
+    jit.save(net, path, input_spec=[InputSpec([None, 8], 'float32')])
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    want = net(paddle.to_tensor(x)).numpy()
+    np.save(tmp_path / "x.npy", x)
+    np.save(tmp_path / "want.npy", want)
+
+    code = f"""
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.jit as jit
+x = np.load(r'{tmp_path}/x.npy')
+want = np.load(r'{tmp_path}/want.npy')
+loaded = jit.load(r'{path}')
+got = loaded(paddle.to_tensor(x)).numpy()
+np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+print('FRESH_PROCESS_OK')
+"""
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env['PYTHONPATH'] = repo
+    env['JAX_PLATFORMS'] = 'cpu'
+    proc = subprocess.run([sys.executable, '-c', code], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert 'FRESH_PROCESS_OK' in proc.stdout
+
+
+def test_save_without_spec_gives_clear_error(tmp_path):
+    net = _build()
+    path = str(tmp_path / "nospec")
+    jit.save(net, path)
+    loaded = jit.load(path)
+    import pytest
+    with pytest.raises(RuntimeError, match="input_spec"):
+        loaded(paddle.to_tensor(np.zeros((2, 8), np.float32)))

@@ -1,0 +1,183 @@
+"""paddle.text layer zoo (VERDICT r3 item 8): cells, stacked/bidirectional
+RNNs, transformer family, CRF layers, SequenceTagging training a step."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.text as text
+
+
+def t(x, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(x, dtype=dtype))
+
+
+class TestCells:
+    def test_basic_lstm_cell(self):
+        cell = text.BasicLSTMCell(6, 8)
+        x = t(np.random.RandomState(0).randn(3, 6))
+        states = cell.get_initial_states(x)
+        out, (h, c) = cell(x, states)
+        assert list(out.shape) == [3, 8]
+        assert list(h.shape) == [3, 8] and list(c.shape) == [3, 8]
+
+    def test_basic_gru_cell(self):
+        cell = text.BasicGRUCell(6, 8)
+        x = t(np.random.RandomState(0).randn(3, 6))
+        out, h = cell(x, cell.get_initial_states(x))
+        assert list(out.shape) == [3, 8]
+
+    def test_stacked_cells(self):
+        cell = text.StackedLSTMCell(6, 8, num_layers=2)
+        x = t(np.random.RandomState(0).randn(3, 6))
+        states = cell.get_initial_states(x)
+        out, new_states = cell(x, states)
+        assert list(out.shape) == [3, 8]
+        assert len(new_states) == 2
+
+
+class TestRNNDrivers:
+    def test_lstm_layer(self):
+        lstm = text.LSTM(5, 7, num_layers=2)
+        x = t(np.random.RandomState(0).randn(2, 4, 5))
+        out, states = lstm(x)
+        assert list(out.shape) == [2, 4, 7]
+
+    def test_gru_reverse(self):
+        gru = text.GRU(5, 7, is_reverse=True)
+        x = t(np.random.RandomState(0).randn(2, 4, 5))
+        out, _ = gru(x)
+        assert list(out.shape) == [2, 4, 7]
+
+    def test_bidirectional_lstm_merge_modes(self):
+        x = t(np.random.RandomState(0).randn(2, 3, 5))
+        bi = text.BidirectionalLSTM(5, 6)
+        out, _ = bi(x)
+        assert list(out.shape) == [2, 3, 12]      # concat
+        bi_sum = text.BidirectionalRNN(text.BasicGRUCell(5, 6),
+                                       text.BasicGRUCell(5, 6),
+                                       merge_mode='sum')
+        out2, _ = bi_sum(x)
+        assert list(out2.shape) == [2, 3, 6]
+
+    def test_bidirectional_gru_merge_each_layer(self):
+        x = t(np.random.RandomState(0).randn(2, 3, 5))
+        bi = text.BidirectionalGRU(5, 6, num_layers=2,
+                                   merge_each_layer=True)
+        out, _ = bi(x)
+        assert list(out.shape) == [2, 3, 12]
+
+
+class TestCNN:
+    def test_conv1d_pool(self):
+        layer = text.Conv1dPoolLayer(4, 8, 3, 2, conv_padding=1,
+                                     pool_stride=2, act='relu')
+        x = t(np.random.RandomState(0).randn(2, 4, 10))
+        out = layer(x)
+        assert list(out.shape) == [2, 8, 5]
+
+    def test_cnn_encoder(self):
+        enc = text.CNNEncoder(num_channels=4, num_filters=8, filter_size=3,
+                              pool_size=2, num_layers=2, conv_padding=1,
+                              pool_stride=2)
+        x = t(np.random.RandomState(0).randn(2, 4, 10))
+        out = enc(x)
+        assert list(out.shape) == [2, 16, 5]
+
+
+class TestTransformerFamily:
+    def test_encoder(self):
+        enc = text.TransformerEncoder(2, 2, 8, 8, 16, 32)
+        enc.eval()
+        x = t(np.random.RandomState(0).randn(2, 5, 16))
+        out = enc(x)
+        assert list(out.shape) == [2, 5, 16]
+
+    def test_decoder_with_caches(self):
+        dec = text.TransformerDecoder(2, 2, 8, 8, 16, 32)
+        dec.eval()
+        rs = np.random.RandomState(0)
+        enc_out = t(rs.randn(2, 5, 16))
+        # full-sequence pass under a CAUSAL self-attention bias (what
+        # step-by-step decoding computes by construction)
+        x = t(rs.randn(2, 3, 16))
+        causal = np.triu(np.full((1, 1, 3, 3), -1e9, np.float32), k=1)
+        full = dec(x, enc_out, self_attn_bias=t(causal))
+        assert list(full.shape) == [2, 3, 16]
+        # incremental pass equals the full pass step by step
+        caches = dec.prepare_incremental_cache(enc_out)
+        steps = []
+        xv = x.numpy()
+        for i in range(3):
+            step_out = dec(t(xv[:, i:i + 1]), enc_out, None, None, caches)
+            steps.append(step_out.numpy()[:, 0])
+        inc = np.stack(steps, axis=1)
+        np.testing.assert_allclose(inc, full.numpy(), rtol=2e-4, atol=2e-5)
+
+    def test_transformer_cell(self):
+        dec = text.TransformerDecoder(1, 2, 8, 8, 16, 32)
+        dec.eval()
+        emb = paddle.nn.Embedding(50, 16)
+        pos_emb = paddle.nn.Embedding(40, 16)
+        out_fc = paddle.nn.Linear(16, 50)
+
+        def embedding_fn(word, pos):
+            return emb(word) + pos_emb(pos)
+
+        cell = text.TransformerCell(dec, embedding_fn, out_fc)
+        enc_out = t(np.random.RandomState(0).randn(2, 5, 16))
+        caches = dec.prepare_incremental_cache(enc_out)
+        word = t(np.array([[3], [7]]), np.int32)
+        pos = t(np.array([[0], [0]]), np.int32)
+        logits, new_states = cell((word, pos), caches,
+                                  enc_output=enc_out)
+        assert list(logits.shape) == [2, 50]
+
+
+class TestCRFLayers:
+    def test_linear_chain_crf_and_decode(self):
+        rs = np.random.RandomState(0)
+        crf = text.LinearChainCRF(4)
+        emission = t(rs.randn(2, 5, 4))
+        label = t(rs.randint(0, 4, (2, 5)), np.int64)
+        length = t([5, 3], np.int64)
+        cost = crf(emission, label, length)
+        assert list(cost.shape) == [2, 1]
+        dec = text.CRFDecoding(4)
+        path = dec(emission, length)
+        assert list(path.shape) == [2, 5]
+
+
+class TestSequenceTagging:
+    def test_trains_a_step_on_synthetic_conll(self):
+        """SequenceTagging end-to-end on synthetic Conll05-style batches:
+        one optimizer step reduces the CRF cost."""
+        rs = np.random.RandomState(0)
+        V, L, T, B = 50, 6, 8, 4
+        model = text.SequenceTagging(vocab_size=V, num_labels=L,
+                                     word_emb_dim=16, grnn_hidden_dim=16,
+                                     bigru_num=1)
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=model.parameters())
+        words = t(rs.randint(1, V, (B, T)), np.int64)
+        lengths = t(rs.randint(3, T + 1, (B,)), np.int64)
+        targets = t(rs.randint(0, L, (B, T)), np.int64)
+        losses = []
+        for _ in range(6):
+            cost, decoded = model(words, lengths, targets)
+            loss = cost.mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses
+        # inference mode returns decoded paths only
+        path = model(words, lengths)
+        assert list(path.shape) == [B, T]
+        assert int(path.numpy().max()) < L
+
+    def test_decoding_ties_training_transition(self):
+        model = text.SequenceTagging(vocab_size=10, num_labels=3,
+                                     word_emb_dim=8, grnn_hidden_dim=8,
+                                     bigru_num=1)
+        assert model.crf_decoding.transition is \
+            model.linear_chain_crf.transition
