@@ -61,3 +61,105 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     """Rough FLOPs estimator (parity: paddle.flops)."""
     from .hapi.model_summary import flops as _flops
     return _flops(net, input_size, custom_ops=custom_ops, print_detail=print_detail)
+
+# -- 2.0-beta top-level alias tail (parity: python/paddle/__init__.py's
+# #DEFINE_ALIAS block) ------------------------------------------------------
+from .static.graph import Variable  # noqa: E402,F401
+from .fluid.layers import (  # noqa: E402,F401
+    create_parameter, create_global_var, crop_tensor, fill_constant,
+    has_inf, has_nan, reduce_all, reduce_any, reduce_max, reduce_mean,
+    reduce_min, reduce_prod, reduce_sum, sums, unique_with_counts)
+from .fluid.lr_schedules import (  # noqa: E402,F401
+    cosine_decay as _cosine_decay_fn,
+    exponential_decay as _exp_decay_fn,
+    inverse_time_decay as _inv_decay_fn,
+    natural_exp_decay as _nat_decay_fn,
+    polynomial_decay as _poly_decay_fn)
+from .optimizer.lr import (NoamDecay, PiecewiseDecay)  # noqa: E402,F401
+from .distributed import DataParallel  # noqa: E402,F401
+
+
+def CosineDecay(learning_rate, step_each_epoch, epochs, **kw):
+    """fluid.dygraph.CosineDecay-signature factory (2.0-beta alias)."""
+    return _cosine_decay_fn(learning_rate, step_each_epoch, epochs)
+
+
+def ExponentialDecay(learning_rate, decay_steps, decay_rate,
+                     staircase=False, **kw):
+    return _exp_decay_fn(learning_rate, decay_steps, decay_rate, staircase)
+
+
+def InverseTimeDecay(learning_rate, decay_steps, decay_rate,
+                     staircase=False, **kw):
+    return _inv_decay_fn(learning_rate, decay_steps, decay_rate, staircase)
+
+
+def NaturalExpDecay(learning_rate, decay_steps, decay_rate,
+                    staircase=False, **kw):
+    return _nat_decay_fn(learning_rate, decay_steps, decay_rate, staircase)
+
+
+def PolynomialDecay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                    power=1.0, cycle=False, **kw):
+    return _poly_decay_fn(learning_rate, decay_steps, end_learning_rate,
+                          power, cycle)
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """fluid.dygraph.to_variable alias."""
+    return to_tensor(value, dtype=dtype)
+
+
+def manual_seed(s):
+    return seed(s)
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    """out = input + value * tensor1 * tensor2 (2.0-beta op)."""
+    return input + tensor1 * tensor2 * value
+
+
+def elementwise_sum(inputs, name=None):
+    return sums(inputs)
+
+
+def inverse(x, name=None):
+    """Matrix inverse (2.0-beta top-level op)."""
+    import jax.numpy as _jnp
+    from .core.tensor import apply_op as _apply_op
+    from .tensor._helpers import _t as _tt
+    return _apply_op(lambda v: _jnp.linalg.inv(v), (_tt(x),))
+
+
+def shuffle(x, name=None):
+    """Random row shuffle (2.0-beta top-level op)."""
+    import jax as _jax
+    from .core.rng import next_key as _nk
+    from .core.tensor import apply_op as _apply_op
+    from .tensor._helpers import _t as _tt
+    key = _nk()
+    return _apply_op(
+        lambda v: v[_jax.random.permutation(key, v.shape[0])], (_tt(x),))
+
+
+def get_cuda_rng_state():
+    """No CUDA here: returns the global generator state (the TPU/host RNG
+    that actually drives sampling) for checkpoint symmetry."""
+    from .core import rng as _rng
+    return _rng.current_generator().get_state()
+
+
+def set_cuda_rng_state(state):
+    from .core import rng as _rng
+    _rng.current_generator().set_state(state)
+
+
+class SaveLoadConfig:
+    """Config holder for jit.save/load (2.0-beta API)."""
+
+    def __init__(self):
+        self.output_spec = None
+        self.model_filename = None
+        self.params_filename = None
+        self.separate_params = False
+        self.keep_name_table = False

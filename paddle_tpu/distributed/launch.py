@@ -26,11 +26,17 @@ from . import env
 __all__ = ['spawn', 'launch', 'get_cluster_and_pod']
 
 
+def _rank_env(rank, nprocs):
+    """The reference trainer env for one rank (shared by _worker, spawn's
+    parent loop, and launch)."""
+    return {'PADDLE_TRAINER_ID': str(rank),
+            'PADDLE_TRAINERS_NUM': str(nprocs),
+            'PADDLE_CURRENT_ENDPOINT': f"127.0.0.1:{6170 + rank}"}
+
+
 def _worker(rank, nprocs, func, args, result_dir):
-    os.environ['PADDLE_TRAINER_ID'] = str(rank)
-    os.environ['PADDLE_TRAINERS_NUM'] = str(nprocs)
+    os.environ.update(_rank_env(rank, nprocs))
     os.environ['FLAGS_selected_gpus'] = str(rank)
-    os.environ['PADDLE_CURRENT_ENDPOINT'] = f"127.0.0.1:{6170 + rank}"
     os.environ.setdefault('JAX_PLATFORMS', 'cpu')
     path = os.path.join(result_dir, f"result_{rank}.pkl")
     # results travel via files (atomic rename), not an mp.Queue — queue FDs
@@ -64,8 +70,11 @@ class _Context:
             # join() must see the same results (the files are consumed and
             # the tempdir removed on the first pass)
             return self._joined
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
         for p in self.processes:
-            p.join(timeout)
+            p.join(None if deadline is None
+                   else max(deadline - _time.monotonic(), 0.001))
         alive = [i for i, p in enumerate(self.processes) if p.is_alive()]
         if alive:
             raise RuntimeError(
@@ -116,11 +125,8 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
                        'PADDLE_CURRENT_ENDPOINT', 'JAX_PLATFORMS')}
     try:
         for rank in range(n):
-            os.environ['PADDLE_TRAINER_ID'] = str(rank)
-            os.environ['PADDLE_TRAINERS_NUM'] = str(n)
-            os.environ['PADDLE_CURRENT_ENDPOINT'] = \
-                f"127.0.0.1:{6170 + rank}"
-            os.environ['JAX_PLATFORMS'] = 'cpu'
+            os.environ.update(_rank_env(rank, n))
+            os.environ['JAX_PLATFORMS'] = 'cpu'  # the parent owns the chip
             p = ctx.Process(target=_worker,
                             args=(rank, n, func, args, result_dir),
                             daemon=daemon)
@@ -162,9 +168,7 @@ def launch():
     procs = []
     for rank in range(ns.nproc_per_node):
         child = dict(os.environ)
-        child['PADDLE_TRAINER_ID'] = str(rank)
-        child['PADDLE_TRAINERS_NUM'] = str(ns.nproc_per_node)
-        child['PADDLE_CURRENT_ENDPOINT'] = f"127.0.0.1:{6170 + rank}"
+        child.update(_rank_env(rank, ns.nproc_per_node))
         child.setdefault('JAX_PLATFORMS', 'cpu')
         procs.append(subprocess.Popen(
             [sys.executable, ns.script] + ns.script_args, env=child))

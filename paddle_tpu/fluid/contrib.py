@@ -24,22 +24,28 @@ def memory_usage(program, batch_size):
     """Estimated activation+parameter memory of a Program in MB
     (memory_usage_calc.py:46): sum over block vars of element count x
     dtype width, with data vars' batch dim scaled to batch_size."""
-    # batch-dim propagation: static.data collapses dynamic dims to 1, and
-    # every downstream activation inherits that 1 on dim 0 — scale ANY var
-    # whose dim 0 equals a feed's collapsed batch dim (the reference
-    # rescales every var carrying a -1 dim)
-    batch_collapsed = set()
+    # batch-dim propagation: static.data collapses dynamic dims to 1; walk
+    # the op list flagging each var whose leading dim FLOWS from a
+    # dynamic-batch feed (matching on the literal size 1 alone would
+    # inflate unrelated [1, ...] constants by batch_size)
+    batchy = set()
     for var in program.global_block.vars.values():
         if getattr(var, 'is_data', False):
             dyn = set(getattr(var, '_dynamic_dims', ()))
             if 0 in dyn and var.shape:
-                batch_collapsed.add(int(var.shape[0]))
+                batchy.add(id(var))
+    for op in program.global_block.ops:
+        srcs = [v for v in op.inputs if id(v) in batchy]
+        if not srcs:
+            continue
+        for o in op.outputs:
+            if o.shape and srcs[0].shape and \
+                    int(o.shape[0]) == int(srcs[0].shape[0]):
+                batchy.add(id(o))
     total = 0.0
     for var in program.global_block.vars.values():
         shape = list(var.shape)
-        is_param = getattr(var, 'concrete', None) is not None and \
-            var.concrete.__class__.__name__ == 'Parameter'
-        if shape and not is_param and int(shape[0]) in batch_collapsed:
+        if shape and id(var) in batchy:
             shape[0] = batch_size
         n = float(np.prod(shape)) if shape else 1.0
         width = _DTYPE_BYTES.get(np.dtype(var.dtype).name, 4)
@@ -95,17 +101,32 @@ def extend_with_decoupled_weight_decay(base_optimizer_cls):
             self._coeff = weight_decay
             super().__init__(*args, **kwargs)
 
+        def functional_update(self, param_values, grad_values, opt_state,
+                              lr=None, params_meta=None):
+            # the decay rides the SHARED pure rule, so both the eager
+            # step() path and the static Executor's compiled train path
+            # (which never calls step()) apply it
+            new_p, new_s = super().functional_update(
+                param_values, grad_values, opt_state, lr=lr,
+                params_meta=params_meta)
+            if self._coeff:
+                rate = self.get_lr() if lr is None else lr
+                new_p = {k: (v - rate * self._coeff * v
+                             if k in grad_values else v)
+                         for k, v in new_p.items()}
+            return new_p, new_s
+
         def step(self):
             super().step()
             if not self._coeff:
                 return
-            lr = self.get_lr() if hasattr(self, 'get_lr') else 0.0
+            lr = self.get_lr()
             from ..core import autograd
-            params = getattr(self, '_parameter_list', None) or \
-                getattr(self, '_parameters', [])
+            params = getattr(self, '_parameters', [])
             with autograd.no_grad():
                 for p in params:
-                    if getattr(p, 'trainable', True):
+                    if getattr(p, 'trainable', True) and \
+                            p.grad is not None:
                         p._inplace_value(
                             p._value - lr * self._coeff * p._value)
 

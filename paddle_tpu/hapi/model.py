@@ -239,11 +239,43 @@ class Model:
 
     # -- persistence --------------------------------------------------------
     def save(self, path, training=True):
+        """training=True: .pdparams (+.pdopt). training=False: a runnable
+        inference export via jit.save, using the Model's declared inputs
+        as the InputSpec (reference hapi/model.py:993)."""
         self._sync_jit_state()
         from ..framework import save as fsave
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        if not training:
+            from .. import jit as _jit
+            spec = self._inputs
+            if spec is not None and not isinstance(spec, (list, tuple)):
+                spec = [spec]
+            if not spec:
+                raise ValueError(
+                    "Model.save(training=False) exports a runnable "
+                    "inference artifact and needs input specs: construct "
+                    "the Model with inputs=[InputSpec(...)] (raising now "
+                    "instead of writing a non-runnable artifact)")
+            was_training = self.network.training
+            self.network.eval()
+            try:
+                _jit.save(self.network, path, input_spec=spec)
+            finally:
+                if was_training:
+                    self.network.train()
+            # jit.save records export failures instead of raising; surface
+            # them NOW rather than at deployment load time
+            import pickle as _pickle
+            with open(path + '.pdmodel', 'rb') as f:
+                meta = _pickle.load(f)
+            if 'exported' not in meta:
+                raise RuntimeError(
+                    "Model.save(training=False): inference export failed "
+                    "(%s) — the artifact would not be runnable"
+                    % meta.get('export_error', 'unknown'))
+            return
         fsave(self.network.state_dict(), path + '.pdparams')
         if training and self._optimizer is not None:
             fsave(self._optimizer.state_dict(), path + '.pdopt')
@@ -255,6 +287,10 @@ class Model:
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(path + '.pdopt'):
             self._optimizer.set_state_dict(fload(path + '.pdopt'))
+
+    def test_batch(self, inputs):
+        """Reference alias of predict_batch (hapi/model.py:956)."""
+        return self.predict_batch(inputs)
 
     def parameters(self, *args, **kwargs):
         return self.network.parameters(*args, **kwargs)

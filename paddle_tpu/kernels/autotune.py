@@ -237,6 +237,11 @@ def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
         return None
     best_t, best = min(results, key=lambda r: r[0])
     best = dict(best, ms=round(best_t * 1e3, 3))
+    # record the untuned XLA time too, so benches can report the
+    # tuned-vs-untuned delta without re-measuring
+    xla_times = [t for t, d in results if d.get('mode') == 'xla']
+    if xla_times:
+        best['xla_ms'] = round(min(xla_times) * 1e3, 3)
     _CACHE[sig] = best
     _save_disk()
     return best

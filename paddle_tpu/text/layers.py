@@ -12,10 +12,12 @@ TransformerDecoderLayer:3170, TransformerDecoder:3314, LinearChainCRF:3506,
 CRFDecoding:3655, SequenceTagging:3832).
 
 TPU-first notes: recurrences lower through the nn cell machinery
-(lax.scan); CRF layers wrap the log-space scan + Viterbi functionals; the
-beam-search adapters reuse nn.decode's preallocated-buffer while_loop design
-(caches are fixed-shape, so `var_dim_in_state` is accepted for API parity
-but nothing needs to grow).
+(lax.scan); CRF layers wrap the log-space scan + Viterbi functionals. The
+transformer incremental caches here GROW by concat along the time dim
+(`var_dim_in_state`) — faithful to the reference API and fine in eager
+decode loops, but not traceable under jit (XLA needs static shapes); for
+compiled generation use the preallocated-KV-cache path (text.gpt
+GPT.generate / nn.decode), which is the production TPU design.
 """
 import numpy as np
 import jax.numpy as jnp
@@ -513,6 +515,17 @@ class MultiHeadAttention(Layer):
         self.dropout_rate = dropout_rate
 
     def _prepare_qkv(self, queries, keys, values, cache=None):
+        cross = keys is not None
+        if cache is not None and cross and 'static_k' in cache:
+            # precomputed cross-attention K/V (prepare_static_cache): skip
+            # the per-step K/V projection over the full encoder output
+            q = self.q_fc(queries)
+
+            def split_q(x):
+                B, T = x.shape[0], x.shape[1]
+                return transpose(x.reshape([B, T, self.n_head, self.d_key]),
+                                 [0, 2, 1, 3])
+            return split_q(q), cache['static_k'], cache['static_v']
         if keys is None:
             keys, values = queries, queries
         q = self.q_fc(queries)
@@ -665,7 +678,8 @@ class TransformerDecoderLayer(Layer):
         self_attn_output = self.postprocesser1(self_attn_output, dec_input)
         cross_attn_output = self.cross_attn(
             self.preprocesser2(self_attn_output), enc_output, enc_output,
-            cross_attn_bias)
+            cross_attn_bias,
+            cache if (cache and 'static_k' in cache) else None)
         cross_attn_output = self.postprocesser2(cross_attn_output,
                                                 self_attn_output)
         ffn_output = self.ffn(self.preprocesser3(cross_attn_output))
@@ -770,21 +784,34 @@ class TransformerBeamSearchDecoder(BeamSearchDecoder):
         return BeamSearchDecoder.tile_beam_merge_with_batch(x, beam_size)
 
     def step(self, time, inputs, states, **kwargs):
-        # transformer cells take 2-D [B*beam, 1] word ids + positions
-        if getattr(inputs, 'ndim', 2) == 1:
-            inputs = inputs.unsqueeze(-1)
-        pos = None
-        if 'trg_pos' not in kwargs:
-            from ..core.tensor import apply_op
-            pos = apply_op(
-                lambda v: jnp.full(v.shape, time, jnp.int32),
-                (inputs,), differentiable=False)
-        cell_states = states.cell_states
-        outputs, next_cell_states = self.cell(
-            (inputs, pos), cell_states, **kwargs)
-        beam_state = self._beam_search_step(time, outputs, states,
-                                            next_cell_states)
-        return beam_state
+        # same flow as BeamSearchDecoder.step, with the transformer shims:
+        # ids reshaped to [B*beam, 1] and a position input filled with
+        # `time` (a traced loop counter — threaded through apply_op)
+        from ..nn.decode import _map_structure
+        from ..core.tensor import apply_op
+        from ..tensor._helpers import _t
+
+        inputs = _map_structure(self._merge_batch_beams, inputs)
+        word = inputs.unsqueeze(-1) if inputs.ndim == 1 else inputs
+        pos = apply_op(
+            lambda w, tt: jnp.full(w.shape, tt.astype(jnp.int32),
+                                   jnp.int32),
+            (_t(word), _t(time)), differentiable=False)
+        cell_states = _map_structure(self._merge_batch_beams,
+                                     states['cell_states'])
+        cell_outputs, next_cell_states = self.cell((word, pos),
+                                                   cell_states, **kwargs)
+        cell_outputs = _map_structure(self._split_batch_beams,
+                                      cell_outputs)
+        next_cell_states = _map_structure(self._split_batch_beams,
+                                          next_cell_states)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        output, state = self._beam_search_step(
+            time, cell_outputs, next_cell_states, states)
+        finished = state['finished']
+        next_inputs = output['predicted_ids']
+        return output, state, next_inputs, finished
 
 
 # ---------------------------------------------------------------------------
@@ -847,17 +874,18 @@ class _GRUEncoder(Layer):
         self.num_layers = num_layers
         self.is_bidirection = is_bidirection
         self.gru_list = LayerList()
-        from ..nn.initializer import Uniform
-        attr = None
+        from ..nn.initializer import Uniform, ParamAttr
+        attr = ParamAttr(initializer=Uniform(-init_bound, init_bound))
         for i in range(num_layers):
             in_dim = input_dim if i == 0 else (
                 grnn_hidden_dim * 2 if is_bidirection else grnn_hidden_dim)
             if is_bidirection:
                 self.gru_list.append(BidirectionalGRU(
-                    in_dim, grnn_hidden_dim, num_layers=1))
+                    in_dim, grnn_hidden_dim, num_layers=1,
+                    param_attr=attr))
             else:
                 self.gru_list.append(GRU(in_dim, grnn_hidden_dim,
-                                         num_layers=1))
+                                         num_layers=1, param_attr=attr))
 
     def forward(self, input_feature, h0=None):
         out = input_feature

@@ -1,0 +1,78 @@
+"""2.0-beta top-level alias tail + hapi Model inference export."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class TestTopLevelAliases:
+    def test_reduce_family(self):
+        x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        assert float(paddle.reduce_sum(x).numpy()) == 10.0
+        assert float(paddle.reduce_prod(x).numpy()) == 24.0
+        assert float(paddle.reduce_max(x).numpy()) == 4.0
+
+    def test_inverse_and_addcmul(self):
+        m = paddle.to_tensor(np.array([[2.0, 0], [0, 4.0]], np.float32))
+        np.testing.assert_allclose(paddle.inverse(m).numpy(),
+                                   np.diag([0.5, 0.25]), rtol=1e-5)
+        a = paddle.to_tensor(np.ones(3, np.float32))
+        out = paddle.addcmul(a, a * 2, a * 3, value=0.5)
+        np.testing.assert_allclose(out.numpy(), 1 + 0.5 * 6, rtol=1e-6)
+
+    def test_shuffle_reverse(self):
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+        s = paddle.shuffle(x)
+        assert sorted(s.numpy().tolist()) == list(range(8))
+        r = paddle.reverse(x, axis=0)
+        np.testing.assert_allclose(r.numpy(), np.arange(8)[::-1])
+
+    def test_lr_decay_factories(self):
+        s = paddle.ExponentialDecay(0.1, decay_steps=10, decay_rate=0.5)
+        for _ in range(10):
+            s.step()
+        np.testing.assert_allclose(s.last_lr, 0.05, rtol=1e-6)
+        c = paddle.CosineDecay(1.0, step_each_epoch=1, epochs=10)
+        assert 0 < c.last_lr <= 1.0
+
+    def test_rng_state_roundtrip(self):
+        st = paddle.get_cuda_rng_state()
+        a = paddle.rand([4]).numpy()
+        paddle.set_cuda_rng_state(st)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_allclose(a, b)
+
+    def test_to_variable_and_manual_seed(self):
+        v = paddle.to_variable(np.ones(3, np.float32))
+        np.testing.assert_allclose(v.numpy(), 1.0)
+        paddle.manual_seed(123)
+
+
+class TestModelInferenceExport:
+    def test_save_training_false_is_runnable(self, tmp_path):
+        from paddle_tpu.static import InputSpec
+        import paddle_tpu.jit as jit
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 2))
+        m = paddle.Model(net, inputs=[InputSpec([None, 4], 'float32')])
+        m.prepare(optimizer=paddle.optimizer.Adam(
+            parameters=net.parameters()),
+            loss=paddle.nn.CrossEntropyLoss())
+        path = str(tmp_path / "infer")
+        m.save(path, training=False)
+        loaded = jit.load(path)
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        net.eval()
+        np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
+                                   net(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-5)
+
+    def test_test_batch_alias(self):
+        net = paddle.nn.Linear(4, 2)
+        m = paddle.Model(net)
+        m.prepare()
+        out = m.test_batch([np.zeros((2, 4), np.float32)])
+        assert np.asarray(out).shape[-1] == 2
