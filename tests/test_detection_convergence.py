@@ -1,0 +1,95 @@
+"""End-to-end convergence of the detection training losses: a tiny SSD
+head and a tiny YOLOv3 head both fit a fixed batch."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class TestSSDTrains:
+    def test_ssd_loss_decreases(self):
+        import paddle_tpu.fluid.layers as L
+        rs = np.random.RandomState(0)
+        B, P, C, G = 2, 12, 4, 2
+        prior = np.sort(rs.rand(P, 4).astype(np.float32), axis=1)
+        gt_box = np.tile(prior[None, :G] * 0.9 + 0.05, (B, 1, 1)) \
+            .astype(np.float32)
+        gt_label = rs.randint(1, C, (B, G)).astype(np.int64)
+
+        feat = paddle.to_tensor(rs.randn(B, 16).astype(np.float32))
+        loc_head = paddle.nn.Linear(16, P * 4)
+        conf_head = paddle.nn.Linear(16, P * C)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.01,
+            parameters=loc_head.parameters() + conf_head.parameters())
+        losses = []
+        for _ in range(25):
+            loc = loc_head(feat).reshape([B, P, 4])
+            conf = conf_head(feat).reshape([B, P, C])
+            loss = L.ssd_loss(loc, conf, paddle.to_tensor(gt_box),
+                              paddle.to_tensor(gt_label),
+                              paddle.to_tensor(prior)).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+class TestYolov3Trains:
+    def test_yolo_loss_decreases(self):
+        import paddle_tpu.fluid.layers as L
+        rs = np.random.RandomState(0)
+        B, H, W, K = 1, 4, 4, 3
+        anchors = [10, 13, 16, 30]
+        mask = [0, 1]
+        C = len(mask) * (5 + K)
+        gt_box = np.array([[[0.5, 0.5, 0.3, 0.3],
+                            [0.2, 0.8, 0.2, 0.15]]], np.float32)
+        gt_label = np.array([[1, 2]], np.int32)
+
+        feat = paddle.to_tensor(rs.randn(B, 8).astype(np.float32))
+        head = paddle.nn.Linear(8, C * H * W)
+        opt = paddle.optimizer.Adam(learning_rate=0.02,
+                                    parameters=head.parameters())
+        losses = []
+        for _ in range(30):
+            x = head(feat).reshape([B, C, H, W])
+            loss = L.yolov3_loss(x, paddle.to_tensor(gt_box),
+                                 paddle.to_tensor(gt_label), anchors, mask,
+                                 K, ignore_thresh=0.5,
+                                 downsample_ratio=8).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+class TestRetinanetFocalTrains:
+    def test_focal_loss_decreases(self):
+        import paddle_tpu.fluid.layers as L
+        rs = np.random.RandomState(0)
+        A, C = 16, 3
+        anchors = np.sort(rs.rand(A, 4) * 10, axis=1).astype(np.float32)
+        gt = anchors[:2].copy()
+        glab = np.array([[1], [2]], np.int32)
+        feat = paddle.to_tensor(rs.randn(A, 8).astype(np.float32))
+        head = paddle.nn.Linear(8, C)
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=head.parameters())
+        # fixed assignment (targets don't depend on the head)
+        _, _, st, _, _, fg_num = L.retinanet_target_assign(
+            paddle.to_tensor(np.zeros((A, 4), np.float32)),
+            paddle.to_tensor(np.zeros((A, C), np.float32)),
+            paddle.to_tensor(anchors),
+            paddle.to_tensor(np.ones((A, 4), np.float32)),
+            paddle.to_tensor(gt), paddle.to_tensor(glab))
+        losses = []
+        for _ in range(25):
+            logits = head(feat)
+            loss = L.sigmoid_focal_loss(logits, st, fg_num).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
