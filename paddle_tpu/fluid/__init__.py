@@ -116,3 +116,5 @@ core = __import__('types').SimpleNamespace(
     is_compiled_with_xpu=lambda: False,
     get_cuda_device_count=lambda: 0,
 )
+
+from . import incubate  # noqa: F401,E402

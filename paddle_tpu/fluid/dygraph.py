@@ -55,3 +55,357 @@ class Pool2D(Layer):
             return F.global_pool(input, 'avg' if ptype == 'avg' else 'max', fmt)
         fn = F.max_pool2d if ptype == "max" else F.avg_pool2d
         return fn(input, size, stride, pad, ceil_mode=ceil, data_format=fmt)
+
+
+# -- 1.8 dygraph namespace tail ---------------------------------------------
+# layer aliases (where the 1.8 signature matches the 2.x layer)
+from ..nn.layer.common import Flatten  # noqa: E402,F401
+from ..nn.layer.norm import GroupNorm  # noqa: E402,F401
+from ..nn.layer.conv import Conv3DTranspose  # noqa: E402,F401
+
+
+class LSTMCell(Layer):
+    """1.8 dygraph.LSTMCell: (hidden_size, input_size, ...) — note the
+    REVERSED argument order vs the 2.x cell."""
+
+    def __init__(self, hidden_size, input_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, use_cudnn_impl=True, dtype='float32'):
+        super().__init__()
+        import jax.numpy as jnp
+        from ..nn.layer.rnn import LSTMCell as _C
+        self._cell = _C(input_size, hidden_size,
+                        weight_ih_attr=param_attr, weight_hh_attr=param_attr,
+                        bias_ih_attr=bias_attr, bias_hh_attr=bias_attr)
+        if forget_bias and self._cell.bias_ih is not None:
+            b = self._cell.bias_ih._value
+            h = hidden_size
+            self._cell.bias_ih._inplace_value(
+                b.at[h:2 * h].add(jnp.asarray(forget_bias, b.dtype)))
+
+    def forward(self, input, pre_hidden, pre_cell):
+        out, (h, c) = self._cell(input, (pre_hidden, pre_cell))
+        return h, c
+
+
+class GRUCell(Layer):
+    """1.8 dygraph.GRUCell: (hidden_size, input_size, ...)."""
+
+    def __init__(self, hidden_size, input_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 use_cudnn_impl=True, dtype='float32'):
+        super().__init__()
+        from ..nn.layer.rnn import GRUCell as _C
+        self._cell = _C(input_size, hidden_size,
+                        weight_ih_attr=param_attr, weight_hh_attr=param_attr,
+                        bias_ih_attr=bias_attr, bias_hh_attr=bias_attr)
+
+    def forward(self, input, pre_hidden):
+        out, h = self._cell(input, pre_hidden)
+        return h
+
+
+class PRelu(Layer):
+    """1.8 dygraph.PRelu: (mode, channel=None, input_shape=None,
+    param_attr=None) — mode is 'all' | 'channel' | 'element'."""
+
+    def __init__(self, mode, channel=None, input_shape=None,
+                 param_attr=None, dtype='float32'):
+        super().__init__()
+        from .layers_tail import _op_param
+        from ..nn.initializer import Constant
+        if mode == 'all':
+            shape = [1]
+        elif mode == 'channel':
+            if channel is None:
+                raise ValueError("PRelu(mode='channel') needs channel=")
+            shape = [int(channel)]
+        elif mode == 'element':
+            if input_shape is None:
+                raise ValueError("PRelu(mode='element') needs input_shape=")
+            shape = [int(d) for d in input_shape]
+        else:
+            raise ValueError(f"PRelu mode {mode!r}")
+        self._mode = mode
+        self.weight = _op_param(shape, param_attr, Constant(0.25),
+                                'prelu_alpha', dtype=dtype)
+
+    def forward(self, input):
+        import jax.numpy as jnp
+        from ..core.tensor import apply_op
+        from ..tensor._helpers import _t
+        mode = self._mode
+
+        def fn(v, av):
+            if mode == 'channel' and v.ndim > 2:
+                av = av.reshape((1, -1) + (1,) * (v.ndim - 2))
+            return jnp.where(v > 0, v, av * v)
+
+        return apply_op(fn, (_t(input), self.weight))
+
+
+class InstanceNorm(Layer):
+    """1.8 dygraph.InstanceNorm: (num_channels, epsilon, param_attr,
+    bias_attr, dtype)."""
+
+    def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype='float32'):
+        super().__init__()
+        from ..nn.layer.norm import (InstanceNorm1D, InstanceNorm2D,
+                                     InstanceNorm3D)
+        self._builders = {3: InstanceNorm1D, 4: InstanceNorm2D,
+                          5: InstanceNorm3D}
+        self._kw = dict(epsilon=epsilon, weight_attr=param_attr,
+                        bias_attr=bias_attr)
+        self._ch = num_channels
+        # holder list: a plain None attribute in __dict__ would shadow the
+        # sublayer registration Layer.__setattr__ performs on assignment
+        self._impl_holder = [None]
+
+    def forward(self, input):
+        if self._impl_holder[0] is None:
+            cls = self._builders[input.ndim]
+            impl = cls(self._ch, **self._kw)
+            self.add_sublayer('impl', impl)
+            self._impl_holder[0] = impl
+        return self._impl_holder[0](input)
+
+# decay classes: the fluid.dygraph learning-rate schedulers are the
+# top-level factory forms (same curves, step()-driven)
+from ..optimizer.lr import (NoamDecay, PiecewiseDecay,  # noqa: E402,F401
+                            MultiStepDecay, StepDecay, LambdaDecay,
+                            ReduceOnPlateau as ReduceLROnPlateau,
+                            LinearWarmup as LinearLrWarmup)
+
+
+def __getattr__(name):
+    if name in ('CosineDecay', 'ExponentialDecay', 'InverseTimeDecay',
+                'NaturalExpDecay', 'PolynomialDecay', 'SaveLoadConfig'):
+        import paddle_tpu
+        return getattr(paddle_tpu, name)
+    raise AttributeError(f"module 'fluid.dygraph' has no attribute {name!r}")
+
+
+class BilinearTensorProduct(Layer):
+    """out_k = x1^T W_k x2 + b (fluid/dygraph/nn.py BilinearTensorProduct)."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None, dtype='float32'):
+        super().__init__()
+        from ..nn.layer.common import Bilinear
+        self._b = Bilinear(input1_dim, input2_dim, output_dim,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x1, x2):
+        out = self._b(x1, x2)
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class NCE(Layer):
+    """Layer form of the nce loss (fluid/dygraph/nn.py NCE): persistent
+    weight/bias injected into the functional fluid.layers.nce (single
+    source of the sampler + loss math)."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=None,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype='float32'):
+        super().__init__()
+        self._kw = dict(num_total_classes=num_total_classes,
+                        num_neg_samples=num_neg_samples, sampler=sampler,
+                        custom_dist=custom_dist, seed=seed,
+                        is_sparse=is_sparse)
+        from .layers_tail import _op_param
+        from ..nn.initializer import XavierUniform, Constant
+        self.weight = _op_param([num_total_classes, dim], param_attr,
+                                XavierUniform(), 'nce_weight')
+        self.bias = _op_param([num_total_classes], bias_attr, Constant(0.0),
+                              'nce_bias')
+
+    def forward(self, input, label, sample_weight=None):
+        from .layers_tail import nce as _nce
+        return _nce(input, label, sample_weight=sample_weight,
+                    weight=self.weight, bias=self.bias, **self._kw)
+
+
+class GRUUnit(Layer):
+    """Layer form of gru_unit (fluid/dygraph/nn.py GRUUnit)."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation='tanh', gate_activation='sigmoid',
+                 origin_mode=False, dtype='float32'):
+        super().__init__()
+        self._size = size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._activation = activation
+        self._gate_activation = gate_activation
+        self._origin_mode = origin_mode
+
+    def forward(self, input, hidden):
+        from .layers import gru_unit
+        return gru_unit(input, hidden, self._size, self._param_attr,
+                        self._bias_attr, self._activation,
+                        self._gate_activation, self._origin_mode)
+
+
+class TreeConv(Layer):
+    """Tree-based convolution (fluid/dygraph/nn.py TreeConv): continuous
+    binary-tree conv over node features with adjacency-derived positional
+    weights (dense formulation: nodes (B, N, D), edges (B, E, 2))."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act='tanh', param_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        from .layers_tail import _op_param
+        from ..nn.initializer import XavierUniform, Constant
+        self._max_depth = max_depth
+        self._act = act
+        self.W = _op_param([feature_size, 3, output_size * num_filters],
+                           param_attr, XavierUniform(), 'treeconv_w')
+        if bias_attr is not False:
+            self.bias = _op_param([num_filters], bias_attr, Constant(0.0),
+                                  'treeconv_b')
+        else:
+            self.bias = None
+        self._output_size = output_size
+        self._num_filters = num_filters
+
+    def forward(self, nodes_vector, edge_set):
+        import jax.numpy as jnp
+        from ..core.tensor import apply_op
+        from ..tensor._helpers import _t
+        W = self.W
+        out_sz, nf = self._output_size, self._num_filters
+        depth = self._max_depth
+
+        def fn(x, edges, wv, *mb):
+            B, N, D = x.shape
+            # adjacency (parent <- child) per batch
+            par = edges[..., 0].astype(jnp.int32)
+            chi = edges[..., 1].astype(jnp.int32)
+            adj = jnp.zeros((B, N, N), x.dtype)
+            bidx = jnp.arange(B)[:, None]
+            adj = adj.at[bidx, par, chi].set(1.0)
+            # mixing by eta weights (top/left/right approximated by
+            # self / children-mean / parent-mean propagation per depth)
+            deg = jnp.maximum(adj.sum(-1, keepdims=True), 1.0)
+            child_mean = adj / deg
+            parent_mean = jnp.swapaxes(child_mean, 1, 2)
+            h = x
+            feats = []
+            for _ in range(depth):
+                t_self = h @ wv[:, 0]
+                t_chi = (child_mean @ h) @ wv[:, 1]
+                t_par = (parent_mean @ h) @ wv[:, 2]
+                h_new = t_self + t_chi + t_par      # (B, N, out*nf)
+                feats.append(h_new)
+                h = h_new[..., :D] if h_new.shape[-1] >= D else \
+                    jnp.pad(h_new, ((0, 0), (0, 0),
+                                    (0, D - h_new.shape[-1])))
+            out = jnp.stack(feats, axis=-1).max(-1)
+            out = out.reshape(B, N, out_sz, nf)
+            if mb:
+                out = out + mb[0][None, None, None, :]
+            return out
+
+        tensors = [_t(nodes_vector), _t(edge_set), W]
+        if self.bias is not None:
+            tensors.append(self.bias)
+        out = apply_op(fn, tuple(tensors))
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class TracedLayer:
+    """jit-traced layer wrapper (fluid/dygraph/jit.py TracedLayer):
+    trace(layer, inputs) -> (outputs, traced) where traced(x...) replays
+    the compiled program and save_inference_model exports it."""
+
+    def __init__(self, layer, inputs):
+        import jax
+        from ..core.tensor import Tensor
+        self._layer = layer
+
+        def fwd(*vals):
+            with no_grad():
+                out = layer(*[Tensor(v) for v in vals])
+            if isinstance(out, (list, tuple)):
+                return tuple(o._value if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._value if isinstance(out, Tensor) else out
+
+        self._jitted = jax.jit(fwd)
+        self._example = [i._value if isinstance(i, Tensor) else i
+                         for i in inputs]
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        traced = cls(layer, inputs)
+        outs = traced(*inputs)
+        return outs, traced
+
+    def __call__(self, *inputs):
+        from ..core.tensor import Tensor
+        vals = [i._value if isinstance(i, Tensor) else i for i in inputs]
+        out = self._jitted(*vals)
+        if isinstance(out, tuple):
+            return [Tensor(o) for o in out]
+        return Tensor(out)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from ..jit import save as _jsave, InputSpec
+        specs = [InputSpec(list(v.shape),
+                           str(v.dtype)) for v in self._example]
+        _jsave(self._layer, path, input_spec=specs)
+        return path
+
+
+def enable_dygraph(place=None):
+    from ..framework import disable_static
+    disable_static()
+
+
+def disable_dygraph():
+    from ..framework import enable_static
+    enable_static()
+
+
+def no_grad_(fn=None):
+    return no_grad(fn) if fn is not None else no_grad()
+
+
+save = save_dygraph
+load = load_dygraph
+dygraph_to_static_func = declarative
+
+
+def prepare_context(strategy=None):
+    from ..distributed.env import init_parallel_env
+    return init_parallel_env()
+
+
+def set_code_level(level=100):
+    """ProgramTranslator debug verbosity — tracing is jax-side here; kept
+    as a no-op knob for script compatibility."""
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """See set_code_level."""
+
+
+def start_gperf_profiler():
+    from ..utils.profiler import start_profiler
+    start_profiler()
+
+
+def stop_gperf_profiler():
+    from ..utils.profiler import stop_profiler
+    stop_profiler()

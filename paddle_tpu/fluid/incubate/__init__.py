@@ -1,0 +1,16 @@
+"""fluid.incubate: the 1.8 import path for incubating features.
+
+Parity: python/paddle/fluid/incubate/ (data_generator, checkpoint, fleet)
+— bridges to the paddle_tpu.incubate implementations. The sys.modules
+aliases make the canonical `import paddle.fluid.incubate.data_generator`
+form work (a re-export alone only covers attribute access).
+"""
+import sys
+
+from ...incubate import data_generator  # noqa: F401
+from ...incubate import checkpoint  # noqa: F401
+from ...distributed import fleet  # noqa: F401
+
+sys.modules[__name__ + '.data_generator'] = data_generator
+sys.modules[__name__ + '.checkpoint'] = checkpoint
+sys.modules[__name__ + '.fleet'] = fleet

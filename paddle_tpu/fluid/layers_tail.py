@@ -919,21 +919,36 @@ def center_loss(input, label, num_classes, alpha, param_attr,
     return out
 
 
+_NCE_CALLS = [0]
+
+
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
-        custom_dist=None, seed=0, is_sparse=False):
+        custom_dist=None, seed=0, is_sparse=False, weight=None, bias=None):
     """Noise-contrastive estimation loss, (N,1) (loss.py:671): binary
     logistic regression of the true class against num_neg_samples sampled
-    noise classes. Samplers: uniform / log_uniform / custom_dist."""
+    noise classes. Samplers: uniform / log_uniform / custom_dist.
+    ``weight``/``bias`` inject existing parameters (the dygraph NCE layer
+    path); otherwise fresh ones are created from param/bias_attr.
+    ``sample_weight`` (N, 1) scales each sample's loss."""
     from ..nn.initializer import XavierUniform, Constant
     from ..core.rng import next_key
     D = input.shape[1]
     num_neg = int(num_neg_samples or 10)
-    weight = _op_param([num_total_classes, D], param_attr, XavierUniform(),
-                       'nce_weight')
-    bias = _op_param([num_total_classes], bias_attr, Constant(0.0),
-                     'nce_bias')
-    key = jax.random.PRNGKey(int(seed)) if seed else next_key()
+    if weight is None:
+        weight = _op_param([num_total_classes, D], param_attr,
+                           XavierUniform(), 'nce_weight')
+    if bias is None:
+        bias = _op_param([num_total_classes], bias_attr, Constant(0.0),
+                         'nce_bias')
+    # a fixed seed still resamples fresh negatives per call (fold_in with a
+    # call counter); seed=0 uses the global generator
+    if seed:
+        _NCE_CALLS[0] += 1
+        key = jax.random.fold_in(jax.random.PRNGKey(int(seed)),
+                                 _NCE_CALLS[0])
+    else:
+        key = next_key()
 
     if sampler == "custom_dist":
         probs = jnp.asarray(np.asarray(custom_dist, np.float32))
@@ -949,7 +964,7 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         logq = jnp.full((num_total_classes,),
                         -math.log(num_total_classes), jnp.float32)
 
-    def fn(xv, lv, wv, bv):
+    def fn(xv, lv, wv, bv, *rest):
         B = xv.shape[0]
         if probs is None:
             negs = jax.random.randint(key, (B, num_neg), 0,
@@ -967,9 +982,15 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         sp = jnp.maximum(logits, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(logits)))
         pos_loss = sp[:, 0] - logits[:, 0]                # -log sigmoid(s+)
         neg_loss = sp[:, 1:].sum(axis=1)                  # -log sigmoid(-s-)
-        return (pos_loss + neg_loss)[:, None]
+        out = (pos_loss + neg_loss)[:, None]
+        if rest:
+            out = out * rest[0].reshape(-1, 1).astype(out.dtype)
+        return out
 
-    return apply_op(fn, (_t(input), _t(label), weight, bias))
+    tensors = [_t(input), _t(label), _t(weight), _t(bias)]
+    if sample_weight is not None:
+        tensors.append(_t(sample_weight))
+    return apply_op(fn, tuple(tensors))
 
 
 def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,

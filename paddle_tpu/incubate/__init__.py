@@ -3,5 +3,6 @@ from . import checkpoint
 from ..distributed import fleet
 
 from . import complex
+from . import data_generator
 from . import custom_op
 from .custom_op import register_op

@@ -1,0 +1,127 @@
+"""Parameter-server training data generators.
+
+Parity: /root/reference/python/paddle/fluid/incubate/data_generator/
+(DataGenerator:28, MultiSlotStringDataGenerator:241,
+MultiSlotDataGenerator:282). Emits the MultiSlotDataFeed text format
+(`ids_num id1 id2 ...` per slot) — the interchange the reference's C++
+DataFeed consumes; here the same lines feed the dense Dataset loaders.
+"""
+import sys
+
+__all__ = ['DataGenerator', 'MultiSlotDataGenerator',
+           'MultiSlotStringDataGenerator']
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 32
+        self._proto_info = None
+        self._line_limit = None
+
+    def _set_line_limit(self, line_limit):
+        self._line_limit = int(line_limit)
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # -- user hooks ---------------------------------------------------------
+    def generate_sample(self, line):
+        """Return a no-arg iterator over [(slot_name, values), ...]."""
+        raise NotImplementedError(
+            "generate_sample() must be implemented by the subclass")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+        return local_iter
+
+    # -- drivers ------------------------------------------------------------
+    def run_from_memory(self):
+        """Process in-memory samples (generate_sample(None)) to stdout."""
+        batch_samples = []
+        line_iter = self.generate_sample(None)
+        for user_parsed_line in line_iter():
+            if user_parsed_line is None:
+                continue
+            batch_samples.append(user_parsed_line)
+            if len(batch_samples) == self.batch_size_:
+                self._flush(batch_samples)
+                batch_samples = []
+        if batch_samples:
+            self._flush(batch_samples)
+
+    def run_from_stdin(self):
+        """Process stdin lines through generate_sample to stdout."""
+        batch_samples = []
+        for n, line in enumerate(sys.stdin, 1):
+            if self._line_limit and n > self._line_limit:
+                break
+            line_iter = self.generate_sample(line)
+            for user_parsed_line in line_iter():
+                if user_parsed_line is None:
+                    continue
+                batch_samples.append(user_parsed_line)
+                if len(batch_samples) == self.batch_size_:
+                    self._flush(batch_samples)
+                    batch_samples = []
+        if batch_samples:
+            self._flush(batch_samples)
+
+    def _flush(self, batch_samples):
+        batch_iter = self.generate_batch(batch_samples)
+        for sample in batch_iter():
+            sys.stdout.write(self._gen_str(sample))
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [str, ...]), ...] -> 'n v1 .. vn m w1 .. wm\\n'."""
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type, "
+                "e.g. [('words', ['1926', '08', '17']), ('label', ['1'])]")
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    def _gen_str(self, line):
+        """[(name, [int|float, ...]), ...] with proto_info tracking."""
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process() must be in list or tuple type, "
+                "e.g. [('words', [1926, 8, 17]), ('label', [1])]")
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                if not isinstance(name, str):
+                    raise ValueError(f"name {name!r} must be a str")
+                if not isinstance(elements, list) or not elements:
+                    raise ValueError(
+                        f"slot {name!r}: elements must be a non-empty list")
+                dtype = "float" if any(isinstance(e, float)
+                                       for e in elements) else "uint64"
+                self._proto_info.append((name, dtype))
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"field count changed: {len(line)} vs "
+                    f"{len(self._proto_info)}")
+            # promote a slot to float once a float shows up (the
+            # reference's proto updating rule)
+            for i, (name, elements) in enumerate(line):
+                if self._proto_info[i][1] == "uint64" and any(
+                        isinstance(e, float) for e in elements):
+                    self._proto_info[i] = (name, "float")
+        parts = []
+        for name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"

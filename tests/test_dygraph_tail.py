@@ -1,0 +1,167 @@
+"""fluid.dygraph namespace tail (NCE/GRUUnit/BilinearTensorProduct/
+TreeConv/TracedLayer/decay aliases) + incubate.data_generator."""
+import io
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid.dygraph as D
+
+
+class TestDygraphLayers:
+    def test_nce_layer_trains(self):
+        rs = np.random.RandomState(0)
+        nce = D.NCE(num_total_classes=50, dim=16, num_neg_samples=5,
+                    seed=3)
+        emb = paddle.nn.Embedding(100, 16)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.05,
+            parameters=emb.parameters() + nce.parameters())
+        ids = paddle.to_tensor(rs.randint(0, 100, (16,)).astype(np.int32))
+        ctx = paddle.to_tensor(rs.randint(0, 50, (16, 1))
+                               .astype(np.int32))
+        losses = []
+        for _ in range(15):
+            loss = nce(emb(ids), ctx).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.6
+
+    def test_18_cell_signatures_hidden_first(self):
+        """1.8 dygraph cells take (hidden_size, input_size)."""
+        rs = np.random.RandomState(0)
+        cell = D.LSTMCell(128, 64)
+        h, c = cell(paddle.to_tensor(rs.randn(2, 64).astype(np.float32)),
+                    paddle.to_tensor(np.zeros((2, 128), np.float32)),
+                    paddle.to_tensor(np.zeros((2, 128), np.float32)))
+        assert list(h.shape) == [2, 128]
+        g = D.GRUCell(32, 16)
+        hn = g(paddle.to_tensor(rs.randn(2, 16).astype(np.float32)),
+               paddle.to_tensor(np.zeros((2, 32), np.float32)))
+        assert list(hn.shape) == [2, 32]
+
+    def test_prelu_mode_string(self):
+        rs = np.random.RandomState(0)
+        p = D.PRelu('channel', channel=4)
+        out = p(paddle.to_tensor(rs.randn(2, 4, 5, 5).astype(np.float32)))
+        assert list(out.shape) == [2, 4, 5, 5]
+        pa = D.PRelu('all')
+        np.testing.assert_allclose(
+            pa(paddle.to_tensor(np.array([-2.0, 3.0], np.float32)))
+            .numpy(), [-0.5, 3.0], rtol=1e-6)
+
+    def test_instance_norm_18_positional(self):
+        rs = np.random.RandomState(0)
+        inorm = D.InstanceNorm(4, 1e-5, None, None)
+        out = inorm(paddle.to_tensor(rs.randn(2, 4, 6, 6)
+                                     .astype(np.float32)))
+        np.testing.assert_allclose(out.numpy().mean(axis=(2, 3)), 0.0,
+                                   atol=1e-4)
+
+    def test_nce_resamples_and_weights(self):
+        rs = np.random.RandomState(0)
+        nce = D.NCE(num_total_classes=50, dim=8, num_neg_samples=5, seed=3)
+        x = paddle.to_tensor(rs.randn(4, 8).astype(np.float32))
+        lab = paddle.to_tensor(rs.randint(0, 50, (4, 1)).astype(np.int32))
+        l1, l2 = nce(x, lab).numpy(), nce(x, lab).numpy()
+        assert not np.allclose(l1, l2)      # fresh negatives per call
+        sw = np.array([[2.0], [0.0], [1.0], [1.0]], np.float32)
+        assert nce(x, lab,
+                   sample_weight=paddle.to_tensor(sw)).numpy()[1, 0] == 0.0
+
+    def test_fluid_incubate_import_path(self):
+        import paddle_tpu.fluid.incubate.data_generator as dg
+        assert hasattr(dg, 'MultiSlotDataGenerator')
+
+    def test_gru_unit_and_bilinear(self):
+        rs = np.random.RandomState(0)
+        g = D.GRUUnit(size=12)
+        hn, rh, gate = g(paddle.to_tensor(rs.randn(3, 12)
+                                          .astype(np.float32)),
+                         paddle.to_tensor(rs.randn(3, 4)
+                                          .astype(np.float32)))
+        assert list(hn.shape) == [3, 4]
+        b = D.BilinearTensorProduct(4, 5, 6)
+        out = b(paddle.to_tensor(rs.randn(2, 4).astype(np.float32)),
+                paddle.to_tensor(rs.randn(2, 5).astype(np.float32)))
+        assert list(out.shape) == [2, 6]
+
+    def test_tree_conv(self):
+        rs = np.random.RandomState(0)
+        tc = D.TreeConv(feature_size=8, output_size=4, num_filters=2)
+        nodes = paddle.to_tensor(rs.randn(1, 5, 8).astype(np.float32))
+        edges = paddle.to_tensor(
+            np.array([[[0, 1], [0, 2], [1, 3], [1, 4]]], np.int32))
+        out = tc(nodes, edges)
+        assert list(out.shape) == [1, 5, 4, 2]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_traced_layer_roundtrip(self, tmp_path):
+        rs = np.random.RandomState(0)
+        net = paddle.nn.Linear(4, 2)
+        x = paddle.to_tensor(rs.randn(2, 4).astype(np.float32))
+        outs, traced = D.TracedLayer.trace(net, [x])
+        y = traced(x)
+        np.testing.assert_allclose(np.asarray(y.numpy()),
+                                   net(x).numpy(), rtol=1e-6)
+        traced.save_inference_model(str(tmp_path / "traced"))
+        import paddle_tpu.jit as jit
+        loaded = jit.load(str(tmp_path / "traced"))
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5)
+
+    def test_decay_aliases_resolve(self):
+        s = D.ExponentialDecay(0.1, decay_steps=10, decay_rate=0.5)
+        for _ in range(10):
+            s.step()
+        np.testing.assert_allclose(s.last_lr, 0.05, rtol=1e-6)
+        assert D.NoamDecay is not None and D.ReduceLROnPlateau is not None
+
+    def test_mode_toggles(self):
+        D.disable_dygraph()
+        try:
+            from paddle_tpu.framework import in_static_mode
+            assert in_static_mode()
+        finally:
+            D.enable_dygraph()
+
+
+class TestDataGenerator:
+    def test_multislot_format(self):
+        from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+
+        class MyData(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def local_iter():
+                    yield [("words", [1926, 8, 17]), ("label", [1])]
+                return local_iter
+
+        md = MyData()
+        md.set_batch(2)
+        buf = io.StringIO()
+        old = sys.stdout
+        sys.stdout = buf
+        try:
+            md.run_from_memory()
+        finally:
+            sys.stdout = old
+        assert buf.getvalue() == "3 1926 8 17 1 1\n"
+        assert md._proto_info == [("words", "uint64"), ("label", "uint64")]
+
+    def test_string_generator(self):
+        from paddle_tpu.incubate.data_generator import \
+            MultiSlotStringDataGenerator
+        g = MultiSlotStringDataGenerator()
+        out = g._gen_str([("words", ["19", "26"]), ("label", ["1"])])
+        assert out == "2 19 26 1 1\n"
+
+    def test_field_count_mismatch_raises(self):
+        from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+        g = MultiSlotDataGenerator()
+        g._gen_str([("a", [1])])
+        with pytest.raises(ValueError, match="field count"):
+            g._gen_str([("a", [1]), ("b", [2])])
