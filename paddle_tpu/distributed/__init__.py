@@ -16,3 +16,74 @@ from .recompute import recompute
 from . import ps
 from .ps import SparseShardedTable
 from .launch import spawn, launch
+
+# -- 2.0-beta distributed top-level surface ----------------------------------
+from .fleet import Fleet, DistributedStrategy  # noqa: F401,E402
+from .fs import (FS, LocalFS, HDFSClient, ExecuteError,  # noqa: F401,E402
+                 FSFileExistsError, FSFileNotExistsError, FSTimeOut,
+                 FSShellCmdAborted)
+from .metrics import (acc, auc, mae, mse, rmse,  # noqa: F401,E402
+                      sum, max, min)
+from .role_maker import (PaddleCloudRoleMaker,  # noqa: F401,E402
+                         UserDefinedRoleMaker)
+from .fleet import _FleetUtils as UtilBase  # noqa: F401,E402
+
+
+class _FleetDataset:
+    """1.8 fleet dataset instance: the config-method surface
+    (set_use_var/set_batch_size/set_filelist/...) over the file-backed
+    reading the dense loaders do."""
+
+    def __init__(self, dataset_type):
+        self.dataset_type = dataset_type
+        self.filelist = []
+        self.batch_size = 1
+        self.thread_num = 1
+        self.use_vars = []
+        self.pipe_command = None
+        self._records = []
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_pipe_command(self, cmd):
+        self.pipe_command = cmd
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        self._hdfs = (fs_name, fs_ugi)
+
+    def load_into_memory(self):
+        self._records = []
+        for path in self.filelist:
+            with open(path) as f:
+                self._records.extend(f.readlines())
+
+    def local_shuffle(self):
+        import random
+        random.shuffle(self._records)
+
+    def release_memory(self):
+        self._records = []
+
+    def get_memory_data_size(self):
+        return len(self._records)
+
+    def __iter__(self):
+        return iter(self._records)
+
+
+class DatasetFactory:
+    """1.8 fleet DatasetFactory: creates the named dataset flavor — the
+    dense rebuild serves every flavor with one file-backed instance."""
+
+    def create_dataset(self, dataset_type="QueueDataset"):
+        return _FleetDataset(dataset_type)

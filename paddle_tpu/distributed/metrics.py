@@ -1,0 +1,91 @@
+"""Distributed metric reductions.
+
+Parity: python/paddle/distributed/fleet/metrics/metric.py — global metric
+aggregation across workers (the reference all_reduces numpy scalars over
+the fleet). Here each helper all-reduces over the mesh when a parallel env
+is initialized, else reduces locally.
+"""
+import builtins
+
+import numpy as np
+
+__all__ = ['acc', 'auc', 'mae', 'mse', 'rmse', 'sum', 'max', 'min']
+
+
+def _np(x):
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+def _allreduce(value, op='sum'):
+    """Aggregate across fleet WORKER PROCESSES (the reference contract) —
+    NOT across mesh devices: in single-process SPMD every local device
+    already sees the same full metric value, so reducing over the mesh
+    would overcount by the device count."""
+    import os
+    n_workers = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
+    if n_workers > 1:
+        from . import env as _env
+        from .collective import all_reduce
+        from ..core.tensor import to_tensor
+        if _env.is_initialized():
+            return np.asarray(
+                all_reduce(to_tensor(np.asarray(value, np.float64)
+                                     .astype(np.float32)), op=op).numpy())
+    return np.asarray(value)
+
+
+def sum(input, scope=None, util=None):
+    return float(_allreduce(_np(input).sum()))
+
+
+def max(input, scope=None, util=None):
+    return float(_allreduce(_np(input).max(), op='max'))
+
+
+def min(input, scope=None, util=None):
+    return float(_allreduce(_np(input).min(), op='min'))
+
+
+def acc(correct, total, scope=None, util=None):
+    c = _allreduce(_np(correct).sum())
+    t = _allreduce(_np(total).sum())
+    return float(c) / builtins.max(float(t), 1.0)
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    e = _allreduce(_np(abserr).sum())
+    n = _allreduce(_np(total_ins_num).sum())
+    return float(e) / builtins.max(float(n), 1.0)
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    e = _allreduce(_np(sqrerr).sum())
+    n = _allreduce(_np(total_ins_num).sum())
+    return float(e) / builtins.max(float(n), 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from positive/negative prediction histograms (the
+    reference's distributed AUC: all-reduce the bucketed stats, then
+    integrate)."""
+    pos = _allreduce(_np(stat_pos).astype(np.float64))
+    neg = _allreduce(_np(stat_neg).astype(np.float64))
+    # walk buckets from high score to low, accumulating the ROC integral
+    tot_pos = new_pos = 0.0
+    tot_neg = new_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))

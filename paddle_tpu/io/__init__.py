@@ -19,3 +19,46 @@ __all__ = ['Dataset', 'IterableDataset', 'TensorDataset', 'ComposeDataset',
            'map_readers', 'shuffle', 'chain', 'buffered', 'compose',
            'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
            'ComposeNotAligned']
+
+# 2.0-beta top-level re-exports (reference io/__init__.py)
+from ..batch import batch  # noqa: F401,E402
+from ..framework import save, load  # noqa: F401,E402
+from ..static.io import (save_inference_model,  # noqa: F401,E402
+                         load_inference_model)
+
+
+def get_worker_info():
+    """DataLoader worker context. Returns None outside a worker (the
+    reference contract); inside our process workers, the rank env set by
+    the pool is surfaced as a lightweight info object."""
+    import os
+
+    class _WorkerInfo:
+        def __init__(self, wid, num):
+            self.id = wid
+            self.num_workers = num
+
+    wid = os.environ.get('PADDLE_DATALOADER_WORKER_ID')
+    if wid is None:
+        return None
+    return _WorkerInfo(int(wid),
+                       int(os.environ.get('PADDLE_DATALOADER_NUM_WORKERS',
+                                          '1')))
+
+
+def load_program_state(model_path, var_list=None):
+    """Load a saved static program state dict (io.py parity)."""
+    import numpy as _np
+    from ..framework import load as _load
+    state = _load(model_path if model_path.endswith('.pdparams')
+                  else model_path + '.pdparams')
+    return {k: _np.asarray(v) for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    """Bind a loaded state dict onto a Program's parameters."""
+    import jax.numpy as _jnp
+    for v in program.list_vars():
+        if v.name in state_dict and v.concrete is not None:
+            v.concrete._inplace_value(
+                _jnp.asarray(state_dict[v.name]).astype(v.concrete.dtype))

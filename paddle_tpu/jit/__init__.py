@@ -472,3 +472,43 @@ class InputSpec:
     @classmethod
     def from_tensor(cls, tensor, name=None):
         return cls(tensor.shape, tensor.dtype, name)
+
+
+# -- 2.0-beta jit namespace tail ---------------------------------------------
+from ..fluid.dygraph import TracedLayer  # noqa: F401,E402
+from ..fluid.dygraph import set_code_level, set_verbosity  # noqa: F401,E402
+
+
+class ProgramTranslator:
+    """Dygraph->static translator controller (jit ProgramTranslator).
+    Tracing is jax-side here; the enable flag gates to_static's jit."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def __init__(self):
+        self.enable_to_static = True
+
+    def enable(self, enable_to_static=True):
+        self.enable_to_static = bool(enable_to_static)
+
+    def get_output(self, dygraph_func, *args, **kwargs):
+        return to_static(dygraph_func)(*args, **kwargs)
+
+    def get_func(self, dygraph_func):
+        return to_static(dygraph_func)
+
+    def get_program(self, dygraph_func, *args, **kwargs):
+        raise RuntimeError(
+            "ProgramTranslator.get_program: the TPU rebuild lowers traced "
+            "functions straight to XLA (no ProgramDesc); use "
+            "get_func/get_output, or static.Program capture for a Program")
+
+    def get_code(self, dygraph_func):
+        import inspect
+        return inspect.getsource(dygraph_func)

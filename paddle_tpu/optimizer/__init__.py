@@ -5,3 +5,48 @@ from . import lr
 from .lr import *  # noqa
 from .extras import ExponentialMovingAverage, LookAhead, ModelAverage
 from .fused import FlatFusedUpdate
+
+# -- 1.8 *Optimizer aliases + 2.0-beta *LR scheduler names -------------------
+MomentumOptimizer = Momentum
+AdagradOptimizer = Adagrad
+AdadeltaOptimizer = Adadelta
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl
+LambOptimizer = Lamb
+LarsMomentumOptimizer = LarsMomentum
+SGDOptimizer = SGD
+DecayedAdagrad = Adagrad          # decay handled by lr schedulers here
+DecayedAdagradOptimizer = Adagrad
+DGCMomentumOptimizer = Momentum   # dgc = bf16-compressed allreduce knob
+Dpsgd = SGD                       # differential-privacy noise not ported
+DpsgdOptimizer = SGD
+LookaheadOptimizer = LookAhead
+ModelAverageOptimizer = ModelAverage
+
+from .lr import (NoamDecay as NoamLR,  # noqa: F401,E402
+                 PiecewiseDecay as PiecewiseLR,
+                 NaturalExpDecay as NaturalExpLR,
+                 InverseTimeDecay as InverseTimeLR,
+                 PolynomialDecay as PolynomialLR,
+                 LinearWarmup as LinearLrWarmup,
+                 ExponentialDecay as ExponentialLR,
+                 MultiStepDecay as MultiStepLR,
+                 StepDecay as StepLR,
+                 LambdaDecay as LambdaLR,
+                 ReduceOnPlateau as ReduceLROnPlateau,
+                 CosineAnnealingDecay as CosineAnnealingLR)
+
+
+def PipelineOptimizer(optimizer, num_microbatches=1, **kw):
+    """1.8 pipeline wrapper: microbatching lives in
+    distributed.pipeline.pipeline_apply here; the optimizer passes through
+    unchanged (kept callable so fleet scripts construct it)."""
+    return optimizer
+
+
+def RecomputeOptimizer(optimizer, **kw):
+    """1.8 recompute wrapper: rematerialization is fleet's recompute knob
+    (jax.checkpoint); the optimizer passes through unchanged."""
+    return optimizer

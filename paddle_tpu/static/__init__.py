@@ -64,3 +64,109 @@ ParallelExecutor = CompiledProgram
 def name_scope(prefix=None):
     from ..utils import unique_name
     return unique_name.guard(prefix + '/' if prefix else None)
+
+
+# -- 2.0-beta static top-level surface ---------------------------------------
+from .nn import (fc, batch_norm, embedding, conv2d)  # noqa: F401,E402
+from ..fluid.backward import append_backward  # noqa: F401,E402
+from ..fluid.layers import (bilinear_tensor_product,  # noqa: F401,E402
+                            conv2d_transpose, conv3d, conv3d_transpose,
+                            create_parameter, crf_decoding, data_norm,
+                            deformable_conv, group_norm, hsigmoid,
+                            instance_norm, layer_norm, multi_box_head, nce,
+                            prelu, row_conv, spectral_norm)
+from ..fluid.control_flow import Print  # noqa: F401,E402
+from ..nn.initializer import WeightNormParamAttr  # noqa: F401,E402
+from ..fluid.layers import py_func  # noqa: F401,E402
+
+
+def save(program, model_path, protocol=4):
+    """Save a Program's parameters + persistables (static/io.py save):
+    writes model_path.pdparams with the parameter payloads."""
+    import numpy as _np
+    from ..framework import save as _fsave
+    state = {v.name: _np.asarray(v.concrete.numpy())
+             for v in program.all_parameters()}
+    _fsave(state, model_path + '.pdparams')
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Load parameters saved by static.save back into the Program."""
+    import jax.numpy as _jnp
+    from ..framework import load as _fload
+    state = _fload(model_path if model_path.endswith('.pdparams')
+                   else model_path + '.pdparams')
+    for v in program.all_parameters():
+        if v.name in state:
+            val = state[v.name]
+            val = val.numpy() if hasattr(val, 'numpy') else val
+            v.concrete._inplace_value(
+                _jnp.asarray(val).astype(v.concrete.dtype))
+
+
+def global_scope():
+    from ..fluid import global_scope as _gs
+    return _gs()
+
+
+def scope_guard(scope):
+    from ..fluid import scope_guard as _sg
+    return _sg(scope)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static-graph gradients of targets wrt inputs (fluid/backward.py
+    gradients), via the same whole-program jax.grad lowering
+    append_backward uses. target_gradients supplies the output cotangents
+    (the documented weighted-vjp semantics); no_grad_set is not supported
+    in the closure IR (raise rather than silently ignore)."""
+    import jax
+    import jax.numpy as jnp
+    from .graph import current_capture_program
+    from .executor import _interpret_ops
+    from ..core.tensor import apply_op
+    if no_grad_set:
+        raise NotImplementedError(
+            "gradients(no_grad_set=...) is not supported by the closure-IR "
+            "lowering; mark vars stop_gradient=True instead")
+    prog = current_capture_program() or default_main_program()
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is not None and not isinstance(
+            target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    ops = list(prog.global_block.ops)
+
+    grad_vars = []
+    for inp in inputs:
+        # bind per-input state NOW: a late-binding closure would leave
+        # every grad_fn reading the LAST iteration's feeds
+        feeds = [v for v in prog.global_block.vars.values()
+                 if getattr(v, 'is_data', False) and v is not inp]
+        cotans = list(target_gradients) if target_gradients else None
+
+        def grad_fn(*in_vals, _inp=inp, _ops=ops, _feeds=feeds,
+                    _nw=len(feeds)):
+            env = {id(_inp): in_vals[0]}
+            for v, val in zip(_feeds, in_vals[1:1 + _nw]):
+                env[id(v)] = val
+            cot_vals = in_vals[1 + _nw:]
+
+            def scalar_of(x0):
+                e = dict(env)
+                e[id(_inp)] = x0
+                e = _interpret_ops(_ops, e)
+                total = 0.0
+                for ti, t in enumerate(targets):
+                    if id(t) in e:
+                        if cot_vals:
+                            total = total + jnp.sum(e[id(t)] *
+                                                    cot_vals[ti])
+                        else:
+                            total = total + jnp.sum(e[id(t)])
+                return total
+            return jax.grad(scalar_of)(in_vals[0])
+
+        args = [inp] + feeds + (cotans or [])
+        grad_vars.append(apply_op(grad_fn, tuple(args)))
+    return grad_vars

@@ -9,3 +9,36 @@ from .stat import *  # noqa
 from .random import *  # noqa
 from .attribute import *  # noqa
 from .einsum import einsum  # noqa
+
+# -- 2.0-beta fluid-holdover names at tensor level ---------------------------
+from ..fluid.layers import (crop_tensor, fill_constant,  # noqa: F401,E402
+                            has_inf, has_nan, reduce_all, reduce_any,
+                            reduce_max, reduce_mean, reduce_min,
+                            reduce_prod, reduce_sum, sums,
+                            unique_with_counts, mul)
+from ..framework import save, load  # noqa: F401,E402
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    return input + tensor1 * tensor2 * value
+
+
+def elementwise_sum(inputs, name=None):
+    return sums(inputs)
+
+
+def inverse(x, name=None):
+    import jax.numpy as _jnp
+    from ..core.tensor import apply_op as _ap
+    from ._helpers import _t as _tt
+    return _ap(lambda v: _jnp.linalg.inv(v), (_tt(x),))
+
+
+def shuffle(x, name=None):
+    import jax as _jax
+    from ..core.rng import next_key as _nk
+    from ..core.tensor import apply_op as _ap
+    from ._helpers import _t as _tt
+    key = _nk()
+    return _ap(lambda v: v[_jax.random.permutation(key, v.shape[0])],
+               (_tt(x),))

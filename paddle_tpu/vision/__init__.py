@@ -3,3 +3,18 @@ from . import models
 from . import datasets
 from . import transforms
 from . import ops
+
+# 2.0-beta top-level re-exports (reference vision/__init__.py lifts the
+# transforms / datasets / models into paddle.vision directly)
+from .models import *  # noqa: F401,F403
+from .datasets import *  # noqa: F401,F403
+from .transforms import *  # noqa: F401,F403
+from . import detection_train  # noqa: F401
+from .detection_train import *  # noqa: F401,F403
+# the star imports rebind the `transforms`/`datasets`/`models` names to
+# same-named inner modules; restore the subPACKAGE bindings from
+# sys.modules (a `from . import X` would just re-read the clobbered attr)
+import sys as _sys  # noqa: E402
+models = _sys.modules[__name__ + '.models']
+datasets = _sys.modules[__name__ + '.datasets']
+transforms = _sys.modules[__name__ + '.transforms']

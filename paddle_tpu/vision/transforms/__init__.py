@@ -1,3 +1,17 @@
 """Vision transforms. Parity: python/paddle/vision/transforms/__init__.py."""
 from .transforms import *  # noqa
 from . import functional
+# beta re-exports the functional forms at the transforms level
+from .functional import (resize, pad, rotate, to_grayscale,  # noqa: F401
+                         normalize, crop, center_crop, hflip, vflip)
+
+
+def flip(image, code):
+    """cv2-style flip (beta functional): code 0 vertical, >0 horizontal,
+    <0 both."""
+    from . import functional as F
+    if code == 0:
+        return F.vflip(image)
+    if code > 0:
+        return F.hflip(image)
+    return F.hflip(F.vflip(image))

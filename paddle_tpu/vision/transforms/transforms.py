@@ -10,7 +10,7 @@ __all__ = ['Compose', 'BaseTransform', 'ToTensor', 'Resize', 'RandomResizedCrop'
            'CenterCrop', 'RandomHorizontalFlip', 'RandomVerticalFlip',
            'Transpose', 'Normalize', 'BrightnessTransform', 'SaturationTransform',
            'ContrastTransform', 'HueTransform', 'ColorJitter', 'RandomCrop',
-           'Pad', 'RandomRotation', 'Grayscale']
+           'Pad', 'RandomRotation', 'Grayscale', 'Permute', 'RandomRotate', 'BatchCompose', 'CenterCropResize', 'GaussianNoise', 'RandomErasing']
 
 
 class Compose:
@@ -264,3 +264,93 @@ class Grayscale(BaseTransform):
 
     def _apply_image(self, img):
         return Fv.to_grayscale(img, self.num_output_channels)
+
+
+# -- 2.0-beta transform tail --------------------------------------------------
+
+Permute = Transpose          # beta name for HWC->CHW
+RandomRotate = RandomRotation
+
+
+class BatchCompose:
+    """Compose applied per batch (reference transforms.BatchCompose)."""
+
+    def __init__(self, transforms=[]):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for f in self.transforms:
+            data = [f(d) for d in data]
+        return data
+
+
+class CenterCropResize(BaseTransform):
+    """Center-crop to the largest square scaled by crop_padding, then
+    resize (reference transforms.CenterCropResize)."""
+
+    def __init__(self, size, crop_padding=32, interpolation='bilinear'):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.crop_padding = crop_padding
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import numpy as _np
+        arr = Fv._as_np(img)
+        h, w = arr.shape[:2]
+        c = min(self.size)
+        side = int(c / (c + self.crop_padding) * min(h, w))
+        top = (h - side) // 2
+        left = (w - side) // 2
+        cropped = arr[top:top + side, left:left + side]
+        return Fv.resize(cropped, self.size, self.interpolation)
+
+    __call__ = _apply_image
+
+
+class GaussianNoise(BaseTransform):
+    """Additive gaussian pixel noise (reference transforms.GaussianNoise)."""
+
+    def __init__(self, mean=0.0, variance=1.0):
+        self.mean = mean
+        self.std = variance ** 0.5
+
+    def _apply_image(self, img):
+        import numpy as _np
+        arr = Fv._as_np(img).astype('float32')
+        noise = _np.random.normal(self.mean, self.std, arr.shape)
+        return (arr + noise).astype('float32')
+
+    __call__ = _apply_image
+
+
+class RandomErasing(BaseTransform):
+    """Random rectangular erase (reference transforms.RandomErasing /
+    the cutout augmentation)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        import numpy as _np
+        arr = Fv._as_np(img).copy()
+        if _np.random.rand() > self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * _np.random.uniform(*self.scale)
+            aspect = _np.random.uniform(*self.ratio)
+            eh = int(round((target * aspect) ** 0.5))
+            ew = int(round((target / aspect) ** 0.5))
+            if eh < h and ew < w:
+                top = _np.random.randint(0, h - eh)
+                left = _np.random.randint(0, w - ew)
+                arr[top:top + eh, left:left + ew] = self.value
+                break
+        return arr
+
+    __call__ = _apply_image

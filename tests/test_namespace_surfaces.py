@@ -1,0 +1,194 @@
+"""2.0-beta namespace surface tails: nn aliases, static
+gradients/save/load, vision re-exports, distributed fs/metrics/roles."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+import paddle_tpu.vision as vision
+import paddle_tpu.distributed as dist
+
+
+class TestNNAliases:
+    def test_lowercase_d_aliases(self):
+        assert nn.Conv2d is nn.Conv2D
+        assert nn.BatchNorm2d is nn.BatchNorm2D
+        assert nn.ConvTranspose2d is nn.Conv2DTranspose
+        assert nn.AdaptiveAvgPool2d is nn.AdaptiveAvgPool2D
+
+    def test_pad_classes_isinstance(self):
+        layer = nn.ReflectionPad2d([1, 1, 1, 1])
+        assert isinstance(layer, nn.ReflectionPad2d)
+        out = layer(paddle.to_tensor(np.ones((1, 2, 4, 4), np.float32)))
+        assert list(out.shape) == [1, 2, 6, 6]
+        rep = nn.ReplicationPad1d([1, 1])
+        out1 = rep(paddle.to_tensor(np.ones((1, 2, 5), np.float32)))
+        assert list(out1.shape) == [1, 2, 7]
+
+    def test_pool2d_hsigmoid_rowconv(self):
+        rs = np.random.RandomState(0)
+        pool = nn.Pool2D(pool_size=2, pool_type='max', pool_stride=2)
+        out = pool(paddle.to_tensor(rs.randn(1, 2, 4, 4)
+                                    .astype(np.float32)))
+        assert list(out.shape) == [1, 2, 2, 2]
+        hs = nn.HSigmoid(8, 10)
+        x = paddle.to_tensor(rs.randn(3, 8).astype(np.float32))
+        lab = paddle.to_tensor(rs.randint(0, 10, (3, 1)).astype(np.int64))
+        loss = hs(x, lab)
+        assert list(loss.shape) == [3, 1] and (loss.numpy() > 0).all()
+        rc = nn.RowConv(4, 2)
+        out2 = rc(paddle.to_tensor(rs.randn(2, 5, 4).astype(np.float32)))
+        assert list(out2.shape) == [2, 5, 4]
+
+    def test_holdover_layers_lazy(self):
+        assert nn.BilinearTensorProduct is not None
+        assert nn.InstanceNorm is not None
+
+
+class TestStaticSurface:
+    @pytest.fixture(autouse=True)
+    def _static(self):
+        paddle.enable_static()
+        yield
+        paddle.disable_static()
+
+    def test_gradients_multi_input(self):
+        main = static.Program()
+        with static.program_guard(main):
+            a = static.data('a', [1, 3], 'float32')
+            b = static.data('b', [1, 3], 'float32')
+            loss = (a * b).sum()
+            ga, gb = static.gradients([loss], [a, b])
+            exe = static.Executor()
+            av = np.array([[1., 2., 3.]], np.float32)
+            bv = np.array([[10., 20., 30.]], np.float32)
+            out = exe.run(main, feed={'a': av, 'b': bv},
+                          fetch_list=[ga, gb])
+        np.testing.assert_allclose(out[0], bv)   # d/da = b
+        np.testing.assert_allclose(out[1], av)   # d/db = a
+
+    def test_gradients_target_gradients(self):
+        main = static.Program()
+        with static.program_guard(main):
+            a = static.data('aw', [1, 3], 'float32')
+            y = a * 2.0
+            g, = static.gradients(
+                [y], [a],
+                target_gradients=[paddle.to_tensor(
+                    np.array([[1., 0., 2.]], np.float32))])
+            exe = static.Executor()
+            out = exe.run(main,
+                          feed={'aw': np.ones((1, 3), np.float32)},
+                          fetch_list=[g])
+        np.testing.assert_allclose(out[0], [[2., 0., 4.]])
+
+    def test_gradients_no_grad_set_raises(self):
+        with static.program_guard(static.Program()):
+            x = static.data('xng', [1], 'float32')
+            with pytest.raises(NotImplementedError):
+                static.gradients([x], [x], no_grad_set={x})
+
+    def test_save_load_roundtrip(self, tmp_path):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data('x', [2, 3], 'float32')
+            y = static.nn.fc(x, 4)
+        static.save(main, str(tmp_path / 'ckpt'))
+        w = main.all_parameters()[0]
+        orig = np.asarray(w.concrete.numpy()).copy()
+        w.concrete._inplace_value(w.concrete._value * 0)
+        static.load(main, str(tmp_path / 'ckpt'))
+        np.testing.assert_allclose(np.asarray(w.concrete.numpy()), orig)
+
+    def test_static_nn_reexports(self):
+        for name in ('fc', 'batch_norm', 'conv2d', 'nce', 'hsigmoid',
+                     'layer_norm', 'py_func', 'append_backward', 'Print',
+                     'WeightNormParamAttr'):
+            assert hasattr(static, name), name
+
+
+class TestVisionSurface:
+    def test_transforms_package_binding(self):
+        assert vision.transforms.__name__.endswith('vision.transforms')
+        assert vision.transforms.functional is not None
+        img = np.random.rand(8, 8, 3).astype(np.float32)
+        assert vision.transforms.flip(img, 0).shape == (8, 8, 3)
+
+    def test_toplevel_reexports(self):
+        for name in ('LeNet', 'MNIST', 'Compose', 'Normalize', 'resnet50',
+                     'RandomErasing', 'GaussianNoise', 'BatchCompose',
+                     'Permute', 'CenterCropResize'):
+            assert hasattr(vision, name), name
+
+    def test_random_erasing_and_noise(self):
+        img = np.ones((16, 16, 3), np.float32)
+        erased = vision.transforms.RandomErasing(prob=1.0)(img)
+        assert erased.shape == img.shape
+        assert (erased == 0).any()            # something was erased
+        noisy = vision.transforms.GaussianNoise(variance=0.01)(img)
+        assert not np.allclose(noisy, img)
+
+
+class TestDistributedSurface:
+    def test_local_fs(self, tmp_path):
+        fs = dist.LocalFS()
+        fs.mkdirs(str(tmp_path / 'a'))
+        fs.touch(str(tmp_path / 'f.txt'))
+        dirs, files = fs.ls_dir(str(tmp_path))
+        assert dirs == ['a'] and files == ['f.txt']
+        fs.rename(str(tmp_path / 'f.txt'), str(tmp_path / 'g.txt'))
+        assert fs.is_file(str(tmp_path / 'g.txt'))
+        with pytest.raises(dist.FSFileNotExistsError):
+            fs.rename(str(tmp_path / 'missing'), str(tmp_path / 'x'))
+
+    def test_metrics(self):
+        assert dist.acc(np.array([8.0]), np.array([10.0])) == 0.8
+        pos = np.zeros(10)
+        neg = np.zeros(10)
+        pos[9] = 10
+        neg[0] = 10
+        np.testing.assert_allclose(dist.auc(pos, neg), 1.0)
+        np.testing.assert_allclose(
+            dist.rmse(np.array([8.0]), np.array([2.0])), 2.0)
+
+    def test_role_maker_and_dataset_factory(self):
+        rm = dist.UserDefinedRoleMaker(current_id=2, worker_num=4)
+        assert rm.worker_index() == 2 and rm.worker_num() == 4
+        assert not rm.is_server()
+        ds = dist.DatasetFactory().create_dataset('InMemoryDataset')
+        ds.set_batch_size(8)
+        assert ds.batch_size == 8
+
+
+class TestTensorIOSurface:
+    def test_tensor_level_holdover(self):
+        import paddle_tpu.tensor as T
+        x = paddle.to_tensor(np.array([[2.0, 0], [0, 4.0]], np.float32))
+        np.testing.assert_allclose(T.inverse(x).numpy(),
+                                   np.diag([0.5, 0.25]), rtol=1e-5)
+        assert float(T.reduce_sum(x).numpy()) == 6.0
+
+    def test_io_program_state(self, tmp_path):
+        import paddle_tpu.io as io
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main):
+                x = static.data('x', [2, 3], 'float32')
+                static.nn.fc(x, 4)
+            static.save(main, str(tmp_path / 'm'))
+            state = io.load_program_state(str(tmp_path / 'm'))
+            assert state
+            io.set_program_state(main, state)
+        finally:
+            paddle.disable_static()
+
+    def test_jit_program_translator(self):
+        import paddle_tpu.jit as jit
+        pt = jit.ProgramTranslator.get_instance()
+        f = pt.get_func(lambda x: x * 3.0)
+        out = f(paddle.to_tensor(np.array([2.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [6.0])
