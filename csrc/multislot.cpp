@@ -1,0 +1,63 @@
+// MultiSlot text parser: the hot path of dataset-driven training
+// (parity: paddle/fluid/framework/data_feed.cc MultiSlotDataFeed's
+// ReadThread parsing; one native pass instead of python str.split per
+// value). Lines are `cnt v1 .. vcnt` groups, one group per slot.
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// Parse newline-separated MultiSlot lines.
+//  text/text_len : input buffer (need not be NUL-terminated)
+//  n_slots       : groups per line
+//  out/out_cap   : flat value output (doubles, line-major then slot-major)
+//  counts/counts_cap : per (line, slot) value counts
+// Returns total doubles written, -1 on malformed input, -2 on overflow.
+long multislot_parse(const char* text, long text_len, int n_slots,
+                     double* out, long out_cap,
+                     long* counts, long counts_cap) {
+    const char* p = text;
+    const char* end = text + text_len;
+    long n_out = 0;
+    long n_lines = 0;
+    while (p < end) {
+        // skip blank lines
+        while (p < end && (*p == '\n' || *p == '\r')) p++;
+        if (p >= end) break;
+        for (int s = 0; s < n_slots; s++) {
+            char* next = nullptr;
+            long cnt = strtol(p, &next, 10);
+            if (next == p || cnt < 0) return -1;
+            p = next;
+            if (n_lines * n_slots + s >= counts_cap) return -2;
+            counts[n_lines * n_slots + s] = cnt;
+            for (long i = 0; i < cnt; i++) {
+                double v = strtod(p, &next);
+                if (next == p) return -1;
+                p = next;
+                if (n_out >= out_cap) return -2;
+                out[n_out++] = v;
+            }
+        }
+        // advance to end of line
+        while (p < end && *p != '\n') {
+            if (*p != ' ' && *p != '\t' && *p != '\r') return -1;
+            p++;
+        }
+        n_lines++;
+    }
+    return n_out;
+}
+
+long multislot_count_lines(const char* text, long text_len) {
+    long n = 0;
+    bool in_line = false;
+    for (long i = 0; i < text_len; i++) {
+        if (text[i] == '\n') { if (in_line) n++; in_line = false; }
+        else if (text[i] != '\r') in_line = true;
+    }
+    if (in_line) n++;
+    return n;
+}
+
+}  // extern "C"

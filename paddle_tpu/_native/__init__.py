@@ -23,7 +23,8 @@ _tried = False
 
 def _build():
     srcs = [os.path.join(_CSRC, f)
-            for f in ('prefetch.cpp', 'tokenizer.cpp')]
+            for f in ('prefetch.cpp', 'tokenizer.cpp',
+                      'multislot.cpp')]
     if not all(os.path.exists(s) for s in srcs):
         return False
     cmd = ['g++', '-O2', '-std=c++17', '-fPIC', '-Wall', '-pthread',

@@ -1,0 +1,76 @@
+"""MultiSlot line parsing: native fast path + python fallback.
+
+Parity: paddle/fluid/framework/data_feed.cc (MultiSlotDataFeed parsing).
+parse_batch(lines, n_slots) -> (values, counts): values is the flat
+float64 array of every slot value in line-major order; counts[i, s] is
+slot s's value count on line i.
+"""
+import ctypes
+
+import numpy as np
+
+from . import load
+
+
+def _bind(lib):
+    lib.multislot_parse.restype = ctypes.c_long
+    lib.multislot_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long), ctypes.c_long]
+    return lib
+
+
+def native_available():
+    return load() is not None
+
+
+def parse_batch(lines, n_slots):
+    """lines: list[str] (or one str with newlines); returns
+    (values float64 (total,), counts int64 (n_lines, n_slots))."""
+    text = lines if isinstance(lines, str) else "\n".join(lines)
+    data = text.encode()
+    n_lines = len(lines) if not isinstance(lines, str) else \
+        len([ln for ln in text.splitlines() if ln.strip()])
+    lib = load()
+    if lib is not None:
+        lib = _bind(lib)
+        # upper bound on value count: every whitespace-separated token
+        cap = max(text.count(' ') + 2 * n_lines + 2, 16)
+        out = np.empty(cap, np.float64)
+        counts = np.empty(n_lines * n_slots, np.int64)
+        n = lib.multislot_parse(
+            data, len(data), n_slots,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), cap,
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            counts.size)
+        if n >= 0:
+            return out[:n], counts.reshape(n_lines, n_slots)
+        if n == -1:
+            raise ValueError("multislot: malformed line")
+        # -2 overflow: fall through to python (shouldn't happen)
+    return _parse_py(text, n_slots)
+
+
+def _parse_py(text, n_slots):
+    values = []
+    counts = []
+    for ln in text.splitlines():
+        toks = ln.split()
+        if not toks:
+            continue
+        i = 0
+        row = []
+        for _ in range(n_slots):
+            if i >= len(toks):
+                raise ValueError("multislot: malformed line")
+            cnt = int(toks[i])
+            i += 1
+            row.append(cnt)
+            values.extend(float(t) for t in toks[i:i + cnt])
+            if len(toks[i:i + cnt]) != cnt:
+                raise ValueError("multislot: malformed line")
+            i += cnt
+        counts.append(row)
+    return (np.asarray(values, np.float64),
+            np.asarray(counts, np.int64).reshape(-1, n_slots))

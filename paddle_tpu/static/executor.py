@@ -138,6 +138,92 @@ class Executor:
             return [np.asarray(jax.device_get(o)) for o in outs]
         return [Tensor(o) for o in outs]
 
+    # -- dataset-driven training (the reference's train/ device-worker
+    # trainers: fluid/executor.py train_from_dataset -> C++ Hogwild/
+    # Section trainers over a DataFeed) --------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Run a training Program over a fleet dataset
+        (DatasetFactory.create_dataset + MultiSlot files).
+
+        TPU-first divergence: the reference spawns `thread` host workers
+        each driving per-op kernels (Hogwild async updates); here every
+        batch is ONE XLA computation that already saturates the chip, so
+        batches run sequentially on-device while the MultiSlot text
+        parsing runs through the native csrc parser. `thread` is accepted
+        for API parity.
+        """
+        return self._run_from_dataset(program, dataset, fetch_list,
+                                      fetch_info, print_period,
+                                      debug=debug, train=True)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        return self._run_from_dataset(program, dataset, fetch_list,
+                                      fetch_info, print_period,
+                                      debug=debug, train=False)
+
+    def _run_from_dataset(self, program, dataset, fetch_list, fetch_info,
+                          print_period, debug=False, train=True):
+        from .._native import multislot
+        program = program or default_main_program()
+        if dataset is None:
+            raise ValueError("train_from_dataset: dataset is required")
+        use_vars = list(getattr(dataset, 'use_vars', []))
+        if not use_vars:
+            raise ValueError(
+                "train_from_dataset: dataset.set_use_var([...]) must name "
+                "the feed Variables (in MultiSlot slot order)")
+        records = list(dataset)
+        if not records:
+            dataset.load_into_memory()
+            records = list(dataset)
+        bs = max(int(getattr(dataset, 'batch_size', 1)), 1)
+        n_slots = len(use_vars)
+        step = 0
+        for start in range(0, len(records), bs):
+            batch_lines = [ln.strip() for ln in records[start:start + bs]
+                           if ln.strip()]
+            if not batch_lines:
+                continue
+            values, counts = multislot.parse_batch(batch_lines, n_slots)
+            feed = {}
+            pos = 0
+            # slice the flat value stream line-major into per-slot padded
+            # dense arrays
+            per_slot = [[] for _ in range(n_slots)]
+            for li in range(counts.shape[0]):
+                for s in range(n_slots):
+                    c = int(counts[li, s])
+                    per_slot[s].append(values[pos:pos + c])
+                    pos += c
+            for s, var in enumerate(use_vars):
+                rows = per_slot[s]
+                width = max((len(r) for r in rows), default=1)
+                arr = np.zeros((len(rows), width), np.float64)
+                for i, r in enumerate(rows):
+                    arr[i, :len(r)] = r
+                dt = np.dtype(var.dtype)
+                name = getattr(var, 'name', str(var))
+                want = tuple(getattr(var, 'shape', ()) or ())
+                if len(want) == 1 or (len(want) == 2 and want[-1] == 1
+                                      and width == 1):
+                    arr = arr.reshape(len(rows), *want[1:]) \
+                        if len(want) > 1 else arr.reshape(len(rows))
+                feed[name] = arr.astype(dt)
+            outs = self.run(program, feed=feed,
+                            fetch_list=list(fetch_list or []))
+            if fetch_list and print_period and step % print_period == 0:
+                labels = fetch_info or [getattr(f, 'name', str(f))
+                                        for f in fetch_list]
+                msg = ", ".join(f"{n}={np.asarray(o).ravel()[:4]}"
+                                for n, o in zip(labels, outs))
+                print(f"[dataset step {step}] {msg}")
+            step += 1
+        return None
+
     # -- internals ----------------------------------------------------------
     def _resolve(self, program, f):
         if isinstance(f, Variable):
