@@ -35,12 +35,33 @@ class ReduceOp:
     PROD = 3
 
 
+def _pprod(x, axis_name):
+    # Exact product reduce: gather the per-shard values and multiply. Unlike
+    # an exp(psum(log)) rewrite this keeps signs, zeros, and integer dtypes
+    # exact; the O(world) gather is acceptable because PROD all_reduce is a
+    # metric/scalar path, never the gradient hot loop.
+    return jnp.prod(lax.all_gather(x, axis_name), axis=0)
+
+
 _LAX_REDUCE = {
     ReduceOp.SUM: lax.psum,
     ReduceOp.MAX: lax.pmax,
     ReduceOp.MIN: lax.pmin,
-    ReduceOp.PROD: lambda x, a: jnp.exp(lax.psum(jnp.log(jnp.maximum(x, 1e-30)), a)),
+    ReduceOp.PROD: _pprod,
 }
+
+# Reference fleet metric helpers pass op by name; accept those aliases.
+_OP_ALIASES = {'sum': ReduceOp.SUM, 'max': ReduceOp.MAX, 'min': ReduceOp.MIN,
+               'prod': ReduceOp.PROD, 'product': ReduceOp.PROD}
+
+
+def _normalize_op(op):
+    if isinstance(op, str):
+        op = _OP_ALIASES.get(op.lower(), op)
+    if op not in _LAX_REDUCE:
+        raise ValueError(f"unknown reduce op {op!r}; expected one of "
+                         f"{sorted(_OP_ALIASES)} or a ReduceOp constant")
+    return op
 
 
 def _in_trace(x):
@@ -66,6 +87,7 @@ def _eager_collective(x, per_shard_fn, axis):
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     t = _t(tensor)
     axis = _axis(group)
+    op = _normalize_op(op)
     red = _LAX_REDUCE[op]
 
     def fn(v):
@@ -77,14 +99,25 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                 f"where that axis is not bound; wrap the step in shard_map "
                 f"over '{axis}' or use shardings + GSPMD instead")
         mesh = env.get_mesh()
-        if mesh is None or env.get_world_size(axis) <= 1:
+        n = env.get_world_size(axis)
+        if mesh is None or n <= 1:
             return v
-        shard = shard_map(lambda s: red(s, axis), mesh=mesh,
-                          in_specs=(P(axis),), out_specs=P(axis))
-        # replicate input over axis so every shard reduces the same value
-        tiled = jnp.concatenate([v] * env.get_world_size(axis), axis=0)
-        out = shard(tiled)
-        return out[:v.shape[0]]
+        shd = getattr(v, 'sharding', None)
+        spec = getattr(shd, 'spec', None)
+        dim0 = spec[0] if spec is not None and len(spec) > 0 else None
+        if dim0 is not None and axis in (
+                dim0 if isinstance(dim0, tuple) else (dim0,)):
+            # Value genuinely partitioned over `axis` along dim 0: reduce the
+            # distinct shards. (Values sharded over other axes/dims are
+            # replicated w.r.t. this axis and take the closed form below.)
+            return _eager_collective(v, lambda s: red(s, axis), axis)
+        # Replicated eager value: every "rank" holds the same tensor, so the
+        # reduce has a closed form — no O(world) materialization needed.
+        if op == ReduceOp.SUM:
+            return v * n
+        if op == ReduceOp.PROD:
+            return v ** n
+        return v  # MAX / MIN of identical copies
     out = apply_op(fn, (t,))
     if isinstance(tensor, Tensor):
         tensor._inplace_value(out._value)
@@ -94,7 +127,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def in_jit_all_reduce(value, axis=None, op=ReduceOp.SUM):
     """For use inside pjit/shard_map-traced train steps (the hot path)."""
-    return _LAX_REDUCE[op](value, axis or env.DATA_AXIS)
+    return _LAX_REDUCE[_normalize_op(op)](value, axis or env.DATA_AXIS)
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=None):

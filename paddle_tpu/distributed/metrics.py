@@ -30,10 +30,18 @@ def _allreduce(value, op='sum'):
         from . import env as _env
         from .collective import all_reduce
         from ..core.tensor import to_tensor
-        if _env.is_initialized():
+        if _env.is_initialized() and _env.get_world_size() == n_workers:
+            # Mesh ranks == worker processes: the mesh collective IS the
+            # fleet reduce.
             return np.asarray(
                 all_reduce(to_tensor(np.asarray(value, np.float64)
                                      .astype(np.float32)), op=op).numpy())
+        # Otherwise emulate the worker reduce directly: every emulated worker
+        # holds this process's value, so sum scales by n_workers and
+        # max/min are the value itself. Never scale by the mesh device
+        # count — that is a different (and here wrong) denominator.
+        v = np.asarray(value)
+        return v * n_workers if op == 'sum' else v
     return np.asarray(value)
 
 
