@@ -102,15 +102,22 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         n = env.get_world_size(axis)
         if mesh is None or n <= 1:
             return v
-        shd = getattr(v, 'sharding', None)
-        spec = getattr(shd, 'spec', None)
-        dim0 = spec[0] if spec is not None and len(spec) > 0 else None
-        if dim0 is not None and axis in (
-                dim0 if isinstance(dim0, tuple) else (dim0,)):
-            # Value genuinely partitioned over `axis` along dim 0: reduce the
-            # distinct shards. (Values sharded over other axes/dims are
-            # replicated w.r.t. this axis and take the closed form below.)
-            return _eager_collective(v, lambda s: red(s, axis), axis)
+        spec = getattr(getattr(v, 'sharding', None), 'spec', None)
+        shard_dim = None
+        if spec is not None:
+            for d, entry in enumerate(spec):
+                entries = entry if isinstance(entry, tuple) else (entry,)
+                if axis in entries:
+                    shard_dim = d
+                    break
+        if shard_dim is not None:
+            # Value genuinely partitioned over `axis` (along whichever dim):
+            # reduce the distinct shards. Values sharded only over OTHER mesh
+            # axes are replicated w.r.t. this axis -> closed form below.
+            pspec = P(*([None] * shard_dim + [axis]))
+            fn_s = shard_map(lambda s: red(s, axis), mesh=mesh,
+                             in_specs=(pspec,), out_specs=pspec)
+            return fn_s(v)
         # Replicated eager value: every "rank" holds the same tensor, so the
         # reduce has a closed form — no O(world) materialization needed.
         if op == ReduceOp.SUM:

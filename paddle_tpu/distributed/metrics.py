@@ -30,7 +30,9 @@ def _allreduce(value, op='sum'):
         from . import env as _env
         from .collective import all_reduce
         from ..core.tensor import to_tensor
-        if _env.is_initialized() and _env.get_world_size() == n_workers:
+        reduce_axis = _env.current_data_axis() or _env.DATA_AXIS
+        if _env.is_initialized() and \
+                _env.get_world_size(reduce_axis) == n_workers:
             # Mesh ranks == worker processes: the mesh collective IS the
             # fleet reduce.
             return np.asarray(

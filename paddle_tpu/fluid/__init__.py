@@ -26,6 +26,7 @@ from ..framework import (in_dygraph_mode, enable_static, disable_static,
                          save, load)
 from ..core import rng as _rng
 from . import layers
+from . import contrib
 from . import dygraph
 from . import nets
 from . import metrics

@@ -121,7 +121,7 @@ def extend_with_decoupled_weight_decay(base_optimizer_cls):
             if not self._coeff:
                 return
             lr = self.get_lr()
-            from ..core import autograd
+            from ...core import autograd
             params = getattr(self, '_parameters', [])
             with autograd.no_grad():
                 for p in params:
@@ -137,4 +137,16 @@ def extend_with_decoupled_weight_decay(base_optimizer_cls):
 
 # decoder/: the 1.8 contrib beam-search machinery is superseded by the
 # dense decode stack; alias the entry points reference scripts import
-from ..nn.decode import BeamSearchDecoder, dynamic_decode  # noqa: E402,F401
+from ...nn.decode import BeamSearchDecoder, dynamic_decode  # noqa: E402,F401
+
+# contrib/layers/: the contrib op zoo (nn.py + rnn_impl.py + metric_op.py)
+from . import layers  # noqa: E402
+from .layers import *  # noqa: E402,F401,F403
+__all__ += layers.__all__
+
+# mixed_precision / slim / reader live at the package top level; bind the
+# reference's contrib paths so 1.8 scripts resolve them from here too
+from ... import amp as mixed_precision  # noqa: E402,F401
+from ... import slim  # noqa: E402,F401
+from ... import reader  # noqa: E402,F401
+__all__ += ['mixed_precision']

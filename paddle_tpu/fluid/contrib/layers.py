@@ -1,0 +1,1162 @@
+"""fluid.contrib.layers — the contrib op zoo as masked-dense TPU ops.
+
+Parity: /root/reference/python/paddle/fluid/contrib/layers/nn.py:54 (the
+18-op __all__), rnn_impl.py:22 (BasicGRUUnit/basic_gru/BasicLSTMUnit/
+basic_lstm), metric_op.py:27 (ctr_metric_bundle).
+
+TPU-first redesign notes
+------------------------
+- LoD (ragged) inputs become dense padded tensors plus optional integer
+  length arguments, matching the package-wide masked-dense convention
+  (see fluid/sequence_tail.py). Static shapes keep XLA happy.
+- Ops whose reference kernels are data-dependent host machinery (tree2col
+  patch construction in paddle/fluid/operators/math/tree2col.cc, tdm
+  negative sampling in tdm_sampler_op.h) do the irregular index work on
+  host in numpy, then run all FLOPs on device — structure prep is IO-bound,
+  the math rides the MXU.
+- BoxPS / large-scale PS sparse tables (sparse_embedding,
+  _pull_box_extended_sparse) are served by dense device-resident tables;
+  the distributed sharded path lives in distributed/ps.py.
+"""
+import math
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply_op
+from ...tensor._helpers import _t
+from ..layers_tail import _op_param, _act
+
+__all__ = [
+    'fused_elemwise_activation', 'sequence_topk_avg_pooling', 'var_conv_2d',
+    'match_matrix_tensor', 'tree_conv', 'fused_embedding_seq_pool',
+    'multiclass_nms2', 'search_pyramid_hash', 'shuffle_batch',
+    'partial_concat', 'sparse_embedding', 'partial_sum', 'tdm_child',
+    'rank_attention', 'tdm_sampler', 'batch_fc', '_pull_box_extended_sparse',
+    'bilateral_slice', 'correlation',
+    'BasicGRUUnit', 'basic_gru', 'BasicLSTMUnit', 'basic_lstm',
+    'ctr_metric_bundle',
+]
+
+
+# ---------------------------------------------------------------------------
+# fused_elemwise_activation (nn.py:64)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    'relu': jax.nn.relu,
+    'tanh': jnp.tanh,
+    'sigmoid': jax.nn.sigmoid,
+    'scale': None,  # handled with the scale attr
+}
+_BINARY = {
+    'elementwise_add': jnp.add,
+    'elementwise_mul': jnp.multiply,
+}
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """Compose a binary elementwise op with a unary activation in one fused
+    op (nn.py:64). ['elementwise_add','relu'] -> add(x, relu(y));
+    ['relu','elementwise_add'] -> relu(add(x, y)). On TPU the fusion itself
+    is XLA's job — this supplies the composed semantics.
+    """
+    if len(functor_list) != 2:
+        raise ValueError("functor_list must hold exactly two op names")
+    f0, f1 = functor_list
+
+    def unary(name, v):
+        if name == 'scale':
+            return v * scale
+        return _UNARY[name](v)
+
+    def fn(xv, yv):
+        if f0 in _BINARY and f1 in _UNARY:
+            return _BINARY[f0](xv, unary(f1, yv))
+        if f0 in _UNARY and f1 in _BINARY:
+            return unary(f0, _BINARY[f1](xv, yv))
+        raise ValueError(
+            f"functor_list must pair one of {sorted(_BINARY)} with one of "
+            f"{sorted(_UNARY)}, got {functor_list}")
+    return apply_op(fn, (_t(x), _t(y)))
+
+
+# ---------------------------------------------------------------------------
+# var_conv_2d (nn.py:128)
+# ---------------------------------------------------------------------------
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype='float32',
+                name=None):
+    """Per-sample variable-size 2D conv (nn.py:128). Dense redesign: input
+    is (B, input_channel, Hmax, Wmax); ``row``/``col`` give each sample's
+    true height/width. SAME conv at ``stride``; positions outside a
+    sample's (ceil(h/s), ceil(w/s)) output window are zeroed.
+    """
+    from ...nn.initializer import XavierUniform
+    x = _t(input)
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    st = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    w = _op_param([output_channel, input_channel, ks[0], ks[1]], param_attr,
+                  XavierUniform(), name or 'var_conv_2d_w', dtype=dtype)
+    rows = _t(row)
+    cols = _t(col)
+
+    def fn(xv, wv, rv, cv):
+        B, C, H, W = xv.shape
+        # zero padding region of each input so border taps read zeros
+        hi = jnp.arange(H)[None, :, None]
+        wi = jnp.arange(W)[None, None, :]
+        in_mask = (hi < rv[:, None, None]) & (wi < cv[:, None, None])
+        xv = xv * in_mask[:, None].astype(xv.dtype)
+        out = lax.conv_general_dilated(
+            xv, wv, window_strides=st, padding='SAME',
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+        oh = -(-rv // st[0])
+        ow = -(-cv // st[1])
+        Ho, Wo = out.shape[2], out.shape[3]
+        hoi = jnp.arange(Ho)[None, :, None]
+        woi = jnp.arange(Wo)[None, None, :]
+        out_mask = (hoi < oh[:, None, None]) & (woi < ow[:, None, None])
+        return out * out_mask[:, None].astype(out.dtype)
+    out = apply_op(fn, (x, w, rows, cols))
+    return _act(out, act)
+
+
+# ---------------------------------------------------------------------------
+# match_matrix_tensor (nn.py:246)
+# ---------------------------------------------------------------------------
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype='float32', name=None, x_len=None, y_len=None):
+    """Semantic match matrix A @ W @ B.T per channel (nn.py:246). Dense: x
+    (B, n, h), y (B, m, h) -> out (B, channel_num, n, m); tmp is x @ W
+    (B, n, channel_num, h). Positions past x_len/y_len are zeroed.
+    """
+    from ...nn.initializer import XavierUniform
+    xt, yt = _t(x), _t(y)
+    h = xt.shape[-1]
+    assert yt.shape[-1] == h, "x and y must share the hidden size"
+    w = _op_param([h, channel_num, h], param_attr, XavierUniform(),
+                  name or 'match_matrix_w', dtype=dtype)
+    tensors = [xt, yt, w]
+    has_len = x_len is not None and y_len is not None
+    if has_len:
+        tensors += [_t(x_len), _t(y_len)]
+
+    def fn(xv, yv, wv, *lens):
+        tmp = jnp.einsum('bnh,hcg->bncg', xv, wv)
+        out = jnp.einsum('bncg,bmg->bcnm', tmp, yv)
+        if lens:
+            xl, yl = lens
+            n, m = xv.shape[1], yv.shape[1]
+            mask = ((jnp.arange(n)[None, :, None] < xl[:, None, None]) &
+                    (jnp.arange(m)[None, None, :] < yl[:, None, None]))
+            out = out * mask[:, None].astype(out.dtype)
+        return out, tmp
+    out, tmp = apply_op(fn, tuple(tensors), n_outputs=2)
+    return _act(out, act), tmp
+
+
+# ---------------------------------------------------------------------------
+# sequence_topk_avg_pooling (nn.py:333)
+# ---------------------------------------------------------------------------
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """Top-k average pooling over the width axis (nn.py:333). Dense: input
+    (B, channel_num, Hmax, Wmax); row/col are the per-sample valid height/
+    width. For each (sample, channel, row) the top-k of the valid row is
+    averaged — dividing by k even when fewer than k values exist (the
+    reference zero-pads short rows). Output (B, Hmax,
+    len(topks)*channel_num), rows past ``row`` zeroed.
+    """
+    xt, rt, ct = _t(input), _t(row), _t(col)
+    topks = [int(k) for k in topks]
+    kmax = max(topks)
+
+    def fn(xv, rv, cv):
+        B, C, H, W = xv.shape
+        wmask = jnp.arange(W)[None, None, None, :] < cv[:, None, None, None]
+        neg = jnp.finfo(xv.dtype).min
+        masked = jnp.where(wmask, xv, neg)
+        kk = min(kmax, W)
+        top = lax.top_k(masked, kk)[0]                      # (B,C,H,kk)
+        valid = jnp.arange(kk)[None, None, None, :] < \
+            jnp.minimum(cv[:, None, None, None], kk)
+        top = jnp.where(valid, top, 0.0)
+        outs = []
+        for k in topks:
+            avg = top[..., :min(k, kk)].sum(-1) / float(k)  # (B,C,H)
+            outs.append(avg)
+        out = jnp.stack(outs, axis=-1)                      # (B,C,H,K)
+        # layout: (B, H, K*C) with channel fastest inside each k group,
+        # matching out.dims = [rows, len(topks)*channel_num]
+        out = out.transpose(0, 2, 3, 1).reshape(B, H, len(topks) * C)
+        hmask = jnp.arange(H)[None, :, None] < rv[:, None, None]
+        return out * hmask.astype(out.dtype)
+    return apply_op(fn, (xt, rt, ct))
+
+
+# ---------------------------------------------------------------------------
+# tree_conv (nn.py:401) — TBCNN continuous binary tree convolution
+# ---------------------------------------------------------------------------
+
+def _tree2col_weights(edges, n_nodes, max_depth):
+    """Host port of Tree2ColUtil (operators/math/tree2col.cc): for each node
+    u, walk its subtree to max_depth collecting (v, eta_t, eta_l, eta_r)
+    weights. Returns a dense (N+1, N+1, 3) float array (node ids are
+    1-based; row/col 0 unused)."""
+    tr = [[] for _ in range(n_nodes + 2)]
+    for u, v in edges:
+        if u != 0 and v != 0:
+            tr[int(u)].append(int(v))
+        else:
+            break
+    W = np.zeros((n_nodes + 1, n_nodes + 1, 3), np.float64)
+
+    for root in range(1, n_nodes + 1):
+        # iterative DFS mirroring construct_patch: (node, index, pclen, depth)
+        patch = [(root, 1.0, 1.0, 0.0)]
+        stack = [(root, 1.0, 1.0, 0.0)]
+        visited = {root}
+        while stack:
+            node, _, _, depth = stack[-1]
+            advanced = False
+            for i, v in enumerate(tr[node]):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    entry = (v, float(i + 1), float(len(tr[node])), depth + 1)
+                    stack.append(entry)
+                    patch.append(entry)
+                    advanced = True
+            if not advanced:
+                stack.pop()
+        fd = float(max_depth)
+        for v, index, pclen, depth in patch:
+            eta_t = (fd - depth) / fd
+            tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * tmp
+            eta_r = (1.0 - eta_t) * (1.0 - tmp)
+            W[root, v, 0] += eta_t
+            W[root, v, 1] += eta_l
+            W[root, v, 2] += eta_r
+    return W
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1, max_depth=2,
+              act='tanh', param_attr=None, bias_attr=None, name=None):
+    """Tree-based convolution (TBCNN, nn.py:401). nodes_vector
+    (B, N, feature); edge_set (B, E, 2) int parent->child pairs with
+    1-based node ids, 0 terminating. The tree2col patch weights are built
+    on host (irregular graph walk — tree2col.cc); the weighted feature
+    gather and the filter matmul run on device. Output
+    (B, N, output_size, num_filters).
+    """
+    from ...nn.initializer import XavierUniform, Constant
+    nv = _t(nodes_vector)
+    B, N, F = nv.shape
+    edges = np.asarray(_t(edge_set).numpy())
+    Wt = np.zeros((B, N + 1, N + 1, 3), np.float32)
+    for b in range(B):
+        Wt[b] = _tree2col_weights(edges[b], N, max_depth)
+    # drop the unused 0 row/col -> (B, N, N, 3): Wt[b, u, v, k]
+    Wt = jnp.asarray(Wt[:, 1:, 1:, :])
+
+    w = _op_param([F, 3, output_size, num_filters], param_attr,
+                  XavierUniform(), name or 'tree_conv_w')
+    tensors = [nv, w]
+    if bias_attr is not False:
+        b_p = _op_param([num_filters], bias_attr, Constant(0.0),
+                        'tree_conv_b')
+        tensors.append(b_p)
+
+    def fn(nvv, wv, *rest):
+        patch = jnp.einsum('buvk,bvf->bukf', Wt, nvv)   # (B,N,3,F)
+        out = jnp.einsum('bukf,fkon->buon', patch, wv)  # (B,N,out,nf)
+        if rest:
+            out = out + rest[0][None, None, None, :]
+        return out
+    out = apply_op(fn, tuple(tensors))
+    return _act(out, act)
+
+
+# ---------------------------------------------------------------------------
+# fused_embedding_seq_pool (nn.py:472)
+# ---------------------------------------------------------------------------
+
+def fused_embedding_seq_pool(input, size, is_sparse=False, padding_idx=None,
+                             combiner='sum', param_attr=None,
+                             dtype='float32'):
+    """Embedding lookup + sequence sum-pool in one op (nn.py:472). Dense:
+    ids (B, T) or (B, T, 1) -> (B, emb_dim). padding_idx rows contribute
+    zero. Only combiner='sum' exists in the reference; same here.
+    """
+    if combiner != 'sum':
+        raise ValueError("fused_embedding_seq_pool supports combiner='sum' "
+                         "only (reference restriction)")
+    from ...nn.initializer import XavierUniform
+    ids = _t(input)
+    w = _op_param(list(size), param_attr, XavierUniform(), 'fused_emb_w',
+                  dtype=dtype)
+
+    def fn(iv, wv):
+        if iv.ndim == 3 and iv.shape[-1] == 1:
+            iv = iv[..., 0]
+        iv = iv.astype(jnp.int32)
+        emb = wv[iv]                                     # (B,T,D)
+        if padding_idx is not None:
+            pad = padding_idx if padding_idx >= 0 else size[0] + padding_idx
+            emb = emb * (iv != pad)[..., None].astype(emb.dtype)
+        return emb.sum(axis=1)
+    return apply_op(fn, (ids, w))
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms2 (nn.py:539)
+# ---------------------------------------------------------------------------
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """multiclass_nms that can also return the selected box indices
+    (nn.py:539). Delegates to vision.ops.multiclass_nms's fixed-shape
+    padded formulation: out (B, keep_top_k, 6) padded with -1; index
+    (B, keep_top_k) int32 row indices into the per-image box list, -1
+    where padded.
+    """
+    from ...vision.ops import multiclass_nms
+    out, index, _counts = multiclass_nms(
+        bboxes, scores, score_threshold=score_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+        normalized=normalized, nms_eta=nms_eta,
+        background_label=background_label, return_index=True)
+    if return_index:
+        return out, index
+    return out
+
+
+# ---------------------------------------------------------------------------
+# search_pyramid_hash (nn.py:668)
+# ---------------------------------------------------------------------------
+
+def _mix_hash(h, v):
+    """Deterministic 32-bit integer mixing (murmur-style), traceable."""
+    h = (h ^ v) * jnp.uint32(0x9E3779B1)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x85EBCA77)
+    return h ^ (h >> 13)
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent, is_training, use_filter,
+                        white_list_len, black_list_len, seed, lr,
+                        param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None,
+                        distribute_update_vars=None, dtype='float32',
+                        length=None):
+    """Pyramid hash embedding (nn.py:668 / operators/pyramid_hash_op).
+    Dense: ids (B, T) int32. For every n-gram window w in [2, pyramid_layer]
+    starting at t, the id tuple is hashed into ``num_emb // rand_len``
+    slots of a 1-D hash space of size ``space_len``; the gathered rand_len
+    chunks concatenate to one num_emb-dim vector. out[b, t] sums the
+    embeddings of all windows starting at t (zero past ``length``).
+    White/black-list filtering is a PS-side feature served by
+    distributed/ps.py; here ``use_filter`` only validates arguments.
+    """
+    from ...nn.initializer import XavierUniform
+    assert num_emb % rand_len == 0, "num_emb must divide into rand_len chunks"
+    ids = _t(input)
+    w = _op_param([space_len], param_attr, XavierUniform(), 'pyramid_hash_w',
+                  dtype=dtype)
+    tensors = [ids, w]
+    if length is not None:
+        tensors.append(_t(length))
+    n_slots = num_emb // rand_len
+
+    def fn(iv, wv, *rest):
+        if iv.ndim == 3 and iv.shape[-1] == 1:
+            iv = iv[..., 0]
+        B, T = iv.shape
+        iu = iv.astype(jnp.uint32)
+        out = jnp.zeros((B, T, num_emb), wv.dtype)
+        for win in range(2, pyramid_layer + 1):
+            if win > T:
+                break
+            h = jnp.full((B, T - win + 1), jnp.uint32(seed or 1))
+            for j in range(win):
+                h = _mix_hash(h, iu[:, j:T - win + 1 + j])
+            chunks = []
+            for s in range(n_slots):
+                hs = _mix_hash(h, jnp.uint32(s + 101))
+                idx = (hs % jnp.uint32(max(space_len - rand_len, 1))
+                       ).astype(jnp.int32)
+                gather = wv[idx[..., None] + jnp.arange(rand_len)[None, None]]
+                chunks.append(gather)
+            emb = jnp.concatenate(chunks, axis=-1)       # (B, T-w+1, num_emb)
+            out = out.at[:, :T - win + 1, :].add(emb)
+        if rest:
+            tmask = jnp.arange(T)[None, :] < rest[0][:, None]
+            out = out * tmask[..., None].astype(out.dtype)
+        if is_training and drop_out_percent and drop_out_percent > 0:
+            from ...core import rng as _rng
+            key = _rng.next_key()
+            keep = jax.random.bernoulli(
+                key, 1.0 - drop_out_percent / 100.0, out.shape[:2])
+            out = out * keep[..., None].astype(out.dtype)
+        return out
+    return apply_op(fn, tuple(tensors))
+
+
+# ---------------------------------------------------------------------------
+# shuffle_batch (nn.py:784)
+# ---------------------------------------------------------------------------
+
+def shuffle_batch(x, seed=None):
+    """Random permutation of the batch dim (nn.py:784), keyed by the global
+    RNG unless ``seed`` is given."""
+    from ...core import rng as _rng
+    t = _t(x)
+    if seed is None:
+        key = _rng.next_key()
+    else:
+        key = jax.random.PRNGKey(int(seed))
+
+    def fn(v):
+        perm = jax.random.permutation(key, v.shape[0])
+        return v[perm]
+    return apply_op(fn, (t,))
+
+
+# ---------------------------------------------------------------------------
+# partial_concat / partial_sum (nn.py:848 / nn.py:911)
+# ---------------------------------------------------------------------------
+
+def _partial_slices(inputs, start_index, length):
+    ts = [_t(v) for v in inputs] if isinstance(inputs, (list, tuple)) \
+        else [_t(inputs)]
+    outs = []
+    for t in ts:
+        D = t.shape[-1]
+        s = start_index if start_index >= 0 else D + start_index
+        e = D if length < 0 else min(s + length, D)
+        outs.append((t, s, e))
+    return outs
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """Concat the [start:start+length] column slice of every input
+    (nn.py:848)."""
+    sl = _partial_slices(input, start_index, length)
+
+    def fn(*vs):
+        return jnp.concatenate(
+            [v[:, s:e] for v, (_, s, e) in zip(vs, sl)], axis=1)
+    return apply_op(fn, tuple(t for t, _, _ in sl))
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """Sum the [start:start+length] column slice of every input
+    (nn.py:911)."""
+    sl = _partial_slices(input, start_index, length)
+
+    def fn(*vs):
+        acc = None
+        for v, (_, s, e) in zip(vs, sl):
+            piece = v[:, s:e]
+            acc = piece if acc is None else acc + piece
+        return acc
+    return apply_op(fn, tuple(t for t, _, _ in sl))
+
+
+# ---------------------------------------------------------------------------
+# sparse_embedding (nn.py:965) + _pull_box_extended_sparse (nn.py:1443)
+# ---------------------------------------------------------------------------
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False, entry=None,
+                     param_attr=None, dtype='float32'):
+    """Large-scale sparse embedding (nn.py:965). The reference serves this
+    from a parameter server; the TPU-first sharded path is
+    distributed/ps.py::SparseShardedTable. The local functional form is a
+    dense device-resident table lookup with padding_idx masking."""
+    from ...nn.initializer import XavierUniform
+    ids = _t(input)
+    w = _op_param(list(size), param_attr, XavierUniform(),
+                  'sparse_embedding_w', dtype=dtype)
+
+    def fn(iv, wv):
+        squeeze = iv.ndim >= 2 and iv.shape[-1] == 1
+        if squeeze:
+            iv = iv[..., 0]
+        iv = iv.astype(jnp.int32)
+        emb = wv[jnp.clip(iv, 0, size[0] - 1)]
+        if padding_idx is not None:
+            pad = padding_idx if padding_idx >= 0 else size[0] + padding_idx
+            emb = emb * (iv != pad)[..., None].astype(emb.dtype)
+        return emb
+    return apply_op(fn, (ids, w))
+
+
+_BOX_TABLE_SLOTS = 1 << 20
+
+
+def _pull_box_extended_sparse(input, size, extend_size=64, dtype='float32'):
+    """BoxPS extended sparse pull (nn.py:1443): for each id tensor return
+    (embedding, extended embedding). The BoxPS keyed store becomes a
+    fixed-slot device table addressed by id % 2**20."""
+    from ...nn.initializer import XavierUniform
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    w = _op_param([_BOX_TABLE_SLOTS, size], None, XavierUniform(),
+                  'boxps_emb', dtype=dtype)
+    w_ext = _op_param([_BOX_TABLE_SLOTS, extend_size], None, XavierUniform(),
+                      'boxps_emb_ext', dtype=dtype)
+    outs, outs_ext = [], []
+    for t in inputs:
+        ids = _t(t)
+
+        def fn(iv, wv, wev):
+            if iv.ndim >= 2 and iv.shape[-1] == 1:
+                iv = iv[..., 0]
+            slot = (iv.astype(jnp.uint32) % jnp.uint32(_BOX_TABLE_SLOTS)
+                    ).astype(jnp.int32)
+            return wv[slot], wev[slot]
+        e, ee = apply_op(fn, (ids, w, w_ext), n_outputs=2)
+        outs.append(e)
+        outs_ext.append(ee)
+    if len(outs) == 1:
+        return outs[0], outs_ext[0]
+    return outs, outs_ext
+
+
+# ---------------------------------------------------------------------------
+# tdm_child / tdm_sampler (nn.py:1018 / nn.py:1103)
+# ---------------------------------------------------------------------------
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype='int32'):
+    """TDM tree child lookup (nn.py:1018). tree_info rows are
+    [item_id, layer_id, parent_id, child_id x child_nums]; returns the
+    child ids of each input node and a leaf mask (child exists AND its
+    item_id != 0)."""
+    from ...nn.initializer import Constant
+    ids = _t(x)
+    info = _op_param([node_nums, 3 + child_nums], param_attr, Constant(0.0),
+                     'tdm_tree_info', dtype='float32')
+
+    def fn(iv, tv):
+        tv = tv.astype(jnp.int32)
+        squeeze = iv.ndim >= 2 and iv.shape[-1] == 1
+        idx = (iv[..., 0] if squeeze else iv).astype(jnp.int32)
+        children = tv[jnp.clip(idx, 0, node_nums - 1), 3:]      # (B, child)
+        item = tv[jnp.clip(children, 0, node_nums - 1), 0]
+        mask = ((children != 0) & (item != 0))
+        out_dt = jnp.int64 if dtype == 'int64' else jnp.int32
+        return children.astype(out_dt), mask.astype(out_dt)
+    child, leaf_mask = apply_op(fn, (ids, info), n_outputs=2,
+                                differentiable=False)
+    return child, leaf_mask
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
+                tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=True, seed=0,
+                tree_dtype='int32', dtype='int32'):
+    """TDM layer-wise negative sampling (nn.py:1103). The travel table maps
+    each leaf item to its per-layer ancestor path; for every layer the op
+    emits the positive node plus ``neg_samples_num_list[i]`` negatives
+    drawn (without replacement, excluding the positive) from that layer's
+    node list. Irregular sampling runs on host numpy — this op prepares
+    training data, it is not in the compiled step."""
+    from ...nn.initializer import Constant
+    if len(neg_samples_num_list) != len(layer_node_num_list):
+        raise ValueError(
+            "The shape of negative samples list must match the shape of "
+            f"layers. But received len of neg_samples_num_list: "
+            f"{len(neg_samples_num_list)}, and len of layer_node_num_list: "
+            f"{len(layer_node_num_list)}")
+    layer_nums = len(layer_node_num_list)
+    node_nums = int(sum(layer_node_num_list))
+    for i, (neg, tot) in enumerate(zip(neg_samples_num_list,
+                                       layer_node_num_list)):
+        if neg >= tot:
+            raise ValueError(
+                f"The number of negative samples must be less than the "
+                f"number of nodes in the layer {i}, But received negative "
+                f"nums {neg}, and num of node at layer {i} is {tot}")
+    assert leaf_node_num is not None
+    assert leaf_node_num < node_nums
+
+    travel = _op_param([leaf_node_num, layer_nums], tree_travel_attr,
+                       Constant(0.0), 'tdm_travel', dtype='float32')
+    layer_tab = _op_param([node_nums, 1], tree_layer_attr, Constant(0.0),
+                          'tdm_layer', dtype='float32')
+
+    ids = np.asarray(_t(x).numpy()).reshape(-1).astype(np.int64)
+    trav = np.asarray(travel.numpy()).astype(np.int64)
+    layer_flat = np.asarray(layer_tab.numpy()).astype(np.int64).reshape(-1)
+    offsets = np.cumsum([0] + list(layer_node_num_list))
+    rng = np.random.RandomState(seed if seed else None)
+    pos_flag = 1 if output_positive else 0
+
+    B = ids.shape[0]
+    width = sum(n + pos_flag for n in neg_samples_num_list)
+    out = np.zeros((B, width), np.int64)
+    labels = np.zeros((B, width), np.int64)
+    mask = np.ones((B, width), np.int64)
+    for b in range(B):
+        col = 0
+        path = trav[ids[b] % leaf_node_num]
+        for li in range(layer_nums):
+            pos = int(path[li])
+            lo, hi = offsets[li], offsets[li + 1]
+            layer_nodes = layer_flat[lo:hi]
+            if output_positive:
+                out[b, col] = pos
+                labels[b, col] = 1
+                mask[b, col] = 0 if pos == 0 else 1
+                col += 1
+            n_neg = neg_samples_num_list[li]
+            if n_neg > 0:
+                cand = layer_nodes[layer_nodes != pos]
+                if len(cand) >= n_neg:
+                    neg = rng.choice(cand, size=n_neg, replace=False)
+                else:
+                    neg = np.concatenate(
+                        [cand, np.zeros(n_neg - len(cand), np.int64)])
+                out[b, col:col + n_neg] = neg
+                labels[b, col:col + n_neg] = 0
+                mask[b, col:col + n_neg] = np.where(
+                    (neg == 0) | (pos == 0), 0, 1)
+                col += n_neg
+
+    np_dt = np.int64 if dtype == 'int64' else np.int32
+    from ...tensor.creation import to_tensor
+    out_t = to_tensor(out.astype(np_dt))
+    labels_t = to_tensor(labels.astype(np_dt))
+    mask_t = to_tensor(mask.astype(np_dt))
+    if output_list:
+        outs, labs, masks = [], [], []
+        start = 0
+        for n_neg in neg_samples_num_list:
+            end = start + n_neg + pos_flag
+            outs.append(out_t[:, start:end].reshape(
+                [-1, n_neg + pos_flag, 1]))
+            labs.append(labels_t[:, start:end].reshape(
+                [-1, n_neg + pos_flag, 1]))
+            masks.append(mask_t[:, start:end].reshape(
+                [-1, n_neg + pos_flag, 1]))
+            start = end
+        return outs, labs, masks
+    return out_t, labels_t, mask_t
+
+
+# ---------------------------------------------------------------------------
+# rank_attention / batch_fc (nn.py:1312 / nn.py:1380)
+# ---------------------------------------------------------------------------
+
+def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,
+                   max_rank=3, max_size=0):
+    """Rank attention for CTR (nn.py:1312, rank_attention.cu.h): instance i
+    with rank ``lower`` mixes the features of up to max_rank related
+    instances through per-(lower, faster) parameter blocks:
+    out[i] = sum_k X[index_k] @ W[lower*max_rank + faster_k]."""
+    x = _t(input)
+    ro = _t(rank_offset)
+    D = x.shape[1]
+    assert D * max_rank * max_rank == rank_param_shape[0]
+    out_col = rank_param_shape[1]
+    from ...nn.initializer import XavierUniform
+    w = _op_param(list(rank_param_shape), rank_param_attr, XavierUniform(),
+                  'rank_attention_w')
+
+    def fn(xv, rv, wv):
+        rv = rv.astype(jnp.int32)
+        lower = rv[:, 0] - 1                                    # (B,)
+        wb = wv.reshape(max_rank * max_rank, D, out_col)
+        out = jnp.zeros((xv.shape[0], out_col), xv.dtype)
+        for k in range(max_rank):
+            faster = rv[:, 2 * k + 1] - 1
+            index = rv[:, 2 * k + 2]
+            valid = (lower >= 0) & (faster >= 0)
+            xk = xv[jnp.clip(index, 0, xv.shape[0] - 1)] * \
+                valid[:, None].astype(xv.dtype)
+            block = jnp.clip(lower * max_rank + faster, 0,
+                             max_rank * max_rank - 1)
+            wk = wb[block] * valid[:, None, None].astype(wv.dtype)
+            out = out + jnp.einsum('bd,bdo->bo', xk, wk)
+        return out
+    return apply_op(fn, (x, ro, w))
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr, act=None):
+    """Batched FC over slot pairs (nn.py:1380): input (S, B, in) @
+    w (S, in, out) + b (S, out), then activation."""
+    from ...nn.initializer import XavierUniform, Constant
+    x = _t(input)
+    assert x.shape[0] == param_size[0] and x.shape[2] == param_size[1]
+    assert param_size[2] == bias_size[1] and x.shape[0] == bias_size[0]
+    w = _op_param(list(param_size), param_attr, XavierUniform(), 'batch_fc_w')
+    b = _op_param(list(bias_size), bias_attr, Constant(0.0), 'batch_fc_b')
+
+    def fn(xv, wv, bv):
+        return jnp.einsum('sbi,sio->sbo', xv, wv) + bv[:, None, :]
+    return _act(apply_op(fn, (x, w, b)), act)
+
+
+# ---------------------------------------------------------------------------
+# bilateral_slice (nn.py:1490) — HDRNet bilateral grid apply
+# ---------------------------------------------------------------------------
+
+def bilateral_slice(x, guide, grid, has_offset, name=None):
+    """Bilateral-grid slice + affine apply (nn.py:1490,
+    operators/bilateral_slice_op). x (N,C,H,W), guide (N,H,W) in [0,1],
+    grid (N, gc, gd, gh, gw). Coefficients are trilinearly sampled at
+    (gx, gy, guide*gd) with tent weights; with offset the grid packs
+    (C+1) affine coefficients per output channel."""
+    def fn(xv, gv, grv):
+        N, C, H, W = xv.shape
+        _, gc, gd, gh, gw = grv.shape
+        if has_offset:
+            out_c = gc // (C + 1)
+            coeff_stride = C + 1
+        else:
+            out_c = gc // C
+            coeff_stride = C
+        gx = (jnp.arange(W) + 0.5) * gw / W                    # (W,)
+        gy = (jnp.arange(H) + 0.5) * gh / H                    # (H,)
+        gz = gv * gd                                           # (N,H,W)
+
+        def tent(dist):
+            return jnp.maximum(1.0 - jnp.abs(dist), 0.0)
+
+        fx = jnp.floor(gx - 0.5)
+        fy = jnp.floor(gy - 0.5)
+        fz = jnp.floor(gz - 0.5)
+        acc = jnp.zeros((N, gc, H, W), xv.dtype)
+        for dz in (0, 1):
+            for dy in (0, 1):
+                for dx in (0, 1):
+                    xi = jnp.clip(fx + dx, 0, gw - 1).astype(jnp.int32)
+                    yi = jnp.clip(fy + dy, 0, gh - 1).astype(jnp.int32)
+                    zi = jnp.clip(fz + dz, 0, gd - 1).astype(jnp.int32)
+                    wx = tent(gx - 0.5 - (fx + dx))            # (W,)
+                    wy = tent(gy - 0.5 - (fy + dy))            # (H,)
+                    wz = tent(gz - 0.5 - (fz + dz))            # (N,H,W)
+                    # gather grid[n, :, zi[n,h,w], yi[h], xi[w]]
+                    g_yx = grv[:, :, :, yi][:, :, :, :, xi]    # (N,gc,gd,H,W)
+                    g = jnp.take_along_axis(
+                        g_yx, zi[:, None, None, :, :].astype(jnp.int32),
+                        axis=2)[:, :, 0]                       # (N,gc,H,W)
+                    wgt = (wz * wy[None, :, None] * wx[None, None, :])
+                    acc = acc + g * wgt[:, None]
+        coeff = acc                                            # (N,gc,H,W)
+        if has_offset:
+            cf = coeff.reshape(N, out_c, coeff_stride, H, W)
+            out = jnp.einsum('nochw,nchw->nohw', cf[:, :, :C], xv) + \
+                cf[:, :, C]
+        else:
+            cf = coeff.reshape(N, out_c, C, H, W)
+            out = jnp.einsum('nochw,nchw->nohw', cf, xv)
+        return out
+    return apply_op(fn, (_t(x), _t(guide), _t(grid)))
+
+
+# ---------------------------------------------------------------------------
+# correlation (nn.py:1552) — FlowNet correlation layer
+# ---------------------------------------------------------------------------
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1):
+    """FlowNetC correlation volume (nn.py:1552, operators/correlation_op):
+    cost between x patches and displaced y patches, averaged over the
+    kernel window and channels. Output
+    (N, ((2*max_displacement//stride2)+1)^2, out_h, out_w)."""
+    def fn(xv, yv):
+        N, C, H, W = xv.shape
+        kr = (kernel_size - 1) // 2
+        border = max_displacement + kr
+        xp = jnp.pad(xv, ((0, 0), (0, 0), (pad_size, pad_size),
+                          (pad_size, pad_size)))
+        yp = jnp.pad(yv, ((0, 0), (0, 0), (pad_size, pad_size),
+                          (pad_size, pad_size)))
+        Hp, Wp = H + 2 * pad_size, W + 2 * pad_size
+        out_h = int(math.ceil((Hp - 2 * border) / float(stride1)))
+        out_w = int(math.ceil((Wp - 2 * border) / float(stride1)))
+        gr = max_displacement // stride2
+        gwid = 2 * gr + 1
+        sumelems = kernel_size * kernel_size * C
+        rows = []
+        for dj in range(-gr, gr + 1):
+            for di in range(-gr, gr + 1):
+                oy, ox = dj * stride2, di * stride2
+                acc = jnp.zeros((N, out_h, out_w), xv.dtype)
+                for kj in range(-kr, kr + 1):
+                    for ki in range(-kr, kr + 1):
+                        x_sl = lax.slice(
+                            xp, (0, 0, border + kj, border + ki),
+                            (N, C, border + kj + (out_h - 1) * stride1 + 1,
+                             border + ki + (out_w - 1) * stride1 + 1),
+                            (1, 1, stride1, stride1))
+                        y_sl = lax.slice(
+                            yp, (0, 0, border + oy + kj, border + ox + ki),
+                            (N, C,
+                             border + oy + kj + (out_h - 1) * stride1 + 1,
+                             border + ox + ki + (out_w - 1) * stride1 + 1),
+                            (1, 1, stride1, stride1))
+                        acc = acc + (x_sl * y_sl).sum(axis=1)
+                rows.append(acc / sumelems)
+        return jnp.stack(rows, axis=1)
+    return apply_op(fn, (_t(x), _t(y)))
+
+
+# ---------------------------------------------------------------------------
+# rnn_impl.py: BasicGRUUnit / basic_gru / BasicLSTMUnit / basic_lstm
+# ---------------------------------------------------------------------------
+
+from ...nn.layer_base import Layer  # noqa: E402
+
+
+class BasicGRUUnit(Layer):
+    """Single-step GRU cell with the fluid-era gate layout
+    (rnn_impl.py:22): one fused gate matmul for [r, u], a separate
+    candidate matmul over [x, r*h]."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype='float32'):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_activation = gate_activation or jax.nn.sigmoid
+        self._activation = activation or jnp.tanh
+        self._dtype = dtype
+        self._built = False
+
+    def _build_once(self, input):
+        from ...nn.initializer import XavierUniform, Constant
+        in_size = input.shape[-1]
+        H = self._hidden_size
+        self.gate_weight = _op_param(
+            [in_size + H, 2 * H], self._param_attr, XavierUniform(),
+            'gru_gate_w', dtype=self._dtype)
+        self.candidate_weight = _op_param(
+            [in_size + H, H], self._param_attr, XavierUniform(),
+            'gru_cand_w', dtype=self._dtype)
+        self.gate_bias = _op_param([2 * H], self._bias_attr, Constant(0.0),
+                                   'gru_gate_b', dtype=self._dtype)
+        self.candidate_bias = _op_param([H], self._bias_attr, Constant(0.0),
+                                        'gru_cand_b', dtype=self._dtype)
+        self._built = True
+
+    def forward(self, input, pre_hidden):
+        if not self._built:
+            self._build_once(input)
+        gact, act, H = self._gate_activation, self._activation, \
+            self._hidden_size
+
+        def fn(xv, hv, gw, gb, cw, cb):
+            gate_in = jnp.concatenate([xv, hv], -1) @ gw + gb
+            gate_in = gact(gate_in)
+            r, u = gate_in[..., :H], gate_in[..., H:]
+            cand = jnp.concatenate([xv, r * hv], -1) @ cw + cb
+            c = act(cand)
+            return u * hv + (1 - u) * c
+        return apply_op(fn, (_t(input), _t(pre_hidden), self.gate_weight,
+                             self.gate_bias, self.candidate_weight,
+                             self.candidate_bias))
+
+
+class BasicLSTMUnit(Layer):
+    """Single-step LSTM cell with a single fused [i, j, f, o] matmul and a
+    forget-gate bias (rnn_impl.py:22)."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype='float32'):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_activation = gate_activation or jax.nn.sigmoid
+        self._activation = activation or jnp.tanh
+        self._forget_bias = float(forget_bias)
+        self._dtype = dtype
+        self._built = False
+
+    def _build_once(self, input):
+        from ...nn.initializer import XavierUniform, Constant
+        in_size = input.shape[-1]
+        H = self._hidden_size
+        self.weight = _op_param([in_size + H, 4 * H], self._param_attr,
+                                XavierUniform(), 'lstm_w', dtype=self._dtype)
+        self.bias = _op_param([4 * H], self._bias_attr, Constant(0.0),
+                              'lstm_b', dtype=self._dtype)
+        self._built = True
+
+    def forward(self, input, pre_hidden, pre_cell):
+        if not self._built:
+            self._build_once(input)
+        gact, act = self._gate_activation, self._activation
+        H, fb = self._hidden_size, self._forget_bias
+
+        def fn(xv, hv, cv, wv, bv):
+            gate = jnp.concatenate([xv, hv], -1) @ wv + bv
+            i, j, f, o = (gate[..., :H], gate[..., H:2 * H],
+                          gate[..., 2 * H:3 * H], gate[..., 3 * H:])
+            new_cell = cv * gact(f + fb) + gact(i) * act(j)
+            new_hidden = act(new_cell) * gact(o)
+            return new_hidden, new_cell
+        return apply_op(fn, (_t(input), _t(pre_hidden), _t(pre_cell),
+                             self.weight, self.bias), n_outputs=2)
+
+
+def _run_rnn(step_params, x, h0, seq_mask, reverse, step_fn):
+    """lax.scan over time with sequence masking: past a sample's length the
+    carried state freezes and the emitted output is zero."""
+    T = x.shape[0]
+    xs = (jnp.flip(x, 0), jnp.flip(seq_mask, 0)) if reverse \
+        else (x, seq_mask)
+
+    def body(carry, inp):
+        xt, mt = inp
+        new = step_fn(step_params, xt, carry)
+        m = mt[:, None]
+        frozen = jax.tree_util.tree_map(
+            lambda n, c: m * n + (1 - m) * c, new, carry)
+        out = jax.tree_util.tree_leaves(frozen)[0] * m
+        return frozen, out
+    last, outs = lax.scan(body, h0, xs)
+    if reverse:
+        outs = jnp.flip(outs, 0)
+    return outs, last
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=False, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype='float32',
+              name='basic_gru'):
+    """Multi-layer (bi)directional GRU built from BasicGRUUnit cells via
+    lax.scan (rnn_impl.py:164). Returns (rnn_out, last_hidden);
+    last_hidden is (num_layers*num_directions, B, hidden_size)."""
+    from ...nn.initializer import XavierUniform, Constant
+    x = _t(input)
+    if batch_first:
+        x = x.transpose([1, 0, 2])
+    T, B = x.shape[0], x.shape[1]
+    directions = 2 if bidirectional else 1
+
+    params = []
+    in_size = x.shape[-1]
+    for layer in range(num_layers):
+        per_dir = []
+        for d in range(directions):
+            gw = _op_param([in_size + hidden_size, 2 * hidden_size],
+                           param_attr, XavierUniform(),
+                           f'{name}_l{layer}d{d}_gate_w', dtype=dtype)
+            cw = _op_param([in_size + hidden_size, hidden_size], param_attr,
+                           XavierUniform(), f'{name}_l{layer}d{d}_cand_w',
+                           dtype=dtype)
+            gb = _op_param([2 * hidden_size], bias_attr, Constant(0.0),
+                           f'{name}_l{layer}d{d}_gate_b', dtype=dtype)
+            cb = _op_param([hidden_size], bias_attr, Constant(0.0),
+                           f'{name}_l{layer}d{d}_cand_b', dtype=dtype)
+            per_dir.append((gw, gb, cw, cb))
+        params.append(per_dir)
+        in_size = hidden_size * directions
+
+    gact = gate_activation or jax.nn.sigmoid
+    act = activation or jnp.tanh
+    drop_keys = None
+    if dropout_prob and dropout_prob > 0 and num_layers > 1:
+        from ...core import rng as _rng
+        drop_keys = [_rng.next_key() for _ in range(num_layers - 1)]
+    flat_params = [p for layer in params for d in layer for p in d]
+    tensors = [x] + flat_params
+    if init_hidden is not None:
+        tensors.append(_t(init_hidden))
+    if sequence_length is not None:
+        tensors.append(_t(sequence_length))
+
+    def step(p, xt, h):
+        gw, gb, cw, cb = p
+        gate_in = gact(jnp.concatenate([xt, h], -1) @ gw + gb)
+        r, u = gate_in[..., :hidden_size], gate_in[..., hidden_size:]
+        c = act(jnp.concatenate([xt, r * h], -1) @ cw + cb)
+        return u * h + (1 - u) * c
+
+    def fn(xv, *rest):
+        rest = list(rest)
+        n_p = num_layers * directions * 4
+        ps = rest[:n_p]
+        rest = rest[n_p:]
+        h0_all = None
+        if init_hidden is not None:
+            h0_all = rest.pop(0)
+            h0_all = h0_all.reshape(num_layers, directions, B, hidden_size)
+        if sequence_length is not None:
+            sl = rest.pop(0)
+            mask = (jnp.arange(T)[:, None] < sl[None, :]).astype(xv.dtype)
+        else:
+            mask = jnp.ones((T, B), xv.dtype)
+        inp = xv
+        lasts = []
+        pi = 0
+        for layer in range(num_layers):
+            outs_d = []
+            for d in range(directions):
+                p = tuple(ps[pi:pi + 4])
+                pi += 4
+                h0 = h0_all[layer, d] if h0_all is not None else \
+                    jnp.zeros((B, hidden_size), xv.dtype)
+                outs, last = _run_rnn(p, inp, h0, mask, d == 1, step)
+                outs_d.append(outs)
+                lasts.append(last)
+            inp = outs_d[0] if directions == 1 else \
+                jnp.concatenate(outs_d, -1)
+            if drop_keys is not None and layer < num_layers - 1:
+                # inter-layer dropout, upscale_in_train semantics
+                # (rnn_impl.py:164 applies layers.dropout between layers)
+                keep = jax.random.bernoulli(
+                    drop_keys[layer], 1.0 - dropout_prob, inp.shape)
+                inp = inp * keep.astype(inp.dtype) / (1.0 - dropout_prob)
+        last_hidden = jnp.stack(lasts, 0)
+        return inp, last_hidden
+
+    out, last_hidden = apply_op(fn, tuple(tensors), n_outputs=2)
+    if batch_first:
+        out = out.transpose([1, 0, 2])
+    return out, last_hidden
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=False, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, forget_bias=1.0,
+               dtype='float32', name='basic_lstm'):
+    """Multi-layer (bi)directional LSTM via lax.scan (rnn_impl.py:405).
+    Returns (rnn_out, last_hidden, last_cell)."""
+    from ...nn.initializer import XavierUniform, Constant
+    x = _t(input)
+    if batch_first:
+        x = x.transpose([1, 0, 2])
+    T, B = x.shape[0], x.shape[1]
+    directions = 2 if bidirectional else 1
+
+    params = []
+    in_size = x.shape[-1]
+    for layer in range(num_layers):
+        for d in range(directions):
+            w = _op_param([in_size + hidden_size, 4 * hidden_size],
+                          param_attr, XavierUniform(),
+                          f'{name}_l{layer}d{d}_w', dtype=dtype)
+            b = _op_param([4 * hidden_size], bias_attr, Constant(0.0),
+                          f'{name}_l{layer}d{d}_b', dtype=dtype)
+            params += [w, b]
+        in_size = hidden_size * directions
+
+    gact = gate_activation or jax.nn.sigmoid
+    act = activation or jnp.tanh
+    fb = float(forget_bias)
+    H = hidden_size
+    drop_keys = None
+    if dropout_prob and dropout_prob > 0 and num_layers > 1:
+        from ...core import rng as _rng
+        drop_keys = [_rng.next_key() for _ in range(num_layers - 1)]
+    tensors = [x] + params
+    if init_hidden is not None:
+        tensors.append(_t(init_hidden))
+    if init_cell is not None:
+        tensors.append(_t(init_cell))
+    if sequence_length is not None:
+        tensors.append(_t(sequence_length))
+
+    def step(p, xt, carry):
+        w, b = p
+        h, c = carry
+        gate = jnp.concatenate([xt, h], -1) @ w + b
+        i, j, f, o = (gate[..., :H], gate[..., H:2 * H],
+                      gate[..., 2 * H:3 * H], gate[..., 3 * H:])
+        nc = c * gact(f + fb) + gact(i) * act(j)
+        nh = act(nc) * gact(o)
+        return (nh, nc)
+
+    def fn(xv, *rest):
+        rest = list(rest)
+        n_p = num_layers * directions * 2
+        ps = rest[:n_p]
+        rest = rest[n_p:]
+        h0_all = c0_all = None
+        if init_hidden is not None:
+            h0_all = rest.pop(0).reshape(num_layers, directions, B, H)
+        if init_cell is not None:
+            c0_all = rest.pop(0).reshape(num_layers, directions, B, H)
+        if sequence_length is not None:
+            sl = rest.pop(0)
+            mask = (jnp.arange(T)[:, None] < sl[None, :]).astype(xv.dtype)
+        else:
+            mask = jnp.ones((T, B), xv.dtype)
+        inp = xv
+        last_h, last_c = [], []
+        pi = 0
+        for layer in range(num_layers):
+            outs_d = []
+            for d in range(directions):
+                p = tuple(ps[pi:pi + 2])
+                pi += 2
+                h0 = h0_all[layer, d] if h0_all is not None else \
+                    jnp.zeros((B, H), xv.dtype)
+                c0 = c0_all[layer, d] if c0_all is not None else \
+                    jnp.zeros((B, H), xv.dtype)
+                outs, (lh, lc) = _run_rnn(p, inp, (h0, c0), mask,
+                                          d == 1, step)
+                outs_d.append(outs)
+                last_h.append(lh)
+                last_c.append(lc)
+            inp = outs_d[0] if directions == 1 else \
+                jnp.concatenate(outs_d, -1)
+            if drop_keys is not None and layer < num_layers - 1:
+                keep = jax.random.bernoulli(
+                    drop_keys[layer], 1.0 - dropout_prob, inp.shape)
+                inp = inp * keep.astype(inp.dtype) / (1.0 - dropout_prob)
+        return inp, jnp.stack(last_h, 0), jnp.stack(last_c, 0)
+
+    out, last_hidden, last_cell = apply_op(fn, tuple(tensors), n_outputs=3)
+    if batch_first:
+        out = out.transpose([1, 0, 2])
+    return out, last_hidden, last_cell
+
+
+# ---------------------------------------------------------------------------
+# metric_op.py: ctr_metric_bundle
+# ---------------------------------------------------------------------------
+
+def ctr_metric_bundle(input, label):
+    """CTR metric partial sums (metric_op.py:30): returns the batch-local
+    (sqrerr, abserr, prob, q, pos_num, ins_num) — the caller all_reduces
+    these and divides by instance count, exactly like the reference's
+    persistable accumulators. Eager divergence: sums are per-call; callers
+    accumulate across steps themselves (the reference mutates persistable
+    scope vars)."""
+    def fn(iv, lv):
+        lv = lv.astype(iv.dtype)
+        diff = iv - lv
+        sqrerr = (diff * diff).sum().reshape(1)
+        abserr = jnp.abs(diff).sum().reshape(1)
+        prob = iv.sum().reshape(1)
+        q = jax.nn.sigmoid(iv).sum().reshape(1)
+        pos = lv.sum().reshape(1)
+        ins = jnp.asarray([iv.shape[0]], iv.dtype)
+        return sqrerr, abserr, prob, q, pos, ins
+    return apply_op(fn, (_t(input), _t(label)), n_outputs=6,
+                    differentiable=False)
+
+
+# reference submodule paths: contrib.layers.nn / .rnn_impl / .metric_op
+nn = sys.modules[__name__]
+rnn_impl = sys.modules[__name__]
+metric_op = sys.modules[__name__]

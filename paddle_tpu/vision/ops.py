@@ -411,12 +411,14 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
     Parity: fluid.layers.multiclass_nms (detection.py:3257), TPU-first:
     returns `out` of shape (B, keep_top_k, 6) [label, score, x1, y1, x2, y2]
     padded with -1 rows, plus `valid_counts` (B,) — instead of the
-    reference's LoD tensor. bboxes: (B, M, 4); scores: (B, C, M).
+    reference's LoD tensor. bboxes: (B, M, 4); scores: (B, C, M). With
+    ``return_index`` also returns the selected per-image box row indices
+    (B, keep_top_k) int32, -1 where padded (the multiclass_nms2 contract).
     """
     def fn(bb, sc):
         B, M, _ = bb.shape
         C = sc.shape[1]
-        k = min(nms_top_k, M)
+        k = min(nms_top_k, M) if nms_top_k > 0 else M
 
         def per_image(boxes, scores_cm):
             if background_label >= 0:
@@ -428,30 +430,37 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
                     boxes, scores_c, nms_threshold, k, score_threshold,
                     normalized)
                 s = jnp.where(alive, scores_c[order], -jnp.inf)
-                return s, boxes[order]
+                return s, boxes[order], jnp.where(alive, order, -1)
 
-            ss, bsel = jax.vmap(per_class)(scores_cm)      # (C,k), (C,k,4)
+            ss, bsel, osel = jax.vmap(per_class)(scores_cm)  # (C,k) ...
             labels = jnp.broadcast_to(
                 jnp.arange(C, dtype=boxes.dtype)[:, None], (C, k))
             allc = jnp.concatenate(
                 [labels[..., None], ss[..., None], bsel],
                 axis=-1).reshape(C * k, 6)
+            flat_idx = osel.reshape(C * k)
             kk = min(keep_top_k, C * k)
             top = jnp.argsort(-allc[:, 1])[:kk]
             sel = allc[top]
+            idx = flat_idx[top]
             valid = jnp.isfinite(sel[:, 1])
             sel = jnp.where(valid[:, None], sel, -1.0)
+            idx = jnp.where(valid, idx, -1).astype(jnp.int32)
             count = jnp.sum(valid.astype(jnp.int32))
             pad = keep_top_k - kk
             if pad > 0:
                 sel = jnp.concatenate(
                     [sel, jnp.full((pad, 6), -1.0, sel.dtype)], axis=0)
-            return sel, count
+                idx = jnp.concatenate(
+                    [idx, jnp.full((pad,), -1, jnp.int32)], axis=0)
+            return sel, idx, count
 
-        sel, counts = jax.vmap(per_image)(bb, sc)
-        return sel, counts
+        return jax.vmap(per_image)(bb, sc)
 
-    return apply_op(fn, (_t(bboxes), _t(scores)), n_outputs=2)
+    sel, idx, counts = apply_op(fn, (_t(bboxes), _t(scores)), n_outputs=3)
+    if return_index:
+        return sel, idx, counts
+    return sel, counts
 
 
 # ---------------------------------------------------------------------------

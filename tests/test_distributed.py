@@ -144,6 +144,44 @@ def test_fleet_metrics_multiworker_string_ops(data_mesh, monkeypatch):
     assert dmetrics.max(np.array([3.0])) == pytest.approx(3.0)
 
 
+def test_eager_all_reduce_nonleading_dim_sharding_reduces():
+    # Value partitioned over the reduce axis along dim 1 (not dim 0) must
+    # still reduce its distinct shards, not take the replicated closed form.
+    mesh = _mesh('data')
+    denv.set_mesh(mesh)
+    try:
+        arr = jax.device_put(
+            jnp.arange(32.0).reshape(4, 8),
+            NamedSharding(mesh, P(None, 'data')))
+        out = collective.all_reduce(Tensor(arr)).numpy()
+        # each width-1 column shard sums across the 8 shards: every column
+        # of row r becomes sum(row r), i.e. 8r*8/... = row sum replicated
+        expect = np.repeat(
+            np.arange(32.0).reshape(4, 8).sum(1, keepdims=True), 8, 1)
+        np.testing.assert_allclose(out, expect)
+    finally:
+        denv.set_mesh(None)
+        denv._global['initialized'] = False
+
+
+def test_fleet_metrics_multiaxis_mesh_uses_data_axis(monkeypatch):
+    # n_workers must be compared against the axis actually reduced (the data
+    # axis), not the total mesh size: Mesh (4,2) with trainers=8 used to
+    # "match" on 8 total devices but reduce over only 4.
+    from paddle_tpu.distributed import metrics as dmetrics
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(4, 2),
+                ('data', 'model'))
+    denv.set_mesh(mesh)
+    try:
+        monkeypatch.setenv('PADDLE_TRAINERS_NUM', '8')
+        denv._global['initialized'] = True
+        # mesh data axis is 4 != 8 workers -> closed form scales by 8
+        assert dmetrics.sum(np.array([1.0, 2.0])) == pytest.approx(24.0)
+    finally:
+        denv.set_mesh(None)
+        denv._global['initialized'] = False
+
+
 def test_eager_all_reduce_other_axis_sharding_uses_closed_form():
     # A value sharded over a *different* mesh axis (or a non-leading dim) is
     # replicated w.r.t. 'data'; it must take the closed form, not get chunk-
