@@ -90,6 +90,12 @@ class Executor:
         if not isinstance(fetch_list, (list, tuple)):
             fetch_list = [fetch_list]
 
+        from .fluid_format import FluidProgram
+        if isinstance(program, FluidProgram):
+            # a translated reference-format (Paddle 1.8) inference program:
+            # run its jitted forward with the canonical exe.run signature
+            return program.run(feed, fetch_list=fetch_list or None)
+
         # startup program: params were initialized eagerly at creation — no-op
         if not program.global_block.ops and not fetch_list:
             return []

@@ -1,0 +1,569 @@
+"""Reference (Paddle 1.8 fluid) checkpoint/inference-model format interop.
+
+Parity targets:
+- LoDTensor binary serialization: paddle/fluid/framework/lod_tensor.cc:246
+  (SerializeToStream) + tensor_util.cc:620 (TensorToStream): uint32 version,
+  LoD levels, then a Tensor record (uint32 version, int32-length-prefixed
+  VarType.TensorDesc protobuf, raw data bytes).
+- save/load var files: python/paddle/fluid/io.py:141 (save_vars writes one
+  LoDTensor file per var, or one save_combine file holding them
+  back-to-back in list order — operators/save_combine_op.h).
+- __model__: a framework.proto ProgramDesc protobuf
+  (paddle/fluid/framework/framework.proto:212).
+
+TPU-first: nothing here touches a ProgramDesc at runtime — the parsed
+program is translated ONCE into a closed jnp forward function (one XLA
+computation), and weights become device arrays. The protobuf layer is a
+minimal generic wire-format reader/writer (no protoc dependency); field
+numbers are cited from framework.proto.
+"""
+import struct
+
+import numpy as np
+
+__all__ = ['load_fluid_lod_tensor', 'load_fluid_persistables',
+           'load_fluid_inference_model', 'parse_program_desc',
+           'FluidProgram', 'save_fluid_lod_tensor']
+
+# framework.proto VarType.Type enum (framework.proto:105)
+_FLUID_DTYPES = {0: np.bool_, 1: np.int16, 2: np.int32, 3: np.int64,
+                 4: np.float16, 5: np.float32, 6: np.float64,
+                 20: np.uint8, 21: np.int8}
+_FLUID_DTYPE_OF = {np.dtype(v).name: k for k, v in _FLUID_DTYPES.items()}
+
+
+# ---------------------------------------------------------------------------
+# generic protobuf wire format (proto2), reader + writer
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _parse_fields(buf):
+    """Parse a protobuf message into {field_number: [raw values]} where a
+    raw value is an int (varint/fixed) or bytes (length-delimited)."""
+    fields = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        fnum, wtype = key >> 3, key & 7
+        if wtype == 0:                       # varint
+            val, pos = _read_varint(buf, pos)
+        elif wtype == 1:                     # 64-bit
+            val = struct.unpack_from('<q', buf, pos)[0]
+            pos += 8
+        elif wtype == 2:                     # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            val = bytes(buf[pos:pos + ln])
+            pos += ln
+        elif wtype == 5:                     # 32-bit
+            val = struct.unpack_from('<i', buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wtype}")
+        fields.setdefault(fnum, []).append(val)
+    return fields
+
+
+def _varints(raw_list):
+    """Decode a repeated int64/int32 field that may be packed or unpacked."""
+    out = []
+    for v in raw_list:
+        if isinstance(v, int):
+            out.append(v)
+        else:  # packed: length-delimited run of varints
+            pos = 0
+            while pos < len(v):
+                x, pos = _read_varint(v, pos)
+                out.append(x)
+    return [x - (1 << 64) if x >= (1 << 63) else x for x in out]
+
+
+def _write_varint(out, value):
+    if value < 0:
+        value += 1 << 64
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _emit(out, fnum, wtype, payload):
+    _write_varint(out, (fnum << 3) | wtype)
+    if wtype == 0:
+        _write_varint(out, payload)
+    elif wtype == 2:
+        _write_varint(out, len(payload))
+        out.extend(payload)
+    else:
+        raise ValueError(wtype)
+
+
+def _msg(pairs):
+    """Encode [(field_num, wire_type, value_or_bytes), ...] to bytes."""
+    out = bytearray()
+    for fnum, wtype, val in pairs:
+        _emit(out, fnum, wtype, val)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# LoDTensor binary records (lod_tensor.cc:246 / tensor_util.cc:620)
+# ---------------------------------------------------------------------------
+
+def load_fluid_lod_tensor(stream):
+    """Read ONE LoDTensor record from a binary stream; returns (ndarray,
+    lod) where lod is a list of per-level offset lists."""
+    version = struct.unpack('<I', stream.read(4))[0]
+    if version != 0:
+        raise ValueError(f"unsupported LoDTensor version {version}")
+    lod_level = struct.unpack('<Q', stream.read(8))[0]
+    lod = []
+    for _ in range(lod_level):
+        nbytes = struct.unpack('<Q', stream.read(8))[0]
+        lod.append(list(np.frombuffer(stream.read(nbytes), np.uint64)))
+    t_version = struct.unpack('<I', stream.read(4))[0]
+    if t_version != 0:
+        raise ValueError(f"unsupported Tensor version {t_version}")
+    desc_size = struct.unpack('<i', stream.read(4))[0]
+    desc = _parse_fields(stream.read(desc_size))
+    dtype = _FLUID_DTYPES[desc[1][0]]            # TensorDesc.data_type = 1
+    dims = _varints(desc.get(2, []))             # TensorDesc.dims = 2
+    count = int(np.prod(dims)) if dims else 1
+    data = stream.read(count * np.dtype(dtype).itemsize)
+    arr = np.frombuffer(data, dtype).reshape(dims).copy()
+    return arr, lod
+
+
+def save_fluid_lod_tensor(stream, array, lod=()):
+    """Write ONE LoDTensor record in the reference layout (used by the
+    round-trip tests and the committed fixture generator)."""
+    array = np.ascontiguousarray(array)
+    stream.write(struct.pack('<I', 0))
+    stream.write(struct.pack('<Q', len(lod)))
+    for level in lod:
+        level = np.asarray(level, np.uint64)
+        stream.write(struct.pack('<Q', level.nbytes))
+        stream.write(level.tobytes())
+    stream.write(struct.pack('<I', 0))
+    desc = bytearray()
+    _emit(desc, 1, 0, _FLUID_DTYPE_OF[array.dtype.name])
+    for d in array.shape:
+        _emit(desc, 2, 0, int(d))
+    stream.write(struct.pack('<i', len(desc)))
+    stream.write(bytes(desc))
+    stream.write(array.tobytes())
+
+
+# ---------------------------------------------------------------------------
+# ProgramDesc parsing (framework.proto:212)
+# ---------------------------------------------------------------------------
+
+def _parse_attr(buf):
+    """OpDesc.Attr (framework.proto:44)."""
+    f = _parse_fields(buf)
+    name = f[1][0].decode()
+    atype = f[2][0]
+    # AttrType enum: INT=0 FLOAT=1 STRING=2 INTS=3 FLOATS=4 STRINGS=5
+    # BOOLEAN=6 BOOLEANS=7 BLOCK=8 LONG=9 BLOCKS=10 LONGS=11
+    if atype == 0:
+        val = _varints(f[3])[0]
+    elif atype == 1:
+        raw = f[4][0]
+        val = struct.unpack('<f', struct.pack('<i', raw))[0] \
+            if isinstance(raw, int) else raw
+    elif atype == 2:
+        val = f[5][0].decode()
+    elif atype == 3:
+        val = [int(np.int32(v)) for v in _varints(f.get(6, []))]
+    elif atype == 4:
+        vals = []
+        for raw in f.get(7, []):
+            if isinstance(raw, bytes):   # packed floats
+                vals.extend(np.frombuffer(raw, '<f4').tolist())
+            else:
+                vals.append(struct.unpack('<f', struct.pack('<i', raw))[0])
+        val = vals
+    elif atype == 5:
+        val = [s.decode() for s in f.get(8, [])]
+    elif atype == 6:
+        val = bool(f[10][0])
+    elif atype == 7:
+        val = [bool(v) for v in _varints(f.get(11, []))]
+    elif atype == 9:
+        val = _varints(f[13])[0]
+    elif atype == 11:
+        val = _varints(f.get(15, []))
+    else:                               # BLOCK/BLOCKS: keep raw index
+        val = _varints(f.get(12, []) + f.get(14, []))
+    return name, val
+
+
+def _parse_op(buf):
+    f = _parse_fields(buf)
+    op = {'type': f[3][0].decode(), 'inputs': {}, 'outputs': {}, 'attrs': {}}
+    for which, key in ((1, 'inputs'), (2, 'outputs')):
+        for raw in f.get(which, []):
+            vf = _parse_fields(raw)
+            pname = vf[1][0].decode()
+            op[key][pname] = [a.decode() for a in vf.get(2, [])]
+    for raw in f.get(4, []):
+        name, val = _parse_attr(raw)
+        op['attrs'][name] = val
+    return op
+
+
+def _parse_var(buf):
+    f = _parse_fields(buf)
+    var = {'name': f[1][0].decode(),
+           'persistable': bool(_varints(f.get(3, [0]))[0]),
+           'shape': None, 'dtype': None}
+    tf = _parse_fields(f[2][0])                  # VarDesc.type (VarType)
+    var['type_id'] = _varints(tf.get(1, [7]))[0]
+    lod_raw = tf.get(3, [])                      # VarType.lod_tensor = 3
+    if lod_raw:
+        lt = _parse_fields(lod_raw[0])
+        td = _parse_fields(lt[1][0])             # LoDTensorDesc.tensor = 1
+        var['dtype'] = _FLUID_DTYPES.get(_varints(td.get(1, [5]))[0])
+        var['shape'] = _varints(td.get(2, []))
+    return var
+
+
+def parse_program_desc(data):
+    """Parse a serialized framework.proto ProgramDesc into
+    {'blocks': [{'vars': {name: var}, 'ops': [op]}]}."""
+    f = _parse_fields(data)
+    blocks = []
+    for raw in f.get(1, []):                     # ProgramDesc.blocks = 1
+        bf = _parse_fields(raw)
+        vars_ = {}
+        for vraw in bf.get(3, []):               # BlockDesc.vars = 3
+            v = _parse_var(vraw)
+            vars_[v['name']] = v
+        ops = [_parse_op(oraw) for oraw in bf.get(4, [])]  # BlockDesc.ops=4
+        blocks.append({'vars': vars_, 'ops': ops})
+    return {'blocks': blocks}
+
+
+# ---------------------------------------------------------------------------
+# ProgramDesc -> jnp forward translator
+# ---------------------------------------------------------------------------
+
+def _op_handlers():
+    import jax
+    import jax.numpy as jnp
+
+    def _mul(env, op):
+        x, y = env[op['inputs']['X'][0]], env[op['inputs']['Y'][0]]
+        xnc = op['attrs'].get('x_num_col_dims', 1)
+        x2 = x.reshape(int(np.prod(x.shape[:xnc])), -1)
+        out = x2 @ y.reshape(y.shape[0], -1)
+        env[op['outputs']['Out'][0]] = out.reshape(
+            tuple(x.shape[:xnc]) + tuple(y.shape[1:]))
+
+    def _matmul(env, op):
+        x, y = env[op['inputs']['X'][0]], env[op['inputs']['Y'][0]]
+        if op['attrs'].get('transpose_X'):
+            x = jnp.swapaxes(x, -1, -2)
+        if op['attrs'].get('transpose_Y'):
+            y = jnp.swapaxes(y, -1, -2)
+        out = jnp.matmul(x, y) * op['attrs'].get('alpha', 1.0)
+        env[op['outputs']['Out'][0]] = out
+
+    def _elem(fn):
+        def h(env, op):
+            x, y = env[op['inputs']['X'][0]], env[op['inputs']['Y'][0]]
+            axis = op['attrs'].get('axis', -1)
+            if y.ndim < x.ndim and axis != -1:
+                y = y.reshape(y.shape + (1,) * (x.ndim - axis - y.ndim))
+            env[op['outputs']['Out'][0]] = fn(x, y)
+        return h
+
+    def _unary(fn):
+        def h(env, op):
+            env[op['outputs']['Out'][0]] = fn(env[op['inputs']['X'][0]])
+        return h
+
+    def _softmax(env, op):
+        x = env[op['inputs']['X'][0]]
+        env[op['outputs']['Out'][0]] = jax.nn.softmax(
+            x, axis=op['attrs'].get('axis', -1))
+
+    def _scale(env, op):
+        x = env[op['inputs']['X'][0]]
+        s, b = op['attrs'].get('scale', 1.0), op['attrs'].get('bias', 0.0)
+        if op['attrs'].get('bias_after_scale', True):
+            out = x * s + b
+        else:
+            out = (x + b) * s
+        env[op['outputs']['Out'][0]] = out
+
+    def _reshape(env, op):
+        x = env[op['inputs']['X'][0]]
+        shape = [int(s) for s in op['attrs']['shape']]
+        shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+        env[op['outputs']['Out'][0]] = x.reshape(shape)
+
+    def _transpose(env, op):
+        x = env[op['inputs']['X'][0]]
+        env[op['outputs']['Out'][0]] = jnp.transpose(
+            x, op['attrs']['axis'])
+
+    def _concat(env, op):
+        xs = [env[n] for n in op['inputs']['X']]
+        env[op['outputs']['Out'][0]] = jnp.concatenate(
+            xs, axis=op['attrs'].get('axis', 0))
+
+    def _dropout(env, op):
+        # inference semantics: downgrade_in_infer scales by (1-p),
+        # upscale_in_train is identity at test time
+        x = env[op['inputs']['X'][0]]
+        impl = op['attrs'].get('dropout_implementation', 'downgrade_in_infer')
+        p = op['attrs'].get('dropout_prob', 0.5)
+        out = x if impl == 'upscale_in_train' else x * (1.0 - p)
+        env[op['outputs']['Out'][0]] = out
+
+    def _require_nchw(op):
+        layout = op['attrs'].get('data_layout',
+                                 op['attrs'].get('data_format', 'NCHW'))
+        if layout not in ('NCHW', 'AnyLayout'):
+            raise NotImplementedError(
+                f"fluid op '{op['type']}' with data layout {layout!r}: only "
+                f"NCHW translations are implemented")
+
+    def _batch_norm(env, op):
+        _require_nchw(op)
+        x = env[op['inputs']['X'][0]]
+        scale = env[op['inputs']['Scale'][0]]
+        bias = env[op['inputs']['Bias'][0]]
+        mean = env[op['inputs']['Mean'][0]]
+        var = env[op['inputs']['Variance'][0]]
+        eps = op['attrs'].get('epsilon', 1e-5)
+        shape = (1, -1) + (1,) * (x.ndim - 2)    # NCHW
+        out = (x - mean.reshape(shape)) / jnp.sqrt(
+            var.reshape(shape) + eps) * scale.reshape(shape) + \
+            bias.reshape(shape)
+        env[op['outputs']['Y'][0]] = out
+
+    def _conv2d(env, op):
+        from jax import lax
+        _require_nchw(op)
+        x = env[op['inputs']['Input'][0]]
+        w = env[op['inputs']['Filter'][0]]
+        a = op['attrs']
+        pads = a.get('paddings', [0, 0])
+        if len(pads) == 2:
+            pads = [(pads[0], pads[0]), (pads[1], pads[1])]
+        else:
+            pads = [(pads[0], pads[1]), (pads[2], pads[3])]
+        out = lax.conv_general_dilated(
+            x, w, window_strides=a.get('strides', [1, 1]), padding=pads,
+            rhs_dilation=a.get('dilations', [1, 1]),
+            feature_group_count=a.get('groups', 1),
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+        env[op['outputs']['Output'][0]] = out
+
+    def _pool2d(env, op):
+        from jax import lax
+        _require_nchw(op)
+        x = env[op['inputs']['X'][0]]
+        a = op['attrs']
+        ks = a.get('ksize', [2, 2])
+        st = a.get('strides', ks)
+        pd = a.get('paddings', [0, 0])
+        if a.get('global_pooling', False):
+            red = jnp.max if a.get('pooling_type', 'max') == 'max' \
+                else jnp.mean
+            env[op['outputs']['Out'][0]] = red(
+                x, axis=(2, 3), keepdims=True)
+            return
+        pads = ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1]))
+        window = (1, 1) + tuple(ks)
+        strides = (1, 1) + tuple(st)
+        if a.get('pooling_type', 'max') == 'max':
+            out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                    pads)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            if a.get('exclusive', True):
+                # reference default: padding cells don't count in the divisor
+                ones = jnp.ones_like(x)
+                denom = lax.reduce_window(ones, 0.0, lax.add, window,
+                                          strides, pads)
+                out = s / denom
+            else:
+                out = s / (ks[0] * ks[1])
+        env[op['outputs']['Out'][0]] = out
+
+    def _lookup_table(env, op):
+        w = env[op['inputs']['W'][0]]
+        ids = env[op['inputs']['Ids'][0]]
+        if ids.ndim >= 2 and ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        out = w[ids.astype(jnp.int32)]
+        pad = op['attrs'].get('padding_idx', -1)
+        if pad is not None and pad >= 0:
+            out = out * (ids != pad)[..., None].astype(out.dtype)
+        env[op['outputs']['Out'][0]] = out
+
+    def _cast(env, op):
+        x = env[op['inputs']['X'][0]]
+        env[op['outputs']['Out'][0]] = x.astype(
+            _FLUID_DTYPES[op['attrs']['out_dtype']])
+
+    def _reduce(fn):
+        def h(env, op):
+            x = env[op['inputs']['X'][0]]
+            dims = tuple(op['attrs'].get('dim', [0]))
+            if op['attrs'].get('reduce_all', False):
+                dims = None
+            env[op['outputs']['Out'][0]] = fn(
+                x, axis=dims, keepdims=op['attrs'].get('keep_dim', False))
+        return h
+
+    return {
+        'mul': _mul, 'matmul': _matmul,
+        'elementwise_add': _elem(jnp.add),
+        'elementwise_sub': _elem(jnp.subtract),
+        'elementwise_mul': _elem(jnp.multiply),
+        'elementwise_div': _elem(jnp.divide),
+        'relu': _unary(jax.nn.relu), 'sigmoid': _unary(jax.nn.sigmoid),
+        'tanh': _unary(jnp.tanh), 'exp': _unary(jnp.exp),
+        'sqrt': _unary(jnp.sqrt), 'abs': _unary(jnp.abs),
+        'softmax': _softmax, 'scale': _scale,
+        'reshape': _reshape, 'reshape2': _reshape,
+        'transpose': _transpose, 'transpose2': _transpose,
+        'concat': _concat, 'dropout': _dropout, 'batch_norm': _batch_norm,
+        'conv2d': _conv2d, 'pool2d': _pool2d,
+        'lookup_table': _lookup_table, 'lookup_table_v2': _lookup_table,
+        'cast': _cast,
+        'reduce_sum': _reduce(jnp.sum), 'reduce_mean': _reduce(jnp.mean),
+    }
+
+
+class FluidProgram:
+    """A parsed 1.8 ProgramDesc translated to ONE jittable jnp forward.
+
+    feed_names/fetch_names come from the program's feed/fetch ops; weights
+    are the loaded persistables. The translated forward is compiled by XLA
+    as a single computation (the package's Executor design, applied to a
+    foreign program)."""
+
+    def __init__(self, program, params=None):
+        self.program = program
+        block = program['blocks'][0]
+        self.feed_names = []
+        self.fetch_names = []
+        self._body = []
+        self._jitted = None
+        handlers = _op_handlers()
+        for op in block['ops']:
+            if op['type'] == 'feed':
+                self.feed_names.append(op['outputs']['Out'][0])
+            elif op['type'] == 'fetch':
+                self.fetch_names.append(op['inputs']['X'][0])
+            elif op['type'] in handlers:
+                self._body.append((handlers[op['type']], op))
+            else:
+                raise NotImplementedError(
+                    f"fluid op '{op['type']}' has no TPU translation yet "
+                    f"(supported: {sorted(handlers)})")
+        self.persistable_names = [
+            n for n, v in block['vars'].items()
+            if v['persistable'] and v['type_id'] == 7
+            and n not in ('feed', 'fetch')]
+        self.set_params(params or {})
+
+    def set_params(self, params):
+        import jax.numpy as jnp
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        self._jitted = None
+
+    def _forward(self, params, feed):
+        env = dict(params)
+        env.update(feed)
+        for fn, op in self._body:
+            fn(env, op)
+        return [env[n] for n in self.fetch_names]
+
+    def run(self, feed, fetch_list=None):
+        """Execute the translated forward as ONE jitted XLA computation;
+        feed: {name: array}. Returns numpy arrays for ``fetch_list`` (names,
+        default: the program's fetch targets in order)."""
+        import jax
+        import jax.numpy as jnp
+        for name in self.feed_names:
+            if name not in feed:
+                raise KeyError(f"missing feed '{name}'")
+        if self._jitted is None:
+            self._jitted = jax.jit(self._forward)
+        outs = self._jitted(self.params,
+                            {k: jnp.asarray(v) for k, v in feed.items()})
+        by_name = dict(zip(self.fetch_names, outs))
+        names = fetch_list if fetch_list is not None else self.fetch_names
+        return [np.asarray(by_name[getattr(n, 'name', n)]) for n in names]
+
+
+# ---------------------------------------------------------------------------
+# public loaders
+# ---------------------------------------------------------------------------
+
+def load_fluid_persistables(dirname, var_names=None, filename=None):
+    """Load persistable vars a real Paddle 1.8 saved (io.py:141).
+
+    - filename=None: one LoDTensor file per var in ``dirname`` (file name ==
+      var name); ``var_names`` selects which (default: every regular file).
+    - filename='...': a save_combine file holding the vars back-to-back in
+      ``var_names`` order (required then).
+    Returns {name: ndarray}.
+    """
+    import os
+    out = {}
+    if filename is not None:
+        if var_names is None:
+            raise ValueError("var_names is required for a combined file "
+                             "(the format stores no names)")
+        with open(os.path.join(dirname, filename), 'rb') as f:
+            for name in var_names:
+                out[name], _ = load_fluid_lod_tensor(f)
+        return out
+    names = var_names if var_names is not None else sorted(
+        n for n in os.listdir(dirname)
+        if os.path.isfile(os.path.join(dirname, n))
+        and not n.startswith('__model__'))
+    for name in names:
+        with open(os.path.join(dirname, name), 'rb') as f:
+            out[name], _ = load_fluid_lod_tensor(f)
+    return out
+
+
+def load_fluid_inference_model(dirname, model_filename=None,
+                               params_filename=None):
+    """Load an inference model saved by real Paddle 1.8's
+    save_inference_model (io.py:1034): parse __model__ (ProgramDesc), load
+    the persistables, translate to a jittable forward. Returns
+    (FluidProgram, feed_names, fetch_names)."""
+    import os
+    model_path = os.path.join(dirname, model_filename or '__model__')
+    with open(model_path, 'rb') as f:
+        program = parse_program_desc(f.read())
+    prog = FluidProgram(program)
+    # save_vars writes combined files in sorted-name order (io.py:344)
+    prog.set_params(load_fluid_persistables(
+        dirname, var_names=sorted(prog.persistable_names),
+        filename=params_filename))
+    return prog, prog.feed_names, prog.fetch_names

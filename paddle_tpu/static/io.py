@@ -30,14 +30,37 @@ save_vars = save_persistables
 
 
 def load_persistables(executor, dirname, main_program=None, filename=None):
-    path = os.path.join(dirname, filename or '__persistables__')
-    with open(path, 'rb') as f:
-        params = pickle.load(f)
     import jax.numpy as jnp
     program = main_program or default_main_program()
+    path = os.path.join(dirname, filename or '__persistables__')
+    if not os.path.exists(path) or (
+            filename and not _is_pickle(path)):
+        # reference 1.8 layout: one LoDTensor file per var (or a
+        # save_combine file) written by real Paddle's save_persistables
+        from .fluid_format import load_fluid_persistables
+        names = [v.name for v in program.list_vars()
+                 if v.concrete is not None and v.concrete.persistable]
+        if filename:
+            # reference save_vars sorts names before save_combine
+            # (io.py:141: `for name in sorted(save_var_map.keys())`)
+            params = load_fluid_persistables(dirname,
+                                             var_names=sorted(names),
+                                             filename=filename)
+        else:
+            on_disk = [n for n in names
+                       if os.path.isfile(os.path.join(dirname, n))]
+            params = load_fluid_persistables(dirname, var_names=on_disk)
+    else:
+        with open(path, 'rb') as f:
+            params = pickle.load(f)
     for v in program.list_vars():
         if v.name in params and v.concrete is not None:
             v.concrete._inplace_value(jnp.asarray(params[v.name]))
+
+
+def _is_pickle(path):
+    with open(path, 'rb') as f:
+        return f.read(1) == b'\x80'
 
 
 load_params = load_persistables
@@ -118,7 +141,19 @@ def _export_portable(program, feed_names, fetch_vars):
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None, **kwargs):
-    with open(os.path.join(dirname, model_filename or '__model__'), 'rb') as f:
+    model_path = os.path.join(dirname, model_filename or '__model__')
+    with open(model_path, 'rb') as f:
+        head = f.read(2)
+    if head[:1] != b'\x80':
+        # not our pickle format: a framework.proto ProgramDesc written by
+        # real Paddle 1.8 (save_inference_model) — translate it
+        # (fluid_format.py) and return the runnable FluidProgram
+        from .fluid_format import load_fluid_inference_model
+        prog, feed_names, fetch_names = load_fluid_inference_model(
+            dirname, model_filename=model_filename,
+            params_filename=params_filename)
+        return prog, feed_names, fetch_names
+    with open(model_path, 'rb') as f:
         meta = pickle.load(f)
     with open(os.path.join(dirname, params_filename or '__params__'),
               'rb') as f:

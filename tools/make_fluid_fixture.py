@@ -1,0 +1,127 @@
+"""Generate a Paddle-1.8-format inference-model fixture.
+
+Writes tests/fixtures/fluid_mlp/: __model__ (framework.proto ProgramDesc
+wire bytes), one LoDTensor file per persistable var, combined_params (the
+save_combine layout), input.npy and expected.npy (the forward's output
+computed in pure numpy, independent of the loader under test).
+
+The model: x(−1,4) -> fc(4,8)+relu -> fc(8,3) -> softmax, i.e. the op
+sequence a real 1.8 save_inference_model emits for a small MLP
+(mul + elementwise_add + relu + mul + elementwise_add + softmax with
+feed/fetch ops). Run: PYTHONPATH=/root/repo python tools/make_fluid_fixture.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.static.fluid_format import (_msg, _emit,  # noqa: E402
+                                            save_fluid_lod_tensor)
+
+
+def _attr(name, atype, value):
+    pairs = [(1, 2, name.encode()), (2, 0, atype)]
+    if atype == 0:
+        pairs.append((3, 0, value))
+    elif atype == 2:
+        pairs.append((5, 2, value.encode()))
+    elif atype == 3:
+        pairs += [(6, 0, v) for v in value]
+    elif atype == 6:
+        pairs.append((10, 0, int(value)))
+    return _msg(pairs)
+
+
+def _op(op_type, inputs, outputs, attrs=()):
+    pairs = []
+    for pname, args in inputs.items():
+        pairs.append((1, 2, _msg([(1, 2, pname.encode())] +
+                                 [(2, 2, a.encode()) for a in args])))
+    for pname, args in outputs.items():
+        pairs.append((2, 2, _msg([(1, 2, pname.encode())] +
+                                 [(2, 2, a.encode()) for a in args])))
+    pairs.append((3, 2, op_type.encode()))
+    for a in attrs:
+        pairs.append((4, 2, a))
+    return _msg(pairs)
+
+
+def _var(name, shape=None, dtype=5, persistable=False, type_id=7):
+    # VarType: type=1 (enum), lod_tensor=3 { tensor=1 { data_type=1 dims=2 } }
+    vt_pairs = [(1, 0, type_id)]
+    if shape is not None:
+        td = _msg([(1, 0, dtype)] + [(2, 0, d & ((1 << 64) - 1))
+                                     for d in shape])
+        vt_pairs.append((3, 2, _msg([(1, 2, td)])))
+    return _msg([(1, 2, name.encode()), (2, 2, _msg(vt_pairs)),
+                 (3, 0, int(persistable))])
+
+
+def main():
+    out_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tests', 'fixtures', 'fluid_mlp')
+    os.makedirs(out_dir, exist_ok=True)
+    rs = np.random.RandomState(42)
+    w0 = rs.randn(4, 8).astype(np.float32) * 0.5
+    b0 = rs.randn(8).astype(np.float32) * 0.1
+    w1 = rs.randn(8, 3).astype(np.float32) * 0.5
+    b1 = rs.randn(3).astype(np.float32) * 0.1
+
+    ops = [
+        _op('feed', {'X': ['feed']}, {'Out': ['x']},
+            [_attr('col', 0, 0)]),
+        _op('mul', {'X': ['x'], 'Y': ['fc0.w_0']}, {'Out': ['fc0.tmp_0']},
+            [_attr('x_num_col_dims', 0, 1), _attr('y_num_col_dims', 0, 1)]),
+        _op('elementwise_add', {'X': ['fc0.tmp_0'], 'Y': ['fc0.b_0']},
+            {'Out': ['fc0.tmp_1']}, [_attr('axis', 0, 1)]),
+        _op('relu', {'X': ['fc0.tmp_1']}, {'Out': ['fc0.tmp_2']}),
+        _op('mul', {'X': ['fc0.tmp_2'], 'Y': ['fc1.w_0']},
+            {'Out': ['fc1.tmp_0']},
+            [_attr('x_num_col_dims', 0, 1), _attr('y_num_col_dims', 0, 1)]),
+        _op('elementwise_add', {'X': ['fc1.tmp_0'], 'Y': ['fc1.b_0']},
+            {'Out': ['fc1.tmp_1']}, [_attr('axis', 0, 1)]),
+        _op('softmax', {'X': ['fc1.tmp_1']}, {'Out': ['softmax_0.tmp_0']},
+            [_attr('axis', 0, -1)]),
+        _op('fetch', {'X': ['softmax_0.tmp_0']}, {'Out': ['fetch']},
+            [_attr('col', 0, 0)]),
+    ]
+    vars_ = [
+        _var('feed', type_id=9), _var('fetch', type_id=10),
+        _var('x', shape=[-1, 4]),
+        _var('fc0.w_0', shape=[4, 8], persistable=True),
+        _var('fc0.b_0', shape=[8], persistable=True),
+        _var('fc0.tmp_0', shape=[-1, 8]), _var('fc0.tmp_1', shape=[-1, 8]),
+        _var('fc0.tmp_2', shape=[-1, 8]),
+        _var('fc1.w_0', shape=[8, 3], persistable=True),
+        _var('fc1.b_0', shape=[3], persistable=True),
+        _var('fc1.tmp_0', shape=[-1, 3]), _var('fc1.tmp_1', shape=[-1, 3]),
+        _var('softmax_0.tmp_0', shape=[-1, 3]),
+    ]
+    block = _msg([(1, 0, 0), (2, 0, 0)] + [(3, 2, v) for v in vars_] +
+                 [(4, 2, o) for o in ops])
+    program = _msg([(1, 2, block)])
+    with open(os.path.join(out_dir, '__model__'), 'wb') as f:
+        f.write(program)
+
+    weights = {'fc0.w_0': w0, 'fc0.b_0': b0, 'fc1.w_0': w1, 'fc1.b_0': b1}
+    for name, arr in weights.items():
+        with open(os.path.join(out_dir, name), 'wb') as f:
+            save_fluid_lod_tensor(f, arr)
+    with open(os.path.join(out_dir, 'combined_params'), 'wb') as f:
+        for name in sorted(weights):
+            save_fluid_lod_tensor(f, weights[name])
+
+    x = rs.randn(5, 4).astype(np.float32)
+    h = np.maximum(x @ w0 + b0, 0)
+    logits = h @ w1 + b1
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    expected = e / e.sum(-1, keepdims=True)
+    np.save(os.path.join(out_dir, 'input.npy'), x)
+    np.save(os.path.join(out_dir, 'expected.npy'), expected)
+    print('fixture written to', out_dir)
+
+
+if __name__ == '__main__':
+    main()
