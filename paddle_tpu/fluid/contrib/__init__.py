@@ -135,9 +135,17 @@ def extend_with_decoupled_weight_decay(base_optimizer_cls):
     return DecoupledWeightDecay
 
 
-# decoder/: the 1.8 contrib beam-search machinery is superseded by the
-# dense decode stack; alias the entry points reference scripts import
-from ...nn.decode import BeamSearchDecoder, dynamic_decode  # noqa: E402,F401
+# decoder/: the fluid-era StateCell/TrainingDecoder/BeamSearchDecoder API
+# (decoder.py); the modern dense decode entry points stay importable too
+from . import decoder  # noqa: E402
+from .decoder import (InitState, StateCell,  # noqa: E402,F401
+                      TrainingDecoder, BeamSearchDecoder)
+from ...nn.decode import dynamic_decode  # noqa: E402,F401
+__all__ += decoder.__all__
+# canonical 1.8 spelling: contrib.decoder.beam_search_decoder.<cls>
+import sys as _sys  # noqa: E402
+decoder.beam_search_decoder = decoder
+_sys.modules[__name__ + '.decoder.beam_search_decoder'] = decoder
 
 # contrib/layers/: the contrib op zoo (nn.py + rnn_impl.py + metric_op.py)
 from . import layers  # noqa: E402

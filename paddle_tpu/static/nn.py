@@ -15,8 +15,15 @@ __all__ = ['fc', 'batch_norm', 'embedding', 'bilinear_tensor_product',
            'spectral_norm']
 
 
-def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
-       activation=None, name=None):
+def fc(x=None, size=None, num_flatten_dims=1, weight_attr=None,
+       bias_attr=None, activation=None, name=None, input=None,
+       param_attr=None, act=None):
+    # accept both the 2.0 (x/weight_attr/activation) and the 1.8 fluid
+    # (input/param_attr/act) keyword spellings
+    if x is None:
+        x = input
+    weight_attr = weight_attr if weight_attr is not None else param_attr
+    activation = activation if activation is not None else act
     in_features = 1
     for s in x.shape[num_flatten_dims:]:
         in_features *= s

@@ -1,0 +1,211 @@
+"""fluid.contrib.decoder: the fluid-era seq2seq decoder classes.
+
+Parity: /root/reference/python/paddle/fluid/contrib/decoder/
+beam_search_decoder.py — a reference-style script builds a StateCell with a
+custom updater, unrolls it with TrainingDecoder through the static
+Executor, and generates with BeamSearchDecoder.decode().
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.contrib import (BeamSearchDecoder, InitState,
+                                      StateCell, TrainingDecoder)
+
+V, D, H = 12, 6, 8   # vocab, word dim, hidden
+
+
+def test_state_cell_standalone_eager():
+    rs = np.random.RandomState(0)
+    h0 = paddle.to_tensor(rs.randn(3, H).astype(np.float32))
+    cell = StateCell(inputs={'x': None},
+                     states={'h': InitState(init=h0)}, out_state='h')
+
+    @cell.state_updater
+    def updater(sc):
+        x = sc.get_input('x')
+        h = sc.get_state('h')
+        sc.set_state('h', paddle.tanh(x + h))
+
+    x = paddle.to_tensor(rs.randn(3, H).astype(np.float32))
+    cell.compute_state(inputs={'x': x})
+    expect = np.tanh(x.numpy() + h0.numpy())
+    np.testing.assert_allclose(cell.get_state('h').numpy(), expect,
+                               rtol=1e-6)
+    assert cell.out_state().numpy().shape == (3, H)
+    with pytest.raises(ValueError, match='Unknown input'):
+        cell.compute_state(inputs={'bogus': x})
+
+
+def test_state_cell_validation():
+    with pytest.raises(ValueError, match='InitState'):
+        StateCell(inputs={}, states={'h': 3}, out_state='h')
+    h0 = paddle.to_tensor(np.zeros((1, 2), np.float32))
+    with pytest.raises(ValueError, match='out_state'):
+        StateCell(inputs={}, states={'h': InitState(init=h0)},
+                  out_state='nope')
+
+
+def test_init_state_from_boot():
+    boot = paddle.to_tensor(np.zeros((5, 3), np.float32))
+    st = InitState(shape=[-1, H], value=0.0, init_boot=boot)
+    assert list(st.value.shape) == [5, H]
+    with pytest.raises(ValueError, match='init_boot'):
+        InitState(shape=[-1, H])
+
+
+def test_training_decoder_reference_script_through_executor():
+    """The reference docstring script (:384): step_input + compute_state +
+    fc softmax + update_states + output, run via Executor."""
+    rs = np.random.RandomState(1)
+    B, T = 4, 5
+    paddle.enable_static()
+    try:
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            trg = fluid.layers.data(name='trg_emb', shape=[-1, T, D],
+                                    dtype='float32')
+            boot = fluid.layers.data(name='boot', shape=[-1, H],
+                                     dtype='float32')
+            hidden = InitState(init=boot)
+            state_cell = StateCell(inputs={'x': None},
+                                   states={'h': hidden}, out_state='h')
+
+            @state_cell.state_updater
+            def updater(sc):
+                x = sc.get_input('x')
+                h = sc.get_state('h')
+                new_h = fluid.layers.fc(input=fluid.layers.concat(
+                    [x, h], axis=1), size=H, act='tanh')
+                sc.set_state('h', new_h)
+
+            decoder = TrainingDecoder(state_cell)
+            with decoder.block():
+                current_word = decoder.step_input(trg)
+                state_cell.compute_state(inputs={'x': current_word})
+                current_score = fluid.layers.fc(
+                    input=state_cell.get_state('h'), size=V, act='softmax')
+                state_cell.update_states()
+                decoder.output(current_score)
+            out = decoder()
+
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            feed = {'trg_emb': rs.randn(B, T, D).astype(np.float32),
+                    'boot': rs.randn(B, H).astype(np.float32)}
+            res = exe.run(main, feed=feed, fetch_list=[out])[0]
+        assert res.shape == (B, T, V)
+        np.testing.assert_allclose(res.sum(-1), np.ones((B, T)), rtol=1e-4)
+        # scores vary across time steps (the scan actually advances state)
+        assert np.abs(res[:, 0] - res[:, 1]).max() > 1e-6
+    finally:
+        paddle.disable_static()
+
+
+def test_training_decoder_block_protocol():
+    h0 = paddle.to_tensor(np.zeros((2, H), np.float32))
+    cell = StateCell(inputs={'x': None}, states={'h': InitState(init=h0)},
+                     out_state='h')
+    dec = TrainingDecoder(cell)
+    with pytest.raises(ValueError, match='inside block'):
+        dec.step_input(paddle.to_tensor(np.zeros((2, 3, D), np.float32)))
+    with pytest.raises(ValueError, match='outside the block'):
+        dec()
+
+
+def _greedy_reference(h0, emb, fc_w, fc_b, upd_w, start_id, end_id, T):
+    """Pure-numpy greedy (beam=1) rollout of the tanh(x+h@U) cell."""
+    h = h0.copy()
+    ids = []
+    cur = start_id
+    for _ in range(T):
+        x = emb[cur]
+        h = np.tanh(x + h @ upd_w)
+        p = h @ fc_w + fc_b
+        e = np.exp(p - p.max())
+        probs = e / e.sum()
+        cur = int(np.argmax(probs))
+        ids.append(cur)
+        if cur == end_id:
+            break
+    return ids
+
+
+def test_beam_search_decoder_matches_greedy_rollout():
+    rs = np.random.RandomState(7)
+    emb = rs.randn(V, H).astype(np.float32)   # word_dim == H for x + h
+    fc_w = rs.randn(H, V).astype(np.float32) * 2.0
+    fc_b = rs.randn(V).astype(np.float32)
+    upd_w = (np.eye(H) + 0.1 * rs.randn(H, H)).astype(np.float32)
+    h0 = rs.randn(1, H).astype(np.float32)
+    end_id = 1
+    upd_t = paddle.to_tensor(upd_w)
+
+    from paddle_tpu.nn.initializer import NumpyArrayInitializer, ParamAttr
+    cell = StateCell(inputs={'x': None},
+                     states={'h': InitState(
+                         init=paddle.to_tensor(h0))}, out_state='h')
+
+    @cell.state_updater
+    def updater(sc):
+        x = sc.get_input('x')
+        h = sc.get_state('h')
+        sc.set_state('h', paddle.tanh(x + paddle.matmul(h, upd_t)))
+
+    dec = BeamSearchDecoder(
+        state_cell=cell,
+        init_ids=paddle.to_tensor(np.array([[0]], np.int64)),
+        init_scores=paddle.to_tensor(np.array([[0.0]], np.float32)),
+        target_dict_dim=V, word_dim=H, beam_size=1, max_len=6, end_id=end_id,
+        embedding_param_attr=ParamAttr(
+            initializer=NumpyArrayInitializer(emb)),
+        fc_param_attr=ParamAttr(initializer=NumpyArrayInitializer(fc_w)),
+        fc_bias_attr=ParamAttr(initializer=NumpyArrayInitializer(fc_b)))
+    dec.decode()
+    seqs, scores = dec()
+    got = seqs.numpy()[:, 0, 0].tolist()
+    expect = _greedy_reference(h0, emb, fc_w, fc_b, upd_w, 0, end_id, 6)
+    assert got[:len(expect)] == expect
+
+
+def test_beam_search_decoder_wider_beam_scores_monotonic():
+    rs = np.random.RandomState(3)
+    cell = StateCell(inputs={'x': None},
+                     states={'h': InitState(init=paddle.to_tensor(
+                         rs.randn(2, H).astype(np.float32)))},
+                     out_state='h')
+
+    @cell.state_updater
+    def updater(sc):
+        sc.set_state('h', paddle.tanh(sc.get_input('x') +
+                                      sc.get_state('h')))
+
+    dec = BeamSearchDecoder(
+        state_cell=cell,
+        init_ids=paddle.to_tensor(np.zeros((2, 1), np.int64)),
+        init_scores=paddle.to_tensor(np.zeros((2, 1), np.float32)),
+        target_dict_dim=V, word_dim=H, beam_size=3, max_len=4, end_id=1)
+    dec.decode()
+    seqs, scores = dec()
+    T, B, W = seqs.numpy().shape
+    assert (B, W) == (2, 3)
+    s = scores.numpy()
+    # within each step, beams are sorted best-first
+    assert np.all(np.diff(s[-1], axis=-1) <= 1e-5)
+    # custom block() is explicitly unsupported with guidance
+    with pytest.raises(NotImplementedError, match='dynamic_decode'):
+        dec.block()
+
+
+def test_contrib_decoder_namespace():
+    import paddle_tpu.fluid as fl
+    for name in ('InitState', 'StateCell', 'TrainingDecoder',
+                 'BeamSearchDecoder'):
+        assert hasattr(fl.contrib, name)
+        assert hasattr(fl.contrib.decoder, name)
+    # the canonical 1.8 import path
+    from paddle_tpu.fluid.contrib.decoder.beam_search_decoder import (
+        BeamSearchDecoder as B2, InitState as I2)
+    assert B2 is BeamSearchDecoder and I2 is InitState
