@@ -27,6 +27,11 @@ from ..framework import (in_dygraph_mode, enable_static, disable_static,
 from ..core import rng as _rng
 from . import layers
 from . import contrib
+from . import evaluator
+from . import transpiler
+from .transpiler import (DistributeTranspiler,  # noqa: F401
+                         DistributeTranspilerConfig, memory_optimize,
+                         release_memory)
 from . import dygraph
 from . import nets
 from . import metrics

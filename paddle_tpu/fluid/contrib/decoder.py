@@ -350,7 +350,8 @@ class BeamSearchDecoder:
             raise ValueError('decode() can only be invoked once')
         self._status = BeamSearchDecoder.IN_BEAM_SEARCH_DECODER
         cell = self._state_cell
-        cell._switch_decoder()
+        if not cell._switched_decoder:   # a get_state may have switched lazily
+            cell._switch_decoder()
         V, D, W = self._target_dict_dim, self._word_dim, self._beam_size
         end = self._end_id
 

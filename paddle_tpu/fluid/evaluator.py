@@ -1,0 +1,235 @@
+"""fluid.evaluator — the 1.8 Evaluator-protocol metric classes.
+
+Parity: /root/reference/python/paddle/fluid/evaluator.py:27
+(ChunkEvaluator, EditDistance, DetectionMAP). The reference accumulates
+state in persistable scope variables through ops appended to the main
+program; every exe.run advances the states, reset() zeroes them with a
+fill_constant program, eval() reads them back.
+
+TPU-first redesign: states live on HOST (plain numpy accumulators). The
+per-batch metric math is irregular host work (Levenshtein DP, chunk-set
+intersection, greedy box matching), so each evaluator appends ONE op that
+computes the batch metrics in a jax.pure_callback and feeds them through
+an ordered jax.experimental.io_callback into the host state. The
+io_callback is effectful, so XLA keeps the chain in the compiled Program
+and every exe.run auto-accumulates exactly like the reference — eager
+construction accumulates immediately. reset()/eval() keep the reference
+signatures; their executor argument is unused.
+"""
+import warnings
+
+import numpy as np
+
+__all__ = ['ChunkEvaluator', 'EditDistance', 'DetectionMAP']
+
+
+class _HostState:
+    def __init__(self, shape, dtype):
+        self.value = np.zeros(shape, dtype)
+
+    def add(self, v):
+        self.value = self.value + np.asarray(v, self.value.dtype).reshape(
+            self.value.shape)
+
+    def zero(self):
+        self.value = np.zeros_like(self.value)
+
+
+class Evaluator:
+    """Base Evaluator (reference :45): states reset per pass, metrics are
+    per-batch variables."""
+
+    def __init__(self, name, **kwargs):
+        warnings.warn(
+            f"The {self.__class__.__name__} is deprecated, please use "
+            f"fluid.metrics.{self.__class__.__name__} instead.", Warning)
+        self.states = []
+        self.metrics = []
+        self._name = name
+
+    def reset(self, executor=None, reset_program=None):
+        for state in self.states:
+            state.zero()
+
+    def eval(self, executor=None, eval_program=None):
+        raise NotImplementedError()
+
+    def _create_state(self, suffix, dtype, shape):
+        state = _HostState(tuple(shape), dtype)
+        self.states.append(state)
+        return state
+
+    def _batch_metric_op(self, inputs, host_fn, out_structs, accumulate,
+                         n_out=None):
+        """Append one traceable op: pure_callback(host_fn) computes the
+        batch metrics, io_callback(accumulate) folds them into host states.
+        The effectful io_callback anchors the chain against DCE, so the op
+        fires on every run of a captured Program and immediately in eager
+        mode."""
+        import jax
+        from ..core.tensor import apply_op
+        from ..tensor._helpers import _t
+
+        def fn(*vals):
+            # out_structs may depend on the actual batch size, so resolve
+            # shapes from the traced values (a [-1]-batch data var's
+            # placeholder size must not get baked in)
+            shapes = out_structs(vals) if callable(out_structs) \
+                else out_structs
+            structs = tuple(jax.ShapeDtypeStruct(s, d) for s, d in shapes)
+            outs = jax.pure_callback(host_fn, structs, *vals,
+                                     vmap_method='sequential')
+            jax.experimental.io_callback(accumulate, None, *outs,
+                                         ordered=True)
+            return tuple(outs) if len(structs) > 1 else outs[0]
+        if n_out is None:
+            n_out = len(out_structs)
+        return apply_op(fn, tuple(_t(v) for v in inputs),
+                        n_outputs=n_out, differentiable=False)
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulates chunk_eval counts into corpus precision/recall/F1
+    (reference :127)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, seq_length=None):
+        super().__init__('chunk_eval')
+        from ..metric import extras
+        self.num_infer_chunks = self._create_state('num_infer_chunks',
+                                                   np.float64, [1])
+        self.num_label_chunks = self._create_state('num_label_chunks',
+                                                   np.float64, [1])
+        self.num_correct_chunks = self._create_state('num_correct_chunks',
+                                                     np.float64, [1])
+
+        def host(inf, lab):
+            p, r, f1, ni, nl, nc = extras.chunk_eval(
+                inf, lab, chunk_scheme, num_chunk_types,
+                excluded_chunk_types=excluded_chunk_types)
+            return (np.asarray(p.numpy(), np.float32),
+                    np.asarray(r.numpy(), np.float32),
+                    np.asarray(f1.numpy(), np.float32),
+                    np.asarray(ni.numpy(), np.int32),
+                    np.asarray(nl.numpy(), np.int32),
+                    np.asarray(nc.numpy(), np.int32))
+
+        def accumulate(p, r, f1, ni, nl, nc):
+            self.num_infer_chunks.add(ni)
+            self.num_label_chunks.add(nl)
+            self.num_correct_chunks.add(nc)
+
+        outs = self._batch_metric_op(
+            [input, label], host,
+            [((1,), np.float32)] * 3 + [((1,), np.int32)] * 3, accumulate)
+        self.metrics.extend(outs[:3])
+
+    def eval(self, executor=None, eval_program=None):
+        num_infer = float(self.num_infer_chunks.value[0])
+        num_label = float(self.num_label_chunks.value[0])
+        num_correct = float(self.num_correct_chunks.value[0])
+        precision = num_correct / num_infer if num_infer else 0.0
+        recall = num_correct / num_label if num_label else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if num_correct else 0.0
+        return (np.array([precision], np.float32),
+                np.array([recall], np.float32),
+                np.array([f1], np.float32))
+
+
+class EditDistance(Evaluator):
+    """Accumulates summed edit distance + error count over sequences
+    (reference :218). eval() returns (avg_distance, avg_instance_error)."""
+
+    def __init__(self, input, label, ignored_tokens=None, **kwargs):
+        super().__init__('edit_distance')
+        from ..metric import extras
+        self.total_distance = self._create_state('total_distance',
+                                                 np.float64, [1])
+        self.seq_num = self._create_state('seq_num', np.float64, [1])
+        self.instance_error = self._create_state('instance_error',
+                                                 np.float64, [1])
+
+        def host(inp, lab):
+            d, n = extras.edit_distance(inp, lab, normalized=False,
+                                        ignored_tokens=ignored_tokens)
+            return (np.asarray(d.numpy(), np.float32),
+                    np.asarray(n.numpy(), np.int32))
+
+        def accumulate(d, n):
+            self.total_distance.add(d.sum().reshape(1))
+            self.seq_num.add(n)
+            self.instance_error.add(
+                np.array([(d.reshape(-1) > 0).sum()], np.float64))
+
+        distances, seq_num = self._batch_metric_op(
+            [input, label], host,
+            lambda vals: [((vals[0].shape[0], 1), np.float32),
+                          ((1,), np.int32)],
+            accumulate, n_out=2)
+        self.metrics.extend([distances, seq_num])
+
+    def eval(self, executor=None, eval_program=None):
+        n = float(self.seq_num.value[0])
+        if n == 0:
+            return (np.array([0.0], np.float32),
+                    np.array([0.0], np.float32))
+        return (np.array([self.total_distance.value[0] / n], np.float32),
+                np.array([self.instance_error.value[0] / n], np.float32))
+
+
+class DetectionMAP(Evaluator):
+    """Accumulative detection mAP (reference :299): per-batch detections
+    and ground truths flow through the callback chain and the corpus mAP
+    is recomputed at eval() (the reference's has_state detection_map op
+    chain, host-side)."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version='integral'):
+        super().__init__('map_eval')
+        if class_num is None:
+            raise ValueError("class_num is required")
+        if gt_difficult is not None or not evaluate_difficult:
+            # metric.extras.detection_map has no difficult-flag input; fail
+            # loudly instead of silently counting difficult GT boxes
+            raise NotImplementedError(
+                "DetectionMAP: difficult-aware evaluation (gt_difficult / "
+                "evaluate_difficult=False) is not implemented; only "
+                "evaluate_difficult=True without a difficult flag is "
+                "supported")
+        from ..metric import extras
+        self._metric = extras.DetectionMAP(
+            class_num, overlap_threshold, ap_version)
+        metric = self._metric
+        self.states.append(self._stub_state())
+
+        def host(det, labs, boxes):
+            return (np.asarray(det, np.float32).reshape(-1, 6),
+                    np.asarray(labs, np.int32).reshape(-1),
+                    np.asarray(boxes, np.float32).reshape(-1, 4))
+
+        def accumulate(det, labs, boxes):
+            metric.update([det], [labs], [boxes])
+
+        outs = self._batch_metric_op(
+            [input, gt_label, gt_box], host,
+            lambda vals: [((vals[0].shape[0], 6), np.float32),
+                          ((vals[1].shape[0],), np.int32),
+                          ((vals[2].shape[0], 4), np.float32)],
+            accumulate, n_out=3)
+        self._map_var = outs[0]
+
+    def _stub_state(self):
+        metric = self._metric
+
+        class _S:
+            def zero(self):
+                metric.reset()
+        return _S()
+
+    def get_map_var(self):
+        return self._map_var
+
+    def eval(self, executor=None, eval_program=None):
+        return np.array([self._metric.accumulate()], np.float32)

@@ -1,0 +1,94 @@
+"""fluid.transpiler — redirect shims for the 1.8 transpiler surface.
+
+Parity: /root/reference/python/paddle/fluid/transpiler/__init__.py:21
+(DistributeTranspiler, memory_optimize, release_memory, HashName,
+RoundRobin, DistributeTranspilerConfig).
+
+TPU-first divergence (SURVEY §6): the transpiler rewrote ProgramDescs into
+pserver/trainer program pairs for the CPU parameter-server runtime. On TPU
+the equivalents are sharding-based: distributed.fleet (collective
+training), distributed.ps.SparseShardedTable (sharded embedding tables),
+and XLA's memory planner (memory_optimize). These names exist so verbatim
+1.8 PS scripts fail with guidance instead of AttributeError.
+"""
+import warnings
+
+__all__ = ['DistributeTranspiler', 'memory_optimize', 'release_memory',
+           'HashName', 'RoundRobin', 'DistributeTranspilerConfig']
+
+_PS_MSG = (
+    "{name} drove the reference's parameter-server runtime, which does not "
+    "exist on TPU. Use paddle_tpu.distributed.fleet (collective training "
+    "over the device mesh) or distributed.ps.SparseShardedTable (sharded "
+    "embeddings); see SURVEY.md §6 for the divergence note.")
+
+
+class DistributeTranspilerConfig:
+    """Accepted for API parity; every knob is recorded but nothing is
+    transpiled (reference distribute_transpiler.py:141)."""
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    sync_mode = True
+    runtime_split_send_recv = False
+    wait_port = True
+
+    def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+class _SplitMethod:
+    def __init__(self, pserver_endpoints=None):
+        self.pserver_endpoints = pserver_endpoints or []
+
+
+class HashName(_SplitMethod):
+    """Name-hash var placement policy (accepted, unused on TPU)."""
+
+
+class RoundRobin(_SplitMethod):
+    """Round-robin var placement policy (accepted, unused on TPU)."""
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+
+    def _refuse(self, method):
+        raise NotImplementedError(
+            _PS_MSG.format(name=f"DistributeTranspiler.{method}"))
+
+    def transpile(self, trainer_id, program=None, pservers=None,
+                  trainers=None, sync_mode=True, startup_program=None,
+                  current_endpoint=None):
+        self._refuse('transpile')
+
+    def get_trainer_program(self, wait_port=True):
+        self._refuse('get_trainer_program')
+
+    def get_pserver_program(self, endpoint):
+        self._refuse('get_pserver_program')
+
+    def get_pserver_programs(self, endpoint):
+        self._refuse('get_pserver_programs')
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        self._refuse('get_startup_program')
+
+
+def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """1.8 already deprecated this into a no-op warning
+    (transpiler/memory_optimization_transpiler.py); XLA's buffer assignment
+    performs the actual memory planning here."""
+    warnings.warn(
+        "memory_optimize is a no-op: XLA's buffer assignment plans memory "
+        "for the compiled program.", DeprecationWarning)
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    warnings.warn("release_memory is a no-op on TPU (XLA-managed HBM).",
+                  DeprecationWarning)

@@ -1,0 +1,173 @@
+"""paddle.utils.image_util — classic image batch/crop/flip helpers.
+
+Parity: /root/reference/python/paddle/utils/image_util.py:1 (resize_image,
+flip, crop_img, decode_jpeg, preprocess_img, load_meta, load_image,
+oversample, ImageTransformer). Pure numpy port with PIL used only where
+the reference decodes/loads files (gated by try_import so the numpy ops
+work without PIL).
+"""
+import io
+
+import numpy as np
+
+__all__ = ['resize_image', 'flip', 'crop_img', 'decode_jpeg',
+           'preprocess_img', 'load_meta', 'load_image', 'oversample',
+           'ImageTransformer']
+
+
+def _pil():
+    from .lazy_import import try_import
+    return try_import('PIL.Image')
+
+
+def resize_image(img, target_size):
+    """Resize a PIL image so the shorter edge equals target_size."""
+    Image = _pil()
+    percent = target_size / float(min(img.size[0], img.size[1]))
+    resized = (int(round(img.size[0] * percent)),
+               int(round(img.size[1] * percent)))
+    resample = getattr(Image, 'LANCZOS', getattr(Image, 'ANTIALIAS', 1))
+    return img.resize(resized, resample)
+
+
+def flip(im):
+    """Horizontal flip of a (K, H, W) or (H, W) ndarray."""
+    if im.ndim == 3:
+        return im[:, :, ::-1]
+    return im[:, ::-1]
+
+
+def crop_img(im, inner_size, color=True, test=True):
+    """Center (test) or random (train, + random flip) inner_size crop of a
+    (K, H, W) / (H, W) ndarray, zero-padded up to inner_size if smaller."""
+    im = im.astype('float32')
+    if color:
+        height = max(inner_size, im.shape[1])
+        width = max(inner_size, im.shape[2])
+        padded = np.zeros((3, height, width), np.float32)
+        sy = (height - im.shape[1]) // 2
+        sx = (width - im.shape[2]) // 2
+        padded[:, sy:sy + im.shape[1], sx:sx + im.shape[2]] = im
+    else:
+        height = max(inner_size, im.shape[0])
+        width = max(inner_size, im.shape[1])
+        padded = np.zeros((height, width), np.float32)
+        sy = (height - im.shape[0]) // 2
+        sx = (width - im.shape[1]) // 2
+        padded[sy:sy + im.shape[0], sx:sx + im.shape[1]] = im
+    if test:
+        sy = (height - inner_size) // 2
+        sx = (width - inner_size) // 2
+    else:
+        sy = np.random.randint(0, height - inner_size + 1)
+        sx = np.random.randint(0, width - inner_size + 1)
+    pic = padded[..., sy:sy + inner_size, sx:sx + inner_size]
+    if not test and np.random.randint(2) == 0:
+        pic = flip(pic)
+    return pic
+
+
+def decode_jpeg(jpeg_string):
+    """Decode JPEG bytes to a (K, H, W) ndarray."""
+    Image = _pil()
+    arr = np.array(Image.open(io.BytesIO(jpeg_string)))
+    if arr.ndim == 3:
+        arr = np.transpose(arr, (2, 0, 1))
+    return arr
+
+
+def preprocess_img(im, img_mean, crop_size, is_train, color=True):
+    """Crop (+train-time flip), subtract the mean image, flatten."""
+    im = im.astype('float32')
+    pic = crop_img(im, crop_size, color, test=not is_train)
+    pic -= img_mean
+    return pic.flatten()
+
+
+def load_meta(meta_path, mean_img_size, crop_size, color=True):
+    """Load the dataset mean image and center-crop it to crop_size."""
+    mean = np.load(meta_path)['data_mean']
+    border = (mean_img_size - crop_size) // 2
+    if color:
+        assert mean_img_size * mean_img_size * 3 == mean.shape[0]
+        mean = mean.reshape(3, mean_img_size, mean_img_size)
+        mean = mean[:, border:border + crop_size,
+                    border:border + crop_size]
+    else:
+        assert mean_img_size * mean_img_size == mean.shape[0]
+        mean = mean.reshape(mean_img_size, mean_img_size)
+        mean = mean[border:border + crop_size, border:border + crop_size]
+    return mean.astype('float32')
+
+
+def load_image(img_path, is_color=True):
+    """Open an image file (PIL)."""
+    Image = _pil()
+    img = Image.open(img_path)
+    img.load()
+    return img
+
+
+def oversample(img, crop_dims):
+    """Ten-crop a batch: 4 corners + center, plus horizontal mirrors.
+    img: iterable of (H, W, K) ndarrays; returns (10*N, ch, cw, K)."""
+    im_shape = np.array(img[0].shape)
+    crop_dims = np.array(crop_dims)
+    im_center = im_shape[:2] / 2.0
+    h_indices = (0, im_shape[0] - crop_dims[0])
+    w_indices = (0, im_shape[1] - crop_dims[1])
+    crops_ix = np.empty((5, 4), dtype=int)
+    curr = 0
+    for i in h_indices:
+        for j in w_indices:
+            crops_ix[curr] = (i, j, i + crop_dims[0], j + crop_dims[1])
+            curr += 1
+    crops_ix[4] = np.tile(im_center, (1, 2)) + np.concatenate(
+        [-crop_dims / 2.0, crop_dims / 2.0])
+    crops_ix = np.tile(crops_ix, (2, 1))
+    crops = np.empty(
+        (10 * len(img), crop_dims[0], crop_dims[1], im_shape[-1]),
+        dtype=np.float32)
+    ix = 0
+    for im in img:
+        for crop in crops_ix:
+            crops[ix] = im[crop[0]:crop[2], crop[1]:crop[3], :]
+            ix += 1
+        crops[ix - 5:ix] = crops[ix - 5:ix, :, ::-1, :]
+    return crops
+
+
+class ImageTransformer:
+    """Channel transpose / swap / mean-subtract pipeline (reference :183)."""
+
+    def __init__(self, transpose=None, channel_swap=None, mean=None,
+                 is_color=True):
+        self.is_color = is_color
+        self.set_transpose(transpose)
+        self.set_channel_swap(channel_swap)
+        self.set_mean(mean)
+
+    def set_transpose(self, order):
+        if order is not None and self.is_color:
+            assert len(order) == 3
+        self.transpose = order
+
+    def set_channel_swap(self, order):
+        if order is not None and self.is_color:
+            assert len(order) == 3
+        self.channel_swap = order
+
+    def set_mean(self, mean):
+        if mean is not None and mean.ndim == 1:
+            mean = mean[:, np.newaxis, np.newaxis]
+        self.mean = mean
+
+    def transformer(self, data):
+        if self.transpose is not None:
+            data = data.transpose(self.transpose)
+        if self.channel_swap is not None:
+            data = data[self.channel_swap, :, :]
+        if self.mean is not None:
+            data = data.astype('float32')
+            data -= self.mean
+        return data

@@ -1,0 +1,191 @@
+"""fluid.evaluator + fluid.transpiler shims + utils.image_util.
+
+Parity: reference fluid/evaluator.py:27, fluid/transpiler/__init__.py:21,
+paddle/utils/image_util.py:1 (VERDICT r4 missing #5/#6 + transpiler note).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+# ---------------------------------------------------------------------------
+# fluid.evaluator
+# ---------------------------------------------------------------------------
+
+def test_edit_distance_evaluator_eager_accumulation():
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        # two batches: the evaluator's io_callback accumulation fires per
+        # construction-time execution in eager mode
+        a = paddle.to_tensor(np.array([[1, 2, 3]], np.int64))
+        b = paddle.to_tensor(np.array([[1, 2, 4]], np.int64))
+        ev = fluid.evaluator.EditDistance(a, b)
+        avg, err = ev.eval(None)
+    assert avg[0] == pytest.approx(1.0)   # one substitution
+    assert err[0] == pytest.approx(1.0)   # 1/1 sequences wrong
+    ev.reset(None)
+    avg, err = ev.eval(None)
+    assert avg[0] == 0.0 and err[0] == 0.0
+
+
+def test_chunk_evaluator_protocol():
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        # IOB scheme, 1 chunk type: perfect prediction
+        label = paddle.to_tensor(np.array([[0, 1, 2, 0]], np.int64))
+        ev = fluid.evaluator.ChunkEvaluator(
+            label, label, chunk_scheme='IOB', num_chunk_types=1)
+        p, r, f1 = ev.eval(None)
+    assert p[0] == pytest.approx(1.0)
+    assert r[0] == pytest.approx(1.0)
+    assert f1[0] == pytest.approx(1.0)
+    assert len(ev.metrics) == 3
+    ev.reset(None)
+    p, r, f1 = ev.eval(None)
+    assert f1[0] == 0.0
+
+
+def test_detection_map_evaluator():
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        det = paddle.to_tensor(np.array(
+            [[0, 0.9, 0, 0, 10, 10]], np.float32))
+        gt_label = paddle.to_tensor(np.array([0], np.int64))
+        gt_box = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+        ev = fluid.evaluator.DetectionMAP(det, gt_label, gt_box,
+                                          class_num=1)
+        m = ev.eval(None)
+    assert m[0] == pytest.approx(1.0)
+    assert ev.get_map_var() is not None
+
+
+def test_edit_distance_evaluator_static_program_accumulates():
+    """The module's central claim: inside a static Program the io_callback
+    accumulation op fires on EVERY exe.run, like the reference's
+    layers.sums-into-persistable-state."""
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        paddle.enable_static()
+        try:
+            main = fluid.Program()
+            startup = fluid.Program()
+            with fluid.program_guard(main, startup):
+                a = fluid.layers.data(name='a', shape=[-1, 3],
+                                      dtype='int64')
+                b = fluid.layers.data(name='b', shape=[-1, 3],
+                                      dtype='int64')
+                ev = fluid.evaluator.EditDistance(a, b)
+                out = a  # something cheap to fetch
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                ev.reset(exe)
+                # batch 1: one substitution; batch 2: identical sequences
+                exe.run(main,
+                        feed={'a': np.array([[1, 2, 3]], np.int64),
+                              'b': np.array([[1, 2, 4]], np.int64)},
+                        fetch_list=[out])
+                exe.run(main,
+                        feed={'a': np.array([[5, 6, 7]], np.int64),
+                              'b': np.array([[5, 6, 7]], np.int64)},
+                        fetch_list=[out])
+                avg, err = ev.eval(exe)
+        finally:
+            paddle.disable_static()
+    # 2 sequences seen, total distance 1 -> avg 0.5, error rate 0.5
+    assert avg[0] == pytest.approx(0.5)
+    assert err[0] == pytest.approx(0.5)
+
+
+def test_evaluator_deprecation_warning():
+    with pytest.warns(Warning, match='deprecated'):
+        fluid.evaluator.EditDistance(
+            paddle.to_tensor(np.array([[1]], np.int64)),
+            paddle.to_tensor(np.array([[1]], np.int64)))
+
+
+# ---------------------------------------------------------------------------
+# fluid.transpiler shims
+# ---------------------------------------------------------------------------
+
+def test_transpiler_names_exist_and_guide():
+    assert hasattr(fluid, 'DistributeTranspiler')
+    assert hasattr(fluid.transpiler, 'HashName')
+    assert hasattr(fluid.transpiler, 'RoundRobin')
+    cfg = fluid.DistributeTranspilerConfig(sync_mode=False)
+    assert cfg.sync_mode is False
+    t = fluid.DistributeTranspiler(config=cfg)
+    with pytest.raises(NotImplementedError, match='fleet'):
+        t.transpile(0, pservers='127.0.0.1:6170', trainers=1)
+    with pytest.raises(NotImplementedError, match='fleet'):
+        t.get_pserver_program('127.0.0.1:6170')
+
+
+def test_memory_optimize_noop_warns():
+    with pytest.warns(DeprecationWarning):
+        fluid.memory_optimize(None)
+    with pytest.warns(DeprecationWarning):
+        fluid.release_memory(None)
+
+
+# ---------------------------------------------------------------------------
+# utils.image_util
+# ---------------------------------------------------------------------------
+
+def test_image_util_flip_and_crop():
+    from paddle_tpu.utils import image_util as iu
+    im = np.arange(2 * 4 * 4, dtype=np.float32).reshape(2, 4, 4)
+    f = iu.flip(im)
+    np.testing.assert_array_equal(f, im[:, :, ::-1])
+    # color center crop: (3, H, W) input
+    im3 = np.arange(3 * 6 * 6, dtype=np.float32).reshape(3, 6, 6)
+    crop = iu.crop_img(im3, 4, color=True, test=True)
+    assert crop.shape == (3, 4, 4)
+    np.testing.assert_array_equal(crop, im3[:, 1:5, 1:5])
+    # smaller than inner_size: zero-padded
+    small = np.ones((3, 2, 2), np.float32)
+    crop = iu.crop_img(small, 4, color=True, test=True)
+    assert crop.shape == (3, 4, 4)
+    assert crop.sum() == pytest.approx(12.0)
+    # grayscale path
+    g = iu.crop_img(np.ones((5, 5), np.float32), 3, color=False, test=True)
+    assert g.shape == (3, 3)
+
+
+def test_image_util_preprocess_and_oversample():
+    from paddle_tpu.utils import image_util as iu
+    im = np.ones((3, 8, 8), np.float32)
+    mean = np.zeros((3, 4, 4), np.float32)
+    flat = iu.preprocess_img(im, mean, 4, is_train=False)
+    assert flat.shape == (3 * 4 * 4,)
+    np.testing.assert_allclose(flat, 1.0)
+    imgs = [np.arange(6 * 6 * 3, dtype=np.float32).reshape(6, 6, 3)]
+    crops = iu.oversample(imgs, (4, 4))
+    assert crops.shape == (10, 4, 4, 3)
+    # second five are mirrors of the first five
+    np.testing.assert_array_equal(crops[5:], crops[:5][:, :, ::-1, :])
+
+
+def test_image_util_load_meta(tmp_path):
+    from paddle_tpu.utils import image_util as iu
+    mean = np.arange(3 * 8 * 8, dtype=np.float32)
+    p = tmp_path / 'meta.npz'
+    np.savez(p, data_mean=mean)
+    m = iu.load_meta(str(p), 8, 4, color=True)
+    assert m.shape == (3, 4, 4)
+    expect = mean.reshape(3, 8, 8)[:, 2:6, 2:6]
+    np.testing.assert_array_equal(m, expect)
+
+
+def test_image_transformer():
+    from paddle_tpu.utils import image_util as iu
+    t = iu.ImageTransformer(transpose=(2, 0, 1), channel_swap=(2, 1, 0),
+                            mean=np.array([1.0, 2.0, 3.0], np.float32))
+    data = np.random.RandomState(0).rand(4, 4, 3).astype(np.float32)
+    out = t.transformer(data)
+    expect = data.transpose(2, 0, 1)[(2, 1, 0), :, :] - \
+        np.array([1, 2, 3], np.float32)[:, None, None]
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
