@@ -8,7 +8,12 @@
 extern "C" {
 
 // Parse newline-separated MultiSlot lines.
-//  text/text_len : input buffer (need not be NUL-terminated)
+//  text/text_len : input buffer. MUST be NUL-terminated at text[text_len]
+//                  (or beyond): strtol/strtod scan from p without a length
+//                  bound, so a buffer ending in a digit with no terminator
+//                  would read past text_len. The ctypes binding satisfies
+//                  this — CPython bytes objects always carry a trailing
+//                  NUL — but any new caller must too.
 //  n_slots       : groups per line
 //  out/out_cap   : flat value output (doubles, line-major then slot-major)
 //  counts/counts_cap : per (line, slot) value counts

@@ -49,7 +49,7 @@ def load():
             stale = any(
                 os.path.getmtime(os.path.join(_CSRC, f)) >
                 os.path.getmtime(_LIB_PATH)
-                for f in ('prefetch.cpp', 'tokenizer.cpp')
+                for f in ('prefetch.cpp', 'tokenizer.cpp', 'multislot.cpp')
                 if os.path.exists(os.path.join(_CSRC, f)))
         if stale and not _build():
             return None

@@ -214,10 +214,17 @@ class Executor:
                 dt = np.dtype(var.dtype)
                 name = getattr(var, 'name', str(var))
                 want = tuple(getattr(var, 'shape', ()) or ())
-                if len(want) == 1 or (len(want) == 2 and want[-1] == 1
-                                      and width == 1):
-                    arr = arr.reshape(len(rows), *want[1:]) \
-                        if len(want) > 1 else arr.reshape(len(rows))
+                if len(want) == 1:
+                    if width != 1:
+                        raise ValueError(
+                            f"train_from_dataset: slot {s} feeds 1-D "
+                            f"variable '{name}' (shape {list(want)}) but a "
+                            f"line carries {width} values per instance; "
+                            f"declare the variable as [-1, {width}] or fix "
+                            f"the slot arity in the data file")
+                    arr = arr.reshape(len(rows))
+                elif len(want) == 2 and want[-1] == 1 and width == 1:
+                    arr = arr.reshape(len(rows), *want[1:])
                 feed[name] = arr.astype(dt)
             outs = self.run(program, feed=feed,
                             fetch_list=list(fetch_list or []))

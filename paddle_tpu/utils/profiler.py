@@ -6,6 +6,7 @@ dumps; scoped annotations map to TraceAnnotation.
 import contextlib
 import cProfile
 import io
+import os
 import pstats
 
 import jax
@@ -27,10 +28,24 @@ def start_profiler(state='All', tracer_option='Default',
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    """Stop profiling and print a sorted per-op time table (the reference
+    profiler.py contract: sorted_key in calls/total/max/min/ave)."""
+    if sorted_key not in _SORT_FIELD:
+        raise ValueError(
+            f"sorted_key must be one of "
+            f"{sorted(k for k in _SORT_FIELD if isinstance(k, str))} or "
+            f"None, got {sorted_key!r}")
+    table = None
     if _active['dir'] is not None:
         jax.profiler.stop_trace()
-        print(f"profile trace written to {_active['dir']}")
+        log_dir = _active['dir']
         _active['dir'] = None
+        print(f"profile trace written to {log_dir}")
+        table = _op_summary(log_dir, sorted_key)
+        if table:
+            print(table)
+    # always clear a cProfile fallback too (a failed double-start can leave
+    # one enabled alongside an active trace)
     if _active['py'] is not None:
         _active['py'].disable()
         s = io.StringIO()
@@ -38,6 +53,38 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
             .print_stats(30)
         print(s.getvalue())
         _active['py'] = None
+    return table
+
+
+_SORT_FIELD = {'total': 'total_ms', 'calls': 'calls', 'max': 'max_ms',
+               'min': 'min_ms', 'ave': 'ave_ms', None: 'total_ms',
+               'default': 'total_ms'}
+
+
+def _op_summary(log_dir, sorted_key=None, limit=40):
+    """Aggregate the xplane dump under log_dir into the reference-style
+    per-op table string ('Event / Calls / Total / Max / Min / Ave')."""
+    import glob
+    from . import xplane
+    paths = glob.glob(os.path.join(log_dir, '**', '*.xplane.pb'),
+                      recursive=True)
+    if not paths:
+        return None
+    # newest dump wins (each start/stop cycle writes a new timestamp dir)
+    path = max(paths, key=os.path.getmtime)
+    ops = xplane.op_table(path)
+    if not ops:
+        return None
+    field = _SORT_FIELD.get(sorted_key, 'total_ms')
+    rows = sorted(ops.items(), key=lambda kv: -kv[1][field])[:limit]
+    width = max([len('Event')] + [len(k) for k, _ in rows])
+    lines = [f"{'Event':<{width}}  {'Calls':>6} {'Total(ms)':>10} "
+             f"{'Max(ms)':>9} {'Min(ms)':>9} {'Ave(ms)':>9}"]
+    for op, a in rows:
+        lines.append(
+            f"{op:<{width}}  {a['calls']:>6} {a['total_ms']:>10.4f} "
+            f"{a['max_ms']:>9.4f} {a['min_ms']:>9.4f} {a['ave_ms']:>9.4f}")
+    return "\n".join(lines)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,134 @@
+"""Minimal XSpace (xplane.pb) parser + per-op aggregation. No TF deps.
+
+Parity context: the reference profiler (python/paddle/fluid/profiler.py)
+prints a sorted per-op time table from its C++ event collector. Here the
+events come from jax.profiler's TensorBoard xplane dump: on TPU the
+device plane's 'XLA Ops' line, on CPU the PjRt client runtime line
+(tf_XLAPjRtCpuClient/...). The protobuf wire walking is hand-rolled so no
+tensorflow/tensorboard import is needed.
+"""
+import collections
+import struct
+
+__all__ = ['op_table', 'parse_planes']
+
+
+def _varint(buf, i):
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def _fields(buf, start=0, end=None):
+    """Yield (field_no, wire_type, value_or_span) over a message buffer."""
+    i = start
+    end = len(buf) if end is None else end
+    while i < end:
+        tag, i = _varint(buf, i)
+        fno, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+            yield fno, wt, v
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            yield fno, wt, (i, i + ln)
+            i += ln
+        elif wt == 5:
+            yield fno, wt, struct.unpack_from('<f', buf, i)[0]
+            i += 4
+        elif wt == 1:
+            yield fno, wt, struct.unpack_from('<d', buf, i)[0]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wt}")
+
+
+def parse_planes(path):
+    """Yield (plane_name, lines, event_metadata, stat_metadata, buf) per
+    XPlane; lines are [(line_name, [event spans])]."""
+    with open(path, 'rb') as f:
+        buf = f.read()
+    for fno, wt, v in _fields(buf):
+        if fno != 1 or wt != 2:
+            continue
+        ps, pe = v
+        name = ''
+        lines = []
+        ev_meta = {}
+        stat_meta = {}
+        for f1, w1, v1 in _fields(buf, ps, pe):
+            if f1 == 2 and w1 == 2:
+                name = buf[v1[0]:v1[1]].decode('utf-8', 'replace')
+            elif f1 == 3 and w1 == 2:
+                lname = ''
+                events = []
+                for f2, w2, v2 in _fields(buf, v1[0], v1[1]):
+                    if f2 == 2 and w2 == 2:
+                        lname = buf[v2[0]:v2[1]].decode('utf-8', 'replace')
+                    elif f2 == 4 and w2 == 2:
+                        events.append(v2)
+                lines.append((lname, events))
+            elif f1 in (4, 5) and w1 == 2:
+                k = None
+                span = None
+                for f2, w2, v2 in _fields(buf, v1[0], v1[1]):
+                    if f2 == 1 and w2 == 0:
+                        k = v2
+                    elif f2 == 2 and w2 == 2:
+                        span = v2
+                if span is None:
+                    continue
+                mname = ''
+                for f3, w3, v3 in _fields(buf, span[0], span[1]):
+                    if f3 == 2 and w3 == 2:
+                        mname = buf[v3[0]:v3[1]].decode('utf-8', 'replace')
+                (ev_meta if f1 == 4 else stat_meta)[k] = mname
+        yield name, lines, ev_meta, stat_meta, buf
+
+
+def _is_op_line(plane_name, line_name):
+    if line_name == 'XLA Ops':                  # TPU/GPU device planes
+        return True
+    return line_name.startswith('tf_XLAPjRtCpuClient')  # CPU runtime
+
+
+def op_table(path):
+    """Aggregate per-op execution stats across every op line in the dump.
+
+    Returns {op_name: {'total_ms', 'calls', 'max_ms', 'min_ms', 'ave_ms'}}.
+    """
+    agg = collections.defaultdict(
+        lambda: {'total_ms': 0.0, 'calls': 0, 'max_ms': 0.0,
+                 'min_ms': float('inf')})
+    for name, lines, ev_meta, _stat, buf in parse_planes(path):
+        for lname, events in lines:
+            if not _is_op_line(name, lname):
+                continue
+            for (es, ee) in events:
+                mid = 0
+                dur = 0
+                for f2, w2, v2 in _fields(buf, es, ee):
+                    if f2 == 1 and w2 == 0:
+                        mid = v2
+                    elif f2 == 3 and w2 == 0:
+                        dur = v2
+                op = ev_meta.get(mid, str(mid))
+                if op.startswith('end: '):      # CPU runtime end markers
+                    continue
+                ms = dur / 1e9                  # ps -> ms
+                a = agg[op]
+                a['total_ms'] += ms
+                a['calls'] += 1
+                a['max_ms'] = max(a['max_ms'], ms)
+                a['min_ms'] = min(a['min_ms'], ms)
+    for a in agg.values():
+        a['ave_ms'] = a['total_ms'] / a['calls'] if a['calls'] else 0.0
+        if a['min_ms'] == float('inf'):
+            a['min_ms'] = 0.0
+    return dict(agg)

@@ -1,0 +1,125 @@
+"""Native shm prefetch ring vs multiprocessing.Queue throughput.
+
+VERDICT r4 #10: prove the csrc ring pays on a real input pipeline, or
+record a removal decision. The ring's job is CROSS-PROCESS batch transfer
+(DataLoader workers -> trainer, _native/process_pool.py): workers
+serialize batches into a SharedMemory ring (csrc/prefetch.cpp provides
+the seq-ordered slot protocol); the baseline is what multiprocessing
+gives for free — pickling each batch through mp.Queue.
+
+(An earlier in-process comparison against PyPrefetchRing was meaningless:
+that ring passes references, which cannot cross processes at all.)
+
+Run: PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python tools/bench_prefetch.py
+Prints one JSON line.
+"""
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+from multiprocessing import shared_memory
+
+import numpy as np
+
+sys.path.insert(0,
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH_SHAPE = (32, 3, 64, 64)
+N_BATCHES = 200
+N_WORKERS = 2
+
+
+def _make_batch(i):
+    return [np.full(BATCH_SHAPE, i % 8, np.float32),
+            np.full((BATCH_SHAPE[0],), i % 8, np.int64)]
+
+
+def _ring_worker(shm_name, pid):
+    from paddle_tpu._native.prefetch import NativePrefetchRing
+    shm = shared_memory.SharedMemory(name=shm_name)
+    ring = NativePrefetchRing.attach(shm.buf)
+    for seq in range(pid, N_BATCHES, N_WORKERS):
+        if not ring.put(_make_batch(seq), seq):
+            break
+    shm.close()
+
+
+def _queue_worker(q, pid):
+    for seq in range(pid, N_BATCHES, N_WORKERS):
+        q.put((seq, _make_batch(seq)))
+
+
+def bench_ring():
+    from paddle_tpu._native.prefetch import (NativePrefetchRing,
+                                             block_bytes, serialized_size)
+    slot_bytes = serialized_size(_make_batch(0))
+    cap = 8
+    shm = shared_memory.SharedMemory(create=True,
+                                     size=block_bytes(cap, slot_bytes))
+    ring = NativePrefetchRing(cap, slot_bytes, _buf=shm.buf)
+    ctx = mp.get_context('fork')
+    procs = [ctx.Process(target=_ring_worker, args=(shm.name, p), daemon=True)
+             for p in range(N_WORKERS)]
+    t0 = time.perf_counter()
+    for p in procs:
+        p.start()
+    got = 0
+    while got < N_BATCHES:
+        res = ring.get(timeout_ms=20000)
+        if res in ('skip', 'timeout') or res is None:
+            break
+        arrays, release = res
+        _ = [np.array(a) for a in arrays]    # copy out of shm (the real path)
+        release()
+        got += 1
+    dt = time.perf_counter() - t0
+    for p in procs:
+        p.join(timeout=10)
+    ring.close()
+    shm.close()
+    shm.unlink()
+    assert got == N_BATCHES, f"ring drained {got}/{N_BATCHES}"
+    return N_BATCHES * BATCH_SHAPE[0] / dt
+
+
+def bench_queue():
+    ctx = mp.get_context('fork')
+    q = ctx.Queue(maxsize=8)
+    procs = [ctx.Process(target=_queue_worker, args=(q, p), daemon=True)
+             for p in range(N_WORKERS)]
+    t0 = time.perf_counter()
+    for p in procs:
+        p.start()
+    pending = {}
+    want = 0
+    got = 0
+    while got < N_BATCHES:
+        seq, arrays = q.get(timeout=20)
+        pending[seq] = arrays
+        while want in pending:                # enforce batch order like ring
+            _ = pending.pop(want)
+            want += 1
+            got += 1
+    dt = time.perf_counter() - t0
+    for p in procs:
+        p.join(timeout=10)
+    return N_BATCHES * BATCH_SHAPE[0] / dt
+
+
+def main():
+    from paddle_tpu._native.prefetch import native_available
+    if not native_available():
+        print(json.dumps({'error': 'native lib unavailable'}))
+        return
+    ring = bench_ring()
+    queue = bench_queue()
+    print(json.dumps({
+        'metric': 'crossproc_prefetch_samples_per_sec',
+        'native_shm_ring': round(ring, 1),
+        'mp_queue_pickle': round(queue, 1),
+        'speedup': round(ring / queue, 3)}))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,108 +1,30 @@
-"""Minimal XSpace (xplane.pb) parser + XLA-op aggregation. No TF deps."""
+"""CLI over paddle_tpu.utils.xplane: per-op table from an xplane.pb dump.
+
+Usage: python tools/parse_xplane.py <path/to/*.xplane.pb>
+The shipped API equivalent is utils.profiler.stop_profiler(sorted_key=...),
+which prints this table automatically after a trace.
+"""
 import collections
-import struct
+import os
 import sys
 
+sys.path.insert(0,
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def varint(buf, i):
-    r = 0; s = 0
-    while True:
-        b = buf[i]; i += 1
-        r |= (b & 0x7f) << s
-        if not b & 0x80:
-            return r, i
-        s += 7
+from paddle_tpu.utils import xplane  # noqa: E402
 
 
-def fields(buf, start=0, end=None):
-    """Yield (field_no, wire_type, value_or_span) over a message buffer."""
-    i = start
-    end = len(buf) if end is None else end
-    while i < end:
-        tag, i = varint(buf, i)
-        fno, wt = tag >> 3, tag & 7
-        if wt == 0:
-            v, i = varint(buf, i)
-            yield fno, wt, v
-        elif wt == 2:
-            ln, i = varint(buf, i)
-            yield fno, wt, (i, i + ln)
-            i += ln
-        elif wt == 5:
-            yield fno, wt, struct.unpack_from('<f', buf, i)[0]; i += 4
-        elif wt == 1:
-            yield fno, wt, struct.unpack_from('<d', buf, i)[0]; i += 8
-        else:
-            raise ValueError(f"wire type {wt}")
-
-
-def parse(path, line_filter=('XLA Ops',)):
-    buf = open(path, 'rb').read()
-    planes = []
-    for fno, wt, v in fields(buf):
-        if fno == 1 and wt == 2:
-            planes.append(v)
-    out = []
-    for (ps, pe) in planes:
-        name = ''
-        lines = []
-        ev_meta = {}    # id -> name
-        stat_meta = {}  # id -> name
-        for fno, wt, v in fields(buf, ps, pe):
-            if fno == 2 and wt == 2:
-                name = buf[v[0]:v[1]].decode('utf-8', 'replace')
-            elif fno == 3 and wt == 2:
-                lines.append(v)
-            elif fno in (4, 5) and wt == 2:
-                # map entry: key=1 varint, value=2 message
-                k = None; span = None
-                for f2, w2, v2 in fields(buf, v[0], v[1]):
-                    if f2 == 1 and w2 == 0: k = v2
-                    elif f2 == 2 and w2 == 2: span = v2
-                if span is None: continue
-                mname = ''
-                for f3, w3, v3 in fields(buf, span[0], span[1]):
-                    if f3 == 2 and w3 == 2:
-                        mname = buf[v3[0]:v3[1]].decode('utf-8', 'replace')
-                (ev_meta if fno == 4 else stat_meta)[k] = mname
-        out.append((name, lines, ev_meta, stat_meta, buf))
-    return out
-
-
-def aggregate(path):
-    for name, lines, ev_meta, stat_meta, buf in parse(path):
-        if 'TPU' not in name or ':' not in name:
-            continue
-        for (ls, le) in lines:
-            lname = ''
-            events = []
-            for fno, wt, v in fields(buf, ls, le):
-                if fno == 2 and wt == 2:
-                    lname = buf[v[0]:v[1]].decode('utf-8', 'replace')
-                elif fno == 4 and wt == 2:
-                    events.append(v)
-            if lname not in ('XLA Ops',):
-                continue
-            agg = collections.defaultdict(lambda: [0.0, 0])
-            for (es, ee) in events:
-                mid = 0; dur = 0
-                for f2, w2, v2 in fields(buf, es, ee):
-                    if f2 == 1 and w2 == 0: mid = v2
-                    elif f2 == 3 and w2 == 0: dur = v2
-                a = agg[ev_meta.get(mid, str(mid))]
-                a[0] += dur / 1e9   # ps -> ms... ps/1e9 = ms? 1e12 ps = 1s; /1e9 = ms yes
-                a[1] += 1
-            yield name, lname, agg
+def main():
+    path = sys.argv[1]
+    ops = xplane.op_table(path)
+    tot = sum(a['total_ms'] for a in ops.values())
+    print(f"== {path}: {tot:.1f} ms, {len(ops)} op names ==")
+    groups = collections.defaultdict(float)
+    for name, a in ops.items():
+        groups[name.split('.')[0]] += a['total_ms']
+    for k, v in sorted(groups.items(), key=lambda kv: -kv[1])[:40]:
+        print(f"  {v:9.1f} ms {100 * v / max(tot, 1e-9):5.1f}%  {k}")
 
 
 if __name__ == '__main__':
-    path = sys.argv[1]
-    for pname, lname, agg in aggregate(path):
-        tot = sum(a[0] for a in agg.values())
-        print(f"== {pname} / {lname}: {tot:.1f} ms, {len(agg)} op names ==")
-        groups = collections.defaultdict(float)
-        for name, (dur, cnt) in agg.items():
-            base = name.split('.')[0]
-            groups[base] += dur
-        for k, v in sorted(groups.items(), key=lambda kv: -kv[1])[:40]:
-            print(f"  {v:9.1f} ms {100*v/tot:5.1f}%  {k}")
+    main()
