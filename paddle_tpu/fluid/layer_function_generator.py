@@ -1,0 +1,98 @@
+"""fluid.layers.layer_function_generator — the op-registry docgen quartet.
+
+Parity: /root/reference/python/paddle/fluid/layers/
+layer_function_generator.py:28 (generate_layer_fn, generate_activation_fn,
+autodoc, templatedoc). The reference generates layer functions and
+docstrings from the C++ OpProto registry; here the "registry" is the
+package's own op surface (nn.functional + tensor ops + fluid.layers), and
+docstring templates substitute with what the Python implementation
+provides — no C++ proto exists by design.
+"""
+import string
+
+__all__ = ['generate_layer_fn', 'generate_activation_fn', 'autodoc',
+           'templatedoc']
+
+# ops whose reference proto also admits integer dtypes
+_INT_OK = ("abs", "exp", "square")
+_FLOATS = ('float16', 'bfloat16', 'float32', 'float64')
+
+
+def _lookup(op_type):
+    """Resolve op_type to this package's implementation (the OpProto-lookup
+    analogue)."""
+    from .. import nn
+    from .. import tensor as tensor_mod
+    from . import layers as fluid_layers
+    for ns in (nn.functional, tensor_mod, fluid_layers):
+        fn = getattr(ns, op_type, None)
+        if callable(fn):
+            return fn
+    raise ValueError(
+        f"generate_layer_fn: no implementation registered for op "
+        f"'{op_type}' (searched nn.functional, paddle.tensor, fluid.layers)")
+
+
+def generate_layer_fn(op_type):
+    """Return the layer function registered for ``op_type``
+    (reference :135 builds it from OpProto; here it resolves the existing
+    TPU implementation)."""
+    fn = _lookup(op_type)
+
+    def func(*args, **kwargs):
+        kwargs.pop('name', None)
+        return fn(*args, **kwargs)
+    func.__name__ = op_type
+    func.__doc__ = fn.__doc__ or f"{op_type} layer (generated)."
+    return func
+
+
+def generate_activation_fn(op_type):
+    """Return an activation function for ``op_type`` with the reference's
+    dtype admission rules (reference :244)."""
+    import numpy as np
+    fn = _lookup(op_type)
+    allowed = _FLOATS + (('int32', 'int64') if op_type in _INT_OK else ())
+
+    def func(x, name=None):
+        dt = np.dtype(getattr(x, 'dtype', np.float32)).name
+        if dt not in allowed:
+            raise TypeError(
+                f"{op_type}: dtype {dt} is not supported; expected one of "
+                f"{allowed}")
+        return fn(x)
+    func.__name__ = op_type
+    func.__doc__ = (fn.__doc__ or '') + (
+        "\n\n    name (str, optional): Name for the operation "
+        "(optional, default is None).")
+    return func
+
+
+def autodoc(comment=""):
+    """Decorator appending ``comment`` to the function's generated
+    docstring (reference :285)."""
+    def __impl__(func):
+        base = func.__doc__ or f"{func.__name__} (generated)."
+        func.__doc__ = base + comment
+        return func
+    return __impl__
+
+
+def templatedoc(op_type=None):
+    """Decorator substituting ``${comment}`` / ``${*_comment}`` /
+    ``${*_type}`` template slots in the docstring (reference :294). With no
+    C++ proto to read, ${comment} becomes the op name and unknown slots
+    resolve to neutral text via safe_substitute."""
+    def __impl__(func):
+        name = op_type or func.__name__
+        tmpl = string.Template(func.__doc__ or '${comment}')
+
+        class _Defaulting(dict):
+            def __missing__(self, key):
+                if key.endswith('_type'):
+                    return 'Variable'
+                return key.replace('_comment', '').replace('_', ' ')
+        func.__doc__ = tmpl.safe_substitute(
+            _Defaulting(comment=f"The {name} operator."))
+        return func
+    return __impl__

@@ -744,3 +744,16 @@ def array_length(array):
     from ..tensor.creation import to_tensor
     import numpy as _np
     return to_tensor(_np.array([len(array)], dtype='int64'))
+
+
+# op-registry docgen quartet (layer_function_generator.py): resolves against
+# this package's op surface instead of a C++ OpProto registry
+from . import layer_function_generator  # noqa: E402
+from .layer_function_generator import (generate_layer_fn,  # noqa: E402,F401
+                                       generate_activation_fn, autodoc,
+                                       templatedoc)
+# the reference spelling `fluid.layers.layer_function_generator` (layers is
+# a module here, not a package) — same aliasing as contrib.decoder
+import sys as _sys  # noqa: E402
+_sys.modules[__name__ + '.layer_function_generator'] = \
+    layer_function_generator
