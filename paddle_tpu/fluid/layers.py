@@ -164,7 +164,6 @@ from ..nn.decode import (Decoder, BeamSearchDecoder,  # noqa: E402,F401
 
 # -- classic 1.8 op functions (round-3 completions) --------------------------
 
-from ..static.graph import data  # noqa: E402,F401  (feed placeholder)
 
 
 def leaky_relu(x, alpha=0.02, name=None):
@@ -757,3 +756,24 @@ from .layer_function_generator import (generate_layer_fn,  # noqa: E402,F401
 import sys as _sys  # noqa: E402
 _sys.modules[__name__ + '.layer_function_generator'] = \
     layer_function_generator
+
+
+def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
+         type=None, stop_gradient=True):
+    """1.8 fluid.layers.data (layers/io.py:41): unlike fluid.data, the
+    shape EXCLUDES the batch dim and a -1 batch dim is prepended by
+    default. Pragmatic divergences: a shape already starting with -1/None
+    is taken as batch-inclusive instead of double-prepending, and a string
+    third positional argument is accepted as dtype with the full-shape
+    (two-point-x) contract."""
+    if isinstance(append_batch_size, str):
+        # 2.x-style positional call: data(name, full_shape, dtype)
+        append_batch_size, dtype = False, append_batch_size
+    shape = list(shape)
+    if append_batch_size and (not shape or
+                              shape[0] not in (-1, None)):
+        shape = [-1] + shape
+    from ..static.graph import data as _static_data
+    v = _static_data(name, shape, dtype=dtype, lod_level=lod_level)
+    v.stop_gradient = stop_gradient
+    return v

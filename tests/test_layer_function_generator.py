@@ -39,6 +39,28 @@ def test_generate_layer_fn_resolves_and_rejects():
         L.generate_layer_fn('definitely_not_an_op')
 
 
+def test_layers_data_18_append_batch_size():
+    """1.8 fluid.layers.data prepends a batch dim (layers/io.py:41);
+    fluid.data keeps the 2.x full-shape contract."""
+    paddle.enable_static()
+    try:
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            v = fluid.layers.data(name='w18', shape=[8], dtype='int64',
+                                  lod_level=1)
+            assert list(v.shape) == [1, 8] and 0 in v._dynamic_dims
+            v2 = fluid.layers.data(name='w20', shape=[-1, 8])
+            assert list(v2.shape) == [1, 8]
+            v3 = fluid.layers.data(name='wno', shape=[8],
+                                   append_batch_size=False)
+            assert list(v3.shape) == [8]
+            # 2.x-style positional dtype stays accepted
+            v4 = fluid.layers.data('wpos', [None, 3], 'float32')
+            assert list(v4.shape) == [1, 3]
+    finally:
+        paddle.disable_static()
+
+
 def test_autodoc_and_templatedoc():
     @L.autodoc(' appended note')
     def doc_fn(a):
