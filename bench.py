@@ -406,9 +406,29 @@ def main():
         "vs_baseline": 0.0, "error": ' | '.join(e for e in errors if e)}))
 
 
+def enable_xla_cache():
+    """Persistent XLA compile cache: over the axon tunnel a single BERT-large
+    train-step compile can take minutes, and the accel child compiles
+    several programs (autotune candidates + three benches). Warm-cache
+    reruns skip all of it, which is the difference between fitting the
+    driver's timeout and not (round-5 cold run: killed at 38 min).
+    Never fatal: on failure the bench just compiles cold."""
+    import jax
+    cache_dir = os.environ.get('PADDLE_TPU_XLA_CACHE',
+                               os.path.expanduser('~/.cache/paddle_tpu/xla'))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update('jax_compilation_cache_dir', cache_dir)
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+    except Exception as e:
+        print(f"compile cache unavailable: {e!r}", file=sys.stderr)
+
+
 def _child_main(mode, model):
     import jax
 
+    enable_xla_cache()
     try:
         on_accel = jax.default_backend() not in ('cpu',)
     except Exception as e:

@@ -1,0 +1,92 @@
+"""Incremental on-chip bench driver for iterating over a slow axon tunnel.
+
+Runs the same measurements as bench.py's accel child, but one stage at a
+time, appending a JSON line per completed stage to $BENCH_STAGES_OUT
+(default /tmp/bench_stages.jsonl) so a timeout/kill of a later stage never
+loses earlier results. Enables the persistent XLA compile cache so reruns
+skip recompilation entirely.
+
+Usage:  python tools/bench_stages.py [stage ...]
+Stages: resnet50 bert128 bert512 tune512 tune128 flashdrop
+The default order runs the losing perf axis (resnet50, autotune-independent)
+first, then tunes each attention signature before benching it, matching
+bench.py's tune-then-bench accel sequence.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.environ.get('BENCH_STAGES_OUT', '/tmp/bench_stages.jsonl')
+
+
+def emit(obj):
+    obj['ts'] = round(time.time(), 1)
+    line = json.dumps(obj, sort_keys=True)
+    print(line, flush=True)
+    with open(OUT, 'a') as f:
+        f.write(line + '\n')
+
+
+def main():
+    stages = sys.argv[1:] or ['resnet50', 'tune128', 'bert128',
+                              'tune512', 'bert512', 'flashdrop']
+    import jax
+    import bench
+
+    bench.enable_xla_cache()
+    emit({'stage': 'init', 'backend': jax.default_backend(),
+          'devices': len(jax.devices())})
+
+    large = dict(vocab_size=30522, hidden_size=1024, num_hidden_layers=24,
+                 num_attention_heads=16, intermediate_size=4096,
+                 max_position_embeddings=512)
+
+    for stage in stages:
+        t0 = time.time()
+        try:
+            if stage == 'resnet50':
+                ips = bench._resnet50_accel_ips()
+                emit({'stage': stage, 'images_per_sec': round(ips, 2),
+                      'vs_baseline': round(
+                          ips / bench.BASELINE_RESNET50_IPS, 4),
+                      'wall_s': round(time.time() - t0, 1)})
+            elif stage == 'bert128':
+                sps = bench.bench_bert(large, batch=64, seq=128, steps=10,
+                                       warmup=2)
+                emit({'stage': stage, 'samples_per_sec': round(sps, 2),
+                      'vs_baseline': round(
+                          sps / bench.BASELINE_SAMPLES_PER_SEC, 4),
+                      'wall_s': round(time.time() - t0, 1)})
+            elif stage == 'bert512':
+                sps = bench.bench_bert(large, batch=16, seq=512, steps=10,
+                                       warmup=2)
+                emit({'stage': stage, 'samples_per_sec': round(sps, 2),
+                      'vs_baseline': round(
+                          sps / bench.BASELINE_SEQ512_SPS, 4),
+                      'wall_s': round(time.time() - t0, 1)})
+            elif stage in ('tune512', 'tune128'):
+                from paddle_tpu.kernels.autotune import autotune_attention
+                b, s = (16, 512) if stage == 'tune512' else (64, 128)
+                budget = float(os.environ.get('PADDLE_TPU_AUTOTUNE_BUDGET',
+                                              '120'))
+                dec = autotune_attention(b, 16, s, 64, dtype='bfloat16',
+                                         causal=False, has_kpad=False,
+                                         dropout_p=0.1, budget_s=budget,
+                                         verbose=True)
+                emit({'stage': stage, 'decision': dec,
+                      'wall_s': round(time.time() - t0, 1)})
+            elif stage == 'flashdrop':
+                emit({'stage': stage, 'status': bench._flash_dropout_check(),
+                      'wall_s': round(time.time() - t0, 1)})
+            else:
+                emit({'stage': stage, 'error': 'unknown stage'})
+        except Exception as e:
+            emit({'stage': stage, 'error': repr(e)[:500],
+                  'wall_s': round(time.time() - t0, 1)})
+
+
+if __name__ == '__main__':
+    main()
