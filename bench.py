@@ -254,10 +254,22 @@ def _flash_dropout_check():
         return f'error: {e!r}'
 
 
+def _resnet50_batch():
+    """On-chip ResNet bench batch; PADDLE_TPU_RESNET_BATCH overrides the
+    256 default (for applying batch-sweep results). The accel child echoes
+    the batch into the emitted JSON so an override can never masquerade as
+    the default run."""
+    try:
+        batch = int(os.environ.get('PADDLE_TPU_RESNET_BATCH', '0'))
+    except ValueError:
+        batch = 0
+    return batch if batch > 0 else 256
+
+
 def _resnet50_accel_ips():
     """The one accelerator-mode ResNet-50 measurement (shared by
     `bench resnet50` and the combined default run so they always agree)."""
-    return bench_resnet50(batch=256, steps=10, warmup=2)
+    return bench_resnet50(batch=_resnet50_batch(), steps=10, warmup=2)
 
 
 def _tail_json(text):
@@ -455,6 +467,7 @@ def _child_main(mode, model):
             "unit": "images/sec",
             "vs_baseline": round(ips / BASELINE_RESNET50_IPS, 4),
             "mode": "train (bf16 compute, SGD+momentum)",
+            "batch": _resnet50_batch(),
         }))
         return
     if on_accel:
@@ -500,6 +513,7 @@ def _child_main(mode, model):
                 "resnet50_vs_baseline": round(
                     resnet_ips / BASELINE_RESNET50_IPS, 4),
                 "resnet50_baseline": BASELINE_RESNET50_IPS,
+                "resnet50_batch": _resnet50_batch(),
                 "autotune": autotune_report,
                 "flash_dropout_check": flash_dropout,
             },

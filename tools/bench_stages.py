@@ -8,6 +8,8 @@ skip recompilation entirely.
 
 Usage:  python tools/bench_stages.py [stage ...]
 Stages: resnet50 bert128 bert512 tune512 tune128 flashdrop
+        resnet50_b128 resnet50_b512 (batch sweep)
+        profile_resnet (xplane trace + per-op table of the train step)
 The default order runs the losing perf axis (resnet50, autotune-independent)
 first, then tunes each attention signature before benching it, matching
 bench.py's tune-then-bench accel sequence.
@@ -52,6 +54,24 @@ def main():
                 emit({'stage': stage, 'images_per_sec': round(ips, 2),
                       'vs_baseline': round(
                           ips / bench.BASELINE_RESNET50_IPS, 4),
+                      'wall_s': round(time.time() - t0, 1)})
+            elif stage.startswith('resnet50_b'):
+                b = int(stage.split('_b')[1])
+                ips = bench.bench_resnet50(batch=b, steps=10, warmup=2)
+                emit({'stage': stage, 'batch': b,
+                      'images_per_sec': round(ips, 2),
+                      'vs_baseline': round(
+                          ips / bench.BASELINE_RESNET50_IPS, 4),
+                      'wall_s': round(time.time() - t0, 1)})
+            elif stage == 'profile_resnet':
+                import jax.profiler
+                trace_dir = '/tmp/resnet_trace'
+                with jax.profiler.trace(trace_dir):
+                    bench.bench_resnet50(batch=256, steps=3, warmup=2)
+                from paddle_tpu.utils.profiler import _op_summary
+                table = _op_summary(trace_dir, sorted_key='total', limit=25)
+                emit({'stage': stage, 'trace_dir': trace_dir,
+                      'op_table': table,
                       'wall_s': round(time.time() - t0, 1)})
             elif stage == 'bert128':
                 sps = bench.bench_bert(large, batch=64, seq=128, steps=10,
