@@ -160,8 +160,12 @@ def bench_resnet50(batch, steps, warmup, train_mode=True):
     paddle.seed(0)
     # NHWC end-to-end: the TPU-native conv layout — no transposes anywhere
     # in the hot loop (the reference's cuDNN path needs NCHW; BASELINE's
-    # A100 number itself runs NHWC under AMP)
-    net = resnet50(num_classes=1000, data_format='NHWC')
+    # A100 number itself runs NHWC under AMP). PADDLE_TPU_RESNET_S2D=1
+    # additionally packs the stem conv 2x2-space-to-depth (exact rewrite,
+    # tests/test_resnet_s2d.py) for MXU input-lane utilization.
+    s2d = os.environ.get('PADDLE_TPU_RESNET_S2D', '') == '1'
+    net = resnet50(num_classes=1000, data_format='NHWC',
+                   space_to_depth_stem=s2d)
     if train_mode:
         net.train()
     else:

@@ -2,6 +2,7 @@
 import functools
 
 from ... import nn
+from ...nn import functional as F
 
 __all__ = ['ResNet', 'resnet18', 'resnet34', 'resnet50', 'resnet101',
            'resnet152']
@@ -75,10 +76,19 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth, num_classes=1000, with_pool=True,
-                 data_format='NCHW'):
+                 data_format='NCHW', space_to_depth_stem=False):
         """data_format='NHWC' puts channels on the TPU lane dimension —
         the layout XLA's conv/BN emitters want (SURVEY §6: NCHW accepted,
-        NHWC preferred)."""
+        NHWC preferred).
+
+        space_to_depth_stem=True (NHWC only) computes the 7x7/stride-2 stem
+        conv as an EXACTLY equivalent 4x4/stride-1 conv on 2x2-space-to-depth
+        packed input (12 channels instead of 3). A 3-channel conv wastes the
+        TPU MXU's 128-wide input-channel lanes; the packed form quadruples
+        the stem's arithmetic intensity (the classic MLPerf TPU ResNet
+        layout trick). The parameter stays the canonical [64, 3, 7, 7]
+        weight — the repack happens in forward, so state dicts and
+        pretrained checkpoints are unaffected."""
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -86,6 +96,10 @@ class ResNet(nn.Layer):
         self.num_classes = num_classes
         self.with_pool = with_pool
         self.data_format = data_format
+        if space_to_depth_stem and data_format != 'NHWC':
+            raise ValueError("space_to_depth_stem requires data_format="
+                             "'NHWC' (it is a TPU lane-packing optimization)")
+        self.space_to_depth_stem = space_to_depth_stem
         self._norm_layer = functools.partial(nn.BatchNorm2D,
                                              data_format=data_format)
         self.inplanes = 64
@@ -124,8 +138,39 @@ class ResNet(nn.Layer):
                                 norm_layer=norm_layer, data_format=df))
         return nn.Sequential(*layers)
 
+    def _stem_s2d(self, x):
+        """7x7/s2 stem as a 4x4/s1 conv on 2x2-packed input; exact rewrite.
+
+        Derivation: output O[i,j,o] reads input rows 2i-3..2i+3. Packed row
+        p holds rows {2p, 2p+1}, so O[i] needs p in {i-2..i+1}: kernel 4,
+        stride 1, pad (2,1). Tap (u,ry) maps to dy = 2(u-2)+ry+3, i.e. the
+        8th tap (dy=-1) is zero — hence the front zero-pad of the 7x7
+        weight to 8x8 before the [4,2,4,2,...] reshape. Channel packing
+        order (ry, rx, c) matches the input reshape below."""
+        B, H, W, C = x.shape
+        if H % 2 or W % 2:
+            raise ValueError(
+                f"space_to_depth_stem needs even input H and W (got "
+                f"{H}x{W}); pad the input or disable the packed stem")
+        x2 = x.reshape([B, H // 2, 2, W // 2, 2, C]) \
+              .transpose([0, 1, 3, 2, 4, 5]) \
+              .reshape([B, H // 2, W // 2, 4 * C])
+        x2 = F.pad(x2, [2, 1, 2, 1], data_format='NHWC')
+        w = self.conv1.weight                      # [O, C, 7, 7]
+        w = w.transpose([2, 3, 1, 0])              # [7, 7, C, O]
+        w = F.pad(w, [1, 0, 1, 0, 0, 0, 0, 0])     # [8, 8, C, O], front pad
+        O = w.shape[-1]
+        w2 = w.reshape([4, 2, 4, 2, C, O]) \
+              .transpose([0, 2, 1, 3, 4, 5]) \
+              .reshape([4, 4, 4 * C, O]) \
+              .transpose([3, 2, 0, 1])             # [O, 4C, 4, 4]
+        return F.conv2d(x2, w2, stride=1, padding=0, data_format='NHWC')
+
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        if self.space_to_depth_stem:
+            x = self.relu(self.bn1(self._stem_s2d(x)))
+        else:
+            x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -9,6 +9,7 @@ skip recompilation entirely.
 Usage:  python tools/bench_stages.py [stage ...]
 Stages: resnet50 bert128 bert512 tune512 tune128 flashdrop
         resnet50_b128 resnet50_b512 (batch sweep)
+        resnet50_s2d (space-to-depth stem A/B, tests/test_resnet_s2d.py)
         profile_resnet (xplane trace + per-op table of the train step)
 The default order runs the losing perf axis (resnet50, autotune-independent)
 first, then tunes each attention signature before benching it, matching
@@ -60,6 +61,16 @@ def main():
                 ips = bench.bench_resnet50(batch=b, steps=10, warmup=2)
                 emit({'stage': stage, 'batch': b,
                       'images_per_sec': round(ips, 2),
+                      'vs_baseline': round(
+                          ips / bench.BASELINE_RESNET50_IPS, 4),
+                      'wall_s': round(time.time() - t0, 1)})
+            elif stage == 'resnet50_s2d':
+                os.environ['PADDLE_TPU_RESNET_S2D'] = '1'
+                try:
+                    ips = bench._resnet50_accel_ips()
+                finally:
+                    os.environ.pop('PADDLE_TPU_RESNET_S2D', None)
+                emit({'stage': stage, 'images_per_sec': round(ips, 2),
                       'vs_baseline': round(
                           ips / bench.BASELINE_RESNET50_IPS, 4),
                       'wall_s': round(time.time() - t0, 1)})

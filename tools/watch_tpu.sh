@@ -1,0 +1,27 @@
+#!/bin/bash
+# Keep exactly one TPU claimant alive; when the chip frees, run the bench
+# stages automatically. A killed in-flight holder leaves a stale grant that
+# takes a long time to clear (claimants block ~25 min in backend init, then
+# fail UNAVAILABLE) — this loop just keeps retrying with a single claimant.
+# Never kill a probe or stage run mid-flight: that is what creates the
+# stale grant in the first place.
+cd "$(dirname "$0")/.." || exit 1
+LOG=/tmp/tpu_watch.log
+echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
+while true; do
+  start=$(date +%s)
+  python -u -c "import jax; print('BACKEND=' + jax.default_backend())" \
+      > /tmp/tpu_probe.log 2>&1
+  took=$(( $(date +%s) - start ))
+  if grep -q "BACKEND=axon\|BACKEND=tpu" /tmp/tpu_probe.log; then
+    echo "$(date -u +%FT%TZ) chip acquired (probe ${took}s); running stages" >> "$LOG"
+    PADDLE_TPU_AUTOTUNE_BUDGET="${PADDLE_TPU_AUTOTUNE_BUDGET:-420}" \
+      python -u tools/bench_stages.py \
+      resnet50 resnet50_s2d tune128 bert128 tune512 bert512 flashdrop \
+      >> /tmp/bench_stages.log 2>> /tmp/bench_stages.err
+    echo "$(date -u +%FT%TZ) stages done rc=$?" >> "$LOG"
+    break
+  fi
+  echo "$(date -u +%FT%TZ) probe failed after ${took}s: $(tail -1 /tmp/tpu_probe.log | head -c 120)" >> "$LOG"
+  sleep 60
+done
