@@ -324,6 +324,16 @@ def _run_child(mode, model, timeout_s):
         out = out.decode('utf-8', 'replace') if isinstance(out, bytes) else out
         obj = _tail_json(out)
         if obj is not None:
+            # the accel child emits a cumulative line per completed section
+            # and marks the last one "complete": keep what finished, and
+            # only annotate genuinely partial results (a child can also
+            # hang in tunnel teardown AFTER its final complete line)
+            if not obj.get('complete'):
+                obj.setdefault(
+                    'error',
+                    f"{mode} child timed out after {timeout_s:.0f}s; "
+                    "partial results (later sections' compiles did "
+                    "not return)")
             return obj, None
         return None, f"{mode} child timed out after {timeout_s:.0f}s"
     except Exception as e:
@@ -334,6 +344,14 @@ def _run_child(mode, model, timeout_s):
     if obj is None:
         return None, (f"{mode} child rc={proc.returncode}, no JSON line; "
                       f"stderr tail: {(proc.stderr or '')[-500:]}")
+    if proc.returncode != 0 and not obj.get('complete'):
+        # cumulative-line child crashed after printing a partial result:
+        # keep what finished, but never report the crash as a clean success
+        # (a nonzero exit AFTER the final complete line is teardown noise)
+        obj.setdefault('error',
+                       f"{mode} child crashed rc={proc.returncode} after "
+                       "partial results; stderr tail: "
+                       f"{(proc.stderr or '')[-300:]}")
     return obj, None
 
 
@@ -461,7 +479,8 @@ def _child_main(mode, model):
         print(json.dumps({
             "metric": "resnet50_smoke_cpu_images_per_sec",
             "value": round(ips, 2), "unit": "images/sec",
-            "vs_baseline": round(ips / BASELINE_RESNET50_IPS, 4)}))
+            "vs_baseline": round(ips / BASELINE_RESNET50_IPS, 4),
+            "complete": True}))
         return
     if on_accel and model == 'resnet50':
         ips = _resnet50_accel_ips()
@@ -472,6 +491,8 @@ def _child_main(mode, model):
             "vs_baseline": round(ips / BASELINE_RESNET50_IPS, 4),
             "mode": "train (bf16 compute, SGD+momentum)",
             "batch": _resnet50_batch(),
+            "s2d_stem": os.environ.get('PADDLE_TPU_RESNET_S2D', '') == '1',
+            "complete": True,
         }))
         return
     if on_accel:
@@ -498,30 +519,49 @@ def _child_main(mode, model):
         except Exception as e:   # never let tuning break the bench
             print("autotune skipped: %r" % (e,), file=sys.stderr)
         flash_dropout = _flash_dropout_check()
-        # phase 1: seq128 (headline, comparable to BASELINE.json)
-        sps128 = bench_bert(large, batch=64, seq=128, steps=10, warmup=2)
-        # phase 2: seq512 — attention-dominated, Pallas flash path
-        sps512 = bench_bert(large, batch=16, seq=512, steps=10, warmup=2)
-        resnet_ips = _resnet50_accel_ips()
-        print(json.dumps({
+        # The child prints a CUMULATIVE result line after EVERY completed
+        # section: a cold compile over the axon tunnel can outlive the
+        # parent's budget (observed: a single ResNet-50 train-step compile
+        # > 60 min), and _run_child tails the child's stdout on timeout —
+        # so each completed measurement survives even if a later section's
+        # compile never returns. The LAST line printed is the result.
+        result = {
             "metric": "bert_large_pretrain_samples_per_sec_per_chip",
-            "value": round(sps128, 2),
+            "value": 0.0,
             "unit": "samples/sec",
-            "vs_baseline": round(sps128 / BASELINE_SAMPLES_PER_SEC, 4),
+            "vs_baseline": 0.0,
             "mode": "train (hidden+attention dropout on)",
             "extras": {
-                "seq512_samples_per_sec": round(sps512, 2),
-                "seq512_vs_baseline": round(sps512 / BASELINE_SEQ512_SPS, 4),
-                "seq512_baseline": BASELINE_SEQ512_SPS,
-                "resnet50_images_per_sec": round(resnet_ips, 2),
-                "resnet50_vs_baseline": round(
-                    resnet_ips / BASELINE_RESNET50_IPS, 4),
-                "resnet50_baseline": BASELINE_RESNET50_IPS,
-                "resnet50_batch": _resnet50_batch(),
                 "autotune": autotune_report,
                 "flash_dropout_check": flash_dropout,
             },
-        }))
+        }
+        # phase 1: seq128 (headline, comparable to BASELINE.json)
+        sps128 = bench_bert(large, batch=64, seq=128, steps=10, warmup=2)
+        result["value"] = round(sps128, 2)
+        result["vs_baseline"] = round(sps128 / BASELINE_SAMPLES_PER_SEC, 4)
+        print(json.dumps(result), flush=True)
+        # phase 2: seq512 — attention-dominated, Pallas flash path
+        sps512 = bench_bert(large, batch=16, seq=512, steps=10, warmup=2)
+        result["extras"].update({
+            "seq512_samples_per_sec": round(sps512, 2),
+            "seq512_vs_baseline": round(sps512 / BASELINE_SEQ512_SPS, 4),
+            "seq512_baseline": BASELINE_SEQ512_SPS,
+        })
+        print(json.dumps(result), flush=True)
+        resnet_ips = _resnet50_accel_ips()
+        result["extras"].update({
+            "resnet50_images_per_sec": round(resnet_ips, 2),
+            "resnet50_vs_baseline": round(
+                resnet_ips / BASELINE_RESNET50_IPS, 4),
+            "resnet50_baseline": BASELINE_RESNET50_IPS,
+            "resnet50_batch": _resnet50_batch(),
+            "resnet50_s2d_stem": os.environ.get(
+                'PADDLE_TPU_RESNET_S2D', '') == '1',
+        })
+        result["complete"] = True   # all sections measured: the timeout/
+        # crash paths in _run_child must not annotate this line as partial
+        print(json.dumps(result), flush=True)
     else:  # local smoke mode: same code path, tiny shapes
         tiny = dict(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
                     num_attention_heads=4, intermediate_size=256,
@@ -532,6 +572,7 @@ def _child_main(mode, model):
             "value": round(sps, 2),
             "unit": "samples/sec",
             "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
+            "complete": True,
         }))
 
 

@@ -19,8 +19,16 @@ while true; do
       python -u tools/bench_stages.py \
       resnet50 resnet50_s2d tune128 bert128 tune512 bert512 flashdrop \
       >> /tmp/bench_stages.log 2>> /tmp/bench_stages.err
-    echo "$(date -u +%FT%TZ) stages done rc=$?" >> "$LOG"
-    break
+    rc=$?
+    # bench_stages catches per-stage exceptions and exits 0 even when every
+    # stage failed (e.g. the chip was re-grabbed between probe and claim):
+    # only stop once some stage actually produced a measurement
+    if grep -q "images_per_sec\|samples_per_sec\|decision" /tmp/bench_stages.log; then
+      echo "$(date -u +%FT%TZ) stages done rc=$rc (measurements present)" >> "$LOG"
+      break
+    fi
+    echo "$(date -u +%FT%TZ) stages produced no measurement (rc=$rc); retrying" >> "$LOG"
+    sleep 60
   fi
   echo "$(date -u +%FT%TZ) probe failed after ${took}s: $(tail -1 /tmp/tpu_probe.log | head -c 120)" >> "$LOG"
   sleep 60
