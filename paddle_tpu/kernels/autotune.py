@@ -24,7 +24,6 @@ import math
 import os
 import time
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -151,14 +150,14 @@ def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
 
     from .flash_attention import flash_attention_bhld
 
-    rng = np.random.default_rng(0)
+    # tuning inputs are generated ON DEVICE: host->device upload of three
+    # [b,h,s,d] arrays (50 MB at b64 h16 s128 d64 bf16) stalls for hours
+    # over the slow remote tunnel, while a jitted random-normal is a
+    # once-cached sub-second compile and no transfer at all
     dt = jnp.dtype(dtype)
-    q = jnp.asarray(rng.standard_normal((batch, heads, seq, head_dim)),
-                    dtype=dt)
-    k = jnp.asarray(rng.standard_normal((batch, heads, seq, head_dim)),
-                    dtype=dt)
-    v = jnp.asarray(rng.standard_normal((batch, heads, seq, head_dim)),
-                    dtype=dt)
+    q, k, v = jax.jit(lambda s: tuple(
+        jax.random.normal(kk, (batch, heads, seq, head_dim), dt)
+        for kk in jax.random.split(s, 3)))(jax.random.PRNGKey(0))
     kpad = None
     if has_kpad:
         kpad = jnp.zeros((batch, seq), dt)
