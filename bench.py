@@ -246,10 +246,8 @@ def _flash_dropout_check():
         return 'skipped (cpu backend)'
     try:
         from paddle_tpu.kernels.flash_attention import flash_attention_bhld
-        # on-device inputs: no large host->device transfer over the tunnel
-        q, k, v = jax.jit(lambda s: tuple(
-            jax.random.normal(kk, (1, 4, 512, 64), jnp.float32)
-            for kk in jax.random.split(s, 3)))(jax.random.PRNGKey(0))
+        from paddle_tpu.kernels.autotune import make_device_qkv
+        q, k, v = make_device_qkv(1, 4, 512, 64, jnp.float32)
         f = jax.jit(lambda s: flash_attention_bhld(
             q, k, v, causal=True, dropout_p=0.3, dropout_seed=s,
             block_q=256, block_k=256))

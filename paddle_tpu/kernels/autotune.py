@@ -126,6 +126,18 @@ def _time_step(fn, args, iters=5, warmup=2):
     return best
 
 
+def make_device_qkv(batch, heads, seq, head_dim, dtype, seed=0):
+    """Three [b,h,s,d] standard-normal tensors generated ON DEVICE as one
+    jitted program (single cached compile, zero host->device transfer).
+    Benchmark/tuning inputs must never be uploaded from host: 50 MB of
+    q/k/v at the b64 h16 s128 d64 bf16 signature stalls for hours over
+    the remote tunnel (~3 KB/s effective)."""
+    dt = jnp.dtype(dtype)
+    return jax.jit(lambda s: tuple(
+        jax.random.normal(kk, (batch, heads, seq, head_dim), dt)
+        for kk in jax.random.split(s, 3)))(jax.random.PRNGKey(seed))
+
+
 def _candidate_blocks(seq, has_kpad):
     """Tile candidates; with a key-padding bias block_k is pinned to the
     full row (the kernel streams the whole bias), so only block_q varies."""
@@ -150,14 +162,8 @@ def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
 
     from .flash_attention import flash_attention_bhld
 
-    # tuning inputs are generated ON DEVICE: host->device upload of three
-    # [b,h,s,d] arrays (50 MB at b64 h16 s128 d64 bf16) stalls for hours
-    # over the slow remote tunnel, while a jitted random-normal is a
-    # once-cached sub-second compile and no transfer at all
     dt = jnp.dtype(dtype)
-    q, k, v = jax.jit(lambda s: tuple(
-        jax.random.normal(kk, (batch, heads, seq, head_dim), dt)
-        for kk in jax.random.split(s, 3)))(jax.random.PRNGKey(0))
+    q, k, v = make_device_qkv(batch, heads, seq, head_dim, dt)
     kpad = None
     if has_kpad:
         kpad = jnp.zeros((batch, seq), dt)

@@ -30,10 +30,8 @@ def timeit(f, *args, iters=20, warmup=3):
 
 
 def bench_config(B, H, L, D, dtype, causal=False):
-    rs = np.random.RandomState(0)
-    q = jnp.asarray(rs.randn(B, H, L, D), dtype)
-    k = jnp.asarray(rs.randn(B, H, L, D), dtype)
-    v = jnp.asarray(rs.randn(B, H, L, D), dtype)
+    from paddle_tpu.kernels.autotune import make_device_qkv
+    q, k, v = make_device_qkv(B, H, L, D, dtype)
 
     def make_fb(attn_fn):
         def loss(q, k, v):

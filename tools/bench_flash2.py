@@ -21,8 +21,8 @@ def timeit(f, *args, iters=30, repeats=3):
 
 
 def run(B, H, L, D, configs, causal=False):
-    rs = np.random.RandomState(0)
-    q, k, v = (jnp.asarray(rs.randn(B, H, L, D), jnp.bfloat16) for _ in range(3))
+    from paddle_tpu.kernels.autotune import make_device_qkv
+    q, k, v = make_device_qkv(B, H, L, D, jnp.bfloat16)
 
     def make_g(attn_fn):
         def loss(q, k, v):

@@ -33,8 +33,8 @@ def timeit(f, *args, repeats=5):
     return best
 
 def run(B, H, L, D, configs, causal=False):
-    rs = np.random.RandomState(0)
-    q, k, v = (jnp.asarray(rs.randn(B, H, L, D), jnp.bfloat16) for _ in range(3))
+    from paddle_tpu.kernels.autotune import make_device_qkv
+    q, k, v = make_device_qkv(B, H, L, D, jnp.bfloat16)
     base = timeit(make_chained(lambda q, k, v: _attn_reference(
         q, k, v, causal, 1.0 / np.sqrt(D))), q, k, v)
     print(f"B={B} L={L} causal={causal}: xla_dense fwd+bwd {base*1e3:7.3f}ms/iter")
