@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ['autotune_attention', 'lookup', 'attention_signature',
+           'make_device_qkv',
            'clear_cache']
 
 _CACHE = {}
@@ -126,16 +127,20 @@ def _time_step(fn, args, iters=5, warmup=2):
     return best
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _qkv_program(key, batch, heads, seq, head_dim, dtype):
+    return tuple(jax.random.normal(kk, (batch, heads, seq, head_dim), dtype)
+                 for kk in jax.random.split(key, 3))
+
+
 def make_device_qkv(batch, heads, seq, head_dim, dtype, seed=0):
     """Three [b,h,s,d] standard-normal tensors generated ON DEVICE as one
-    jitted program (single cached compile, zero host->device transfer).
-    Benchmark/tuning inputs must never be uploaded from host: 50 MB of
-    q/k/v at the b64 h16 s128 d64 bf16 signature stalls for hours over
-    the remote tunnel (~3 KB/s effective)."""
-    dt = jnp.dtype(dtype)
-    return jax.jit(lambda s: tuple(
-        jax.random.normal(kk, (batch, heads, seq, head_dim), dt)
-        for kk in jax.random.split(s, 3)))(jax.random.PRNGKey(seed))
+    jitted program (compiled once per shape signature per process, zero
+    host->device transfer). Benchmark/tuning inputs must never be uploaded
+    from host: 50 MB of q/k/v at the b64 h16 s128 d64 bf16 signature
+    stalls for hours over the remote tunnel (~3 KB/s effective)."""
+    return _qkv_program(jax.random.PRNGKey(seed), batch, heads, seq,
+                        head_dim, jnp.dtype(dtype))
 
 
 def _candidate_blocks(seq, has_kpad):

@@ -1,0 +1,99 @@
+"""The bench.py orchestrator's partial-result contract.
+
+The accel child prints a CUMULATIVE result line after each completed
+section and marks the final line ``"complete": true``; the parent
+(_run_child) must (a) salvage the last line when the child times out or
+crashes mid-run, annotating it as partial, and (b) NOT annotate a result
+whose final complete line was printed (teardown noise after the real
+result). A cold compile over the remote tunnel can outlive any budget, so
+this is the difference between BENCH_r{N}.json carrying real measurements
+and losing everything to one slow section.
+"""
+import json
+import subprocess
+import sys
+
+sys.path.insert(0, __file__.rsplit('/', 2)[0])
+
+import bench  # noqa: E402
+
+
+def _line(value, complete=False, **extras):
+    obj = {"metric": "m", "value": value, "unit": "s",
+           "vs_baseline": value / 10.0}
+    if extras:
+        obj["extras"] = extras
+    if complete:
+        obj["complete"] = True
+    return json.dumps(obj)
+
+
+def _with_fake_run(fake, *args):
+    real = subprocess.run
+    subprocess.run = fake
+    try:
+        return bench._run_child(*args)
+    finally:
+        subprocess.run = real
+
+
+def test_tail_json_picks_last_parseable_line():
+    text = "\n".join([_line(1.0), "garbage {not json", _line(2.0), "trail"])
+    assert bench._tail_json(text)["value"] == 2.0
+    assert bench._tail_json("no json here") is None
+
+
+def test_timeout_salvages_partial_and_annotates():
+    out = (_line(1.0) + "\n" + _line(2.0, seq512_samples_per_sec=88.0)
+           + "\n").encode()
+
+    def fake(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get('timeout'), output=out)
+
+    obj, err = _with_fake_run(fake, 'accel', 'bert', 123.0)
+    assert err is None
+    assert obj["value"] == 2.0
+    assert obj["extras"]["seq512_samples_per_sec"] == 88.0
+    assert "partial results" in obj["error"]
+
+
+def test_timeout_after_complete_line_is_not_partial():
+    out = (_line(2.0, complete=True) + "\n").encode()
+
+    def fake(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get('timeout'), output=out)
+
+    obj, err = _with_fake_run(fake, 'accel', 'bert', 60.0)
+    assert err is None and "error" not in obj
+
+
+def test_timeout_with_no_output_is_an_error():
+    def fake(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get('timeout'), output=b"")
+
+    obj, err = _with_fake_run(fake, 'accel', 'bert', 5.0)
+    assert obj is None and "timed out" in err
+
+
+def test_crash_after_partial_line_is_annotated():
+    def fake(cmd, **kw):
+        cp = subprocess.CompletedProcess(cmd, 1)
+        cp.stdout = _line(3.0) + "\n"
+        cp.stderr = "boom"
+        return cp
+
+    obj, err = _with_fake_run(fake, 'accel', 'bert', 60.0)
+    assert err is None
+    assert obj["value"] == 3.0
+    assert "crashed rc=1" in obj["error"]
+
+
+def test_crash_after_complete_line_is_teardown_noise():
+    def fake(cmd, **kw):
+        cp = subprocess.CompletedProcess(cmd, 1)
+        cp.stdout = _line(3.0, complete=True) + "\n"
+        cp.stderr = "teardown noise"
+        return cp
+
+    obj, err = _with_fake_run(fake, 'accel', 'bert', 60.0)
+    assert err is None and "error" not in obj
