@@ -1,0 +1,12 @@
+"""Worker entry for distributed.spawn: `python -m
+paddle_tpu.distributed._spawn_entry <payload> <rank>`.
+
+A separate module (imported by nothing) so runpy's -m execution doesn't
+re-execute an already-imported launch.py.
+"""
+import sys
+
+from .launch import _worker_main
+
+if __name__ == '__main__':
+    _worker_main(sys.argv[1], int(sys.argv[2]))

@@ -16,9 +16,10 @@ real modes here:
 Multi-host pods use init_distributed() (jax.distributed) with one process
 per host.
 """
-import multiprocessing as mp
 import os
 import pickle
+import subprocess
+import sys
 import tempfile
 
 from . import env
@@ -55,6 +56,96 @@ def _worker(rank, nprocs, func, args, result_dir):
     os.replace(path + '.tmp', path)
 
 
+class _Proc:
+    """Popen with the slice of the multiprocessing.Process API _Context
+    uses (join/is_alive/exitcode/terminate)."""
+
+    def __init__(self, popen):
+        self._p = popen
+        self.pid = popen.pid
+
+    def join(self, timeout=None):
+        try:
+            self._p.wait(timeout)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def is_alive(self):
+        return self._p.poll() is None
+
+    @property
+    def exitcode(self):
+        return self._p.poll()
+
+    def terminate(self):
+        self._p.terminate()
+
+    def kill(self):
+        self._p.kill()
+
+
+def _worker_main(payload_path, rank):
+    """Entry point of one spawned worker interpreter (`python -m
+    paddle_tpu.distributed._spawn_entry <payload_path> <rank>`)."""
+    with open(payload_path, 'rb') as f:
+        payload = pickle.load(f)
+    # the parent's import roots (pytest test dirs, script dirs) must be
+    # visible before the function is unpickled by module+qualname — and in
+    # the parent's ORDER, so a local dir that shadows an installed package
+    # in the parent shadows it here too
+    sys.path[:0] = [p for p in payload['sys_path'] if p not in sys.path]
+    if payload['main_path']:
+        # the parent's __main__ was a plain script: load that file into this
+        # process's __main__ namespace so pickle-by-name resolves func AND
+        # any classes the script defined (the contract multiprocessing's
+        # spawn start method implements). run_name keeps the script's
+        # `if __name__ == '__main__'` guard false; registering the module
+        # under the run_name makes objects the script's classes produce
+        # picklable back to the parent.
+        import runpy
+        import types
+        ns = runpy.run_path(payload['main_path'], run_name='__spawn_main__')
+        mod = types.ModuleType('__spawn_main__')
+        mod.__dict__.update(ns)
+        sys.modules['__spawn_main__'] = mod
+        sys.modules['__main__'].__dict__.update(
+            {k: v for k, v in ns.items() if not k.startswith('__')})
+    elif payload.get('main_name'):
+        # parent ran as `python -m <mod>`: import the module by name and
+        # project its namespace into __main__ for pickle-by-name
+        import importlib
+        mod = importlib.import_module(payload['main_name'])
+        sys.modules['__main__'].__dict__.update(
+            {k: v for k, v in mod.__dict__.items()
+             if not k.startswith('__')})
+    func, args = pickle.loads(payload['func_bytes'])
+    _worker(rank, payload['nprocs'], func, args, payload['result_dir'])
+
+
+_daemon_procs = set()
+
+
+def _kill_daemon_procs():
+    for proc in list(_daemon_procs):
+        if proc.is_alive():
+            proc.terminate()
+
+
+import atexit as _atexit  # noqa: E402
+_atexit.register(_kill_daemon_procs)
+
+
+class _SpawnMainUnpickler(pickle.Unpickler):
+    """Resolve worker-side '__spawn_main__' classes (defined by the parent's
+    entry script, re-executed in the worker under that run name) back to the
+    parent's own __main__ when results return."""
+
+    def find_class(self, module, name):
+        if module == '__spawn_main__' and '__spawn_main__' not in sys.modules:
+            module = '__main__'
+        return super().find_class(module, name)
+
+
 class _Context:
     def __init__(self, procs, result_dir, result=None):
         self.processes = procs
@@ -81,6 +172,8 @@ class _Context:
                 f"spawn: ranks {alive} still running after "
                 f"join(timeout={timeout}) — terminate them or join "
                 "without a timeout")
+        for p in self.processes:
+            _daemon_procs.discard(p)
         results = {}
         err = None
         for rank in range(len(self.processes)):
@@ -88,7 +181,7 @@ class _Context:
             if not os.path.exists(path):
                 continue
             with open(path, 'rb') as f:
-                status, payload = pickle.load(f)
+                status, payload = _SpawnMainUnpickler(f).load()
             if status == 'error' and err is None:
                 err = f"spawn: rank {rank} failed: {payload}"
             results[rank] = payload if status == 'ok' else None
@@ -107,6 +200,14 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
           **options):
     """Run func on nprocs workers (spawn.py parity; see module docstring
     for the TPU execution model)."""
+    if os.environ.get('PADDLE_TPU_SPAWN_WORKER') == '1':
+        # a worker re-executing the parent's entry script reached an
+        # unguarded spawn() call (any nprocs — the in-process fast path
+        # must not silently re-run either): the same bootstrapping error
+        # multiprocessing raises, or workers would recurse indefinitely
+        raise RuntimeError(
+            "spawn() called inside a spawn worker. Put the spawn() call "
+            "under `if __name__ == '__main__':` in your entry script.")
     if nprocs in (-1, 0, 1) and backend in (None, 'tpu', 'xla'):
         if not env.is_initialized():
             env.init_parallel_env()
@@ -114,30 +215,64 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
         return _Context([], None, result)
 
     n = max(int(nprocs), 1)
-    ctx = mp.get_context('spawn')
     result_dir = tempfile.mkdtemp(prefix='paddle_tpu_spawn_')
     procs = []
-    # the rank env + CPU backend pin must be in place BEFORE each child
-    # starts: the spawn child imports paddle_tpu (backend init!) while
-    # unpickling the target, long before _worker's own env writes run
-    saved = {k: os.environ.get(k)
-             for k in ('PADDLE_TRAINER_ID', 'PADDLE_TRAINERS_NUM',
-                       'PADDLE_CURRENT_ENDPOINT', 'JAX_PLATFORMS')}
-    try:
-        for rank in range(n):
-            os.environ.update(_rank_env(rank, n))
-            os.environ['JAX_PLATFORMS'] = 'cpu'  # the parent owns the chip
-            p = ctx.Process(target=_worker,
-                            args=(rank, n, func, args, result_dir),
-                            daemon=daemon)
-            p.start()
-            procs.append(p)
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+    # Workers are fresh interpreters started via subprocess (the posix_spawn
+    # fast path: no preexec_fn, close_fds=False, no cwd/session changes) —
+    # NOT multiprocessing children. multiprocessing's fork/fork+exec startup
+    # runs pthread_atfork handlers registered by native libraries (the PJRT
+    # plugin among them), and in a thread-heavy parent that deadlocks the
+    # child before it ever reaches exec (observed: spawn children wedged in
+    # futex_wait while a device compile was in flight). posix_spawn uses
+    # vfork semantics and never runs atfork handlers, so worker startup
+    # cannot inherit a poisoned lock.
+    main = sys.modules.get('__main__')
+    main_path = getattr(main, '__file__', None)
+    main_spec = getattr(main, '__spec__', None)
+    # Preload the parent's entry module in every worker: func or its args
+    # may reference classes __main__ defined, not just when func itself
+    # lives in __main__. Plain `python script.py` → re-run the file
+    # (guarded by run_name); `python -m pkg.mod` → import by module name
+    # (multiprocessing's init_main_from_name contract).
+    preload_path = (os.path.abspath(main_path)
+                    if main_path and main_spec is None else None)
+    preload_name = (main_spec.name
+                    if main_spec is not None
+                    and main_spec.name not in ('__main__', '__mp_main__')
+                    else None)
+    payload = {
+        'sys_path': list(sys.path),
+        'main_path': preload_path,
+        'main_name': preload_name,
+        'func_bytes': pickle.dumps((func, tuple(args))),
+        'nprocs': n,
+        'result_dir': result_dir,
+    }
+    payload_path = os.path.join(result_dir, 'payload.pkl')
+    with open(payload_path, 'wb') as f:
+        pickle.dump(payload, f)
+    for rank in range(n):
+        child_env = dict(os.environ)
+        child_env.update(_rank_env(rank, n))
+        child_env['FLAGS_selected_gpus'] = str(rank)
+        child_env['JAX_PLATFORMS'] = 'cpu'  # the parent owns the chip
+        # CPU-pinned workers must not load (or talk to) the device plugin:
+        # the parent's session owns the chip, and plugin registration in
+        # every worker is wasted startup at best
+        child_env['PALLAS_AXON_POOL_IPS'] = ''
+        child_env['PADDLE_TPU_SPAWN_WORKER'] = '1'
+        p = subprocess.Popen(
+            [sys.executable, '-m', 'paddle_tpu.distributed._spawn_entry',
+             payload_path, str(rank)],
+            env=child_env, close_fds=False)
+        proc = _Proc(p)
+        if daemon:
+            # multiprocessing's daemon contract: the child must not outlive
+            # the parent. Popen has no such mode, so re-establish it with
+            # ONE atexit handler over a live-process set (joined/exited
+            # workers are discarded — see _Context.join).
+            _daemon_procs.add(proc)
+        procs.append(proc)
     context = _Context(procs, result_dir)
     if join:
         context.join()
@@ -151,8 +286,6 @@ def launch():
     N > 1)."""
     import argparse
     import runpy
-    import subprocess
-    import sys
 
     parser = argparse.ArgumentParser('paddle_tpu.distributed.launch')
     parser.add_argument('--nproc_per_node', type=int, default=1)

@@ -81,6 +81,64 @@ class TestSpawn:
         with pytest.raises(RuntimeError, match="spawn"):
             dist.spawn(_boom, nprocs=2, backend='cpu')
 
+    @pytest.mark.skipif(sys.platform == 'win32', reason='posix only')
+    def test_script_main_classes_roundtrip(self, tmp_path):
+        # func AND a result class defined in a plain `python script.py`
+        # __main__: the worker must preload the script to unpickle func,
+        # and the parent must unpickle the '__spawn_main__'-module result
+        script = tmp_path / "train_script.py"
+        script.write_text(
+            "import os, json\n"
+            "import paddle_tpu.distributed as dist\n\n"
+            "class Cfg:\n"
+            "    def __init__(self, scale):\n"
+            "        self.scale = scale\n\n"
+            "def rank_fn(cfg):\n"
+            "    r = int(os.environ.get('PADDLE_TRAINER_ID', '0'))\n"
+            "    out = Cfg(r * cfg.scale)\n"
+            "    return out\n\n"
+            "if __name__ == '__main__':\n"
+            "    ctx = dist.spawn(rank_fn, args=(Cfg(7),), nprocs=2,\n"
+            "                     backend='cpu')\n"
+            "    res = ctx.join()\n"
+            "    print(json.dumps([c.scale for c in res]))\n")
+        import subprocess as sp
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS='cpu',
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get('PYTHONPATH', ''))
+        out = sp.run([sys.executable, str(script)], env=env,
+                     capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        import json
+        assert json.loads(out.stdout.strip().splitlines()[-1]) == [0, 7]
+
+    @pytest.mark.skipif(sys.platform == 'win32', reason='posix only')
+    def test_module_main_spawn(self, tmp_path):
+        # parent launched `python -m mytrain`: workers must resolve func
+        # defined in that module-style __main__ (init_main_from_name)
+        mod = tmp_path / "mytrain_mod.py"
+        mod.write_text(
+            "import os, json\n"
+            "import paddle_tpu.distributed as dist\n\n"
+            "def rank_fn(off):\n"
+            "    return off + int(os.environ.get('PADDLE_TRAINER_ID',"
+            " '0'))\n\n"
+            "if __name__ == '__main__':\n"
+            "    res = dist.spawn(rank_fn, args=(5,), nprocs=2,\n"
+            "                     backend='cpu').join()\n"
+            "    print(json.dumps(res))\n")
+        import subprocess as sp
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS='cpu',
+                   PYTHONPATH=str(tmp_path) + os.pathsep + repo
+                   + os.pathsep + os.environ.get('PYTHONPATH', ''))
+        out = sp.run([sys.executable, '-m', 'mytrain_mod'], env=env,
+                     capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        import json
+        assert json.loads(out.stdout.strip().splitlines()[-1]) == [5, 6]
+
 
 def _boom():
     raise ValueError("worker failure")
