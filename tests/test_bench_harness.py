@@ -97,3 +97,30 @@ def test_crash_after_complete_line_is_teardown_noise():
 
     obj, err = _with_fake_run(fake, 'accel', 'bert', 60.0)
     assert err is None and "error" not in obj
+
+
+def test_onchip_history_fallback(tmp_path, monkeypatch):
+    """With the tunnel wedged, the freshest recorded on-chip measurements
+    (stage entries and accel-child cumulative lines) become the result —
+    labeled with measurement time — instead of a CPU smoke number."""
+    monkeypatch.setattr(bench, 'ONCHIP_HISTORY',
+                        str(tmp_path / 'hist.jsonl'))
+    assert bench._result_from_history([]) is None  # no file -> no result
+    bench.record_onchip({'stage': 'bert128', 'samples_per_sec': 480.5})
+    bench.record_onchip({'stage': 'bert512', 'samples_per_sec': 92.1})
+    bench.record_onchip({'stage': 'resnet50', 'images_per_sec': 2600.0})
+    bench.record_onchip({'stage': 'resnet50', 'images_per_sec': 2700.0})
+    r = bench._result_from_history(['probe hung'])
+    assert r['value'] == 480.5
+    assert r['vs_baseline'] == round(480.5 / bench.BASELINE_SAMPLES_PER_SEC,
+                                     4)
+    assert r['extras']['seq512_samples_per_sec'] == 92.1
+    # same-ts tie goes to the later line
+    assert r['extras']['resnet50_images_per_sec'] == 2700.0
+    assert 'onchip_history' in r['source'] and 'git' in r['source']
+    assert 'probe hung' in r['error']
+    # a newer accel-child cumulative line outranks the stage entries
+    bench.record_onchip({
+        'metric': 'bert_large_pretrain_samples_per_sec_per_chip',
+        'value': 500.0, 'extras': {'seq512_samples_per_sec': 95.0}})
+    assert bench._result_from_history([])['value'] == 500.0
