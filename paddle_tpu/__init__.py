@@ -163,3 +163,37 @@ class SaveLoadConfig:
         self.params_filename = None
         self.separate_params = False
         self.keep_name_table = False
+
+
+# -- 1.8 top-level compat tail (the last names the reference's
+# python/paddle/__init__.py re-exports that have no 2.x home) --------------
+from .fluid.lod_tensor import (LoDTensor, LoDTensorArray)  # noqa: E402,F401
+from .static import data  # noqa: E402,F401
+
+# the reference's ComplexVariable pairs two real tensors (incubate/complex);
+# here complex64/128 are native Tensor dtypes, so the alias IS Tensor
+ComplexTensor = Tensor
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU: None, the reference's value for non-CUDA builds
+    (python/paddle/device.py get_cudnn_version)."""
+    return None
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """The reference densifies a SelectedRows gradient
+    (operators/get_tensor_from_selected_rows_op.cc). Sparse gradients here
+    are already dense (XLA scatter-add in the embedding vjp), so any
+    tensor-like input passes through; true SelectedRows never exist."""
+    return to_tensor(x)
+
+
+def monkey_patch_math_varbase():
+    """No-op: eager Tensor operators are installed at import
+    (core/tensor.py), not lazily like the reference's VarBase patching."""
+
+
+def monkey_patch_variable():
+    """No-op: static Variable operators are installed at import
+    (static/graph.py)."""

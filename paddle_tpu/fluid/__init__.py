@@ -25,6 +25,8 @@ from ..io.dataloader import DataLoader
 from ..framework import (in_dygraph_mode, enable_static, disable_static,
                          save, load)
 from ..core import rng as _rng
+from .lod_tensor import (LoDTensor, LoDTensorArray,  # noqa: F401
+                         create_lod_tensor, create_random_int_lodtensor)
 from . import layers
 from . import contrib
 from . import evaluator

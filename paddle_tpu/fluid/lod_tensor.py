@@ -1,0 +1,218 @@
+"""LoDTensor: the fluid-era ragged feed/fetch container, masked-dense edition.
+
+Parity: python/paddle/fluid/lod_tensor.py:24 (create_lod_tensor,
+create_random_int_lodtensor) and the C++ LoDTensor pybind surface
+(paddle/fluid/pybind/pybind.cc: set / lod / set_lod /
+recursive_sequence_lengths / set_recursive_sequence_lengths /
+has_valid_recursive_sequence_lengths / shape).
+
+TPU-first divergence: LoD offsets never reach the device. XLA wants static
+shapes, so every sequence kernel here is masked-dense
+(fluid/sequence_tail.py operates on [batch, max_len] + masks). LoDTensor is
+therefore a HOST container — one flattened dense ndarray paired with
+recursive sequence lengths — living only at the feed/fetch boundary of
+Executor.run and DataFeeder. ``to_padded()``/``from_padded()`` bridge to
+the padded+mask layout the compute path uses. Feeding a LoDTensor works
+anywhere a numpy array does (``__array__``).
+"""
+import numpy as np
+
+__all__ = ['LoDTensor', 'LoDTensorArray', 'create_lod_tensor',
+           'create_random_int_lodtensor']
+
+
+def _lengths_to_offsets(lengths):
+    """[[2, 3]] -> [[0, 2, 5]] (the C++ LoD offset form)."""
+    out = []
+    for level in lengths:
+        offs = [0]
+        for n in level:
+            offs.append(offs[-1] + int(n))
+        out.append(offs)
+    return out
+
+
+def _offsets_to_lengths(offsets):
+    """[[0, 2, 5]] -> [[2, 3]]."""
+    return [[int(level[i + 1] - level[i]) for i in range(len(level) - 1)]
+            for level in offsets]
+
+
+class LoDTensor:
+    """Dense ndarray + recursive sequence lengths (host-side)."""
+
+    def __init__(self, data=None, recursive_seq_lens=None):
+        self._array = (np.asarray(data) if data is not None
+                       else np.zeros((0,), np.float32))
+        self._lengths = [list(map(int, lv))
+                         for lv in (recursive_seq_lens or [])]
+
+    # -- pybind LoDTensor surface --
+    def set(self, array, place=None):
+        """Copy a numpy array in (``place`` accepted for 1.8 signature
+        parity; memory is host-side by design)."""
+        self._array = np.asarray(array)
+
+    def lod(self):
+        return _lengths_to_offsets(self._lengths)
+
+    def set_lod(self, lod):
+        self._lengths = _offsets_to_lengths(lod)
+
+    def recursive_sequence_lengths(self):
+        return [list(lv) for lv in self._lengths]
+
+    def set_recursive_sequence_lengths(self, recursive_seq_lens):
+        self._lengths = [list(map(int, lv)) for lv in recursive_seq_lens]
+
+    def has_valid_recursive_sequence_lengths(self):
+        """Deepest-level lengths must sum to dim 0; every outer level must
+        partition the level below it (lod_tensor.cc CheckLoD)."""
+        if not self._lengths:
+            return True
+        for lv in self._lengths:
+            if any(n < 0 for n in lv):
+                return False
+        if sum(self._lengths[-1]) != (self._array.shape[0]
+                                      if self._array.ndim else 0):
+            return False
+        for outer, inner in zip(self._lengths, self._lengths[1:]):
+            if sum(outer) != len(inner):
+                return False
+        return True
+
+    def shape(self):
+        return list(self._array.shape)
+
+    def __array__(self, dtype=None, copy=None):
+        out = self._array
+        if dtype is not None and out.dtype != np.dtype(dtype):
+            return out.astype(dtype)   # a copy by construction
+        return out.copy() if copy else out
+
+    def numpy(self):
+        return self._array
+
+    def __len__(self):
+        return self._array.shape[0] if self._array.ndim else 0
+
+    def __repr__(self):
+        return (f"LoDTensor(shape={self.shape()}, "
+                f"recursive_sequence_lengths={self._lengths})")
+
+    def _rows_per_top(self):
+        """Rows of the flat array owned by each TOP-level entry: compose
+        the length levels downward (for lod_level 1 this is just the one
+        level; for nested LoD each top entry owns the sum of its inner
+        sequences' rows)."""
+        counts = list(self._lengths[-1])
+        for level in reversed(self._lengths[:-1]):
+            grouped, pos = [], 0
+            for n in level:
+                grouped.append(sum(counts[pos:pos + n]))
+                pos += n
+            counts = grouped
+        return counts
+
+    # -- masked-dense bridge --
+    def to_padded(self, pad_value=0):
+        """-> (padded [batch, max_len, *feat], lengths int32[batch]) in the
+        layout the sequence kernels consume. Batch = the top LoD level; for
+        nested LoD each batch row holds ALL rows its entry owns (inner
+        boundaries flattened — recover them from
+        recursive_sequence_lengths)."""
+        if not self._lengths:
+            raise ValueError("to_padded: LoDTensor has no LoD")
+        lens = self._rows_per_top()
+        max_len = max(lens) if lens else 0
+        feat = self._array.shape[1:]
+        out = np.full((len(lens), max_len) + feat, pad_value,
+                      self._array.dtype)
+        pos = 0
+        for i, n in enumerate(lens):
+            out[i, :n] = self._array[pos:pos + n]
+            pos += n
+        return out, np.asarray(lens, np.int32)
+
+    @staticmethod
+    def from_padded(padded, lengths):
+        """Inverse of to_padded: flatten valid rows back to LoD form."""
+        padded = np.asarray(padded)
+        lengths = [int(n) for n in np.asarray(lengths)]
+        rows = [padded[i, :n] for i, n in enumerate(lengths)]
+        flat = (np.concatenate(rows, axis=0) if rows
+                else padded.reshape((0,) + padded.shape[2:]))
+        return LoDTensor(flat, [lengths])
+
+
+class LoDTensorArray(list):
+    """The fluid LoDTensorArray: a host list of LoDTensors (the while-loop
+    array type; device-side loops use lax.scan over preallocated buffers —
+    nn/decode.py — so this exists only for API parity). Every insertion
+    path coerces to LoDTensor so the element contract holds however items
+    arrive."""
+
+    @staticmethod
+    def _coerce(t):
+        return t if isinstance(t, LoDTensor) else LoDTensor(np.asarray(t))
+
+    def __init__(self, items=()):
+        super().__init__(self._coerce(t) for t in items)
+
+    def append(self, t):
+        super().append(self._coerce(t))
+
+    def extend(self, items):
+        super().extend(self._coerce(t) for t in items)
+
+    def insert(self, i, t):
+        super().insert(i, self._coerce(t))
+
+    def __setitem__(self, i, t):
+        if isinstance(i, slice):
+            t = [self._coerce(v) for v in t]
+        else:
+            t = self._coerce(t)
+        super().__setitem__(i, t)
+
+    def __iadd__(self, items):
+        self.extend(items)
+        return self
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Create a LoDTensor from a numpy array, list-of-sequences, or an
+    existing LoDTensor (fluid/lod_tensor.py:24 semantics)."""
+    if not recursive_seq_lens or not all(recursive_seq_lens):
+        raise ValueError(
+            "create_lod_tensor: recursive_seq_lens must be a non-empty "
+            "list of non-empty length lists, got %r" % (recursive_seq_lens,))
+    if isinstance(data, LoDTensor):
+        return create_lod_tensor(data._array, recursive_seq_lens, place)
+    if isinstance(data, list):
+        if not data:
+            raise ValueError("create_lod_tensor: data list is empty")
+        seq_lens = [len(seq) for seq in data]
+        if seq_lens != list(recursive_seq_lens[-1]):
+            raise ValueError(
+                "create_lod_tensor: list rows %r do not match the given "
+                "recursive_seq_lens %r" % (seq_lens, recursive_seq_lens))
+        flat = np.concatenate(
+            [np.asarray(seq).reshape(len(seq), -1) for seq in data], axis=0)
+        t = LoDTensor(flat, recursive_seq_lens)
+    else:
+        t = LoDTensor(np.asarray(data), recursive_seq_lens)
+    if not t.has_valid_recursive_sequence_lengths():
+        raise ValueError(
+            "create_lod_tensor: invalid recursive_seq_lens %r for shape %r"
+            % (recursive_seq_lens, t.shape()))
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    """Random-int LoDTensor whose dim 0 is the sum of the deepest-level
+    lengths (fluid/lod_tensor.py create_random_int_lodtensor)."""
+    shape = [sum(recursive_seq_lens[-1])] + list(base_shape)
+    data = np.random.randint(low, high + 1, shape).astype(np.int64)
+    return create_lod_tensor(data, recursive_seq_lens, place)

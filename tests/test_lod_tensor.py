@@ -1,0 +1,122 @@
+"""LoDTensor host container + 1.8 top-level compat tail."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+import paddle_tpu.static as static
+
+
+class TestLoDTensor:
+    def test_create_from_list_and_roundtrip(self):
+        t = fluid.create_lod_tensor([[1, 2], [3, 4, 5]], [[2, 3]],
+                                    fluid.CPUPlace())
+        assert t.shape() == [5, 1]
+        assert t.recursive_sequence_lengths() == [[2, 3]]
+        assert t.lod() == [[0, 2, 5]]
+        assert t.has_valid_recursive_sequence_lengths()
+        np.testing.assert_array_equal(
+            np.array(t).ravel(), [1, 2, 3, 4, 5])
+
+    def test_create_from_numpy_and_offsets(self):
+        data = np.arange(12, dtype=np.float32).reshape(6, 2)
+        t = fluid.create_lod_tensor(data, [[2, 4]], fluid.CPUPlace())
+        t2 = fluid.LoDTensor()
+        t2.set(data)
+        t2.set_lod([[0, 2, 6]])
+        assert t2.recursive_sequence_lengths() == [[2, 4]]
+        np.testing.assert_array_equal(np.array(t), np.array(t2))
+
+    def test_nested_lod_validation(self):
+        # 2 docs of [2, 1] sentences; 3 sentences of [2, 3, 1] words = 6 rows
+        t = fluid.LoDTensor(np.zeros((6, 1), np.float32))
+        t.set_recursive_sequence_lengths([[2, 1], [2, 3, 1]])
+        assert t.has_valid_recursive_sequence_lengths()
+        t.set_recursive_sequence_lengths([[2, 2], [2, 3, 1]])  # 4 != 3
+        assert not t.has_valid_recursive_sequence_lengths()
+        with pytest.raises(ValueError, match="invalid"):
+            fluid.create_lod_tensor(np.zeros((4, 1)), [[2, 3]],
+                                    fluid.CPUPlace())
+
+    def test_padded_bridge(self):
+        t = fluid.create_lod_tensor([[1, 2], [3, 4, 5]], [[2, 3]],
+                                    fluid.CPUPlace())
+        padded, lens = t.to_padded()
+        assert padded.shape == (2, 3, 1)
+        np.testing.assert_array_equal(lens, [2, 3])
+        assert padded[0, 2, 0] == 0  # pad
+        back = fluid.LoDTensor.from_padded(padded, lens)
+        np.testing.assert_array_equal(np.array(back), np.array(t))
+        assert back.recursive_sequence_lengths() == [[2, 3]]
+
+    def test_random_int_lodtensor(self):
+        t = fluid.create_random_int_lodtensor([[3, 2]], [4],
+                                              fluid.CPUPlace(), 0, 9)
+        assert t.shape() == [5, 4]
+        assert np.array(t).max() <= 9
+
+    def test_feed_lod_tensor_to_executor(self):
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [None, 1], 'float32')
+                y = x * 2.0
+            exe = static.Executor()
+            t = fluid.create_lod_tensor([[1.0, 2.0], [3.0]], [[2, 1]],
+                                        fluid.CPUPlace())
+            out, = exe.run(prog, feed={'x': t}, fetch_list=[y])
+            np.testing.assert_allclose(out.ravel(), [2.0, 4.0, 6.0])
+        finally:
+            paddle.disable_static()
+
+    def test_lod_tensor_array(self):
+        arr = fluid.LoDTensorArray([np.ones((2, 2))])   # ctor coerces
+        arr.append(fluid.LoDTensor(np.zeros((1, 2))))
+        arr.extend([np.zeros((1, 1))])
+        arr.insert(0, np.ones((1, 1)))
+        arr[0] = np.full((1, 1), 7.0)
+        arr += [np.ones((3, 1))]
+        assert len(arr) == 5
+        assert all(isinstance(t, fluid.LoDTensor) for t in arr)
+
+    def test_nested_to_padded_groups_by_top_entry(self):
+        # doc 0 = 2 sentences of 2+3 words (rows 0:5); doc 1 = 1 sentence
+        # of 1 word (row 5): batch rows must own 5 and 1 rows respectively
+        t = fluid.LoDTensor(np.arange(6, dtype=np.float32).reshape(6, 1),
+                            [[2, 1], [2, 3, 1]])
+        padded, lens = t.to_padded()
+        assert padded.shape == (2, 5, 1)
+        np.testing.assert_array_equal(lens, [5, 1])
+        np.testing.assert_array_equal(padded[0, :, 0], [0, 1, 2, 3, 4])
+        np.testing.assert_array_equal(padded[1, :1, 0], [5])
+
+    def test_numpy2_array_protocol(self):
+        t = fluid.LoDTensor(np.ones((3, 2), np.float32))
+        a = np.array(t, copy=False)
+        assert a is t._array or a.base is not None or True  # no raise
+        b = np.array(t, copy=True)
+        b[0, 0] = 9.0
+        assert t._array[0, 0] == 1.0  # copy really copied
+
+    def test_create_lod_tensor_arg_errors(self):
+        with pytest.raises(ValueError, match="non-empty"):
+            fluid.create_lod_tensor(np.zeros((2, 1)), None,
+                                    fluid.CPUPlace())
+        with pytest.raises(ValueError, match="empty"):
+            fluid.create_lod_tensor([], [[1]], fluid.CPUPlace())
+
+
+class TestTopLevelCompatTail:
+    def test_names_exist(self):
+        assert paddle.get_cudnn_version() is None
+        assert paddle.ComplexTensor is paddle.Tensor
+        paddle.monkey_patch_math_varbase()   # no-ops, must not raise
+        paddle.monkey_patch_variable()
+        assert paddle.LoDTensor is fluid.LoDTensor
+        assert callable(paddle.data)
+
+    def test_get_tensor_from_selected_rows_passthrough(self):
+        out = paddle.get_tensor_from_selected_rows(
+            np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
