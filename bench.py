@@ -268,16 +268,23 @@ def _flash_dropout_check():
         return f'error: {e!r}'
 
 
-def _resnet50_batch():
-    """On-chip ResNet bench batch; PADDLE_TPU_RESNET_BATCH overrides the
-    256 default (for applying batch-sweep results). The accel child echoes
-    the batch into the emitted JSON so an override can never masquerade as
-    the default run."""
+def _env_batch(var, default):
+    """Bench batch with env override (for applying batch-sweep results);
+    every emitter echoes the batch into its JSON so an override can never
+    masquerade as the default run."""
     try:
-        batch = int(os.environ.get('PADDLE_TPU_RESNET_BATCH', '0'))
+        batch = int(os.environ.get(var, '0'))
     except ValueError:
         batch = 0
-    return batch if batch > 0 else 256
+    return batch if batch > 0 else default
+
+
+def _bert_batch(seq, default):
+    return _env_batch('PADDLE_TPU_BERT%d_BATCH' % seq, default)
+
+
+def _resnet50_batch():
+    return _env_batch('PADDLE_TPU_RESNET_BATCH', 256)
 
 
 def _resnet50_accel_ips():
@@ -648,7 +655,8 @@ def _child_main(mode, model):
             from paddle_tpu.kernels.autotune import autotune_attention
             budget = float(os.environ.get('PADDLE_TPU_AUTOTUNE_BUDGET',
                                           '120'))
-            for b, s in ((64, 128), (16, 512)):
+            for b, s in ((_bert_batch(128, 64), 128),
+                         (_bert_batch(512, 16), 512)):
                 dec = autotune_attention(
                     b, 16, s, 64, dtype='bfloat16', causal=False,
                     has_kpad=False, dropout_p=0.1, budget_s=budget,
@@ -678,13 +686,17 @@ def _child_main(mode, model):
             },
         }
         # phase 1: seq128 (headline, comparable to BASELINE.json)
-        sps128 = bench_bert(large, batch=64, seq=128, steps=10, warmup=2)
+        b128 = _bert_batch(128, 64)
+        sps128 = bench_bert(large, batch=b128, seq=128, steps=10, warmup=2)
         result["value"] = round(sps128, 2)
         result["vs_baseline"] = round(sps128 / BASELINE_SAMPLES_PER_SEC, 4)
+        result["batch"] = b128   # echoed so an override can't masquerade
         print(json.dumps(result), flush=True)
         record_onchip(result)
         # phase 2: seq512 — attention-dominated, Pallas flash path
-        sps512 = bench_bert(large, batch=16, seq=512, steps=10, warmup=2)
+        b512 = _bert_batch(512, 16)
+        sps512 = bench_bert(large, batch=b512, seq=512, steps=10, warmup=2)
+        result["extras"]["seq512_batch"] = b512
         result["extras"].update({
             "seq512_samples_per_sec": round(sps512, 2),
             "seq512_vs_baseline": round(sps512 / BASELINE_SEQ512_SPS, 4),

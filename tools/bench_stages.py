@@ -88,23 +88,33 @@ def main():
                 emit({'stage': stage, 'trace_dir': trace_dir,
                       'op_table': table,
                       'wall_s': round(time.time() - t0, 1)})
-            elif stage == 'bert128':
-                sps = bench.bench_bert(large, batch=64, seq=128, steps=10,
+            elif stage == 'bert128' or stage.startswith('bert128_b'):
+                b = (int(stage.split('_b')[1]) if '_b' in stage
+                     else bench._bert_batch(128, 64))
+                sps = bench.bench_bert(large, batch=b, seq=128, steps=10,
                                        warmup=2)
-                emit({'stage': stage, 'samples_per_sec': round(sps, 2),
+                emit({'stage': stage, 'batch': b,
+                      'samples_per_sec': round(sps, 2),
                       'vs_baseline': round(
                           sps / bench.BASELINE_SAMPLES_PER_SEC, 4),
                       'wall_s': round(time.time() - t0, 1)})
-            elif stage == 'bert512':
-                sps = bench.bench_bert(large, batch=16, seq=512, steps=10,
+            elif stage == 'bert512' or stage.startswith('bert512_b'):
+                b = (int(stage.split('_b')[1]) if '_b' in stage
+                     else bench._bert_batch(512, 16))
+                sps = bench.bench_bert(large, batch=b, seq=512, steps=10,
                                        warmup=2)
-                emit({'stage': stage, 'samples_per_sec': round(sps, 2),
+                emit({'stage': stage, 'batch': b,
+                      'samples_per_sec': round(sps, 2),
                       'vs_baseline': round(
                           sps / bench.BASELINE_SEQ512_SPS, 4),
                       'wall_s': round(time.time() - t0, 1)})
             elif stage in ('tune512', 'tune128'):
                 from paddle_tpu.kernels.autotune import autotune_attention
-                b, s = (16, 512) if stage == 'tune512' else (64, 128)
+                # tune the same signature the bert stages will bench
+                # (PADDLE_TPU_BERT{seq}_BATCH override included)
+                b, s = ((bench._bert_batch(512, 16), 512)
+                        if stage == 'tune512'
+                        else (bench._bert_batch(128, 64), 128))
                 budget = float(os.environ.get('PADDLE_TPU_AUTOTUNE_BUDGET',
                                               '120'))
                 dec = autotune_attention(b, 16, s, 64, dtype='bfloat16',

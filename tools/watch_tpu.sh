@@ -1,35 +1,41 @@
 #!/bin/bash
-# Keep exactly one TPU claimant alive; when the chip frees, run the bench
-# stages automatically. A killed in-flight holder leaves a stale grant that
-# takes a long time to clear (claimants block ~25 min in backend init, then
-# fail UNAVAILABLE) — this loop just keeps retrying with a single claimant.
-# Never kill a probe or stage run mid-flight: that is what creates the
-# stale grant in the first place.
+# Reclaim the TPU after a wedge, gently. Evidence from the .so strings
+# ("idle interval evicting closed/expired for ...") says the terminal's
+# stale-session evictor needs the connection IDLE for an interval —
+# back-to-back 25-min claim attempts may keep resetting that clock. So:
+# wait QUIET_S first, then probe; on failure wait QUIET_S again (not 60s).
+# Never kill a probe or stage run mid-flight: a killed in-flight holder is
+# what creates the stale grant in the first place.
+#
+# Stage order: BERT first (small tensors, known-good on-chip since r2) so
+# measurements land in the on-chip history early; ResNet (whose batch-256
+# step coincided with the 03:17 wedge) runs last, smaller batch first.
 cd "$(dirname "$0")/.." || exit 1
 LOG=/tmp/tpu_watch.log
-echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
+QUIET_S="${QUIET_S:-2700}"
+STAGES="${STAGES:-bert128 tune128 bert128 tune512 bert512 flashdrop resnet50_b128 resnet50 resnet50_s2d}"
+echo "$(date -u +%FT%TZ) watcher start (quiet ${QUIET_S}s between attempts)" >> "$LOG"
+# the success grep below must only see THIS watcher's output
+: > /tmp/bench_stages.log
 while true; do
+  echo "$(date -u +%FT%TZ) going quiet for ${QUIET_S}s" >> "$LOG"
+  sleep "$QUIET_S"
   start=$(date +%s)
   python -u -c "import jax; print('BACKEND=' + jax.default_backend())" \
       > /tmp/tpu_probe.log 2>&1
   took=$(( $(date +%s) - start ))
   if grep -q "BACKEND=axon\|BACKEND=tpu" /tmp/tpu_probe.log; then
-    echo "$(date -u +%FT%TZ) chip acquired (probe ${took}s); running stages" >> "$LOG"
+    echo "$(date -u +%FT%TZ) chip acquired (probe ${took}s); running stages: $STAGES" >> "$LOG"
     PADDLE_TPU_AUTOTUNE_BUDGET="${PADDLE_TPU_AUTOTUNE_BUDGET:-420}" \
-      python -u tools/bench_stages.py \
-      resnet50 resnet50_s2d tune128 bert128 tune512 bert512 flashdrop \
+      python -u tools/bench_stages.py $STAGES \
       >> /tmp/bench_stages.log 2>> /tmp/bench_stages.err
     rc=$?
-    # bench_stages catches per-stage exceptions and exits 0 even when every
-    # stage failed (e.g. the chip was re-grabbed between probe and claim):
-    # only stop once some stage actually produced a measurement
-    if grep -q "images_per_sec\|samples_per_sec\|decision" /tmp/bench_stages.log; then
+    if grep -q "images_per_sec\|samples_per_sec" /tmp/bench_stages.log; then
       echo "$(date -u +%FT%TZ) stages done rc=$rc (measurements present)" >> "$LOG"
       break
     fi
     echo "$(date -u +%FT%TZ) stages produced no measurement (rc=$rc); retrying" >> "$LOG"
-    sleep 60
+    continue
   fi
-  echo "$(date -u +%FT%TZ) probe failed after ${took}s: $(tail -1 /tmp/tpu_probe.log | head -c 120)" >> "$LOG"
-  sleep 60
+  echo "$(date -u +%FT%TZ) probe failed after ${took}s: $(tail -1 /tmp/tpu_probe.log | head -c 160)" >> "$LOG"
 done
