@@ -137,9 +137,9 @@ def test_wide_deep_auc_improves():
 
 def test_resnet_tiny_images_loss_decreases():
     """ResNet-18 NHWC (the TPU conv layout) on a learnable synthetic
-    image task: smoothed train loss strictly decreases across thirds —
-    the BASELINE 'ResNet-50 ImageNet' config's convergence smoke at
-    CI scale."""
+    image task: a large first->middle smoothed-loss drop that the tail
+    HOLDS (batch-8 BN noise rules out strict monotonicity) — the BASELINE
+    'ResNet-50 ImageNet' config's convergence smoke at CI scale."""
     from paddle_tpu.vision.models import resnet18
     import paddle_tpu.nn.functional as F
 
