@@ -63,6 +63,17 @@ def _rank_fn(scale):
     return rank * scale
 
 
+def _cli_env(*extra_path):
+    """Subprocess env for script/module children: repo (and extras) on
+    PYTHONPATH, CPU backend, no device-plugin registration."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.pathsep.join(list(map(str, extra_path)) + [repo]
+                           + ([os.environ['PYTHONPATH']]
+                              if os.environ.get('PYTHONPATH') else []))
+    return dict(os.environ, JAX_PLATFORMS='cpu', PALLAS_AXON_POOL_IPS='',
+                PYTHONPATH=path)
+
+
 class TestSpawn:
     def test_inprocess_default(self):
         import paddle_tpu.distributed as dist
@@ -103,11 +114,7 @@ class TestSpawn:
             "    res = ctx.join()\n"
             "    print(json.dumps([c.scale for c in res]))\n")
         import subprocess as sp
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env = dict(os.environ, JAX_PLATFORMS='cpu',
-                   PYTHONPATH=repo + os.pathsep
-                   + os.environ.get('PYTHONPATH', ''))
-        out = sp.run([sys.executable, str(script)], env=env,
+        out = sp.run([sys.executable, str(script)], env=_cli_env(),
                      capture_output=True, text=True, timeout=300)
         assert out.returncode == 0, out.stderr[-2000:]
         import json
@@ -129,11 +136,8 @@ class TestSpawn:
             "                     backend='cpu').join()\n"
             "    print(json.dumps(res))\n")
         import subprocess as sp
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env = dict(os.environ, JAX_PLATFORMS='cpu',
-                   PYTHONPATH=str(tmp_path) + os.pathsep + repo
-                   + os.pathsep + os.environ.get('PYTHONPATH', ''))
-        out = sp.run([sys.executable, '-m', 'mytrain_mod'], env=env,
+        out = sp.run([sys.executable, '-m', 'mytrain_mod'],
+                     env=_cli_env(tmp_path),
                      capture_output=True, text=True, timeout=300)
         assert out.returncode == 0, out.stderr[-2000:]
         import json
@@ -142,3 +146,28 @@ class TestSpawn:
 
 def _boom():
     raise ValueError("worker failure")
+
+
+class TestLaunchCLI:
+    @pytest.mark.skipif(sys.platform == 'win32', reason='posix only')
+    def test_launch_module_two_ranks(self, tmp_path):
+        # `python -m paddle_tpu.distributed.launch --nproc_per_node 2 s.py`
+        # must run the script once per rank with the trainer env set
+        script = tmp_path / "train_cli.py"
+        script.write_text(
+            "import os, json, pathlib\n"
+            "rank = os.environ['PADDLE_TRAINER_ID']\n"
+            "world = os.environ['PADDLE_TRAINERS_NUM']\n"
+            "out = pathlib.Path(__file__).parent / ('rank_%s.json' % rank)\n"
+            "out.write_text(json.dumps({'rank': rank, 'world': world}))\n")
+        import subprocess as sp
+        out = sp.run([sys.executable, '-m', 'paddle_tpu.distributed.launch',
+                      '--nproc_per_node', '2', str(script)],
+                     env=_cli_env(),
+                     capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        import json
+        recs = [json.loads((tmp_path / ('rank_%d.json' % r)).read_text())
+                for r in range(2)]
+        assert sorted(r['rank'] for r in recs) == ['0', '1']
+        assert all(r['world'] == '2' for r in recs)

@@ -26,15 +26,22 @@ while true; do
   took=$(( $(date +%s) - start ))
   if grep -q "BACKEND=axon\|BACKEND=tpu" /tmp/tpu_probe.log; then
     echo "$(date -u +%FT%TZ) chip acquired (probe ${took}s); running stages: $STAGES" >> "$LOG"
+    # Bounded as a last resort: a wedged execute blocks in C forever, and
+    # only a kill regains control (accepting the stale-grant cost — the
+    # header rule still holds for HEALTHY runs, which is why the budget is
+    # 3h: far above any observed healthy stage sequence).
     PADDLE_TPU_AUTOTUNE_BUDGET="${PADDLE_TPU_AUTOTUNE_BUDGET:-420}" \
+      timeout --signal=KILL "${STAGE_BUDGET_S:-10800}" \
       python -u tools/bench_stages.py $STAGES \
       >> /tmp/bench_stages.log 2>> /tmp/bench_stages.err
     rc=$?
-    if grep -q "images_per_sec\|samples_per_sec" /tmp/bench_stages.log; then
+    if [ $rc -eq 0 ] && grep -q "images_per_sec\|samples_per_sec" /tmp/bench_stages.log; then
       echo "$(date -u +%FT%TZ) stages done rc=$rc (measurements present)" >> "$LOG"
       break
     fi
-    echo "$(date -u +%FT%TZ) stages produced no measurement (rc=$rc); retrying" >> "$LOG"
+    # killed-at-budget or no measurement: partial results are already in
+    # the log + on-chip history; re-quiet and retry the remaining value
+    echo "$(date -u +%FT%TZ) stages incomplete (rc=$rc); retrying" >> "$LOG"
     continue
   fi
   echo "$(date -u +%FT%TZ) probe failed after ${took}s: $(tail -1 /tmp/tpu_probe.log | head -c 160)" >> "$LOG"
