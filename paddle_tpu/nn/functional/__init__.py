@@ -10,3 +10,55 @@ from .vision import *  # noqa
 from .transformer import scaled_dot_product_attention, multi_head_attention  # noqa
 from .rnn import rnn_scan  # noqa
 from .crf import linear_chain_crf, crf_decoding  # noqa
+
+# -- 2.0-beta DEFINE_ALIAS tail -------------------------------------------
+# The reference's paddle.nn.functional re-exports the fluid-era op zoo
+# wholesale (python/paddle/nn/functional/__init__.py, the DEFINE_ALIAS
+# block). Those ops live in paddle_tpu.fluid.layers; resolving lazily via
+# PEP 562 keeps nn.functional importable without the fluid package
+# (fluid imports nn, so an eager import here would be a cycle).
+_FLUID_ALIASES = frozenset([
+    'adaptive_pool2d', 'adaptive_pool3d', 'add_position_encoding',
+    'affine_channel', 'anchor_generator', 'assign', 'bipartite_match',
+    'birnn', 'box_clip', 'box_coder', 'box_decoder_and_assign', 'bpr_loss',
+    'center_loss', 'collect_fpn_proposals', 'continuous_value_model',
+    'cosine_decay', 'deformable_roi_pooling', 'density_prior_box',
+    'detection_output', 'dice_loss', 'distribute_fpn_proposals',
+    'edit_distance', 'erf', 'exponential_decay', 'filter_by_instag',
+    'fsp_matrix', 'generate_mask_labels', 'generate_proposal_labels',
+    'generate_proposals', 'hard_sigmoid', 'hard_swish', 'hash', 'hsigmoid',
+    'image_resize', 'image_resize_short', 'inverse_time_decay',
+    'iou_similarity', 'l2_normalize', 'linear_lr_warmup', 'lrn',
+    'multiclass_nms', 'natural_exp_decay', 'noam_decay', 'pad2d',
+    'pad_constant_like', 'piecewise_decay', 'polygon_box_transform',
+    'polynomial_decay', 'pool2d', 'pool3d', 'prior_box', 'prroi_pool',
+    'psroi_pool', 'random_crop', 'rank_loss', 'resize_bilinear',
+    'resize_nearest', 'resize_trilinear', 'retinanet_detection_output',
+    'retinanet_target_assign', 'roi_align', 'roi_perspective_transform',
+    'roi_pool', 'row_conv', 'rpn_target_assign', 'shuffle_channel',
+    'sigmoid_cross_entropy_with_logits', 'similarity_focus', 'smooth_l1',
+    'space_to_depth', 'ssd_loss', 'target_assign',
+    'teacher_student_sigmoid_loss', 'warpctc', 'yolo_box', 'yolov3_loss',
+])
+# the targets are already eager (from .conv import * above): plain bindings
+conv_transpose1d = conv1d_transpose  # noqa: F405
+conv_transpose2d = conv2d_transpose  # noqa: F405
+conv_transpose3d = conv3d_transpose  # noqa: F405
+
+# __all__ makes the lazy names reachable by star-import (which getattr()s
+# each listed name, firing __getattr__) and __dir__ keeps dir()/completion
+# honest about them
+__all__ = sorted(
+    [n for n in globals() if not n.startswith('_')] + list(_FLUID_ALIASES))
+
+
+def __getattr__(name):
+    if name in _FLUID_ALIASES:
+        from ...fluid import layers as _fluid_layers
+        return getattr(_fluid_layers, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return __all__
