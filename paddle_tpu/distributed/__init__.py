@@ -5,7 +5,7 @@ from .env import (init_parallel_env, init_distributed, get_rank,
 from .collective import (ReduceOp, all_reduce, all_gather, broadcast, reduce,
                          scatter, reduce_scatter, alltoall, all_to_all,
                          barrier, ppermute, new_group)
-from .parallel import DataParallel
+from .parallel import DataParallel, ParallelStrategy, prepare_context
 from . import fleet
 from . import sharding
 from .sharding import shard_tensor, shard_layer

@@ -51,3 +51,31 @@ class DataParallel(Layer):
 
     def set_state_dict(self, state_dict, *args, **kwargs):
         return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+class ParallelStrategy:
+    """Parity: fluid/dygraph/parallel.py ParallelStrategy (the C++ struct's
+    four fields, host-side)."""
+
+    def __init__(self):
+        self.nranks = 1
+        self.local_rank = 0
+        self.trainer_endpoints = []
+        self.current_endpoint = ""
+
+
+def prepare_context(strategy=None):
+    """Parity: fluid/dygraph/parallel.py:34 prepare_context. TPU-first: no
+    NCCL communicator to construct — the mesh IS the communicator — so this
+    fills the strategy from the parallel env and ensures the mesh exists."""
+    if strategy is None:
+        strategy = ParallelStrategy()
+        e = env.ParallelEnv()
+        strategy.nranks = e.nranks
+        strategy.local_rank = e.local_rank
+        strategy.trainer_endpoints = list(
+            getattr(e, 'trainer_endpoints', []) or [])
+        strategy.current_endpoint = getattr(e, 'current_endpoint', '')
+    if strategy.nranks > 1 and not env.is_initialized():
+        env.init_parallel_env()
+    return strategy

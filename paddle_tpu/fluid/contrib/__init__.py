@@ -152,9 +152,13 @@ from . import layers  # noqa: E402
 from .layers import *  # noqa: E402,F401,F403
 __all__ += layers.__all__
 
-# mixed_precision / slim / reader live at the package top level; bind the
+# mixed_precision / slim live at the package top level; bind the
 # reference's contrib paths so 1.8 scripts resolve them from here too
 from ... import amp as mixed_precision  # noqa: E402,F401
 from ... import slim  # noqa: E402,F401
-from ... import reader  # noqa: E402,F401
 __all__ += ['mixed_precision']
+# contrib.reader: distributed_batch_reader + the decorator API (reader.py
+# re-exports the top-level package so both 1.8 surfaces resolve here)
+from . import reader  # noqa: E402,F401
+from .reader import distributed_batch_reader  # noqa: E402,F401
+__all__ += ['reader', 'distributed_batch_reader']

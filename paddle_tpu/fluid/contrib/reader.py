@@ -1,0 +1,35 @@
+"""fluid.contrib.reader. Parity:
+python/paddle/fluid/contrib/reader/distributed_reader.py:21.
+
+``distributed_batch_reader`` shards a batch reader across trainers by
+round-robin on batch index: trainer *i* of *N* yields batches i, i+N,
+i+2N, ... (the reference reads PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM
+the same way).
+"""
+import os
+
+from ...reader import *  # noqa: F401,F403  (decorator API stays reachable
+# here: fluid.contrib.reader previously aliased the top-level reader
+# package, and 1.8 scripts mix both surfaces)
+from ...reader import __all__ as _decorator_all
+
+__all__ = ['distributed_batch_reader'] + list(_decorator_all)
+
+
+def distributed_batch_reader(batch_reader):
+    trainer_id = int(os.environ.get('PADDLE_TRAINER_ID', '0'))
+    trainers = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
+    if trainers <= 0:
+        raise ValueError("PADDLE_TRAINERS_NUM must be positive, got %d"
+                         % trainers)
+    if not 0 <= trainer_id < trainers:
+        raise ValueError(
+            "PADDLE_TRAINER_ID %d out of range for %d trainers"
+            % (trainer_id, trainers))
+
+    def reader():
+        for idx, batch in enumerate(batch_reader()):
+            if idx % trainers == trainer_id:
+                yield batch
+
+    return reader

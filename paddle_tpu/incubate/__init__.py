@@ -6,3 +6,5 @@ from . import complex
 from . import data_generator
 from . import custom_op
 from .custom_op import register_op
+
+from ..fluid.contrib import reader  # noqa: E402,F401  (paddle.incubate.reader)

@@ -183,3 +183,16 @@ def accuracy(input, label, k=1, correct=None, total=None):
 from .extras import (EditDistance, ChunkEvaluator, DetectionMAP,  # noqa: E402
                      CompositeMetric, edit_distance, chunk_eval, auc,
                      detection_map)
+
+
+from . import metrics  # noqa: E402,F401  (paddle.metric.metrics module path)
+
+
+def __getattr__(name):
+    # cos_sim / mean_iou: the reference re-exports these fluid.layers ops
+    # into paddle.metric (python/paddle/metric/__init__.py); lazy to avoid
+    # an import cycle with the fluid package
+    if name in ('cos_sim', 'mean_iou'):
+        from ..fluid import layers as _L
+        return getattr(_L, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

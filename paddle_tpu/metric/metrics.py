@@ -1,0 +1,6 @@
+"""``paddle.metric.metrics`` module path (the reference's implementation
+module, re-exported: python/paddle/metric/metrics.py). One implementation
+in :mod:`paddle_tpu.metric`, two import paths."""
+from . import Metric, Accuracy, Precision, Recall, Auc  # noqa: F401
+
+__all__ = ['Metric', 'Accuracy', 'Precision', 'Recall', 'Auc']

@@ -50,3 +50,5 @@ def RecomputeOptimizer(optimizer, **kw):
     """1.8 recompute wrapper: rematerialization is fleet's recompute knob
     (jax.checkpoint); the optimizer passes through unchanged."""
     return optimizer
+from . import lr_scheduler  # noqa: E402,F401  (2.0-beta module path)
+from .lr_scheduler import _LRScheduler  # noqa: E402,F401

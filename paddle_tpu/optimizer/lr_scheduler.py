@@ -1,0 +1,13 @@
+"""2.0-beta ``paddle.optimizer.lr_scheduler`` module path.
+
+Parity: python/paddle/optimizer/lr_scheduler.py:27 — the beta shipped the
+scheduler base as ``_LRScheduler`` in this module; the schedulers
+themselves live in :mod:`paddle_tpu.optimizer.lr` (one implementation,
+two import paths).
+"""
+from .lr import *  # noqa: F401,F403
+from .lr import LRScheduler, __all__ as _lr_all
+
+_LRScheduler = LRScheduler
+
+__all__ = list(_lr_all) + ['_LRScheduler']

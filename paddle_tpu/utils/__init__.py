@@ -15,3 +15,9 @@ def run_check():
 from . import debug
 from .debug import (check_numerics, enable_check_nan_inf,
                     divergence_check, deterministic_guard)
+from . import download  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+from .profiler import Profiler, ProfilerOptions, get_profiler  # noqa: E402,F401
+from . import image_util  # noqa: E402,F401
+__all__ += ['download', 'profiler', 'Profiler', 'ProfilerOptions',
+            'get_profiler', 'image_util']

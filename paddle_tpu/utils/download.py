@@ -1,0 +1,36 @@
+"""Weights-by-URL cache resolution. Parity:
+python/paddle/utils/download.py:58 (get_weights_path_from_url).
+
+TPU-first divergence: this build runs in zero-egress environments, so no
+network fetch is attempted. The function resolves the URL to the same
+cache layout the reference uses (~/.cache/paddle/weights/<basename>) and
+returns the path when the file is already present (pre-seeded caches,
+mounted volumes); otherwise it raises with the exact path to provision.
+"""
+import os
+
+__all__ = ['get_weights_path_from_url']
+
+WEIGHTS_HOME = os.path.expanduser('~/.cache/paddle/weights')
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    fname = os.path.basename(url.split('?')[0])
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(path):
+        if md5sum is not None:
+            import hashlib
+            digest = hashlib.md5()
+            with open(path, 'rb') as f:
+                for chunk in iter(lambda: f.read(1 << 20), b''):
+                    digest.update(chunk)
+            if digest.hexdigest() != md5sum:
+                raise RuntimeError(
+                    f"cached weights at {path!r} fail the md5 check "
+                    f"(expected {md5sum}, got {digest.hexdigest()}): the "
+                    f"pre-seeded file is stale or corrupt — replace it")
+        return path
+    raise RuntimeError(
+        f"weights for {url!r} not present at {path!r}: this environment "
+        f"has no network egress — place the file there (or point "
+        f"model code at a local checkpoint via paddle.load) and retry")

@@ -111,3 +111,106 @@ def get_hlo(fn, *args, optimized=False):
     if optimized:
         return lowered.compile().as_text()
     return lowered.as_text()
+
+
+# -- utils-level Profiler wrapper (parity: python/paddle/utils/profiler.py:
+# ProfilerOptions:26, Profiler:63, get_profiler:131) ----------------------
+class ProfilerOptions:
+    def __init__(self, options=None):
+        self.options = {
+            'state': 'All',
+            'sorted_key': 'default',
+            'tracer_level': 'Default',
+            'batch_range': [0, 2 ** 31 - 1],
+            'output_thread_detail': False,
+            'profile_path': 'none',
+            'timeline_path': 'none',
+            'op_summary_path': 'none',
+        }
+        if options is not None:
+            for key in self.options:
+                if options.get(key, None) is not None:
+                    self.options[key] = options[key]
+
+    def with_state(self, state):
+        self.options['state'] = state
+        return self
+
+    def __getitem__(self, name):
+        if name not in self.options:
+            raise ValueError(
+                "ProfilerOptions does not have an option named %s." % name)
+        value = self.options[name]
+        return None if isinstance(value, str) and value == 'none' else value
+
+
+_current_profiler = None
+
+
+class Profiler:
+    """Batch-range-aware profiler driver over start/stop_profiler (the
+    reference's utils.Profiler contract: context manager + record_step)."""
+
+    def __init__(self, enabled=True, options=None):
+        self.profiler_options = (options if options is not None
+                                 else ProfilerOptions())
+        self.batch_id = 0
+        self.enabled = enabled
+        self._running = False
+
+    def __enter__(self):
+        global _current_profiler
+        self.previous_profiler = _current_profiler
+        _current_profiler = self
+        if self.enabled and self.profiler_options['batch_range'][0] == 0:
+            self.start()
+        return self
+
+    def __exit__(self, exception_type, exception_value, traceback):
+        global _current_profiler
+        _current_profiler = self.previous_profiler
+        if self.enabled:
+            self.stop()
+
+    def start(self):
+        if self.enabled and not self._running:
+            start_profiler(state=self.profiler_options['state'],
+                           tracer_option=self.profiler_options[
+                               'tracer_level'])
+            self._running = True
+
+    def stop(self):
+        if self.enabled and self._running:
+            stop_profiler(
+                # __getitem__ converts the 'none' sentinel to None for
+                # sorted_key the same as every other option
+                sorted_key=self.profiler_options['sorted_key'],
+                profile_path=self.profiler_options['profile_path']
+                or '/tmp/profile')
+            self._running = False
+
+    def reset(self):
+        """The xplane trace has no in-flight reset: restart the window."""
+        if self.enabled and self._running:
+            self.stop()
+            self.start()
+
+    def record_step(self, change_profiler_status=True):
+        if not self.enabled:
+            return
+        self.batch_id += 1
+        if change_profiler_status:
+            if self.batch_id == self.profiler_options['batch_range'][0]:
+                self.reset() if self._running else self.start()
+            if self.batch_id == self.profiler_options['batch_range'][1]:
+                self.stop()
+
+
+def get_profiler():
+    global _current_profiler
+    if _current_profiler is None:
+        _current_profiler = Profiler()
+    return _current_profiler
+
+
+__all__ += ['Profiler', 'ProfilerOptions', 'get_profiler']

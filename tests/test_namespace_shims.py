@@ -1,0 +1,111 @@
+"""2.0-beta module-path shims: lr_scheduler, metric.metrics, Profiler,
+prepare_context, contrib.reader, utils.download."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestLRSchedulerPath:
+    def test_module_and_base_alias(self):
+        from paddle_tpu.optimizer import lr_scheduler, _LRScheduler
+        from paddle_tpu.optimizer.lr import LRScheduler, NoamDecay
+        assert lr_scheduler._LRScheduler is LRScheduler
+        assert _LRScheduler is LRScheduler
+        assert lr_scheduler.NoamDecay is NoamDecay
+
+    def test_scheduler_runs_via_beta_path(self):
+        from paddle_tpu.optimizer.lr_scheduler import PiecewiseDecay
+        sched = PiecewiseDecay(boundaries=[2, 4], values=[1.0, 0.5, 0.1])
+        vals = []
+        for _ in range(5):
+            vals.append(float(sched()))
+            sched.step()
+        assert vals == [1.0, 1.0, 0.5, 0.5, 0.1]
+
+
+class TestMetricPaths:
+    def test_metrics_module(self):
+        import paddle_tpu.metric as metric
+        assert metric.metrics.Accuracy is metric.Accuracy
+
+    def test_cos_sim_mean_iou(self):
+        import paddle_tpu.metric as metric
+        a = paddle.to_tensor(np.array([[1.0, 0.0]], np.float32))
+        b = paddle.to_tensor(np.array([[0.0, 1.0]], np.float32))
+        np.testing.assert_allclose(
+            np.ravel(metric.cos_sim(a, b).numpy()), [0.0], atol=1e-6)
+        pred = paddle.to_tensor(np.array([[0, 1], [1, 0]], np.int64))
+        label = paddle.to_tensor(np.array([[0, 1], [1, 1]], np.int64))
+        iou, *_ = metric.mean_iou(pred, label, 2)
+        assert 0.0 < float(np.ravel(iou.numpy())[0]) <= 1.0
+
+
+class TestPrepareContext:
+    def test_single_process_strategy(self):
+        import paddle_tpu.distributed as dist
+        strategy = dist.prepare_context()
+        assert isinstance(strategy, dist.ParallelStrategy)
+        assert strategy.nranks >= 1
+        assert strategy.local_rank == 0
+
+    def test_user_strategy_passthrough(self):
+        import paddle_tpu.distributed as dist
+        s = dist.ParallelStrategy()
+        s.nranks = 1
+        assert dist.prepare_context(s) is s
+
+
+class TestUtilsProfiler:
+    def test_record_step_window(self, capsys):
+        from paddle_tpu.utils import Profiler, ProfilerOptions, get_profiler
+        opts = ProfilerOptions({'batch_range': [2, 4], 'sorted_key': None})
+        with Profiler(options=opts) as prof:
+            assert get_profiler() is prof
+            for _ in range(5):
+                x = paddle.to_tensor(np.ones((4, 4), np.float32))
+                (x @ x).numpy()
+                prof.record_step()
+        assert prof.batch_id == 5
+        out = capsys.readouterr().out
+        assert 'profile trace written' in out or 'cumulative' in out
+
+    def test_options_none_conversion(self):
+        from paddle_tpu.utils import ProfilerOptions
+        o = ProfilerOptions()
+        assert o['profile_path'] is None       # 'none' -> None
+        assert o.with_state('CPU')['state'] == 'CPU'
+        with pytest.raises(ValueError, match='does not have an option'):
+            o['nope']
+
+
+class TestContribReader:
+    def test_distributed_batch_reader_shards(self, monkeypatch):
+        import paddle_tpu.incubate as incubate
+        from paddle_tpu.fluid.contrib import distributed_batch_reader
+        assert incubate.reader.distributed_batch_reader \
+            is distributed_batch_reader
+
+        def batches():
+            for i in range(7):
+                yield i
+        monkeypatch.setenv('PADDLE_TRAINERS_NUM', '2')
+        monkeypatch.setenv('PADDLE_TRAINER_ID', '1')
+        assert list(distributed_batch_reader(batches)()) == [1, 3, 5]
+        monkeypatch.setenv('PADDLE_TRAINER_ID', '0')
+        assert list(distributed_batch_reader(batches)()) == [0, 2, 4, 6]
+
+
+class TestUtilsDownload:
+    def test_cache_hit_and_egress_error(self, tmp_path, monkeypatch):
+        from paddle_tpu.utils import download
+        monkeypatch.setattr(download, 'WEIGHTS_HOME', str(tmp_path))
+        (tmp_path / 'model.pdparams').write_bytes(b'x')
+        got = download.get_weights_path_from_url(
+            'https://example.com/weights/model.pdparams?dl=1')
+        assert got == str(tmp_path / 'model.pdparams')
+        with pytest.raises(RuntimeError, match='no network egress'):
+            download.get_weights_path_from_url(
+                'https://example.com/absent.pdparams')

@@ -199,24 +199,21 @@ class TestFunctionalAliasTail:
         """Every uncommented import in the reference's
         python/paddle/nn/functional/__init__.py (the 2.0-beta DEFINE_ALIAS
         zoo) must resolve on paddle_tpu.nn.functional."""
-        import re
+        import ast
         import paddle_tpu.nn.functional as F
         ref = '/root/reference/python/paddle/nn/functional/__init__.py'
         try:
-            lines = open(ref).readlines()
+            tree = ast.parse(open(ref).read())
         except OSError:
             pytest.skip('reference tree not present')
         names = set()
-        for line in lines:
-            line = line.split('#')[0]
-            m = re.match(r"\s*from\s+[.\w]+\s+import\s+(.+)", line)
-            if m:
-                for p in m.group(1).split(','):
-                    p = p.strip()
-                    if ' as ' in p:
-                        p = p.split(' as ')[1].strip()
-                    if p and p.isidentifier():
-                        names.add(p)
+        # ast handles parenthesized/multi-line imports a regex would drop
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module != '__future__':
+                for alias in node.names:
+                    if alias.name != '*':
+                        names.add(alias.asname or alias.name)
         assert names, 'parsed no names from the reference init'
         missing = sorted(n for n in names if not hasattr(F, n))
         assert not missing, missing
