@@ -38,6 +38,7 @@ class TPUPlace(Place):
 
 # Aliases so reference-era scripts run unmodified on TPU.
 XLAPlace = TPUPlace
+XPUPlace = TPUPlace
 CUDAPlace = TPUPlace
 
 

@@ -44,7 +44,7 @@ from .data_feeder import DataFeeder
 from . import data_feeder
 from ..optimizer import optimizer as _opt_mod
 from ..utils import unique_name
-from ..utils import profiler
+from . import profiler  # fluid/profiler.py: + cuda_profiler/reset_profiler
 
 
 def data(name, shape, dtype='float32', lod_level=0, append_batch_size=True):
@@ -53,21 +53,27 @@ def data(name, shape, dtype='float32', lod_level=0, append_batch_size=True):
     return _static_data(name, shape, dtype, lod_level)
 
 
-class optimizer:
-    """fluid.optimizer namespace (1.8 spelling: *Optimizer suffixes)."""
-    from ..optimizer import (SGD, Momentum, Adam, AdamW, Adamax, Adagrad,
-                             Adadelta, RMSProp, Lamb, LarsMomentum, Ftrl,
-                             ExponentialMovingAverage, LookAhead, ModelAverage)
-    SGDOptimizer = SGD
-    MomentumOptimizer = Momentum
-    AdamOptimizer = Adam
-    AdamaxOptimizer = Adamax
-    AdagradOptimizer = Adagrad
-    AdadeltaOptimizer = Adadelta
-    RMSPropOptimizer = RMSProp
-    LambOptimizer = Lamb
-    LarsMomentumOptimizer = LarsMomentum
-    FtrlOptimizer = Ftrl
+from . import optimizer  # noqa: E402  (real module: fluid/optimizer.py,
+# the full 1.8 *Optimizer surface incl. Dpsgd/DecayedAdagrad/Pipeline/
+# Recompute/Lookahead wrappers)
+from . import framework  # noqa: E402  (fluid/framework.py module path)
+from . import clip as clip  # noqa: E402  (fluid/clip.py: set_gradient_clip,
+# ErrorClipByValue + GradientClipBy* spellings)
+from .clip import set_gradient_clip, ErrorClipByValue  # noqa: E402,F401
+from .framework import (name_scope, cuda_places, cpu_places,  # noqa: E402,F401
+                        cuda_pinned_places, device_guard, require_version,
+                        load_op_library, is_compiled_with_xpu,
+                        ComplexVariable)
+from ..core.place import XPUPlace  # noqa: E402,F401
+from ..core.tensor import Tensor as VarBase  # noqa: E402,F401
+from ..nn.initializer import WeightNormParamAttr  # noqa: E402,F401
+from ..utils import install_check  # noqa: E402,F401
+from ..framework import (enable_static as disable_dygraph,  # noqa: E402,F401
+                         disable_static as enable_dygraph)
+enable_imperative = enable_dygraph
+disable_imperative = disable_dygraph
+from . import lr_schedules as learning_rate_decay  # noqa: E402,F401
+from .layers import embedding, one_hot  # noqa: E402,F401
 
 
 class initializer_ns:
@@ -78,7 +84,10 @@ def global_scope():
     return _GLOBAL_SCOPE
 
 
-class _Scope:
+class Scope:
+    """Variable scope over the default program (1.8 fluid.Scope surface;
+    the Executor's whole-program XLA design keeps one global scope)."""
+
     def find_var(self, name):
         prog = default_main_program()
         if prog.global_block.has_var(name):
@@ -95,7 +104,8 @@ class _VarWrap:
             else None
 
 
-_GLOBAL_SCOPE = _Scope()
+_Scope = Scope   # internal spelling kept for compat
+_GLOBAL_SCOPE = Scope()
 
 
 def scope_guard(scope):

@@ -65,3 +65,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         g.name = p.name + '@GRAD'
         block.vars[g.name] = g
     return list(zip(params, outs))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid.backward.gradients — same implementation as
+    paddle.static.gradients (static/__init__.py:117)."""
+    from ..static import gradients as _g
+    return _g(targets, inputs, target_gradients, no_grad_set)
+
+
+__all__ += ['gradients']

@@ -1,0 +1,73 @@
+"""``paddle.fluid.clip`` module path. Parity: python/paddle/fluid/clip.py
+__all__ = [set_gradient_clip, ErrorClipByValue, GradientClipByValue,
+GradientClipByNorm, GradientClipByGlobalNorm].
+
+The clip classes are the 1.8 spellings of :mod:`paddle_tpu.nn.clip`'s
+ClipGradBy* (bound in fluid/__init__ too); this module adds the two
+fluid-only names.
+"""
+from ..nn.clip import (  # noqa: F401
+    ClipGradBase, ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+    clip_grad_norm_)
+
+# 1.8 spellings of the same classes
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+__all__ = ['set_gradient_clip', 'ErrorClipByValue', 'GradientClipByValue',
+           'GradientClipByNorm', 'GradientClipByGlobalNorm']
+
+# process-wide default installed by set_gradient_clip; Optimizer falls back
+# to it when constructed without grad_clip (fluid/clip.py:set_gradient_clip
+# stores clip attrs on the program — one whole-program default here, since
+# the whole program IS one XLA computation)
+_GLOBAL_GRAD_CLIP = [None]
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Install a default gradient clip (1.8 global-clip API). The modern
+    spelling — passing ``grad_clip=`` to the optimizer — takes precedence
+    when both are used."""
+    if clip is not None and not isinstance(clip, ClipGradBase):
+        raise TypeError(
+            "set_gradient_clip: clip should be an instance of ClipGradBase "
+            "(GradientClipByValue / ByNorm / ByGlobalNorm), got %r"
+            % (type(clip).__name__,))
+    if param_list:
+        for p in param_list:
+            if hasattr(p, 'grad_clip'):
+                p.grad_clip = clip
+    _GLOBAL_GRAD_CLIP[0] = clip
+
+
+def get_gradient_clip():
+    return _GLOBAL_GRAD_CLIP[0]
+
+
+class ErrorClipByValue:
+    """Per-variable backward-gradient value clip (fluid/clip.py
+    ErrorClipByValue). Attach via ``var.error_clip``.
+
+    TPU-first divergence: the reference injects a clip op after each
+    variable's gradient during append_backward; here the whole program is
+    one XLA computation and per-intermediate clips are applied by
+    ``apply()`` when the variable's gradient is materialized (used by the
+    classic scripts only for numerical band-aids — prefer grad_clip on the
+    optimizer).
+    """
+
+    def __init__(self, max, min=None):
+        max = float(max)
+        if min is None:
+            min = -max
+        else:
+            min = float(min)
+        self.max, self.min = max, min
+
+    def apply(self, grad):
+        import jax.numpy as jnp
+        return jnp.clip(grad, self.min, self.max)
+
+    def __repr__(self):
+        return f"ErrorClipByValue(min={self.min}, max={self.max})"

@@ -388,8 +388,10 @@ dygraph_to_static_func = declarative
 
 
 def prepare_context(strategy=None):
-    from ..distributed.env import init_parallel_env
-    return init_parallel_env()
+    # one implementation: distributed.parallel.prepare_context (returns a
+    # filled ParallelStrategy; only initializes the mesh when nranks > 1)
+    from ..distributed.parallel import prepare_context as _pc
+    return _pc(strategy)
 
 
 def set_code_level(level=100):
@@ -409,3 +411,11 @@ def start_gperf_profiler():
 def stop_gperf_profiler():
     from ..utils.profiler import stop_profiler
     stop_profiler()
+
+
+def __getattr__(name):
+    if name == 'ProgramTranslator':
+        # dygraph-era home of the jit translator; lazy — jit imports fluid
+        from ..jit import ProgramTranslator
+        return ProgramTranslator
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

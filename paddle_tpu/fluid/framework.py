@@ -1,0 +1,124 @@
+"""``paddle.fluid.framework`` module path. Parity:
+python/paddle/fluid/framework.py __all__ (Program/Variable/program_guard/
+default_*_program plus the environment predicates and place helpers).
+
+The graph types live in :mod:`paddle_tpu.static.graph`; this module serves
+the canonical ``from paddle.fluid.framework import Program`` spelling and
+the handful of fluid-only helpers.
+"""
+import contextlib
+import warnings
+
+from ..static.graph import (  # noqa: F401
+    Program, Variable, program_guard, default_main_program,
+    default_startup_program)
+from ..framework import (  # noqa: F401
+    in_dygraph_mode, in_dynamic_mode, enable_static, disable_static)
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, CUDAPinnedPlace)
+from ..core.tensor import Tensor as ComplexVariable  # noqa: F401
+# complex dtypes are native Tensor dtypes here (see paddle.ComplexTensor)
+
+__all__ = ['Program', 'default_startup_program', 'default_main_program',
+           'program_guard', 'name_scope', 'cuda_places', 'cpu_places',
+           'cuda_pinned_places', 'in_dygraph_mode', 'is_compiled_with_cuda',
+           'is_compiled_with_xpu', 'Variable', 'ComplexVariable',
+           'load_op_library', 'require_version', 'device_guard',
+           'set_flags', 'get_flags']
+
+
+_NAME_SCOPE = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Debug/visualization name prefix stack (framework.py:name_scope);
+    current prefix readable via current_name_scope()."""
+    _NAME_SCOPE.append(str(prefix or ''))
+    try:
+        yield
+    finally:
+        _NAME_SCOPE.pop()
+
+
+def current_name_scope():
+    return '/'.join(s for s in _NAME_SCOPE if s)
+
+
+def cpu_places(device_count=None):
+    if device_count is None:
+        import os
+        device_count = int(os.environ.get('CPU_NUM', 1))
+    return [CPUPlace()] * device_count
+
+
+def cuda_places(device_ids=None):
+    """On TPU: the accelerator places (one per mesh device) — the
+    ParallelExecutor idiom `places=fluid.cuda_places()` maps to the chips."""
+    import jax
+    devs = jax.devices()
+    if device_ids is not None:
+        devs = [devs[i] for i in device_ids]
+    return [TPUPlace(d.id) for d in devs]
+
+
+def cuda_pinned_places(device_count=None):
+    return cpu_places(device_count)
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def load_op_library(lib_path):
+    raise RuntimeError(
+        "load_op_library loads CUDA op .so files; custom ops here are "
+        "Pallas kernels — see paddle_tpu.incubate.custom_op (register a "
+        "python/pallas kernel, autograd via custom_vjp)")
+
+
+def require_version(min_version, max_version=None):
+    """Version gate (framework.py:require_version). Compares against this
+    package's version; 1.8-era minimums always pass (this IS the 1.8
+    surface)."""
+    import paddle_tpu
+
+    def parse(v):
+        return [int(x) for x in str(v).split('+')[0].split('.')
+                if x.isdigit()]
+    cur = parse(getattr(paddle_tpu, '__version__', '1.8.0'))
+    if parse(min_version) > cur and parse(min_version)[0] > 2:
+        raise RuntimeError(
+            f"this installation satisfies the 1.8/2.0-beta surface; "
+            f"require_version({min_version!r}) asks for a newer line")
+    if max_version is not None and parse(max_version) < [1, 8]:
+        raise RuntimeError(
+            f"require_version(max_version={max_version!r}) excludes the "
+            f"1.8 surface this package provides")
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Op-placement hint (framework.py:device_guard). XLA owns placement on
+    TPU: accepted and recorded, never enforced."""
+    if device not in (None, 'cpu', 'gpu', 'xpu', 'tpu') and \
+            not str(device).startswith(('gpu:', 'tpu:')):
+        warnings.warn(f"device_guard: unknown device {device!r}")
+    yield
+
+
+def _get_flags_module():
+    from .. import fluid as _fluid
+    return _fluid
+
+
+def set_flags(flags):
+    _get_flags_module().set_flags(flags)
+
+
+def get_flags(flags):
+    return _get_flags_module().get_flags(flags)

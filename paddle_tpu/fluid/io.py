@@ -18,3 +18,88 @@ __all__ = ['save_inference_model', 'load_inference_model',
            'map_readers', 'shuffle', 'chain', 'buffered', 'compose',
            'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
            'ComposeNotAligned']
+
+from ..static.io import save_vars, load_vars  # noqa: E402,F401
+
+
+def get_program_parameter(program):
+    """Parameters of a Program (fluid/io.py:get_program_parameter)."""
+    from ..core.tensor import Parameter
+    return [v for v in program.list_vars()
+            if v.concrete is not None and isinstance(v.concrete, Parameter)]
+
+
+def get_program_persistable_vars(program):
+    """Persistable vars of a Program (fluid/io.py:
+    get_program_persistable_vars)."""
+    return [v for v in program.list_vars()
+            if v.concrete is not None and v.concrete.persistable]
+
+
+def load_program_state(model_path, var_list=None):
+    """-> dict name->ndarray from a save_persistables/save_vars artifact,
+    ours (pickle) or real Paddle 1.8's (per-var LoDTensor files /
+    save_combine). Parity: fluid/io.py:load_program_state."""
+    import os
+    import pickle
+    import numpy as np
+    names = [getattr(v, 'name', v) for v in var_list] if var_list else None
+    if os.path.isfile(model_path):
+        with open(model_path, 'rb') as f:
+            head = f.read(1)
+        if head == b'\x80':
+            with open(model_path, 'rb') as f:
+                state = pickle.load(f)
+            return {k: np.asarray(v) for k, v in state.items()
+                    if names is None or k in names}
+        if names is None:
+            raise ValueError(
+                "load_program_state: a reference save_combine file needs "
+                "var_list (names define the order real Paddle wrote)")
+        from ..static.fluid_format import load_fluid_persistables
+        return load_fluid_persistables(
+            os.path.dirname(model_path), var_names=sorted(names),
+            filename=os.path.basename(model_path))
+    pkl = os.path.join(model_path, '__persistables__')
+    if os.path.isfile(pkl):
+        with open(pkl, 'rb') as f:
+            state = pickle.load(f)
+        return {k: np.asarray(v) for k, v in state.items()
+                if names is None or k in names}
+    from ..static.fluid_format import load_fluid_persistables
+    on_disk = names if names is not None else [
+        n for n in os.listdir(model_path)
+        if os.path.isfile(os.path.join(model_path, n))
+        and not n.startswith('__model__')]
+    return load_fluid_persistables(model_path, var_names=on_disk)
+
+
+def set_program_state(program, state_dict):
+    """Assign a load_program_state dict into a Program's vars (shape-checked;
+    parity: fluid/io.py:set_program_state)."""
+    import numpy as np
+    import jax.numpy as jnp
+    used = set()
+    for v in program.list_vars():
+        if v.name in state_dict and v.concrete is not None:
+            arr = np.asarray(state_dict[v.name])
+            cur = v.concrete.numpy()
+            if tuple(arr.shape) != tuple(np.asarray(cur).shape):
+                raise ValueError(
+                    "set_program_state: var %r has shape %s but the state "
+                    "carries %s" % (v.name, np.asarray(cur).shape,
+                                    arr.shape))
+            v.concrete._inplace_value(jnp.asarray(arr))
+            used.add(v.name)
+    unused = sorted(set(state_dict) - used)
+    if unused:
+        import warnings
+        warnings.warn("set_program_state: %d state entr%s had no matching "
+                      "program var: %s" % (len(unused),
+                                           'y' if len(unused) == 1 else 'ies',
+                                           unused[:5]))
+
+
+__all__ += ['save_vars', 'load_vars', 'get_program_parameter',
+            'get_program_persistable_vars', 'load_program_state',
+            'set_program_state']

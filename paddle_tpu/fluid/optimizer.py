@@ -1,0 +1,28 @@
+"""``paddle.fluid.optimizer`` module path. Parity:
+python/paddle/fluid/optimizer.py __all__ (the 1.8 *Optimizer spellings).
+
+One implementation set in :mod:`paddle_tpu.optimizer`; this module makes
+``import paddle_tpu.fluid.optimizer`` and ``fluid.optimizer.SGDOptimizer``
+work exactly as 1.8 scripts write them.
+"""
+from ..optimizer import (  # noqa: F401
+    Optimizer, SGD, SGDOptimizer, Momentum, MomentumOptimizer,
+    Adam, AdamOptimizer, Adamax, AdamaxOptimizer,
+    Adagrad, AdagradOptimizer, Adadelta, AdadeltaOptimizer,
+    DecayedAdagrad, DecayedAdagradOptimizer, Dpsgd, DpsgdOptimizer,
+    RMSProp, RMSPropOptimizer, Ftrl, FtrlOptimizer,
+    Lamb, LambOptimizer, LarsMomentum, LarsMomentumOptimizer,
+    DGCMomentumOptimizer, ExponentialMovingAverage, LookAhead,
+    LookaheadOptimizer, ModelAverage, PipelineOptimizer,
+    RecomputeOptimizer)
+
+__all__ = [
+    'SGD', 'Momentum', 'Adagrad', 'Adam', 'Adamax', 'Dpsgd', 'DecayedAdagrad',
+    'Ftrl', 'SGDOptimizer', 'MomentumOptimizer', 'AdagradOptimizer',
+    'AdamOptimizer', 'AdamaxOptimizer', 'DpsgdOptimizer',
+    'DecayedAdagradOptimizer', 'RMSPropOptimizer', 'FtrlOptimizer',
+    'Adadelta', 'AdadeltaOptimizer', 'ModelAverage', 'LarsMomentum',
+    'LarsMomentumOptimizer', 'DGCMomentumOptimizer', 'LambOptimizer',
+    'ExponentialMovingAverage', 'PipelineOptimizer', 'LookaheadOptimizer',
+    'RecomputeOptimizer',
+]

@@ -1,0 +1,34 @@
+"""``paddle.fluid.profiler`` module path. Parity:
+python/paddle/fluid/profiler.py (profiler context, start/stop_profiler,
+reset_profiler, cuda_profiler).
+
+Implementation lives in :mod:`paddle_tpu.utils.profiler` (jax-trace +
+xplane per-op table); this module serves the canonical
+``import paddle.fluid.profiler as profiler`` spelling.
+"""
+import contextlib
+import warnings
+
+from ..utils.profiler import (  # noqa: F401
+    profiler, start_profiler, stop_profiler, profile_scope, annotate,
+    get_hlo, Profiler, ProfilerOptions, get_profiler)
+
+__all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
+           'stop_profiler']
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """No CUDA on TPU: warn and run the body unprofiled (use
+    start_profiler/stop_profiler for the XLA trace)."""
+    warnings.warn("cuda_profiler is a no-op on TPU; use "
+                  "fluid.profiler.profiler (the XLA trace) instead")
+    yield
+
+
+def reset_profiler():
+    """Restart the active trace window (the xplane trace has no in-flight
+    reset; parity: fluid/profiler.py reset_profiler)."""
+    prof = get_profiler()
+    if getattr(prof, '_running', False):
+        prof.reset()

@@ -1,9 +1,12 @@
 """paddle_tpu.optimizer. Parity: python/paddle/optimizer/__init__.py."""
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
-                        Adadelta, Adagrad, RMSProp, Lamb, LarsMomentum, Ftrl)
+                        Adadelta, Adagrad, RMSProp, Lamb, LarsMomentum, Ftrl,
+                        DecayedAdagrad, DecayedAdagradOptimizer,
+                        Dpsgd, DpsgdOptimizer)
 from . import lr
 from .lr import *  # noqa
-from .extras import ExponentialMovingAverage, LookAhead, ModelAverage
+from .extras import (ExponentialMovingAverage, LookAhead, ModelAverage,
+                     PipelineOptimizer, RecomputeOptimizer)
 from .fused import FlatFusedUpdate
 
 # -- 1.8 *Optimizer aliases + 2.0-beta *LR scheduler names -------------------
@@ -17,11 +20,7 @@ FtrlOptimizer = Ftrl
 LambOptimizer = Lamb
 LarsMomentumOptimizer = LarsMomentum
 SGDOptimizer = SGD
-DecayedAdagrad = Adagrad          # decay handled by lr schedulers here
-DecayedAdagradOptimizer = Adagrad
 DGCMomentumOptimizer = Momentum   # dgc = bf16-compressed allreduce knob
-Dpsgd = SGD                       # differential-privacy noise not ported
-DpsgdOptimizer = SGD
 LookaheadOptimizer = LookAhead
 ModelAverageOptimizer = ModelAverage
 
@@ -39,16 +38,5 @@ from .lr import (NoamDecay as NoamLR,  # noqa: F401,E402
                  CosineAnnealingDecay as CosineAnnealingLR)
 
 
-def PipelineOptimizer(optimizer, num_microbatches=1, **kw):
-    """1.8 pipeline wrapper: microbatching lives in
-    distributed.pipeline.pipeline_apply here; the optimizer passes through
-    unchanged (kept callable so fleet scripts construct it)."""
-    return optimizer
-
-
-def RecomputeOptimizer(optimizer, **kw):
-    """1.8 recompute wrapper: rematerialization is fleet's recompute knob
-    (jax.checkpoint); the optimizer passes through unchanged."""
-    return optimizer
 from . import lr_scheduler  # noqa: E402,F401  (2.0-beta module path)
 from .lr_scheduler import _LRScheduler  # noqa: E402,F401

@@ -133,3 +133,82 @@ class _MAGuard:
     def __exit__(self, *exc):
         self._ma.restore()
         return False
+
+
+class PipelineOptimizer:
+    """1.8 pipeline-training wrapper. Parity: fluid/optimizer.py:3666.
+
+    TPU-first divergence: the reference splits the Program into
+    device-pinned sections with a microbatch schedule (C++ Section
+    trainers); here pipeline parallelism lives in
+    :func:`paddle_tpu.distributed.pipeline.pipeline_apply` (GPipe over a
+    'pipe' mesh axis inside one XLA program). This wrapper keeps the 1.8
+    script shape: it validates the config and delegates optimization to
+    the inner optimizer — `num_microbatches` is honored by the mesh
+    pipeline, not a host scheduler.
+    """
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        if num_microbatches < 1:
+            raise ValueError("num_microbatches must be a positive value.")
+        if start_cpu_core_id < 0:
+            raise ValueError(
+                "start_cpu_core_id must be greater than or equal to 0.")
+        self._optimizer = optimizer
+        self._num_microbatches = num_microbatches
+        self._start_cpu_core_id = start_cpu_core_id
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+
+class RecomputeOptimizer:
+    """1.8 recompute (activation-checkpointing) wrapper. Parity:
+    fluid/optimizer.py:4518.
+
+    TPU-first divergence: the reference rewrites the backward pass to
+    recompute forward segments between user checkpoints; under XLA the
+    equivalent is :func:`paddle_tpu.distributed.recompute` /
+    ``jax.checkpoint`` around model blocks, which the compiler schedules.
+    The wrapper preserves the script API (`_set_checkpoints`, `backward`,
+    `apply_gradients`, `apply_optimize`, `minimize`) and records the
+    checkpoint variables for introspection.
+    """
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        if not isinstance(checkpoints, (list, tuple)):
+            raise ValueError("checkpoints should be a list or tuple")
+        self._checkpoints = list(checkpoints)
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def load(self, state_dict):
+        raise NotImplementedError(
+            "RecomputeOptimizer.load is not supported (the reference raises "
+            "here too); call set_state_dict on the inner optimizer")
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)

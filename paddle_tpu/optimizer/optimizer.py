@@ -152,6 +152,18 @@ class Optimizer:
     set_dict = set_state_dict
 
     # -- decay/clip plumbing -------------------------------------------------
+
+    def _effective_grad_clip(self):
+        """Constructor grad_clip, else the fluid.clip.set_gradient_clip
+        process default (1.8 global-clip API)."""
+        if self._grad_clip is not None:
+            return self._grad_clip
+        try:
+            from ..fluid.clip import get_gradient_clip
+            return get_gradient_clip()
+        except ImportError:
+            return None
+
     def _apply_decay_and_clip(self, params_grads):
         out = []
         for p, g in params_grads:
@@ -160,8 +172,9 @@ class Optimizer:
             if isinstance(reg, WeightDecayRegularizer):
                 g = g + reg.grad_term(p._value)
             out.append((p, g))
-        if self._grad_clip is not None:
-            out = self._grad_clip(out)
+        clip = self._effective_grad_clip()
+        if clip is not None:
+            out = clip(out)
         return out
 
     # -- stepping ------------------------------------------------------------
@@ -199,6 +212,58 @@ class Optimizer:
         self.clear_grad()
         return [], []
 
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        """1.8 split-phase API: compute grads, return [(param, grad)].
+        Parity: fluid/optimizer.py Optimizer.backward."""
+        if getattr(loss, '_symbolic', False):
+            # remember the loss so a following apply_gradients can record
+            # the train spec the way minimize() does (static mode has no
+            # eager step to run)
+            self._pending_static_loss = loss
+            from ..fluid.backward import append_backward
+            return append_backward(loss, parameter_list, no_grad_set)
+        loss.backward()
+        params = parameter_list or self._parameters or []
+        return [(p, p.grad) for p in params if p.grad is not None]
+
+    def apply_gradients(self, params_grads):
+        """1.8 split-phase API: apply pre-computed [(param, grad)] pairs —
+        the pairs GIVEN, overwriting any stored grad (callers transform
+        grads between backward and apply). Parity: fluid/optimizer.py
+        Optimizer.apply_gradients."""
+        params_grads = list(params_grads)
+        if any(getattr(g, '_symbolic', False) for _, g in params_grads
+               if g is not None):
+            loss = getattr(self, '_pending_static_loss', None)
+            if loss is None:
+                raise RuntimeError(
+                    "apply_gradients got symbolic gradients but no "
+                    "preceding backward(loss) on this optimizer — in "
+                    "static mode call backward() first (or minimize())")
+            return self.apply_optimize(loss, None, params_grads)
+        saved = self._parameters
+        try:
+            self._parameters = [p for p, _ in params_grads]
+            for p, g in params_grads:
+                if g is not None:
+                    p._grad = g if isinstance(g, Tensor) else Tensor(g)
+            self.step()
+        finally:
+            self._parameters = saved
+        return []
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        if getattr(loss, '_symbolic', False):
+            # static mode: record the train spec like minimize() — the
+            # Executor lowers forward+grad+update into one XLA program
+            from ..static.graph import current_capture_program
+            prog = current_capture_program()
+            prog._train_spec = (loss, self)
+            self._pending_static_loss = None
+            return []
+        return self.apply_gradients(params_grads)
+
     def clear_grad(self):
         if self._parameters is not None:
             for p in self._parameters:
@@ -232,13 +297,14 @@ class Optimizer:
                     g = g + reg.grad_term(param_values[k])
                 new_grads[k] = g
             grad_values = new_grads
-        if self._grad_clip is not None:
+        _clip = self._effective_grad_clip()
+        if _clip is not None:
             class _Meta:
                 need_clip = True
             meta = _Meta()
             pairs = [(params_meta[k] if params_meta and k in params_meta
                       else meta, grad_values[k]) for k in grad_values]
-            clipped = self._grad_clip(pairs)
+            clipped = _clip(pairs)
             grad_values = {k: g for k, (_, g) in zip(grad_values, clipped)}
         new_params, new_state = {}, {}
         for k, g in grad_values.items():
@@ -532,3 +598,69 @@ class Ftrl(Optimizer):
             (jnp.sign(new_z) * self._l1 - new_z) /
             (new_n ** -self._lr_power / lr + 2 * self._l2))
         return new_p, {'squared': new_n, 'linear': new_z}
+
+
+class DecayedAdagrad(Optimizer):
+    """Adagrad with an exponentially DECAYED accumulator. Parity:
+    fluid/optimizer.py DecayedAdagradOptimizer /
+    operators/optimizers/decayed_adagrad_op.h:
+    moment = decay*moment + (1-decay)*g^2; p -= lr * g / (sqrt(moment)+eps).
+    """
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-06,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._decay, self._eps = decay, epsilon
+
+    def _init_state(self, value):
+        return {'moment': jnp.zeros_like(value)}
+
+    def _rule(self, g, p, state, lr):
+        g = g.astype(p.dtype)
+        m = self._decay * state['moment'] + (1 - self._decay) * g * g
+        return p - lr * g / (jnp.sqrt(m) + self._eps), {'moment': m}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (CCS16). Parity: fluid/optimizer.py:2264
+    DpsgdOptimizer / operators/optimizers/dpsgd_op.h — per-tensor L2 clip
+    (scale = max(1, ||g||/clip)) plus one shared gaussian noise sample
+    N(0, sigma)/batch_size added to every element:
+    p -= lr * (g/scale + noise/batch_size).
+
+    The noise key lives in the optimizer STATE (split each step), so the
+    rule stays pure and each jitted step draws fresh noise — a host-side
+    RNG call here would be baked in at trace time.
+    """
+
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1e-8, parameters=None, seed=0):
+        super().__init__(learning_rate, parameters, None, None)
+        self._dp_clip, self._batch_size, self._sigma = clip, batch_size, sigma
+        self._seed = seed
+        self._n_keys = 0
+
+    def _init_state(self, value):
+        import jax
+        # fold a per-parameter INDEX in (init order is the deterministic
+        # parameter order) so no two tensors share a noise stream —
+        # element counts collide, indices cannot
+        self._n_keys += 1
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed),
+                                 self._n_keys)
+        return {'key': key}
+
+    def _rule(self, g, p, state, lr):
+        import jax
+        g = g.astype(p.dtype)
+        norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        scale = jnp.maximum(norm / self._dp_clip, 1.0).astype(p.dtype)
+        key, sub = jax.random.split(state['key'])
+        noise = (jax.random.normal(sub, (), jnp.float32)
+                 * self._sigma / self._batch_size).astype(p.dtype)
+        return p - lr * (g / scale + noise), {'key': key}
+
+
+DpsgdOptimizer = Dpsgd
+DecayedAdagradOptimizer = DecayedAdagrad

@@ -1,0 +1,229 @@
+"""fluid submodule paths (optimizer/framework/clip/profiler/io tail) and
+the real DecayedAdagrad/Dpsgd optimizers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+class TestModulePaths:
+    def test_import_spellings(self):
+        # the canonical 1.8 import statements must work as modules
+        import paddle_tpu.fluid.optimizer as opt_mod
+        import paddle_tpu.fluid.profiler as prof_mod
+        import paddle_tpu.fluid.framework as fw_mod
+        import paddle_tpu.fluid.clip as clip_mod
+        assert opt_mod.SGDOptimizer is paddle.optimizer.SGD
+        assert hasattr(prof_mod, 'cuda_profiler')
+        assert fw_mod.Program is fluid.Program
+        assert clip_mod.GradientClipByNorm is fluid.GradientClipByNorm
+
+    def test_root_names(self):
+        assert fluid.VarBase is paddle.Tensor
+        assert fluid.XPUPlace(0) is not None
+        assert isinstance(fluid.Scope(), fluid.Scope)
+        assert fluid.framework.is_compiled_with_cuda() is False
+        assert fluid.is_compiled_with_xpu() is False
+        with fluid.name_scope('block1'):
+            with fluid.name_scope('sub'):
+                assert fluid.framework.current_name_scope() == 'block1/sub'
+        assert fluid.cpu_places(2) == [fluid.CPUPlace(), fluid.CPUPlace()]
+        fluid.require_version('1.8')
+        with fluid.device_guard('cpu'):
+            pass
+        assert hasattr(fluid.learning_rate_decay, 'exponential_decay')
+        assert callable(fluid.embedding) and callable(fluid.one_hot)
+        with pytest.raises(RuntimeError, match='Pallas'):
+            fluid.load_op_library('/tmp/op.so')
+
+    def test_backward_gradients_and_dygraph_translator(self):
+        from paddle_tpu.fluid.backward import gradients
+        from paddle_tpu.fluid.dygraph import ProgramTranslator
+        import paddle_tpu.static as static
+        assert gradients is not None
+        assert ProgramTranslator.get_instance() is not None
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [None, 2], 'float32')
+                y = (x * x).sum()
+                gx, = gradients(y, x)
+            exe = static.Executor()
+            out, = exe.run(prog, feed={'x': np.ones((3, 2), np.float32)},
+                           fetch_list=[gx])
+            np.testing.assert_allclose(out, 2 * np.ones((3, 2)), rtol=1e-6)
+        finally:
+            paddle.disable_static()
+
+
+class TestNewOptimizers:
+    def test_decayed_adagrad_rule(self):
+        from paddle_tpu.optimizer import DecayedAdagrad
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.array([1.0, 2.0], np.float32))
+        o = DecayedAdagrad(learning_rate=0.1, decay=0.5, epsilon=1e-6,
+                           parameters=[p])
+        (p * np.array([1.0, 2.0], np.float32)).sum().backward()
+        o.step()
+        g = np.array([1.0, 2.0], np.float32)
+        m = 0.5 * 0 + 0.5 * g * g
+        expect = np.array([1.0, 2.0]) - 0.1 * g / (np.sqrt(m) + 1e-6)
+        np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5)
+
+    def test_dpsgd_clips_and_steps(self):
+        from paddle_tpu.optimizer import Dpsgd
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.zeros(4, np.float32))
+        o = Dpsgd(learning_rate=1.0, clip=1.0, batch_size=1.0, sigma=0.0,
+                  parameters=[p])
+        big = np.full(4, 10.0, np.float32)
+        (p * big).sum().backward()
+        o.step()
+        # ||g|| = 20 > clip=1 -> g/20; sigma=0 -> deterministic
+        np.testing.assert_allclose(p.numpy(), -big / 20.0, rtol=1e-5)
+
+    def test_dpsgd_noise_fresh_per_step(self):
+        from paddle_tpu.optimizer import Dpsgd
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.zeros(2, np.float32))
+        o = Dpsgd(learning_rate=1.0, clip=1e9, batch_size=1.0, sigma=1.0,
+                  parameters=[p])
+        deltas = []
+        for _ in range(2):
+            before = p.numpy().copy()
+            (p * 0.0).sum().backward()   # zero grad: delta IS the noise
+            o.step()
+            o.clear_grad()
+            deltas.append(p.numpy() - before)
+        assert not np.allclose(deltas[0], deltas[1])  # key split each step
+
+    def test_dpsgd_params_get_distinct_noise(self):
+        from paddle_tpu.optimizer import Dpsgd
+        from paddle_tpu.core.tensor import Parameter
+        p1 = Parameter(np.zeros(3, np.float32))
+        p2 = Parameter(np.zeros(3, np.float32))   # same element count
+        o = Dpsgd(learning_rate=1.0, clip=1e9, batch_size=1.0, sigma=1.0,
+                  parameters=[p1, p2])
+        (p1.sum() * 0.0 + p2.sum() * 0.0).backward()
+        o.step()
+        assert not np.allclose(p1.numpy(), p2.numpy())
+
+    def test_apply_gradients_uses_given_grads(self):
+        from paddle_tpu.optimizer import SGD
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.zeros(2, np.float32))
+        o = SGD(learning_rate=1.0, parameters=[p])
+        pg = o.backward((p * np.array([2.0, 4.0], np.float32)).sum())
+        # transform between phases: the halved grads MUST be what applies
+        pg = [(q, g * 0.5) for q, g in pg]
+        o.apply_gradients(pg)
+        np.testing.assert_allclose(p.numpy(), [-1.0, -2.0], rtol=1e-6)
+
+    def test_static_split_phase(self):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [None, 2], 'float32')
+                loss = (static.nn.fc(x, 1)).sum()
+                o = paddle.optimizer.SGD(learning_rate=0.1)
+                pg = o.backward(loss)
+                o.apply_gradients(pg)
+            assert prog._train_spec is not None
+            exe = static.Executor()
+            exe.run(static.default_startup_program())
+            l0, = exe.run(prog, feed={'x': np.ones((4, 2), np.float32)},
+                          fetch_list=[loss])
+            l1, = exe.run(prog, feed={'x': np.ones((4, 2), np.float32)},
+                          fetch_list=[loss])
+            assert float(l1) < float(l0)   # params actually updated
+        finally:
+            paddle.disable_static()
+
+    def test_wrappers_delegate(self):
+        from paddle_tpu.optimizer import (PipelineOptimizer,
+                                          RecomputeOptimizer, SGD)
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.ones(2, np.float32))
+        inner = SGD(learning_rate=0.5, parameters=[p])
+        rec = RecomputeOptimizer(inner)
+        rec._set_checkpoints([p])
+        pg = rec.backward((p * p).sum())
+        rec.apply_gradients(pg)
+        np.testing.assert_allclose(p.numpy(), 1.0 - 0.5 * 2.0, rtol=1e-6)
+        pipe = PipelineOptimizer(inner, num_microbatches=4)
+        assert pipe._num_microbatches == 4
+        with pytest.raises(ValueError):
+            PipelineOptimizer(inner, num_microbatches=0)
+        with pytest.raises(NotImplementedError):
+            rec.load({})
+
+
+class TestGlobalGradClip:
+    def test_set_gradient_clip_applies(self):
+        from paddle_tpu.core.tensor import Parameter
+        try:
+            fluid.set_gradient_clip(fluid.GradientClipByValue(0.1))
+            p = Parameter(np.zeros(2, np.float32))
+            o = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+            (p * np.array([5.0, -5.0], np.float32)).sum().backward()
+            o.step()
+            np.testing.assert_allclose(p.numpy(), [-0.1, 0.1], rtol=1e-5)
+        finally:
+            fluid.set_gradient_clip(None)
+
+    def test_constructor_clip_wins(self):
+        from paddle_tpu.core.tensor import Parameter
+        try:
+            fluid.set_gradient_clip(fluid.GradientClipByValue(100.0))
+            p = Parameter(np.zeros(1, np.float32))
+            o = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p],
+                                     grad_clip=fluid.GradientClipByValue(
+                                         0.5))
+            (p * 5.0).sum().backward()
+            o.step()
+            np.testing.assert_allclose(p.numpy(), [-0.5], rtol=1e-5)
+        finally:
+            fluid.set_gradient_clip(None)
+
+    def test_bad_clip_type_raises(self):
+        with pytest.raises(TypeError, match='ClipGradBase'):
+            fluid.set_gradient_clip(0.5)
+
+
+class TestProgramState:
+    def test_roundtrip_and_introspection(self, tmp_path):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [None, 3], 'float32')
+                y = static.nn.fc(x, 2)
+            exe = static.Executor()
+            exe.run(static.default_startup_program())
+            params = fluid.io.get_program_parameter(prog)
+            assert len(params) == 2      # weight + bias
+            pvars = fluid.io.get_program_persistable_vars(prog)
+            assert len(pvars) >= len(params)
+            fluid.io.save_persistables(exe, str(tmp_path),
+                                       main_program=prog)
+            state = fluid.io.load_program_state(str(tmp_path))
+            assert set(p.name for p in params) <= set(state)
+            # perturb, then restore
+            mutated = {k: np.zeros_like(v) for k, v in state.items()}
+            fluid.io.set_program_state(prog, mutated)
+            out, = exe.run(prog, feed={'x': np.ones((1, 3), np.float32)},
+                           fetch_list=[y])
+            np.testing.assert_allclose(out, np.zeros((1, 2)), atol=1e-7)
+            fluid.io.set_program_state(prog, state)
+            bad = dict(state)
+            first = next(iter(bad))
+            bad[first] = np.zeros((9, 9), np.float32)
+            with pytest.raises(ValueError, match='shape'):
+                fluid.io.set_program_state(prog, bad)
+        finally:
+            paddle.disable_static()
