@@ -183,6 +183,10 @@ def __getattr__(name):
                 'NaturalExpDecay', 'PolynomialDecay', 'SaveLoadConfig'):
         import paddle_tpu
         return getattr(paddle_tpu, name)
+    if name == 'ProgramTranslator':
+        # dygraph-era home of the jit translator; lazy — jit imports fluid
+        from ..jit import ProgramTranslator
+        return ProgramTranslator
     raise AttributeError(f"module 'fluid.dygraph' has no attribute {name!r}")
 
 
@@ -411,11 +415,3 @@ def start_gperf_profiler():
 def stop_gperf_profiler():
     from ..utils.profiler import stop_profiler
     stop_profiler()
-
-
-def __getattr__(name):
-    if name == 'ProgramTranslator':
-        # dygraph-era home of the jit translator; lazy — jit imports fluid
-        from ..jit import ProgramTranslator
-        return ProgramTranslator
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
