@@ -777,3 +777,15 @@ def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
     v = _static_data(name, shape, dtype=dtype, lod_level=lod_level)
     v.stop_gradient = stop_gradient
     return v
+
+# fluid/layers/ops.py generated activations (1.8 underscore spellings)
+from ..nn.functional import (gelu,  # noqa: E402,F401
+                             hardshrink as hard_shrink,
+                             thresholded_relu)
+from ..nn import functional as _F_acts
+
+
+def softshrink(x, alpha=0.5, name=None):
+    """1.8 generated-op signature (attr named alpha; 2.x calls it
+    threshold)."""
+    return _F_acts.softshrink(x, threshold=alpha, name=name)
