@@ -1,16 +1,17 @@
 """fluid.incubate: the 1.8 import path for incubating features.
 
-Parity: python/paddle/fluid/incubate/ (data_generator, checkpoint, fleet)
-— bridges to the paddle_tpu.incubate implementations. The sys.modules
-aliases make the canonical `import paddle.fluid.incubate.data_generator`
-form work (a re-export alone only covers attribute access).
+Parity: python/paddle/fluid/incubate/. data_generator and checkpoint
+bridge to the paddle_tpu.incubate implementations via sys.modules aliases
+(a re-export alone only covers attribute access, not `import ...` forms);
+fleet is a REAL local subpackage (fleet/collective, fleet/base, ...)
+mirroring the reference layout over the one distributed.fleet
+implementation.
 """
 import sys
 
 from ...incubate import data_generator  # noqa: F401
 from ...incubate import checkpoint  # noqa: F401
-from ...distributed import fleet  # noqa: F401
+from . import fleet  # noqa: F401  (real package: fleet/collective/base/...)
 
 sys.modules[__name__ + '.data_generator'] = data_generator
 sys.modules[__name__ + '.checkpoint'] = checkpoint
-sys.modules[__name__ + '.fleet'] = fleet

@@ -1,0 +1,14 @@
+"""fluid.incubate.fleet as a real PACKAGE, so the canonical 1.8 deep
+imports work: fleet.collective, fleet.base.role_maker,
+fleet.parameter_server.distribute_transpiler, fleet.utils.*.
+
+Parity: python/paddle/fluid/incubate/fleet/ — every path resolves to the
+ONE TPU-first fleet implementation (paddle_tpu.distributed.fleet: mesh
+collectives instead of NCCL rings / parameter servers).
+"""
+from paddle_tpu.distributed.fleet import *  # noqa: F401,F403
+from paddle_tpu.distributed.fleet import fleet, Fleet, DistributedStrategy  # noqa: F401
+from . import base  # noqa: F401
+from . import collective  # noqa: F401
+from . import parameter_server  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,14 @@
+"""fleet.base.fleet_base (1.8 path). Parity:
+fluid/incubate/fleet/base/fleet_base.py — the Fleet protocol class and
+DistributedOptimizer wrapper."""
+from paddle_tpu.distributed.fleet import (  # noqa: F401
+    Fleet, DistributedStrategy, fleet)
+from paddle_tpu.distributed.fleet import _DistributedOptimizer as \
+    DistributedOptimizer  # noqa: F401
+
+class Mode:
+    """fleet run modes (fleet_base.py Mode): on TPU every mode lowers to
+    mesh collectives."""
+    TRANSPILER = 1
+    PSLIB = 2
+    COLLECTIVE = 3

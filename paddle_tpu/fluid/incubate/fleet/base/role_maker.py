@@ -1,0 +1,18 @@
+"""fleet.base.role_maker (1.8 path). Parity:
+fluid/incubate/fleet/base/role_maker.py — role selection from the cloud
+env; one implementation in paddle_tpu.distributed.role_maker."""
+from paddle_tpu.distributed.role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, UserDefinedRoleMaker)
+
+Role = type('Role', (), {'WORKER': 1, 'SERVER': 2})
+
+
+class MPISymetricRoleMaker:
+    """MPI-launched symmetric roles: not applicable — multi-host here is
+    jax.distributed over the cloud env (PaddleCloudRoleMaker)."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            "MPISymetricRoleMaker requires an MPI launcher; use "
+            "PaddleCloudRoleMaker (jax.distributed reads the same "
+            "PADDLE_TRAINER_* env) instead")

@@ -1,0 +1,30 @@
+"""fleet.collective (1.8 path). Parity:
+fluid/incubate/fleet/collective/__init__.py:49 (Collective fleet,
+CollectiveOptimizer, DistributedStrategy, LambConfig/DistFCConfig).
+
+TPU-first: collective training IS the native mode — grads mean-reduce
+over the 'data' mesh axis inside the jitted step; the NCCL ring/fuse
+knobs in DistributedStrategy are accepted and folded into the one XLA
+program (SURVEY §6).
+"""
+from paddle_tpu.distributed.fleet import (  # noqa: F401
+    fleet, Fleet, DistributedStrategy)
+from paddle_tpu.distributed.fleet import Fleet as Collective  # noqa: F401
+from paddle_tpu.distributed.fleet import _DistributedOptimizer as \
+    CollectiveOptimizer  # noqa: F401
+
+
+class LambConfig:
+    """collective/__init__.py:39 — accepted; Lamb itself is the real
+    optimizer.Lamb here."""
+
+    def __init__(self, *a, **k):
+        pass
+
+
+class DistFCConfig:
+    """collective/__init__.py:44 — accepted; sharded FC = tensor-parallel
+    ColumnParallelLinear here."""
+
+    def __init__(self, *a, **k):
+        pass

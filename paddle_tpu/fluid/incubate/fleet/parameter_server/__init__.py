@@ -1,0 +1,10 @@
+"""fleet.parameter_server (1.8 path): PS-mode fleet.
+
+TPU-first divergence (SURVEY §6): the async parameter server is replaced
+by SPMD — sparse tables shard over the 'model' axis
+(paddle_tpu.distributed.ps.SparseShardedTable) and updates ride mesh
+collectives. The canonical `from ...parameter_server.distribute_transpiler
+import fleet` resolves to the same fleet object; transpiler-specific
+calls raise with guidance (fluid.transpiler shims).
+"""
+from . import distribute_transpiler  # noqa: F401

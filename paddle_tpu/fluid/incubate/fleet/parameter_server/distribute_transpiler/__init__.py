@@ -1,0 +1,4 @@
+"""fleet.parameter_server.distribute_transpiler (1.8 path)."""
+from paddle_tpu.distributed.fleet import fleet, Fleet, DistributedStrategy  # noqa: F401
+from paddle_tpu.fluid.transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig)

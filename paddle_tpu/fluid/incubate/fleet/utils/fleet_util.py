@@ -1,0 +1,2 @@
+"""fleet.utils.fleet_util (1.8 path)."""
+from paddle_tpu.distributed.fleet import _FleetUtils as FleetUtil  # noqa: F401

@@ -1,0 +1,4 @@
+"""fleet.utils.fs (1.8 path) — one FS implementation set in
+paddle_tpu.distributed.fs (LocalFS real; HDFSClient shells to hadoop)."""
+from paddle_tpu.distributed.fs import *  # noqa: F401,F403
+from paddle_tpu.distributed.fs import __all__  # noqa: F401

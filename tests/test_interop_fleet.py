@@ -276,3 +276,48 @@ class TestFleetAmpGradientMerge:
         dopt.minimize(((m(x) - y) ** 2).mean())              # 3rd: steps
         assert not np.allclose(m.weight.numpy(), w0)
         assert np.isfinite(m.weight.numpy()).all()
+
+
+class TestFleetDeepImportPaths:
+    def test_canonical_18_import_statements(self):
+        """The exact import statements 1.8 fleet scripts use must resolve
+        to the one TPU-first fleet implementation."""
+        from paddle_tpu.fluid.incubate.fleet.collective import (
+            fleet as col_fleet, CollectiveOptimizer, DistributedStrategy)
+        from paddle_tpu.fluid.incubate.fleet.base import role_maker
+        from paddle_tpu.fluid.incubate.fleet.base.fleet_base import (
+            Fleet, Mode, DistributedOptimizer)
+        from paddle_tpu.fluid.incubate.fleet.parameter_server \
+            .distribute_transpiler import fleet as ps_fleet
+        from paddle_tpu.fluid.incubate.fleet.utils.fs import (
+            LocalFS, HDFSClient)
+        from paddle_tpu.fluid.incubate.fleet.utils.fleet_util import (
+            FleetUtil)
+        from paddle_tpu.distributed.fleet import fleet as canonical
+        assert col_fleet is canonical and ps_fleet is canonical
+        assert role_maker.PaddleCloudRoleMaker is not None
+        assert role_maker.UserDefinedRoleMaker is not None
+        assert Mode.COLLECTIVE == 3
+        assert callable(CollectiveOptimizer) and callable(
+            DistributedOptimizer)
+        assert LocalFS().is_exist('/') and HDFSClient is not None
+        assert FleetUtil is not None
+        with pytest.raises(RuntimeError, match='MPI'):
+            role_maker.MPISymetricRoleMaker()
+
+    def test_collective_optimizer_minimizes_eager(self):
+        from paddle_tpu.fluid.incubate.fleet.collective import (
+            fleet as col_fleet, DistributedStrategy)
+        from paddle_tpu import nn
+        col_fleet.init()
+        net = nn.Linear(3, 1)
+        opt = col_fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters()),
+            strategy=DistributedStrategy())
+        x = paddle.to_tensor(np.ones((4, 3), np.float32))
+        loss = net(x).sum()
+        before = [p.numpy().copy() for p in net.parameters()]
+        opt.minimize(loss)
+        after = [p.numpy() for p in net.parameters()]
+        assert any(not np.allclose(b, a) for b, a in zip(before, after))
