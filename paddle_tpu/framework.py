@@ -96,3 +96,38 @@ def load(path, **configs):
 def set_grad_enabled(mode):
     from .core import autograd
     return autograd.set_grad_enabled(mode)
+
+
+# -- 2.0-beta paddle.framework namespace tail (reference python/paddle/
+# framework/__init__.py re-exports; one implementation each) ---------------
+from .core.place import (CPUPlace, CUDAPlace,  # noqa: E402,F401
+                         CUDAPinnedPlace)
+from .core.autograd import no_grad, grad  # noqa: E402,F401
+
+
+def __getattr__(name):
+    _lazy = {
+        'CosineDecay', 'ExponentialDecay', 'InverseTimeDecay',
+        'NaturalExpDecay', 'NoamDecay', 'PiecewiseDecay', 'PolynomialDecay',
+        'SaveLoadConfig', 'manual_seed', 'get_default_dtype',
+        'set_default_dtype', 'get_cuda_rng_state', 'set_cuda_rng_state',
+        'ParamAttr', 'create_parameter', 'create_global_var',
+    }
+    if name in _lazy:
+        # top-level paddle_tpu owns these; lazy because this module loads
+        # before the package finishes initializing
+        import paddle_tpu
+        return getattr(paddle_tpu, name)
+    if name == 'DataParallel':
+        from .distributed import DataParallel
+        return DataParallel
+    if name == 'LayerList':
+        from .nn import LayerList
+        return LayerList
+    if name == 'Variable':
+        from .static.graph import Variable
+        return Variable
+    if name == 'to_variable':
+        from .fluid.dygraph import to_variable
+        return to_variable
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

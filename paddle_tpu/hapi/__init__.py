@@ -2,3 +2,5 @@
 from .model import Model
 from . import callbacks
 from .model_summary import summary, flops
+from .callbacks import Callback, ModelCheckpoint, ProgBarLogger  # noqa: F401
+from .progressbar import ProgressBar  # noqa: F401

@@ -18,3 +18,10 @@ from .layers import (RNNCell, BasicLSTMCell, BasicGRUCell, RNN,
                      TransformerDecoderLayer, TransformerDecoder,
                      TransformerCell, TransformerBeamSearchDecoder,
                      LinearChainCRF, CRFDecoding, SequenceTagging)
+
+# dataset classes at the paddle.text top level (reference text/__init__.py)
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
+                       UCIHousing, WMT14, WMT16)
+from .datasets import Sentiment as MovieReviews  # noqa: F401
+# (the reference's movie_reviews.py NLTK polarity set; one loader, 1.8
+# name Sentiment + 2.0-beta name MovieReviews)

@@ -109,3 +109,32 @@ class TestUtilsDownload:
         with pytest.raises(RuntimeError, match='no network egress'):
             download.get_weights_path_from_url(
                 'https://example.com/absent.pdparams')
+
+
+class TestFrameworkHapiTextTails:
+    def test_framework_namespace(self):
+        import paddle_tpu.framework as fw
+        assert fw.CPUPlace is paddle.CPUPlace
+        assert fw.no_grad is not None and callable(fw.grad)
+        assert fw.DataParallel is not None
+        assert fw.LayerList is not None
+        assert fw.NoamDecay is paddle.NoamDecay
+        assert fw.manual_seed is paddle.manual_seed
+        assert callable(fw.to_variable)
+        with pytest.raises(AttributeError):
+            fw.not_a_name
+
+    def test_hapi_top_level(self):
+        import paddle_tpu.hapi as hapi
+        assert hapi.Callback is hapi.callbacks.Callback
+        assert hapi.ProgressBar is not None
+        assert hapi.ModelCheckpoint is not None
+
+    def test_text_dataset_classes(self):
+        import paddle_tpu.text as text
+        for n in ('Conll05st', 'Imdb', 'Imikolov', 'MovieReviews',
+                  'Movielens', 'UCIHousing', 'WMT14', 'WMT16'):
+            assert hasattr(text, n), n
+        ds = text.UCIHousing(mode='train')
+        x, y = ds[0]
+        assert len(x) == 13
