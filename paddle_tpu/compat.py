@@ -1,0 +1,73 @@
+"""Py2/Py3 string + arithmetic compat helpers. Parity:
+python/paddle/compat.py:18 (__all__: long_type, to_text, to_bytes, round,
+floor_division, get_exception_message). Python-3-only environment, so the
+Py2 branches collapse; list/set containers convert per-item (optionally in
+place) like the reference.
+"""
+import math
+
+__all__ = ['long_type', 'to_text', 'to_bytes', 'round', 'floor_division',
+           'get_exception_message']
+
+long_type = int
+
+
+def _convert_container(obj, encoding, inplace, one):
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [one(x, encoding) for x in obj]
+            return obj
+        return [one(x, encoding) for x in obj]
+    if isinstance(obj, set):
+        if inplace:
+            vals = {one(x, encoding) for x in obj}
+            obj.clear()
+            obj.update(vals)
+            return obj
+        return {one(x, encoding) for x in obj}
+    return one(obj, encoding)
+
+
+def _to_text_one(obj, encoding):
+    if obj is None:
+        return obj
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    return str(obj)
+
+
+def _to_bytes_one(obj, encoding):
+    if obj is None:
+        return obj
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return bytes(obj)
+
+
+def to_text(obj, encoding='utf-8', inplace=False):
+    """Decode bytes (or containers of them) to str."""
+    return _convert_container(obj, encoding, inplace, _to_text_one)
+
+
+def to_bytes(obj, encoding='utf-8', inplace=False):
+    """Encode str (or containers of them) to bytes."""
+    return _convert_container(obj, encoding, inplace, _to_bytes_one)
+
+
+def round(x, d=0):
+    """Python-2-style round: halves away from zero (the reference keeps
+    this semantic under Python 3, compat.py:193)."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0:
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return 0.0
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

@@ -43,3 +43,12 @@ class cuda:
     @staticmethod
     def synchronize(device=None):
         synchronize()
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU: None (device.py get_cudnn_version for non-CUDA
+    builds; same value as paddle.get_cudnn_version)."""
+    return None
+
+
+__all__ += ['get_cudnn_version']

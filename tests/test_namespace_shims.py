@@ -138,3 +138,25 @@ class TestFrameworkHapiTextTails:
         ds = text.UCIHousing(mode='train')
         x, y = ds[0]
         assert len(x) == 13
+
+
+class TestCompatModule:
+    def test_round_trip_and_py2_round(self):
+        import paddle_tpu.compat as cpt
+        assert cpt.long_type is int
+        assert cpt.to_text(b'abc') == 'abc'
+        assert cpt.to_bytes('abc') == b'abc'
+        lst = [b'a', b'b']
+        out = cpt.to_text(lst, inplace=True)
+        assert out is lst and lst == ['a', 'b']
+        s = {'x', 'y'}
+        bs = cpt.to_bytes(s)
+        assert bs == {b'x', b'y'} and isinstance(bs, set)
+        # py2-style: halves away from zero (banker's rounding would give 2)
+        assert cpt.round(2.5) == 3.0
+        assert cpt.round(-2.5) == -3.0
+        assert cpt.round(0) == 0.0
+        assert cpt.floor_division(7, 2) == 3
+        assert cpt.get_exception_message(ValueError('boom')) == 'boom'
+        import paddle_tpu.device as device
+        assert device.get_cudnn_version() is None
