@@ -170,3 +170,24 @@ def decorate(optimizer=None, models=None, level='O1', dtype='bfloat16',
     if models is None:
         return optimizer
     return models, optimizer
+
+
+class AutoMixedPrecisionLists:
+    """Parity: contrib/mixed_precision/fp16_lists.py AutoMixedPrecisionLists
+    — the op-name white/black/black-varnames triple, seeded from the
+    builtin lists and adjusted by the custom sets."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        cw = set(custom_white_list or ())
+        cb = set(custom_black_list or ())
+        both = cw & cb
+        if both:
+            raise ValueError(
+                "custom_white_list and custom_black_list both contain "
+                "%s" % sorted(both))
+        # fp16_lists._update_list semantics: a custom-white op leaves the
+        # black list (and vice versa), so no op sits in both
+        self.white_list = (set(white_list) | cw) - cb
+        self.black_list = (set(black_list) | cb) - cw
+        self.black_varnames = set(custom_black_varnames or ())

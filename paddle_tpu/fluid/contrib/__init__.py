@@ -162,3 +162,100 @@ __all__ += ['mixed_precision']
 from . import reader  # noqa: E402,F401
 from .reader import distributed_batch_reader  # noqa: E402,F401
 __all__ += ['reader', 'distributed_batch_reader']
+
+# -- slim.quantization + mixed_precision + utils deep paths ----------------
+import sys as _sys2  # noqa: E402
+from ...slim import quantization as _quantization  # noqa: E402
+from ...slim.quantization import (  # noqa: E402,F401
+    FakeQuantAbsMax, FakeQuantMovingAverage, QuantizedConv2D,
+    QuantizedLinear, ImperativeQuantAware, PostTrainingQuantization,
+    WeightQuantization, QuantizationTransformPass, QuantizationFreezePass,
+    ConvertToInt8Pass, AddQuantDequantPass, OutScaleForTrainingPass,
+    OutScaleForInferencePass, TransformForMobilePass, QuantInt8MkldnnPass,
+    Quant2Int8MkldnnPass)
+from ...amp import decorate, AutoMixedPrecisionLists  # noqa: E402,F401
+from ...distributed.fs import HDFSClient  # noqa: E402,F401
+# `import paddle.fluid.contrib.slim.quantization` statement forms:
+_sys2.modules[__name__ + '.slim'] = slim
+_sys2.modules[__name__ + '.slim.quantization'] = _quantization
+_sys2.modules[__name__ + '.mixed_precision'] = mixed_precision
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """Parity: contrib/utils/hdfs_utils.py multi_download — each trainer
+    pulls its 1/N shard of the files under hdfs_path."""
+    import os
+    if hasattr(client, 'ls_dir'):           # the FS interface (fs.py)
+        _, names = client.ls_dir(hdfs_path)
+        files = sorted(os.path.join(hdfs_path, n) for n in names)
+    else:                                   # duck-typed external client
+        files = sorted(client.ls(hdfs_path))
+    mine = [f for i, f in enumerate(files) if i % trainers == trainer_id]
+    out = []
+    for f in mine:
+        dst = os.path.join(local_path, os.path.basename(f))
+        client.download(f, dst)
+        out.append(dst)
+    return out
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    """Parity: contrib/utils/hdfs_utils.py multi_upload."""
+    import os
+    made = set()
+    for root, _, files in os.walk(local_path):
+        for f in files:
+            src = os.path.join(root, f)
+            rel = os.path.relpath(src, local_path)
+            dest = os.path.join(hdfs_path, rel)
+            parent = os.path.dirname(dest)
+            if parent not in made:
+                client.mkdirs(parent)
+                made.add(parent)
+            client.upload(src, dest)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    """Parity: contrib/utils/lookup_table_utils.py — here sparse tables are
+    dense mesh-sharded vars, so this is load_persistables (the lookup-table
+    name is accepted; its rows load with everything else)."""
+    from ...static.io import load_persistables
+    load_persistables(executor, dirname, main_program=program)
+    return program
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """Parity: contrib/utils/lookup_table_utils.py (increment flavor)."""
+    from ...static.io import load_persistables
+    load_persistables(executor, dirname, main_program=program)
+    return program
+
+
+def convert_dist_to_sparse_program(program):
+    """Parity: utils lookup-table helper — the distributed (PS) lookup
+    table IS the sparse path here (distributed.ps.SparseShardedTable);
+    programs need no conversion, returned unchanged."""
+    return program
+
+
+__all__ += ['FakeQuantAbsMax', 'FakeQuantMovingAverage', 'QuantizedConv2D',
+            'QuantizedLinear', 'ImperativeQuantAware',
+            'PostTrainingQuantization', 'WeightQuantization',
+            'QuantizationTransformPass', 'QuantizationFreezePass',
+            'ConvertToInt8Pass', 'AddQuantDequantPass',
+            'OutScaleForTrainingPass', 'OutScaleForInferencePass',
+            'TransformForMobilePass', 'QuantInt8MkldnnPass',
+            'Quant2Int8MkldnnPass', 'decorate', 'AutoMixedPrecisionLists',
+            'HDFSClient', 'multi_download', 'multi_upload',
+            'load_persistables_for_inference',
+            'load_persistables_for_increment',
+            'convert_dist_to_sparse_program', 'QuantizeTranspiler']
+
+from ...slim.quantization import _pass_shim as _ps  # noqa: E402
+QuantizeTranspiler = _ps('QuantizeTranspiler',
+                         'slim.quantize_qat / PostTrainingQuantization')

@@ -1,0 +1,127 @@
+"""The 1.8 ``fluid.contrib.slim.quantization`` surface. Parity:
+python/paddle/fluid/contrib/slim/quantization/*.py.
+
+TPU-first redesign: the reference's quantization is ProgramDesc IR passes
+(insert fake-quant ops, freeze, convert); here quantization is LAYER
+WRAPPING + calibration (slim/quant.py, qat.py, ptq.py) because the whole
+program is one XLA computation — there is no op-graph to mutate. The
+class names below keep 1.8 scripts importable: the ones with a direct
+analogue delegate to it; the pass-pipeline classes raise with the
+replacement recipe.
+"""
+from . import (  # noqa: F401
+    FakeQuantAbsMax, MovingAverageAbsMax, QuantedLinear, QuantedConv2D,
+    quantize_qat, PostTrainingQuantization, Int8Linear, Int8Conv2D,
+    save_quantized_model, load_quantized_model, quantize_weight,
+    dequantize_weight)
+
+__all__ = [
+    'FakeQuantAbsMax', 'FakeQuantMovingAverage', 'QuantizedConv2D',
+    'QuantizedLinear', 'ImperativeQuantAware', 'PostTrainingQuantization',
+    'WeightQuantization', 'QuantizationTransformPass',
+    'QuantizationFreezePass', 'ConvertToInt8Pass', 'AddQuantDequantPass',
+    'OutScaleForTrainingPass', 'OutScaleForInferencePass',
+    'TransformForMobilePass', 'QuantInt8MkldnnPass', 'Quant2Int8MkldnnPass',
+]
+
+# 1.8 spellings of the layer wrappers / observers
+FakeQuantMovingAverage = MovingAverageAbsMax
+QuantizedConv2D = QuantedConv2D
+QuantizedLinear = QuantedLinear
+
+
+class ImperativeQuantAware:
+    """Dygraph QAT driver (imperative/qat.py ImperativeQuantAware):
+    ``quantize(model)`` wraps Linear/Conv2D sublayers with fake-quant
+    (slim.quantize_qat); ``save_quantized_model`` emits the int8-resident
+    artifact."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type='abs_max',
+                 activation_quantize_type='moving_average_abs_max',
+                 moving_rate=0.9, quantizable_layer_type=None):
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+
+    def quantize(self, model):
+        return quantize_qat(model, weight_bits=self._weight_bits,
+                            activation_bits=self._activation_bits)
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        return save_quantized_model(model, path)
+
+
+class WeightQuantization:
+    """Weight-only quantization of a saved model
+    (quantization/quantize_transpiler_v2... WeightQuantization): loads the
+    state, int8-quantizes every >=2-D float weight (per-channel abs-max),
+    saves the quantized artifact."""
+
+    def __init__(self, model_dir, model_filename=None, params_filename=None):
+        self._model_dir = model_dir
+        self._model_filename = model_filename
+        self._params_filename = params_filename
+
+    def quantize_weight_to_int8(self, save_model_dir, weight_bits=8,
+                                quantizable_op_type=None, threshold_rate=0.0):
+        import os
+        import pickle
+        import numpy as np
+        src = os.path.join(self._model_dir,
+                           self._params_filename or '__persistables__')
+        with open(src, 'rb') as f:
+            state = pickle.load(f)
+        out = {}
+        for name, arr in state.items():
+            arr = np.asarray(arr)
+            if arr.ndim >= 2 and arr.dtype in (np.float32, np.float64):
+                q, scale = quantize_weight(arr, bits=weight_bits,
+                                           channel_axis=arr.ndim - 1)
+                out[name] = {'int8': np.asarray(q), 'scale': np.asarray(scale)}
+            else:
+                out[name] = arr
+        os.makedirs(save_model_dir, exist_ok=True)
+        dst = os.path.join(save_model_dir,
+                           self._params_filename or '__persistables__')
+        with open(dst, 'wb') as f:
+            pickle.dump(out, f)
+        return dst
+
+
+def _pass_shim(name, recipe):
+    class _Pass:
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                f"{name} mutates the ProgramDesc op graph, which this "
+                f"TPU-first build replaces with layer wrapping + "
+                f"calibration. Use {recipe} instead.")
+    _Pass.__name__ = name
+    _Pass.__qualname__ = name
+    return _Pass
+
+
+QuantizationTransformPass = _pass_shim(
+    'QuantizationTransformPass',
+    'slim.quantize_qat(model) (fake-quant wrapping, STE custom_vjp)')
+QuantizationFreezePass = _pass_shim(
+    'QuantizationFreezePass',
+    'slim.save_quantized_model (scales persist with the artifact)')
+ConvertToInt8Pass = _pass_shim(
+    'ConvertToInt8Pass',
+    'slim.PostTrainingQuantization(...).quantize() (int8-resident weights)')
+AddQuantDequantPass = _pass_shim(
+    'AddQuantDequantPass', 'slim.quantize_qat activation fake-quant')
+OutScaleForTrainingPass = _pass_shim(
+    'OutScaleForTrainingPass',
+    'slim.quantize_qat (per-layer moving-average scales train in-line)')
+OutScaleForInferencePass = _pass_shim(
+    'OutScaleForInferencePass',
+    'slim.save_quantized_model (scales are saved with the model)')
+TransformForMobilePass = _pass_shim(
+    'TransformForMobilePass',
+    'jit.save / inference.Predictor (StableHLO export serves all targets)')
+QuantInt8MkldnnPass = _pass_shim(
+    'QuantInt8MkldnnPass', 'slim.PostTrainingQuantization (mkldnn is '
+    'CPU-specific; XLA lowers int8 natively)')
+Quant2Int8MkldnnPass = _pass_shim(
+    'Quant2Int8MkldnnPass', 'slim.PostTrainingQuantization')

@@ -211,3 +211,106 @@ class TestQATPersistence:
         assert m2[0].act_quanter.scale is not None or \
             float(m2[0].act_scale.numpy()[0]) > 0
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestContribQuantSurface:
+    def test_18_names_and_deep_import(self):
+        import paddle_tpu.fluid.contrib as C
+        import paddle_tpu.fluid.contrib.slim.quantization as Q
+        import paddle_tpu.slim as slim
+        assert C.QuantizedLinear is slim.QuantedLinear
+        assert C.FakeQuantMovingAverage is slim.MovingAverageAbsMax
+        assert Q.PostTrainingQuantization is slim.PostTrainingQuantization
+        with pytest.raises(RuntimeError, match='layer wrapping'):
+            C.QuantizationTransformPass()
+        with pytest.raises(RuntimeError, match='slim'):
+            C.QuantizeTranspiler()
+
+    def test_imperative_quant_aware_quantizes(self):
+        from paddle_tpu.fluid.contrib import ImperativeQuantAware
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        q = ImperativeQuantAware().quantize(net)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 4).astype('float32'))
+        out = q(x)
+        assert list(out.shape) == [2, 2]
+
+    def test_weight_quantization_roundtrip(self, tmp_path):
+        import pickle
+        from paddle_tpu.fluid.contrib import WeightQuantization
+        state = {'w': np.random.RandomState(0).randn(8, 4).astype('float32'),
+                 'b': np.zeros(4, np.float32)}
+        src = tmp_path / 'model'
+        src.mkdir()
+        with open(src / '__persistables__', 'wb') as f:
+            pickle.dump(state, f)
+        wq = WeightQuantization(str(src))
+        dst = wq.quantize_weight_to_int8(str(tmp_path / 'q'))
+        with open(dst, 'rb') as f:
+            out = pickle.load(f)
+        assert out['w']['int8'].dtype == np.int8
+        deq = out['w']['int8'].astype(np.float32) * out['w']['scale']
+        np.testing.assert_allclose(deq, state['w'], atol=0.02)
+        np.testing.assert_array_equal(out['b'], state['b'])
+
+    def test_amp_lists_and_decorate(self):
+        from paddle_tpu.fluid.contrib import (AutoMixedPrecisionLists,
+                                              decorate)
+        lists = AutoMixedPrecisionLists(custom_white_list={'my_op'},
+                                        custom_black_list={'matmul'})
+        assert 'my_op' in lists.white_list
+        assert 'matmul' in lists.black_list
+        assert 'matmul' not in lists.white_list
+        assert callable(decorate)
+
+    def test_amp_lists_conflict_and_promotion(self):
+        from paddle_tpu.fluid.contrib import AutoMixedPrecisionLists
+        import pytest as _p
+        with _p.raises(ValueError, match='both'):
+            AutoMixedPrecisionLists(custom_white_list={'x'},
+                                    custom_black_list={'x'})
+        from paddle_tpu.amp import black_list
+        some_black = next(iter(black_list))
+        lists = AutoMixedPrecisionLists(custom_white_list={some_black})
+        assert some_black in lists.white_list
+        assert some_black not in lists.black_list
+
+    def test_multi_download_upload_local_fs(self, tmp_path):
+        from paddle_tpu.fluid.contrib import multi_download, multi_upload
+        from paddle_tpu.distributed.fs import LocalFS
+        fs = LocalFS()
+        src = tmp_path / 'remote'
+        (src / 'sub').mkdir(parents=True)
+        for i in range(4):
+            (src / f'part-{i}').write_text(str(i))
+        local = tmp_path / 'local'
+        local.mkdir()
+        got = multi_download(fs, str(src), str(local), trainer_id=1,
+                             trainers=2)
+        assert [p.rsplit('-', 1)[1] for p in sorted(got)] == ['1', '3']
+        up_src = tmp_path / 'up'
+        (up_src / 'nested').mkdir(parents=True)
+        (up_src / 'nested' / 'w.bin').write_bytes(b'x')
+        dest = tmp_path / 'updest'
+        multi_upload(fs, str(dest), str(up_src))
+        assert (dest / 'nested' / 'w.bin').read_bytes() == b'x'
+
+    def test_load_persistables_for_inference_returns_program(self, tmp_path):
+        import paddle_tpu.static as static
+        from paddle_tpu.fluid.contrib import load_persistables_for_inference
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data('x', [None, 2], 'float32')
+                static.nn.fc(x, 2)
+            exe = static.Executor()
+            exe.run(static.default_startup_program())
+            from paddle_tpu.static.io import save_persistables
+            save_persistables(exe, str(tmp_path), main_program=prog)
+            out = load_persistables_for_inference(str(tmp_path), exe, prog,
+                                                  None)
+            assert out is prog
+        finally:
+            paddle.disable_static()
