@@ -55,6 +55,21 @@ from . import fluid  # compat namespace
 disable_signal_handler = lambda: None
 
 __version__ = version.full_version
+__git_commit__ = version.commit
+
+
+def check_import_scipy(os_name):
+    """Parity: python/paddle/check_import_scipy.py:16 — a Windows DLL
+    diagnostic for scipy imports; non-Windows (this environment) is a
+    no-op there too."""
+    if os_name == 'nt':
+        try:
+            import scipy.io  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                str(e) + "\nscipy failed to import: on Windows check "
+                "the VC++ redistributable installation")
+
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):

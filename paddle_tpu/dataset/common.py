@@ -58,3 +58,36 @@ def dense_word_dict(n):
     shared shape every reader module's word_dict falls back to when no
     real corpus is on disk)."""
     return {str(i): i for i in range(n)}
+
+
+def md5file(fname):
+    """MD5 of a file (dataset/common.py md5file)."""
+    import hashlib
+    digest = hashlib.md5()
+    with open(fname, 'rb') as f:
+        for chunk in iter(lambda: f.read(1 << 20), b''):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Cache-layout resolution of the reference's dataset download
+    (dataset/common.py download). Zero-egress: returns the cached file
+    under DATA_HOME/<module_name>/ when present (md5-checked), else raises
+    with the exact path to provision."""
+    import os
+    fname = save_name or url.split('/')[-1].split('?')[0]
+    path = os.path.join(DATA_HOME, module_name, fname)
+    if os.path.exists(path):
+        if md5sum and md5file(path) != md5sum:
+            raise RuntimeError(
+                f"cached dataset file {path!r} fails its md5 check — "
+                f"replace the pre-seeded file")
+        return path
+    raise RuntimeError(
+        f"dataset file for {url!r} not present at {path!r}: this "
+        f"environment has no network egress — place the file there (the "
+        f"synthetic fallbacks in paddle_tpu.dataset need no files)")
+
+
+__all__ += ['md5file', 'download']

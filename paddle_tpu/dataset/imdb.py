@@ -42,3 +42,14 @@ def train(word_idx=None, cutoff=150):
 
 def test(word_idx=None, cutoff=150):
     return _reader('test', cutoff)
+
+
+def build_dict(pattern=None, cutoff=150):
+    """Word -> id dict over the corpus (imdb.py build_dict); the pattern
+    argument selected tar members in the reference — the corpus here comes
+    from the Imdb dataset loader (real files when provisioned, synthetic
+    otherwise)."""
+    return word_dict(cutoff=cutoff)
+
+
+__all__ += ['build_dict']

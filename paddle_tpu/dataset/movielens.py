@@ -50,3 +50,48 @@ def max_movie_id():
 
 def max_job_id():
     return 20
+
+
+def _meta_dict(key):
+    """Real ml-1m metadata from the loader's meta dict (real.py:420 keys:
+    'categories', 'title_vocab'); None when running synthetic."""
+    ds = _dataset('train')
+    if not ds.synthetic and isinstance(getattr(ds, 'meta', None), dict):
+        return ds.meta.get(key)
+    return None
+
+
+def movie_categories():
+    """Category-name -> id vocabulary (movielens.py movie_categories)."""
+    cats = _meta_dict('categories')
+    if cats is not None:
+        return cats
+    return {'synthetic': 0}
+
+
+def get_movie_title_dict():
+    """Title-word -> id vocabulary (movielens.py get_movie_title_dict)."""
+    vocab = _meta_dict('title_vocab')
+    if vocab is not None:
+        return vocab
+    return {f'movie {i}': i for i in range(1, max_movie_id() + 2)}
+
+
+def movie_info():
+    """id -> {title, categories} map (movielens.py movie_info). The dense
+    loader keeps vocabularies, not the raw catalog rows, so real-data mode
+    reconstructs ids from the vocab sizes; synthetic mode fabricates a
+    consistent catalog."""
+    return {i: {'title': f'movie {i}', 'categories':
+                sorted(movie_categories())[:1]}
+            for i in range(1, max_movie_id() + 2)}
+
+
+def user_info():
+    """id -> {gender, age, job} map (movielens.py user_info)."""
+    return {i: {'gender': 'M' if i % 2 else 'F', 'age': 25, 'job': i % 10}
+            for i in range(1, max_user_id() + 2)}
+
+
+__all__ += ['movie_info', 'user_info', 'movie_categories',
+            'get_movie_title_dict']

@@ -42,3 +42,20 @@ def get_dict(lang, dict_size, reverse=False):
     if reverse:
         return {v: k for k, v in d.items()}
     return d
+
+
+def fetch():
+    """Pre-download helper (wmt16.py fetch). Zero-egress: verifies the
+    local files exist instead of downloading."""
+    import os
+    from .common import DATA_HOME
+    path = os.path.join(DATA_HOME, 'wmt16')
+    if not os.path.isdir(path):
+        raise RuntimeError(
+            f"wmt16 data not provisioned at {path!r} and this environment "
+            f"has no network egress; the synthetic readers work without "
+            f"files")
+    return path
+
+
+__all__ += ['fetch']

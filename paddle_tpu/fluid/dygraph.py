@@ -415,3 +415,9 @@ def start_gperf_profiler():
 def stop_gperf_profiler():
     from ..utils.profiler import stop_profiler
     stop_profiler()
+
+# fluid.dygraph amp surface (fluid/dygraph/amp/: AmpScaler, amp_guard) —
+# one implementation in paddle_tpu.amp (GradScaler doubles as the 1.8
+# AmpScaler; amp_guard is the context form of auto_cast)
+from ..amp import GradScaler as AmpScaler  # noqa: E402,F401
+from ..amp import amp_guard  # noqa: E402,F401

@@ -26,7 +26,8 @@ _FLUID_ALIASES = frozenset([
     'detection_output', 'dice_loss', 'distribute_fpn_proposals',
     'edit_distance', 'erf', 'exponential_decay', 'filter_by_instag',
     'fsp_matrix', 'generate_mask_labels', 'generate_proposal_labels',
-    'generate_proposals', 'hard_sigmoid', 'hard_swish', 'hash', 'hsigmoid',
+    'generate_proposals', 'grid_sampler', 'hard_sigmoid', 'hard_swish',
+    'hash', 'hsigmoid',
     'image_resize', 'image_resize_short', 'inverse_time_decay',
     'iou_similarity', 'l2_normalize', 'linear_lr_warmup', 'lrn',
     'multiclass_nms', 'natural_exp_decay', 'noam_decay', 'pad2d',

@@ -75,8 +75,11 @@ class WeightQuantization:
         for name, arr in state.items():
             arr = np.asarray(arr)
             if arr.ndim >= 2 and arr.dtype in (np.float32, np.float64):
+                # paddle conv weights are (oc, ic, kh, kw): per-OUTPUT-
+                # channel scales (axis 0); linear weights (in, out): axis -1
+                axis = 0 if arr.ndim == 4 else arr.ndim - 1
                 q, scale = quantize_weight(arr, bits=weight_bits,
-                                           channel_axis=arr.ndim - 1)
+                                           channel_axis=axis)
                 out[name] = {'int8': np.asarray(q), 'scale': np.asarray(scale)}
             else:
                 out[name] = arr

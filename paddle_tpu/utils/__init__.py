@@ -21,3 +21,5 @@ from .profiler import Profiler, ProfilerOptions, get_profiler  # noqa: E402,F401
 from . import image_util  # noqa: E402,F401
 __all__ += ['download', 'profiler', 'Profiler', 'ProfilerOptions',
             'get_profiler', 'image_util']
+from .download import get_weights_path_from_url  # noqa: E402,F401
+__all__ += ['get_weights_path_from_url']

@@ -1,0 +1,208 @@
+"""THE parity artifact: every ``__all__`` export the reference declares,
+across its whole python/paddle tree, resolves on the corresponding
+paddle_tpu module.
+
+Sweeps are ast-based (no reference code executes). Each row maps one
+reference file/package to the module that carries its surface here; the
+union of a package sweep covers every non-test .py beneath it.
+"""
+import ast
+import importlib
+import os
+
+import pytest
+
+REF = '/root/reference/python/paddle'
+
+# (reference path relative to python/paddle, our module)
+FILE_MAP = [
+    ('batch.py', 'paddle_tpu.batch'),
+    ('compat.py', 'paddle_tpu.compat'),
+    ('device.py', 'paddle_tpu.device'),
+    ('distribution.py', 'paddle_tpu.distribution'),
+    # regularizer.py declares no __all__; its four classes are checked in
+    # test_regularizer_names below
+    ('sysconfig.py', 'paddle_tpu.sysconfig'),
+    ('fluid/io.py', 'paddle_tpu.fluid.io'),
+    ('fluid/initializer.py', 'paddle_tpu.nn.initializer'),
+    ('fluid/nets.py', 'paddle_tpu.fluid.nets'),
+    ('fluid/metrics.py', 'paddle_tpu.fluid.metrics'),
+    ('fluid/executor.py', 'paddle_tpu.static'),
+    ('fluid/backward.py', 'paddle_tpu.fluid.backward'),
+    ('fluid/framework.py', 'paddle_tpu.fluid.framework'),
+    ('fluid/param_attr.py', 'paddle_tpu.fluid'),
+    ('fluid/clip.py', 'paddle_tpu.fluid.clip'),
+    ('fluid/optimizer.py', 'paddle_tpu.fluid.optimizer'),
+    ('fluid/profiler.py', 'paddle_tpu.fluid.profiler'),
+    ('fluid/unique_name.py', 'paddle_tpu.utils.unique_name'),
+    ('fluid/evaluator.py', 'paddle_tpu.fluid.evaluator'),
+    ('fluid/__init__.py', 'paddle_tpu.fluid'),
+]
+
+TREE_MAP = [
+    ('dataset', 'paddle_tpu.dataset'),
+    ('fluid/contrib', 'paddle_tpu.fluid.contrib'),
+    ('fluid/dygraph', 'paddle_tpu.fluid.dygraph'),
+    ('fluid/layers', 'paddle_tpu.fluid.layers'),
+    ('framework', 'paddle_tpu.framework'),
+    ('hapi', 'paddle_tpu.hapi'),
+    ('incubate', 'paddle_tpu.incubate'),
+    ('io', 'paddle_tpu.io'),
+    ('jit', 'paddle_tpu.jit'),
+    ('metric', 'paddle_tpu.metric'),
+    ('nn', 'paddle_tpu.nn'),
+    ('optimizer', 'paddle_tpu.optimizer'),
+    ('reader', 'paddle_tpu.reader'),
+    ('static', 'paddle_tpu.static'),
+    ('tensor', 'paddle_tpu.tensor'),
+    ('text', 'paddle_tpu.text'),
+    ('utils', 'paddle_tpu.utils'),
+    ('vision', 'paddle_tpu.vision'),
+]
+
+
+def _exports_of_file(path):
+    try:
+        tree = ast.parse(open(path).read())
+    except (SyntaxError, OSError):
+        return set()
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = (node.targets if isinstance(node, ast.Assign)
+                    else [node.target])
+            for t in tgts:
+                if isinstance(t, ast.Name) and t.id == '__all__':
+                    for el in ast.walk(node.value):
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, str):
+                            names.add(el.value)
+    return names
+
+
+def _exports_of_tree(root):
+    names = set()
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != 'tests']
+        for f in files:
+            if f.endswith('.py'):
+                names |= _exports_of_file(os.path.join(dirpath, f))
+    return names
+
+
+needs_ref = pytest.mark.skipif(not os.path.isdir(REF),
+                               reason='reference tree not present')
+
+
+@needs_ref
+@pytest.mark.parametrize('rel,mod', FILE_MAP,
+                         ids=[r for r, _ in FILE_MAP])
+def test_file_exports_resolve(rel, mod):
+    names = _exports_of_file(os.path.join(REF, rel))
+    assert names, f'no __all__ parsed from {rel}'
+    m = importlib.import_module(mod)
+    missing = sorted(n for n in names if not hasattr(m, n))
+    assert not missing, missing
+
+
+# Names the reference declares but does not itself provide, or that are
+# internal-only machinery replaced wholesale by the TPU-first design:
+ALLOW = {
+    # reference source typo: tensor/manipulation.py __all__ has the
+    # adjacent strings 'chunk' 'squeeze' (missing comma) which the parser
+    # (and python itself) concatenates — both real names are covered
+    'chunksqueeze',
+    # phantom export: utils/__init__.py __all__ lists dump_config but no
+    # definition exists anywhere in the reference tree — AttributeError
+    # in the reference too
+    'dump_config',
+    # reference source typo: dataset/conll05.py __all__ = ['test, get_dict',
+    # ...] — one string, missing comma; both real names are covered
+    'test, get_dict',
+}
+
+# Internal sub-trees whose exports the reference does NOT surface as user
+# API; their FUNCTION is replaced by a different mechanism here:
+SKIP_DIRS = {
+    # AST-rewriting machinery behind @declarative (AstNodeWrapper,
+    # LoopTransformer, ...): jax tracing IS the dygraph->static
+    # translator here; the user API (ProgramTranslator, declarative,
+    # to_static) is covered
+    'dygraph_to_static',
+}
+
+
+def _target_module(rel_file):
+    """python/paddle/a/b.py -> our module chain, most specific first."""
+    parts = rel_file[:-3].split('/')
+    if parts[-1] == '__init__':
+        parts = parts[:-1]
+    chain = []
+    for i in range(len(parts), 0, -1):
+        chain.append('paddle_tpu.' + '.'.join(parts[:i]))
+    return chain
+
+
+@needs_ref
+@pytest.mark.parametrize('rel,mod', TREE_MAP,
+                         ids=[r for r, _ in TREE_MAP])
+def test_tree_exports_resolve(rel, mod):
+    """Every file's __all__ resolves on the SAME-PATH module here (falling
+    back through parent packages, then the tree top)."""
+    root = os.path.join(REF, rel)
+    checked = 0
+    missing = []
+    top = importlib.import_module(mod)
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d != 'tests' and d not in SKIP_DIRS]
+        for f in files:
+            if not f.endswith('.py'):
+                continue
+            path = os.path.join(dirpath, f)
+            names = _exports_of_file(path) - ALLOW
+            if not names:
+                continue
+            rel_file = os.path.relpath(path, REF)
+            mods = [top]
+            for cand in _target_module(rel_file):
+                try:
+                    mods.insert(0, importlib.import_module(cand))
+                except ImportError:
+                    continue
+            for n in names:
+                checked += 1
+                if not any(hasattr(m, n) for m in mods):
+                    missing.append(f'{rel_file}:{n}')
+    assert checked, f'no __all__ parsed under {rel}'
+    assert not missing, missing
+
+
+def test_regularizer_names():
+    import paddle_tpu.regularizer as R
+    for n in ('L1Decay', 'L2Decay', 'L1DecayRegularizer',
+              'L2DecayRegularizer'):
+        assert hasattr(R, n), n
+
+
+@needs_ref
+def test_top_level_imports_resolve():
+    """Every name python/paddle/__init__.py imports (incl. aliases) exists
+    on paddle_tpu."""
+    import re
+    import paddle_tpu
+    flat = set()
+    for line in open(os.path.join(REF, '__init__.py')):
+        line = line.split('#')[0]
+        m = re.match(r"\s*from\s+[.\w]+\s+import\s+(.+)", line)
+        if m:
+            for p in m.group(1).split(','):
+                p = p.strip()
+                if ' as ' in p:
+                    p = p.split(' as ')[1].strip()
+                if p and p.isidentifier():
+                    flat.add(p)
+    missing = sorted(n for n in flat
+                     if n != 'print_function'
+                     and not hasattr(paddle_tpu, n))
+    assert not missing, missing
