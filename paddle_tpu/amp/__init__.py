@@ -126,6 +126,16 @@ class GradScaler:
         self.step(optimizer)
         optimizer.clear_grad()
 
+    def mark_found_inf(self):
+        """Resilience hook: an external NaN/Inf guard (resilience.NanGuard)
+        reports a poisoned step that never reached unscale_/step, so the
+        dynamic scale backs off through the same decrement path a bad
+        gradient would take."""
+        if not self._enable:
+            return
+        self._found_inf = True
+        self.update()
+
     def update(self):
         if not self._dynamic:
             return

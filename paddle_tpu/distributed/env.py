@@ -42,14 +42,45 @@ def init_parallel_env(mesh_shape=None, axis_names=None):
     return ParallelEnv()
 
 
+def _reset_partial_distributed_state():
+    """Clear jax's half-initialized distributed globals after a failed
+    initialize. jax sets global client/service BEFORE connect(), and its
+    'initialize should only be called once' guard would otherwise turn every
+    retry into an instant failure that masks the real connect error."""
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        try:   # shutdown itself can raise on a dead client; clear directly
+            from jax._src.distributed import global_state
+            global_state.client = None
+            global_state.service = None
+        except Exception:
+            pass
+
+
 def init_distributed(coordinator_address=None, num_processes=None,
-                     process_id=None):
-    """Multi-host bring-up (parity: paddle.distributed.launch env wiring)."""
+                     process_id=None, max_init_retries=3):
+    """Multi-host bring-up (parity: paddle.distributed.launch env wiring).
+
+    Coordinator connection is retried with exponential backoff + jitter
+    (resilience.retry): on a preempted-and-rescheduled pod the coordinator
+    routinely comes up seconds after the workers, and one-shot initialize
+    turns that race into a permanent job failure. Between attempts the
+    partial distributed state is torn down so re-initialize is legal.
+    """
+    from ..resilience.retry import retry as _retry
     kwargs = {}
     if coordinator_address:
         kwargs = dict(coordinator_address=coordinator_address,
                       num_processes=num_processes, process_id=process_id)
-    jax.distributed.initialize(**kwargs)
+    connect = _retry(max_attempts=max_init_retries, backoff=1.0, factor=2.0,
+                     jitter=0.5,
+                     retry_on=(RuntimeError, ConnectionError, OSError,
+                               TimeoutError),
+                     on_retry=lambda attempt, exc, delay:
+                         _reset_partial_distributed_state())(
+                             jax.distributed.initialize)
+    connect(**kwargs)
     return init_parallel_env()
 
 

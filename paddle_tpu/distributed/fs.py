@@ -150,16 +150,29 @@ class LocalFS(FS):
 
 
 class HDFSClient(FS):
-    """`hadoop fs` shell-out client (fleet/utils/fs.py HDFSClient)."""
+    """`hadoop fs` shell-out client (fleet/utils/fs.py HDFSClient).
+
+    Timed-out shell-outs are retried with backoff + jitter
+    (``resilience.retry``): an HDFS namenode failover stalls commands for
+    seconds and the reference client loops on exactly this case. Non-timeout
+    failures (``ExecuteError``) are NOT retried — ``is_exist``/``is_dir``
+    use them as negative answers, and retrying every ``-test`` miss would
+    triple the latency of the common path.
+    """
 
     def __init__(self, hadoop_home=None, configs=None, time_out=300,
-                 sleep_inter=1000):
+                 sleep_inter=1000, retries=3):
+        from ..resilience.retry import retry as _retry
         self._hadoop = os.path.join(hadoop_home, 'bin', 'hadoop') \
             if hadoop_home else shutil.which('hadoop')
         self._configs = configs or {}
         self._timeout = time_out
+        self._run = _retry(max_attempts=max(1, retries),
+                           backoff=max(0.001, sleep_inter / 1000.0),
+                           factor=2.0, jitter=0.5, reraise=True,
+                           retry_on=(FSTimeOut,))(self._run_once)
 
-    def _run(self, *args):
+    def _run_once(self, *args):
         if not self._hadoop:
             raise ExecuteError(
                 "HDFSClient: no hadoop binary found — pass hadoop_home= or "

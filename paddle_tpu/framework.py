@@ -73,13 +73,15 @@ class _TensorPayload:
 
 
 def save(obj, path, protocol=4, **configs):
-    """paddle.save — pickles nested state (Tensors -> numpy payloads)."""
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
+    """paddle.save — pickles nested state (Tensors -> numpy payloads).
+
+    Writes go through the resilience atomic commit (same-dir temp + fsync +
+    os.replace): a crash mid-save leaves the previous file intact instead of
+    a torn pickle that load() would die on.
+    """
+    from .resilience.atomic_io import atomic_pickle_dump
     payload = _to_saveable(obj)
-    with open(path, 'wb') as f:
-        pickle.dump(payload, f, protocol=protocol)
+    atomic_pickle_dump(payload, path, protocol=protocol)
 
 
 def load(path, **configs):
@@ -88,8 +90,16 @@ def load(path, **configs):
     if path.endswith('.npz'):
         data = np.load(path, allow_pickle=True)
         return {k: data[k] for k in data.files}
-    with open(path, 'rb') as f:
-        payload = pickle.load(f)
+    try:
+        with open(path, 'rb') as f:
+            payload = pickle.load(f)
+    except (EOFError, pickle.UnpicklingError) as e:
+        raise RuntimeError(
+            "paddle.load: %r is truncated or corrupt (%s). Files written by "
+            "this build commit atomically, so this usually means an external "
+            "copy was torn; for rotating checkpoints with automatic fallback "
+            "to the last good one, use resilience.CheckpointManager."
+            % (path, e)) from e
     return _from_saveable(payload, return_numpy)
 
 

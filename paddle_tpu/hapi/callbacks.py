@@ -8,7 +8,7 @@ import numpy as np
 from .progressbar import ProgressBar
 
 __all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'LRScheduler',
-           'EarlyStopping', 'VisualDL', 'CallbackList']
+           'EarlyStopping', 'VisualDL', 'CallbackList', 'CheckpointSaver']
 
 
 class Callback:
@@ -134,6 +134,96 @@ class ModelCheckpoint(Callback):
     def on_train_end(self, logs=None):
         if self.save_dir:
             self.model.save(os.path.join(self.save_dir, 'final'))
+
+
+class CheckpointSaver(Callback):
+    """Preemption-safe training checkpoints (resilience.CheckpointManager).
+
+    Saves the FULL resumable state — network params, optimizer accumulators,
+    RNG streams (paddle generator + numpy), AMP loss scale, NaN-guard
+    counters, epoch/step position — as CRC-stamped rotating checkpoints:
+
+    - every ``save_freq`` epochs at the epoch boundary;
+    - immediately at the next batch boundary after SIGTERM (fleet
+      preemption), then stops training cleanly.
+
+    Resume with ``Model.fit(..., resume_from=<same dir>)``: training
+    continues bitwise-identically to a never-interrupted run (the epoch-start
+    RNG snapshot lets a mid-epoch resume replay the epoch's shuffle, skip the
+    completed steps, then restore the exact mid-epoch RNG state).
+    """
+
+    def __init__(self, save_dir, save_freq=1, max_keep=3,
+                 save_on_preempt=True):
+        super().__init__()
+        self.save_dir = save_dir
+        self.save_freq = save_freq
+        self.max_keep = max_keep
+        self.save_on_preempt = save_on_preempt
+        self._mgr = None
+        self._guard = None
+        self._epoch = 0
+        self._preempt_saved = False
+
+    def manager(self):
+        if self._mgr is None:
+            from ..resilience import CheckpointManager
+            self._mgr = CheckpointManager(self.save_dir,
+                                          max_keep=self.max_keep)
+        return self._mgr
+
+    def on_train_begin(self, logs=None):
+        self.manager()
+        self._preempt_saved = False
+        if self.save_on_preempt and self._guard is None:
+            from ..resilience import PreemptionGuard
+            self._guard = PreemptionGuard().install()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._guard is not None and self._guard.preempted and \
+                not self._preempt_saved:
+            # step+1 batches of this epoch are complete; resume skips them
+            self._save(epoch=self._epoch, step_in_epoch=step + 1)
+            self._preempt_saved = True
+            self.model.stop_training = True
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._preempt_saved:
+            return   # the preemption checkpoint already holds this position
+        if (epoch + 1) % self.save_freq == 0:
+            self._save(epoch=epoch + 1, step_in_epoch=0)
+
+    def on_train_end(self, logs=None):
+        if self._guard is not None:
+            self._guard.uninstall()
+            self._guard = None
+
+    @property
+    def preempted(self):
+        return self._preempt_saved
+
+    def _save(self, epoch, step_in_epoch):
+        from ..resilience import capture_rng
+        model = self.model
+        model._sync_jit_state()
+        state = {
+            'model': model.network.state_dict(),
+            'rng': capture_rng(),
+            'epoch_start_rng': getattr(model, '_epoch_start_rng', None),
+        }
+        if model._optimizer is not None:
+            state['opt'] = model._optimizer.state_dict()
+        scaler = getattr(model, '_scaler', None)
+        if scaler is not None:
+            state['scaler'] = scaler.state_dict()
+        guard = getattr(model, '_nan_guard', None)
+        if guard is not None:
+            state['nan_guard'] = guard.state_dict()
+        self.manager().save(state, meta={'epoch': int(epoch),
+                                         'step_in_epoch': int(step_in_epoch)})
 
 
 class LRScheduler(Callback):

@@ -28,10 +28,13 @@ class Model:
         self._jit_step = None
         self._jit_state = None
         self._use_jit = False
+        self._scaler = None
+        self._nan_guard = None
+        self._epoch_start_rng = None
 
     # -- setup --------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, jit=False,
-                amp_configs=None):
+                amp_configs=None, nan_guard=None):
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -40,6 +43,20 @@ class Model:
             self._metrics = [metrics]
         else:
             self._metrics = list(metrics)
+        from ..amp import GradScaler
+        self._scaler = None
+        if isinstance(amp_configs, GradScaler):
+            self._scaler = amp_configs
+        elif isinstance(amp_configs, dict) and \
+                isinstance(amp_configs.get('scaler'), GradScaler):
+            self._scaler = amp_configs['scaler']
+        self._nan_guard = None
+        if nan_guard:
+            from ..resilience import NanGuard
+            self._nan_guard = nan_guard if isinstance(nan_guard, NanGuard) \
+                else NanGuard()
+            if self._scaler is not None:
+                self._nan_guard.attach_scaler(self._scaler)
         self._use_jit = jit
         if jit:
             self._build_jit_step()
@@ -92,9 +109,20 @@ class Model:
         total = losses_list[0]
         for l in losses_list[1:]:
             total = total + l
-        total.backward()
-        self._optimizer.step()
-        self._optimizer.clear_grad()
+        if self._nan_guard is not None and self._nan_guard.check(total):
+            # poisoned loss: no backward, no update — also decays the AMP
+            # loss scale through the attached GradScaler
+            self._optimizer.clear_grad()
+            metrics = self._update_metrics(outs, labels)
+            return [float(l.numpy()) for l in losses_list], metrics
+        if self._scaler is not None and self._scaler.is_enable():
+            self._scaler.scale(total).backward()
+            self._scaler.step(self._optimizer)   # skips the step on inf grads
+            self._optimizer.clear_grad()
+        else:
+            total.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
         metrics = self._update_metrics(outs, labels)
         return [float(l.numpy()) for l in losses_list], metrics
 
@@ -104,24 +132,63 @@ class Model:
         from ..core import rng as _rng
         if self._jit_state is None:
             pv = param_values(self.network)
+            opt_state = self._optimizer.init_state_values(pv)
+            # adopt restored eager accumulators (optimizer.set_state_dict on
+            # resume) instead of fresh zeros: jit resume must continue
+            # Adam/Momentum moments exactly like the eager path does
+            acc = self._optimizer._accumulators
+            name_of = self._param_unique_names()
+            for k in opt_state:
+                nm = name_of.get(k)
+                if nm in acc and acc[nm]:
+                    opt_state[k] = dict(acc[nm])
             self._jit_state = {
                 'params': pv,
                 'buffers': buffer_values(self.network),
-                'opt': self._optimizer.init_state_values(pv)}
+                'opt': opt_state}
         bx = tuple(self._tensor(i)._value for i in inputs)
         by = tuple(self._tensor(l)._value for l in labels)
         key = _rng.next_key()
+        prev_state = self._jit_state
         self._jit_state, loss_val, out_vals = self._jit_step_fn(
             self._jit_state, bx, by, key)
+        if self._nan_guard is not None:
+            # the fused step already applied the poisoned update — roll the
+            # functional state back to the pre-step snapshot. Rollback must
+            # also cover check() RAISING (NanStepError at the consecutive
+            # limit), or fit()'s finally-block _sync_jit_state would write
+            # the NaN params into the network
+            try:
+                poisoned = self._nan_guard.check(np.asarray(loss_val))
+            except BaseException:
+                self._jit_state = prev_state
+                raise
+            if poisoned:
+                self._jit_state = prev_state
         outs = [Tensor(v) for v in out_vals]
         metrics = self._update_metrics(outs, labels)
         return [float(np.asarray(loss_val))], metrics
+
+    def _param_unique_names(self):
+        """structured param name (named_parameters key) -> unique name (the
+        optimizer._accumulators key)."""
+        return {k: (p.name or str(id(p)))
+                for k, p in self.network.named_parameters()}
 
     def _sync_jit_state(self):
         if self._jit_state is not None:
             from ..nn.layer_base import load_state_values
             load_state_values(self.network, self._jit_state['params'])
             load_state_values(self.network, self._jit_state['buffers'])
+            # mirror the functional optimizer state back into the eager
+            # accumulators: optimizer.state_dict() (checkpointing) must see
+            # the live moments, not the stale pre-jit zeros
+            if self._optimizer is not None and self._jit_state.get('opt'):
+                name_of = self._param_unique_names()
+                for k, st in self._jit_state['opt'].items():
+                    nm = name_of.get(k)
+                    if nm is not None and st:
+                        self._optimizer._accumulators[nm] = dict(st)
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -151,7 +218,19 @@ class Model:
     # -- loops --------------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            resume_from=None):
+        """Train for ``epochs`` epochs.
+
+        ``resume_from``: a directory previously written by a
+        :class:`~paddle_tpu.hapi.callbacks.CheckpointSaver` callback (or a
+        ``resilience.CheckpointManager``). The newest non-corrupt checkpoint
+        restores params, optimizer accumulators, AMP loss scale, NaN-guard
+        counters, and both RNG streams, then training continues from the
+        recorded epoch/step — bitwise-identical to a run that was never
+        interrupted. A SIGTERM during training (with a CheckpointSaver
+        active) checkpoints at the next batch boundary and stops cleanly.
+        """
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = self._to_loader(eval_data, batch_size, False, False,
@@ -166,12 +245,54 @@ class Model:
         except TypeError:
             pass
         cbks.set_params({'epochs': epochs, 'steps': steps, 'verbose': verbose})
+        start_epoch, skip_steps, resume_rng = 0, 0, None
+        if resume_from is not None:
+            start_epoch, skip_steps, resume_rng = \
+                self._restore_checkpoint(resume_from)
         cbks.on_train_begin()
         self.stop_training = False
-        for epoch in range(epochs):
+        from ..resilience.checkpoint import capture_rng, restore_rng
+        try:
+            self._fit_loop(train_loader, eval_loader, cbks, epochs,
+                           start_epoch, skip_steps, resume_rng, eval_freq,
+                           save_dir, save_freq, capture_rng, restore_rng)
+        finally:
+            # always: on_train_end uninstalls CheckpointSaver's SIGTERM
+            # handler — leaking it past an exception (e.g. NanStepError)
+            # would leave the process ignoring the scheduler's SIGTERM
+            self._sync_jit_state()
+            cbks.on_train_end()
+
+    def _fit_loop(self, train_loader, eval_loader, cbks, epochs, start_epoch,
+                  skip_steps, resume_rng, eval_freq, save_dir, save_freq,
+                  capture_rng, restore_rng):
+        for epoch in range(start_epoch, epochs):
+            resuming = resume_rng is not None and epoch == start_epoch
+            if resuming and skip_steps == 0:
+                # epoch-boundary resume: continue the RNG streams exactly
+                # where the checkpoint left them (before this epoch's
+                # shuffle draws)
+                restore_rng(resume_rng['save_point'])
+            elif resuming:
+                # mid-epoch resume: rewind to the epoch-start snapshot so
+                # iterating the loader below replays the SAME shuffle the
+                # interrupted epoch used
+                restore_rng(resume_rng['epoch_start'])
+            # epoch-start snapshot (taken BEFORE the loader draws shuffle
+            # randomness): lets a mid-epoch preemption checkpoint replay
+            # this epoch's batch order on resume
+            self._epoch_start_rng = capture_rng()
             cbks.on_epoch_begin(epoch)
             logs = {}
+            mid_restore_pending = resuming and skip_steps > 0
             for step, batch in enumerate(train_loader):
+                if resuming and step < skip_steps:
+                    continue   # already trained before the preemption
+                if mid_restore_pending:
+                    # shuffle replayed, completed steps skipped: now adopt
+                    # the exact RNG state of the preemption point
+                    restore_rng(resume_rng['save_point'])
+                    mid_restore_pending = False
                 cbks.on_train_batch_begin(step)
                 ins, lbs = self._split_batch(batch)
                 losses, metrics = self.train_batch(ins, lbs)
@@ -183,6 +304,18 @@ class Model:
                     for n, v in zip(names, vals):
                         logs[n] = float(v)
                 cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            if mid_restore_pending:
+                # preemption landed on the epoch's final batch: nothing to
+                # retrain here, but the RNG streams must still continue from
+                # the preemption point, not the replayed-shuffle state
+                restore_rng(resume_rng['save_point'])
+            if self.stop_training:
+                # preempted mid-epoch: the CheckpointSaver already committed
+                # this position; skip epoch-end bookkeeping that would
+                # otherwise record the partial epoch as complete
+                break
             cbks.on_epoch_end(epoch, logs)
             for m in self._metrics:
                 m.reset()
@@ -194,8 +327,40 @@ class Model:
                 self.save(os.path.join(save_dir, str(epoch)))
             if self.stop_training:
                 break
-        self._sync_jit_state()
-        cbks.on_train_end()
+
+    def _restore_checkpoint(self, resume_from):
+        """Restore the newest non-corrupt CheckpointSaver checkpoint.
+
+        Returns ``(start_epoch, skip_steps, rng_snapshots)``; with no
+        loadable checkpoint, training starts fresh (warning) — the standard
+        preemption-loop contract where the first run of a job has no
+        checkpoint yet.
+        """
+        import warnings
+        from ..resilience import CheckpointManager
+        mgr = resume_from if isinstance(resume_from, CheckpointManager) \
+            else CheckpointManager(resume_from)
+        loaded = mgr.load()
+        if loaded is None:
+            warnings.warn(
+                "Model.fit(resume_from=%r): no loadable checkpoint found — "
+                "starting from scratch" % (mgr.path,))
+            return 0, 0, None
+        state, meta = loaded
+        self.network.set_state_dict(state['model'])
+        if self._use_jit:
+            self._jit_state = None   # rebuild functional state from network
+        if self._optimizer is not None and state.get('opt') is not None:
+            self._optimizer.set_state_dict(state['opt'])
+        if self._scaler is not None and state.get('scaler') is not None:
+            self._scaler.load_state_dict(state['scaler'])
+        if self._nan_guard is not None and \
+                state.get('nan_guard') is not None:
+            self._nan_guard.load_state_dict(state['nan_guard'])
+        rng = {'save_point': state.get('rng'),
+               'epoch_start': state.get('epoch_start_rng')}
+        return int(meta.get('epoch', 0)), int(meta.get('step_in_epoch', 0)), \
+            rng
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, _from_fit=False):

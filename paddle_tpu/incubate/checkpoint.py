@@ -49,6 +49,7 @@ def _write_snapshot(path, snap):
         # fsync before the commit rename: the rename's metadata must never
         # reach disk ahead of the payload pages, or a power loss could leave
         # a committed-but-torn checkpoint that resume would trust.
+        # atomic-ok: staged inside the tmp dir, committed via os.rename below
         with open(os.path.join(tmp, name), 'wb') as f:
             writer(f)
             f.flush()

@@ -93,10 +93,10 @@ class AOTCompiledFunction:
         arg_shardings = self._compiled.input_shardings[0]
         n_devices = (len(arg_shardings[0].device_set)
                      if arg_shardings else 1)
-        with open(path, 'wb') as f:
-            pickle.dump({'backend': jax.default_backend(),
-                         'n_devices': n_devices,
-                         'payload': payload}, f)
+        from ..resilience.atomic_io import atomic_pickle_dump
+        atomic_pickle_dump({'backend': jax.default_backend(),
+                            'n_devices': n_devices,
+                            'payload': payload}, path)
         return path
 
     @classmethod

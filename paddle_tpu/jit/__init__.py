@@ -397,8 +397,8 @@ def save(layer, path, input_spec=None, **configs):
                                     for s in input_spec]
         except Exception as e:  # export is best-effort
             meta['export_error'] = str(e)
-    with open(path + '.pdmodel', 'wb') as f:
-        pickle.dump(meta, f)
+    from ..resilience.atomic_io import atomic_pickle_dump
+    atomic_pickle_dump(meta, path + '.pdmodel')
 
 
 def load(path, **configs):

@@ -1,0 +1,34 @@
+"""paddle_tpu.resilience: fault tolerance for production TPU training.
+
+Four pillars, each independently usable and all threaded through the rest of
+the tree (framework.save, hapi.Model.fit, amp.GradScaler, utils.download,
+distributed.{env,fs}):
+
+- atomic checkpoint I/O (``atomic_io``, ``CheckpointManager``): temp + fsync
+  + os.replace commits, CRC32-stamped manifests, keep-last-N rotation, and
+  load-time fallback to the newest non-corrupt checkpoint;
+- preemption-safe training (``PreemptionGuard``, hapi ``CheckpointSaver``,
+  ``Model.fit(resume_from=...)``): SIGTERM checkpoints before exit, resume
+  restores epoch/step, optimizer state, RNG streams, and AMP loss scale for
+  bitwise-identical continuation;
+- a NaN/Inf step guard (``NanGuard``) that skips poisoned updates and
+  reports them to the dynamic GradScaler;
+- bounded ``retry`` with exponential backoff + jitter for transient I/O.
+
+``faultinject`` produces each of the failures above deterministically so the
+whole layer is testable on CPU (tier-1, ``-m fault``).
+"""
+from .atomic_io import (atomic_open, atomic_write, atomic_pickle_dump,
+                        crc32_file, crc32_bytes, AtomicWriteError)
+from .retry import retry, RetryError
+from .preempt import PreemptionGuard
+from .nanguard import NanGuard, NanStepError
+from .checkpoint import CheckpointManager, capture_rng, restore_rng
+from . import atomic_io
+from . import faultinject
+
+__all__ = ['atomic_open', 'atomic_write', 'atomic_pickle_dump',
+           'crc32_file', 'crc32_bytes',
+           'AtomicWriteError', 'retry', 'RetryError', 'PreemptionGuard',
+           'NanGuard', 'NanStepError', 'CheckpointManager', 'capture_rng',
+           'restore_rng', 'atomic_io', 'faultinject']

@@ -1,0 +1,181 @@
+"""CRC-stamped, rotating, fallback-capable checkpoint store.
+
+Layout under a checkpoint directory::
+
+    ckpt-00000012.ckpt            # pickled payload (Tensors -> numpy)
+    ckpt-00000012.manifest.json   # {"format":1,"step":12,"size":...,"crc32":...,
+                                  #  "meta":{"epoch":3,"step_in_epoch":0,...}}
+
+Commit protocol: payload first, manifest second, both through
+``atomic_io.atomic_write``. A checkpoint EXISTS only once its manifest does;
+a crash between the two writes leaves an orphan payload that loaders ignore
+and the next save of that step overwrites. ``load()`` walks steps newest
+first, verifies size+CRC32 against the manifest, and transparently falls
+back to the newest non-corrupt checkpoint (warning on every skip) — a torn
+or bit-flipped latest file costs one checkpoint interval, not the run.
+"""
+import json
+import os
+import pickle
+import warnings
+import zlib
+
+from .atomic_io import atomic_open, atomic_write, crc32_file
+
+__all__ = ['CheckpointManager', 'capture_rng', 'restore_rng']
+
+_FMT = 1
+_PREFIX = 'ckpt-'
+_PAYLOAD_EXT = '.ckpt'
+_MANIFEST_EXT = '.manifest.json'
+
+
+class CheckpointManager:
+    """Keep-last-N rotating checkpoint directory with corruption fallback."""
+
+    def __init__(self, path, max_keep=3):
+        self.path = os.fspath(path)
+        self.max_keep = max_keep
+
+    # -- naming -------------------------------------------------------------
+    def _payload(self, step):
+        return os.path.join(self.path, '%s%08d%s' % (_PREFIX, step,
+                                                     _PAYLOAD_EXT))
+
+    def _manifest(self, step):
+        return os.path.join(self.path, '%s%08d%s' % (_PREFIX, step,
+                                                     _MANIFEST_EXT))
+
+    def steps(self):
+        """Committed (manifest present) steps, ascending."""
+        if not os.path.isdir(self.path):
+            return []
+        out = []
+        for name in os.listdir(self.path):
+            if name.startswith(_PREFIX) and name.endswith(_MANIFEST_EXT):
+                digits = name[len(_PREFIX):-len(_MANIFEST_EXT)]
+                if digits.isdigit():
+                    out.append(int(digits))
+        return sorted(out)
+
+    def latest_step(self):
+        s = self.steps()
+        return s[-1] if s else None
+
+    # -- write --------------------------------------------------------------
+    def save(self, state, step=None, meta=None):
+        """Atomically commit ``state`` (arbitrary pytree; Tensors become
+        numpy payloads) as checkpoint ``step`` (default: latest+1)."""
+        from ..framework import _to_saveable
+        if step is None:
+            latest = self.latest_step()
+            step = 0 if latest is None else latest + 1
+        step = int(step)
+        pay_path = self._payload(step)
+        with atomic_open(pay_path) as f:   # streamed: no full blob in RAM
+            w = _Crc32Writer(f)
+            pickle.dump(_to_saveable(state), w, protocol=4)
+        # CRC/size accumulated while streaming — no read-back of a multi-GB
+        # payload inside the preemption grace window
+        manifest = {'format': _FMT, 'step': step, 'size': w.size,
+                    'crc32': w.crc, 'meta': dict(meta or {})}
+        atomic_write(self._manifest(step),
+                     json.dumps(manifest, sort_keys=True).encode())
+        self._rotate()
+        return step
+
+    def _rotate(self):
+        if not self.max_keep:
+            return
+        for s in self.steps()[:-self.max_keep]:
+            for p in (self._payload(s), self._manifest(s)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    # -- read ---------------------------------------------------------------
+    def verify(self, step):
+        """True iff checkpoint ``step``'s payload matches its manifest."""
+        return self._check(step) is None
+
+    def _check(self, step):
+        """None when intact, else a human-readable defect description."""
+        man_path, pay_path = self._manifest(step), self._payload(step)
+        try:
+            with open(man_path, 'rb') as f:
+                man = json.loads(f.read().decode())
+        except (OSError, ValueError) as e:
+            return 'unreadable manifest (%s)' % e
+        if not os.path.isfile(pay_path):
+            return 'payload missing'
+        size = os.path.getsize(pay_path)
+        if size != man.get('size'):
+            return 'payload truncated/resized (%d bytes, manifest says %s)' \
+                % (size, man.get('size'))
+        crc = crc32_file(pay_path)
+        if crc != man.get('crc32'):
+            return 'payload CRC32 mismatch (0x%08x, manifest says 0x%08x)' \
+                % (crc, man.get('crc32', 0))
+        return None
+
+    def load(self, step=None, return_numpy=False):
+        """Return ``(state, meta)`` of checkpoint ``step`` (default: the
+        newest NON-CORRUPT one), or ``None`` when nothing loadable exists.
+        Corrupt checkpoints are skipped with a warning, never deleted —
+        an operator may still salvage them."""
+        from ..framework import _from_saveable
+        candidates = [step] if step is not None else \
+            list(reversed(self.steps()))
+        for s in candidates:
+            defect = self._check(s)
+            if defect is None:
+                try:
+                    with open(self._payload(s), 'rb') as f:
+                        state = pickle.load(f)
+                except Exception as e:   # CRC passed but unpickle failed
+                    defect = 'unpicklable payload (%s)' % e
+                else:
+                    with open(self._manifest(s), 'rb') as f:
+                        meta = json.loads(f.read().decode()).get('meta', {})
+                    return _from_saveable(state, return_numpy), meta
+            warnings.warn(
+                "CheckpointManager: checkpoint step %d at %r is corrupt "
+                "(%s) — falling back to the previous good checkpoint"
+                % (s, self.path, defect))
+        return None
+
+
+class _Crc32Writer:
+    """File-like shim accumulating CRC32 + byte count as pickle streams."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+        self.size = 0
+
+    def write(self, data):
+        self.crc = zlib.crc32(data, self.crc) & 0xFFFFFFFF
+        self.size += len(data)
+        return self._f.write(data)
+
+
+# -- RNG capture for exact resume -------------------------------------------
+
+def capture_rng():
+    """Snapshot every RNG stream training consumes (paddle generator +
+    global numpy), as plain pickleable python/numpy state."""
+    import numpy as np
+    from ..core import rng as _rng
+    return {'paddle': _rng.get_rng_state(), 'numpy': np.random.get_state()}
+
+
+def restore_rng(state):
+    import numpy as np
+    from ..core import rng as _rng
+    if not state:
+        return
+    if state.get('paddle') is not None:
+        _rng.set_rng_state(state['paddle'])
+    if state.get('numpy') is not None:
+        np.random.set_state(state['numpy'])

@@ -1,0 +1,164 @@
+"""Deterministic fault injection: make every resilience behavior testable.
+
+The harness produces exactly the failures the resilience layer defends
+against, on CPU, deterministically:
+
+- ``fail_writes`` — the next N atomic writes raise before commit (torn-write
+  crash model; destinations must stay intact);
+- ``corrupt_file``/``truncate_file`` — flip or drop committed bytes (disk
+  corruption model; manifests must catch it);
+- ``flaky`` — wrap a callable to fail its first N calls (transient-network
+  model for retry());
+- ``poison_loss`` — wrap a loss fn to return NaN at chosen global steps
+  (NaN-guard model);
+- ``PreemptAtStep`` — a hapi callback that delivers a real SIGTERM to this
+  process at a chosen global batch (preemption model).
+
+All injectors are context-managed or idempotent to deactivate, so a failing
+test cannot leak faults into the next one.
+"""
+import os
+import signal
+
+from . import atomic_io
+
+__all__ = ['FaultInjector', 'flaky', 'poison_loss', 'corrupt_file',
+           'truncate_file', 'PreemptAtStep', 'InjectedWriteError']
+
+
+class InjectedWriteError(OSError):
+    """The injected failure for write faults."""
+
+
+class FaultInjector:
+    """Context manager arming write faults against atomic_io.
+
+    >>> with FaultInjector().fail_writes(times=1, match='model'):
+    ...     paddle.save(state, 'model.pdparams')   # raises, file untouched
+    """
+
+    def __init__(self):
+        self._arms = []       # list of [stage, remaining, match]
+        self._prev_hook = None
+        self.triggered = 0
+
+    def fail_writes(self, times=1, match=None, stage='write'):
+        """Arm: the next ``times`` atomic writes whose destination contains
+        ``match`` (substring; None = all) raise ``InjectedWriteError`` at
+        ``stage`` ('write' = before any bytes, 'replace' = staged bytes
+        written but commit rename never happens)."""
+        self._arms.append([stage, times, match])
+        return self
+
+    def _hook(self, stage, path):
+        for arm in self._arms:
+            a_stage, remaining, match = arm
+            if a_stage != stage or remaining <= 0:
+                continue
+            if match is not None and match not in os.fspath(path):
+                continue
+            arm[1] -= 1
+            self.triggered += 1
+            raise InjectedWriteError(
+                "fault injection: forced %s failure for %r" % (stage, path))
+
+    def __enter__(self):
+        self._prev_hook = atomic_io._fault_hook
+        atomic_io._fault_hook = self._hook
+        return self
+
+    def __exit__(self, *exc):
+        atomic_io._fault_hook = self._prev_hook
+        return False
+
+
+def flaky(fn, fail_times=1, exc_factory=None):
+    """Wrap ``fn`` to raise on its first ``fail_times`` calls, succeed after.
+    The wrapper exposes ``.calls`` (total) and ``.failures`` (raised)."""
+    state = {'calls': 0}
+
+    def wrapper(*args, **kwargs):
+        state['calls'] += 1
+        if state['calls'] <= fail_times:
+            if exc_factory is not None:
+                raise exc_factory(state['calls'])
+            raise ConnectionError(
+                "fault injection: flaky call %d/%d failing"
+                % (state['calls'], fail_times))
+        return fn(*args, **kwargs)
+
+    wrapper.state = state
+    return wrapper
+
+
+def poison_loss(loss_fn, at_steps):
+    """Wrap a loss callable: at the given 0-based global call indices the
+    returned loss is multiplied by NaN (keeps shape/dtype/graph so the guard
+    sees exactly what a numeric blow-up produces)."""
+    at_steps = set(int(s) for s in at_steps)
+    state = {'calls': 0}
+
+    def wrapper(*args, **kwargs):
+        step = state['calls']
+        state['calls'] += 1
+        loss = loss_fn(*args, **kwargs)
+        if step in at_steps:
+            return loss * float('nan')
+        return loss
+
+    wrapper.state = state
+    return wrapper
+
+
+def corrupt_file(path, offset=0, nbytes=1):
+    """Flip ``nbytes`` bytes of a committed file in place at ``offset``
+    (negative offset = from end)."""
+    size = os.path.getsize(path)
+    if offset < 0:
+        offset = max(0, size + offset)
+    with open(path, 'r+b') as f:
+        f.seek(offset)
+        block = f.read(nbytes)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in block))
+    return path
+
+
+def truncate_file(path, keep_bytes=None, drop_bytes=None):
+    """Truncate a committed file to ``keep_bytes`` (or drop ``drop_bytes``
+    from the end) — the classic torn-write artifact."""
+    size = os.path.getsize(path)
+    if keep_bytes is None:
+        keep_bytes = max(0, size - (drop_bytes if drop_bytes is not None
+                                    else size // 2))
+    with open(path, 'r+b') as f:
+        f.truncate(keep_bytes)
+    return path
+
+
+class PreemptAtStep:
+    """hapi callback delivering a real SIGTERM at the end of global batch
+    ``step`` (0-based, counted across epochs) — exercises the full
+    PreemptionGuard -> CheckpointSaver -> stop_training path.
+
+    Imported lazily as a Callback subclass so this module stays stdlib-only
+    until a test actually uses it.
+    """
+
+    def __new__(cls, step):
+        from ..hapi.callbacks import Callback
+
+        class _Preempter(Callback):
+            def __init__(self, at):
+                super().__init__()
+                self.at = int(at)
+                self.seen = 0
+                self.fired = False
+
+            def on_train_batch_end(self, batch_step, logs=None):
+                if self.seen == self.at and not self.fired:
+                    self.fired = True
+                    signal.raise_signal(signal.SIGTERM)
+                self.seen += 1
+
+        return _Preempter(step)

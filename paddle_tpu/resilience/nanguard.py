@@ -1,0 +1,76 @@
+"""NaN/Inf step guard: skip poisoned updates instead of corrupting the run.
+
+A single non-finite loss on a long TPU run poisons every parameter the moment
+the update applies; the guard checks the loss before backward (eager) or the
+pre-update snapshot (jit) and skips the step. It cooperates with the dynamic
+``amp.GradScaler``: a skipped step is reported as a found-inf event so the
+loss scale backs off through the scaler's existing decrement path — the two
+mechanisms see a consistent count of bad steps.
+"""
+import numpy as np
+
+__all__ = ['NanGuard', 'NanStepError']
+
+
+class NanStepError(RuntimeError):
+    """Raised when ``max_consecutive_skips`` poisoned steps occur in a row —
+    at that point the run is diverging, not hitting a transient spike."""
+
+
+class NanGuard:
+    def __init__(self, max_consecutive_skips=25, scaler=None, verbose=True):
+        self.max_consecutive_skips = max_consecutive_skips
+        self.skipped_steps = 0
+        self.consecutive_skips = 0
+        self.total_steps = 0
+        self._scaler = scaler
+        self._verbose = verbose
+
+    def attach_scaler(self, scaler):
+        """Report skipped steps to a GradScaler so dynamic loss scaling
+        decays on guard-skipped updates too."""
+        self._scaler = scaler
+        return self
+
+    @staticmethod
+    def is_finite(value):
+        """True iff every element of ``value`` (Tensor/array/scalar) is
+        finite. Forces a host sync — callers already need the loss on host
+        for logging, so this is not an extra device round-trip in practice."""
+        arr = np.asarray(value.numpy() if hasattr(value, 'numpy') else value)
+        return bool(np.isfinite(arr).all())
+
+    def check(self, loss):
+        """Record one step; returns True when the step must be SKIPPED."""
+        self.total_steps += 1
+        if self.is_finite(loss):
+            self.consecutive_skips = 0
+            return False
+        self.skipped_steps += 1
+        self.consecutive_skips += 1
+        if self._scaler is not None and self._scaler.is_enable():
+            self._scaler.mark_found_inf()
+        if self._verbose:
+            import warnings
+            warnings.warn(
+                "NanGuard: non-finite loss at step %d — skipping the "
+                "update (%d skipped so far, %d consecutive)"
+                % (self.total_steps, self.skipped_steps,
+                   self.consecutive_skips))
+        if self.consecutive_skips >= self.max_consecutive_skips:
+            raise NanStepError(
+                "NanGuard: %d consecutive non-finite steps (limit %d) — "
+                "the run is diverging; lower the learning rate or inspect "
+                "the data pipeline" % (self.consecutive_skips,
+                                       self.max_consecutive_skips))
+        return True
+
+    def state_dict(self):
+        return {'skipped_steps': self.skipped_steps,
+                'consecutive_skips': self.consecutive_skips,
+                'total_steps': self.total_steps}
+
+    def load_state_dict(self, sd):
+        self.skipped_steps = int(sd.get('skipped_steps', 0))
+        self.consecutive_skips = int(sd.get('consecutive_skips', 0))
+        self.total_steps = int(sd.get('total_steps', 0))

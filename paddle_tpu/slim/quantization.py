@@ -86,8 +86,8 @@ class WeightQuantization:
         os.makedirs(save_model_dir, exist_ok=True)
         dst = os.path.join(save_model_dir,
                            self._params_filename or '__persistables__')
-        with open(dst, 'wb') as f:
-            pickle.dump(out, f)
+        from ..resilience.atomic_io import atomic_pickle_dump
+        atomic_pickle_dump(out, dst)
         return dst
 
 

@@ -18,11 +18,11 @@ def _collect_params(program):
 
 
 def save_persistables(executor, dirname, main_program=None, filename=None):
+    from ..resilience.atomic_io import atomic_pickle_dump
     os.makedirs(dirname, exist_ok=True)
     params = _collect_params(main_program)
     path = os.path.join(dirname, filename or '__persistables__')
-    with open(path, 'wb') as f:
-        pickle.dump(params, f)
+    atomic_pickle_dump(params, path)
 
 
 save_params = save_persistables
@@ -95,11 +95,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
             "dir will load via Executor in-process but inference.Predictor "
             "cannot serve it standalone" % (e,))
         meta['export_error'] = repr(e)
-    with open(os.path.join(dirname, model_filename or '__model__'), 'wb') as f:
-        pickle.dump(meta, f)
-    with open(os.path.join(dirname, params_filename or '__params__'),
-              'wb') as f:
-        pickle.dump(params, f)
+    from ..resilience.atomic_io import atomic_pickle_dump
+    atomic_pickle_dump(meta, os.path.join(dirname,
+                                          model_filename or '__model__'))
+    atomic_pickle_dump(params, os.path.join(dirname,
+                                            params_filename or '__params__'))
     return [t.name for t in target_vars]
 
 

@@ -1,17 +1,70 @@
 """Weights-by-URL cache resolution. Parity:
 python/paddle/utils/download.py:58 (get_weights_path_from_url).
 
-TPU-first divergence: this build runs in zero-egress environments, so no
-network fetch is attempted. The function resolves the URL to the same
-cache layout the reference uses (~/.cache/paddle/weights/<basename>) and
-returns the path when the file is already present (pre-seeded caches,
-mounted volumes); otherwise it raises with the exact path to provision.
+TPU-first divergence: this build is hermetic (zero-egress) BY DEFAULT — see
+utils/hermetic.allow_egress(). In hermetic mode the function resolves the URL
+to the reference cache layout (~/.cache/paddle/weights/<basename>) and
+returns the path when the file is pre-seeded; otherwise it raises with the
+exact path to provision. With PADDLE_TPU_ALLOW_EGRESS=1 it downloads through
+bounded retry (exponential backoff + jitter, resilience.retry) and commits
+the file atomically so a killed download never leaves a torn cache entry.
 """
+import hashlib
+import http.client
 import os
+
+from .hermetic import allow_egress
+from ..resilience.atomic_io import atomic_write
+from ..resilience.retry import retry
 
 __all__ = ['get_weights_path_from_url']
 
 WEIGHTS_HOME = os.path.expanduser('~/.cache/paddle/weights')
+
+# seam for tests/faultinject: patched to a fake opener so retry behavior is
+# testable without egress. Returns a file-like with .read().
+def _open_url(url, timeout=30.0):
+    import urllib.request
+    return urllib.request.urlopen(url, timeout=timeout)
+
+
+# http.client.HTTPException covers mid-body failures (IncompleteRead on a
+# dropped connection) that are NOT OSError subclasses but just as transient
+@retry(max_attempts=4, backoff=0.5, factor=2.0, jitter=0.5,
+       retry_on=(OSError, ConnectionError, TimeoutError,
+                 http.client.HTTPException))
+def _fetch(url, dest):
+    """One bounded-retry download, streamed in chunks (constant memory for
+    multi-GB weights) and committed via atomic replace."""
+    import urllib.error
+    try:
+        resp = _open_url(url)
+    except urllib.error.HTTPError as e:
+        if e.code < 500 and e.code not in (408, 429):
+            # permanent client error (404/403/...): HTTPError subclasses
+            # OSError, so re-type it or retry() would hammer the server
+            # with a request that can never succeed. 408 (timeout) and 429
+            # (throttled fleet stampede) ARE transient — exactly what the
+            # backoff+jitter here is for — and stay retryable.
+            raise RuntimeError(
+                "download of %r failed with HTTP %s %s — not retrying a "
+                "permanent client error" % (url, e.code, e.reason)) from e
+        raise
+    try:
+        atomic_write(dest, resp)   # file-like: streamed to the staged temp
+    finally:
+        close = getattr(resp, 'close', None)
+        if close:
+            close()
+    return dest
+
+
+def _md5_of(path):
+    digest = hashlib.md5()
+    with open(path, 'rb') as f:
+        for chunk in iter(lambda: f.read(1 << 20), b''):
+            digest.update(chunk)
+    return digest.hexdigest()
 
 
 def get_weights_path_from_url(url, md5sum=None):
@@ -19,18 +72,23 @@ def get_weights_path_from_url(url, md5sum=None):
     path = os.path.join(WEIGHTS_HOME, fname)
     if os.path.exists(path):
         if md5sum is not None:
-            import hashlib
-            digest = hashlib.md5()
-            with open(path, 'rb') as f:
-                for chunk in iter(lambda: f.read(1 << 20), b''):
-                    digest.update(chunk)
-            if digest.hexdigest() != md5sum:
+            got = _md5_of(path)
+            if got != md5sum:
                 raise RuntimeError(
                     f"cached weights at {path!r} fail the md5 check "
-                    f"(expected {md5sum}, got {digest.hexdigest()}): the "
+                    f"(expected {md5sum}, got {got}): the "
                     f"pre-seeded file is stale or corrupt — replace it")
         return path
-    raise RuntimeError(
-        f"weights for {url!r} not present at {path!r}: this environment "
-        f"has no network egress — place the file there (or point "
-        f"model code at a local checkpoint via paddle.load) and retry")
+    if not allow_egress():
+        raise RuntimeError(
+            f"weights for {url!r} not present at {path!r}: this environment "
+            f"has no network egress — place the file there (or point "
+            f"model code at a local checkpoint via paddle.load) and retry, "
+            f"or set PADDLE_TPU_ALLOW_EGRESS=1 to enable downloads")
+    _fetch(url, path)
+    if md5sum is not None and _md5_of(path) != md5sum:
+        os.unlink(path)
+        raise RuntimeError(
+            f"downloaded weights for {url!r} fail the md5 check "
+            f"(expected {md5sum}) — the source is corrupt; not caching it")
+    return path

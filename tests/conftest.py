@@ -19,6 +19,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "fault: fault-injection / resilience tests (deterministic "
+        "write failures, corruption, SIGTERM, NaN injection)")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle

@@ -1,0 +1,593 @@
+"""Resilience subsystem: atomic checkpoints, preemption-safe resume, NaN
+guard, retry, and the fault-injection harness that exercises them all on CPU.
+
+The two acceptance properties from the resilience issue:
+- SIGTERM at ANY training step resumes to bitwise-identical final params;
+- a truncated latest checkpoint is transparently skipped for the last good
+  one, with a clear warning.
+"""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi.callbacks import CheckpointSaver
+from paddle_tpu.resilience import (AtomicWriteError, CheckpointManager,
+                                   NanGuard, NanStepError, PreemptionGuard,
+                                   RetryError, capture_rng, restore_rng,
+                                   retry)
+from paddle_tpu.resilience import faultinject as fi
+
+import importlib
+# the package exports retry (the decorator), which shadows the submodule name
+retry_mod = importlib.import_module('paddle_tpu.resilience.retry')
+
+
+# -- shared tiny training setup ---------------------------------------------
+
+N_SAMPLES, N_FEATURES, N_CLASSES = 48, 6, 3
+
+
+class _ToyData(paddle.io.Dataset):
+    """Deterministic synthetic classification set."""
+
+    def __init__(self):
+        rs = np.random.RandomState(7)
+        self.x = rs.randn(N_SAMPLES, N_FEATURES).astype(np.float32)
+        self.y = rs.randint(0, N_CLASSES, N_SAMPLES).astype(np.int64)
+
+    def __len__(self):
+        return N_SAMPLES
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _fresh_model(seed=123, nan_guard=None, scaler=None):
+    """Model with dropout (exercises per-step RNG keys) + Adam (exercises
+    optimizer accumulator restore)."""
+    paddle.seed(seed)
+    np.random.seed(seed)
+    net = nn.Sequential(nn.Linear(N_FEATURES, 16), nn.ReLU(),
+                        nn.Dropout(0.25), nn.Linear(16, N_CLASSES))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        nan_guard=nan_guard,
+        amp_configs=scaler)
+    return model
+
+
+def _state_bytes(model):
+    """Canonical bitwise fingerprint of params + optimizer accumulators.
+
+    Optimizer keys embed per-instance unique parameter names (linear_32 vs
+    linear_36 across fresh instances), so accumulators are canonicalized by
+    parameter POSITION — the same contract optimizer.set_state_dict uses.
+    """
+    out = {}
+    for k, v in sorted(model.network.state_dict().items()):
+        out['net.' + k] = np.asarray(v.numpy()).tobytes()
+    pname_idx = {p.name: i for i, p in
+                 enumerate(model._optimizer._parameters or [])}
+    for k, v in model._optimizer.state_dict().items():
+        pname, _, sname = k.rpartition('.')
+        if pname in pname_idx:
+            key = 'opt.p%d.%s' % (pname_idx[pname], sname)
+        else:
+            key = 'opt.' + k
+        arr = v.numpy() if hasattr(v, 'numpy') else v
+        out[key] = np.asarray(arr).tobytes() if not isinstance(arr, dict) \
+            else repr(sorted(arr.items())).encode()
+    return out
+
+
+def _assert_bitwise_equal(a, b):
+    assert sorted(a) == sorted(b)
+    diff = [k for k in a if a[k] != b[k]]
+    assert not diff, "state differs bitwise at: %s" % diff
+
+
+def _fit(model, epochs, callbacks=None, resume_from=None):
+    model.fit(_ToyData(), batch_size=8, epochs=epochs, shuffle=True,
+              verbose=0, callbacks=callbacks, resume_from=resume_from)
+
+
+# -- atomic write / framework.save ------------------------------------------
+
+@pytest.mark.fault
+def test_save_crash_keeps_previous_file(tmp_path):
+    """A write failure mid-save must leave the previous checkpoint intact —
+    the exact torn-file bug in the old open(path, 'wb') path."""
+    path = str(tmp_path / "model.pdparams")
+    paddle.save({'w': paddle.to_tensor(np.ones(4, np.float32))}, path)
+    with fi.FaultInjector().fail_writes(times=1, match='model.pdparams'):
+        with pytest.raises((AtomicWriteError, fi.InjectedWriteError)):
+            paddle.save({'w': paddle.to_tensor(np.zeros(4, np.float32))},
+                        path)
+    loaded = paddle.load(path)
+    np.testing.assert_array_equal(loaded['w'].numpy(), np.ones(4, np.float32))
+
+
+@pytest.mark.fault
+def test_save_crash_between_write_and_commit(tmp_path):
+    """Failure AFTER staging but BEFORE os.replace: destination untouched,
+    no temp litter left behind."""
+    path = str(tmp_path / "model.pdparams")
+    paddle.save({'w': 1}, path)
+    with fi.FaultInjector().fail_writes(times=1, stage='replace'):
+        with pytest.raises((AtomicWriteError, fi.InjectedWriteError)):
+            paddle.save({'w': 2}, path)
+    assert paddle.load(path)['w'] == 1
+    assert [f for f in os.listdir(tmp_path) if '.tmp.' in f] == []
+
+
+def test_torn_pickle_load_message(tmp_path):
+    path = str(tmp_path / "model.pdparams")
+    paddle.save({'w': np.arange(100)}, path)
+    fi.truncate_file(path, keep_bytes=os.path.getsize(path) // 2)
+    with pytest.raises(RuntimeError, match="truncated or corrupt"):
+        paddle.load(path)
+
+
+# -- CheckpointManager: manifest, rotation, fallback -------------------------
+
+def test_manager_rotation_keeps_last_n(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_keep=2)
+    for i in range(5):
+        mgr.save({'v': np.full(3, i)}, meta={'i': i})
+    assert mgr.steps() == [3, 4]
+    state, meta = mgr.load()
+    assert meta['i'] == 4 and int(state['v'][0]) == 4
+
+
+@pytest.mark.fault
+def test_manager_truncated_latest_falls_back(tmp_path):
+    """ISSUE satellite: truncate the newest checkpoint via the fault
+    injector; load must recover the previous good one and warn clearly."""
+    mgr = CheckpointManager(str(tmp_path), max_keep=3)
+    mgr.save({'v': np.array([1.0])}, meta={'tag': 'good'})
+    s2 = mgr.save({'v': np.array([2.0])}, meta={'tag': 'newest'})
+    fi.truncate_file(mgr._payload(s2), drop_bytes=7)
+    with pytest.warns(UserWarning, match="corrupt.*falling back"):
+        state, meta = mgr.load()
+    assert meta['tag'] == 'good' and float(state['v'][0]) == 1.0
+    # the corrupt artifact is kept for forensics, not deleted
+    assert os.path.exists(mgr._payload(s2))
+
+
+@pytest.mark.fault
+def test_manager_bitflip_detected_by_crc(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save({'v': np.array([1.0])})
+    s2 = mgr.save({'v': np.array([2.0])})
+    fi.corrupt_file(mgr._payload(s2), offset=-3, nbytes=1)
+    with pytest.warns(UserWarning, match="CRC32 mismatch"):
+        state, _ = mgr.load()
+    assert float(state['v'][0]) == 1.0
+
+
+@pytest.mark.fault
+def test_manager_all_corrupt_returns_none(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    s = mgr.save({'v': np.array([1.0])})
+    fi.truncate_file(mgr._payload(s), keep_bytes=1)
+    with pytest.warns(UserWarning):
+        assert mgr.load() is None
+
+
+# -- retry -------------------------------------------------------------------
+
+def _no_sleep(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(retry_mod, '_sleep', sleeps.append)
+    return sleeps
+
+
+@pytest.mark.fault
+def test_retry_recovers_from_transient_failures(monkeypatch):
+    sleeps = _no_sleep(monkeypatch)
+    fn = fi.flaky(lambda: 'ok', fail_times=2)
+    wrapped = retry(max_attempts=4, backoff=0.1, factor=2.0, jitter=0)(fn)
+    assert wrapped() == 'ok'
+    assert fn.state['calls'] == 3
+    assert sleeps == pytest.approx([0.1, 0.2])
+
+
+@pytest.mark.fault
+def test_retry_exhaustion_raises_retryerror(monkeypatch):
+    _no_sleep(monkeypatch)
+    fn = fi.flaky(lambda: 'ok', fail_times=10)
+    with pytest.raises(RetryError) as ei:
+        retry(max_attempts=3, jitter=0)(fn)()
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last_exception, ConnectionError)
+
+
+def test_retry_non_matching_exception_propagates(monkeypatch):
+    _no_sleep(monkeypatch)
+    calls = []
+
+    @retry(max_attempts=5, retry_on=(OSError,))
+    def boom():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        boom()
+    assert len(calls) == 1   # no retries on non-matching exceptions
+
+
+def test_retry_reraise_keeps_exception_type(monkeypatch):
+    _no_sleep(monkeypatch)
+
+    @retry(max_attempts=2, retry_on=(TimeoutError,), reraise=True, jitter=0)
+    def always_times_out():
+        raise TimeoutError("slow namenode")
+
+    with pytest.raises(TimeoutError, match="slow namenode"):
+        always_times_out()
+
+
+# -- download: hermetic gate + retry adoption --------------------------------
+
+@pytest.mark.fault
+def test_download_retries_then_caches_atomically(tmp_path, monkeypatch):
+    from paddle_tpu.utils import download
+    monkeypatch.setattr(download, 'WEIGHTS_HOME', str(tmp_path))
+    monkeypatch.setenv('PADDLE_TPU_ALLOW_EGRESS', '1')
+    monkeypatch.setattr(retry_mod, '_sleep', lambda s: None)
+    import io as _io
+    opener = fi.flaky(lambda url, timeout=30.0: _io.BytesIO(b'weights!'),
+                      fail_times=2, exc_factory=lambda n: OSError("net %d" % n))
+    monkeypatch.setattr(download, '_open_url', opener)
+    path = download.get_weights_path_from_url(
+        'https://example.invalid/m.pdparams')
+    assert opener.state['calls'] == 3   # two injected failures, one success
+    with open(path, 'rb') as f:
+        assert f.read() == b'weights!'
+
+
+def test_download_hermetic_mode_never_touches_network(tmp_path, monkeypatch):
+    from paddle_tpu.utils import download
+    monkeypatch.setattr(download, 'WEIGHTS_HOME', str(tmp_path / 'none'))
+    monkeypatch.delenv('PADDLE_TPU_ALLOW_EGRESS', raising=False)
+    calls = []
+    monkeypatch.setattr(download, '_open_url',
+                        lambda *a, **k: calls.append(1))
+    with pytest.raises(RuntimeError, match="no network egress"):
+        download.get_weights_path_from_url('https://example.invalid/w.bin')
+    assert calls == []
+
+
+# -- NaN guard ---------------------------------------------------------------
+
+@pytest.mark.fault
+def test_nan_guard_skips_poisoned_step_params_unchanged():
+    model = _fresh_model(nan_guard=True)
+    data = _ToyData()
+    x, y = [data.x[:8]], [data.y[:8]]
+    model.train_batch(x, y)                      # one clean step
+    before = _state_bytes(model)
+    poisoned = fi.poison_loss(model._loss, at_steps={0})
+    clean_loss, model._loss = model._loss, poisoned
+    losses, _ = model.train_batch(x, y)          # poisoned step
+    model._loss = clean_loss
+    assert not np.isfinite(losses[0])
+    assert model._nan_guard.skipped_steps == 1
+    _assert_bitwise_equal(before, _state_bytes(model))  # update was skipped
+    model.train_batch(x, y)                      # training continues fine
+    assert model._nan_guard.consecutive_skips == 0
+
+
+@pytest.mark.fault
+def test_nan_guard_cooperates_with_gradscaler():
+    from paddle_tpu.amp import GradScaler
+    scaler = GradScaler(init_loss_scaling=1024.0, decr_every_n_nan_or_inf=1)
+    guard = NanGuard(scaler=scaler, verbose=False)
+    assert guard.check(np.float32('nan')) is True
+    assert scaler.get_loss_scaling() == 512.0   # decayed via mark_found_inf
+    assert guard.check(np.float32(1.0)) is False
+    assert scaler.get_loss_scaling() == 512.0
+
+
+@pytest.mark.fault
+def test_nan_guard_raises_after_consecutive_limit():
+    guard = NanGuard(max_consecutive_skips=3, verbose=False)
+    for _ in range(2):
+        assert guard.check(float('inf')) is True
+    with pytest.raises(NanStepError, match="3 consecutive"):
+        guard.check(float('nan'))
+
+
+# -- preemption guard --------------------------------------------------------
+
+@pytest.mark.fault
+def test_preemption_guard_catches_sigterm_and_restores_handler():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as g:
+        assert g.installed and not g.preempted
+        signal.raise_signal(signal.SIGTERM)
+        assert g.preempted
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+# -- kill-and-resume equivalence (the acceptance property) -------------------
+
+def _uninterrupted_reference(epochs):
+    model = _fresh_model()
+    _fit(model, epochs)
+    return _state_bytes(model)
+
+
+@pytest.mark.fault
+@pytest.mark.parametrize("preempt_step", [0, 3, 5, 11])
+def test_sigterm_resume_is_bitwise_identical(tmp_path, preempt_step):
+    """SIGTERM at various global steps (incl. step 0 and the final batch of
+    epoch 0 — 48 samples / batch 8 = 6 steps/epoch, so step 5 is an epoch
+    boundary corner and step 11 ends epoch 1): kill, resume, finish; final
+    params AND optimizer accumulators must match an uninterrupted run
+    bitwise."""
+    epochs = 3
+    want = _uninterrupted_reference(epochs)
+
+    ckpt_dir = str(tmp_path / ("ck%d" % preempt_step))
+    killed = _fresh_model()
+    saver = CheckpointSaver(ckpt_dir, save_freq=1, max_keep=3)
+    preempter = fi.PreemptAtStep(preempt_step)
+    _fit(killed, epochs, callbacks=[preempter, saver])
+    assert preempter.fired and saver.preempted
+    assert CheckpointManager(ckpt_dir).latest_step() is not None
+
+    resumed = _fresh_model()
+    _fit(resumed, epochs, callbacks=[CheckpointSaver(ckpt_dir)],
+         resume_from=ckpt_dir)
+    _assert_bitwise_equal(want, _state_bytes(resumed))
+
+
+@pytest.mark.fault
+def test_resume_after_truncated_latest_checkpoint(tmp_path):
+    """Preempt twice; truncate the newest checkpoint. Resume must warn,
+    fall back to the previous good checkpoint, and still converge to the
+    bitwise-identical final state."""
+    epochs = 3
+    want = _uninterrupted_reference(epochs)
+
+    ckpt_dir = str(tmp_path / "ck")
+    killed = _fresh_model()
+    _fit(killed, epochs, callbacks=[fi.PreemptAtStep(8),
+                                    CheckpointSaver(ckpt_dir, save_freq=1)])
+    mgr = CheckpointManager(ckpt_dir)
+    steps = mgr.steps()
+    assert len(steps) >= 2   # epoch-end checkpoint + preemption checkpoint
+    fi.truncate_file(mgr._payload(steps[-1]), drop_bytes=11)
+
+    resumed = _fresh_model()
+    with pytest.warns(UserWarning, match="corrupt.*falling back"):
+        _fit(resumed, epochs, callbacks=[CheckpointSaver(ckpt_dir)],
+             resume_from=ckpt_dir)
+    _assert_bitwise_equal(want, _state_bytes(resumed))
+
+
+def test_resume_from_epoch_checkpoint_equivalence(tmp_path):
+    """Plain two-phase training (no kill): 2 epochs + resume for 2 more
+    equals 4 straight epochs, including AMP loss-scale restore."""
+    from paddle_tpu.amp import GradScaler
+    epochs = 4
+    ref = _fresh_model(scaler=GradScaler(init_loss_scaling=256.0))
+    _fit(ref, epochs)
+    want = _state_bytes(ref)
+
+    ckpt_dir = str(tmp_path / "ck")
+    first = _fresh_model(scaler=GradScaler(init_loss_scaling=256.0))
+    _fit(first, 2, callbacks=[CheckpointSaver(ckpt_dir, save_freq=1)])
+    second = _fresh_model(scaler=GradScaler(init_loss_scaling=256.0))
+    _fit(second, epochs, callbacks=[CheckpointSaver(ckpt_dir)],
+         resume_from=ckpt_dir)
+    _assert_bitwise_equal(want, _state_bytes(second))
+    assert second._scaler.get_loss_scaling() == \
+        ref._scaler.get_loss_scaling()
+
+
+def test_jit_resume_restores_optimizer_moments(tmp_path):
+    """prepare(jit=True): optimizer accumulators live in the functional
+    _jit_state — checkpoints must capture them and resume must seed the
+    rebuilt jit state from them (not fresh zeros)."""
+    def _jit_model():
+        model = _fresh_model()
+        model._use_jit = True
+        model._build_jit_step()
+        return model
+
+    epochs = 4
+    ref = _jit_model()
+    _fit(ref, epochs)
+    want = _state_bytes(ref)
+
+    ckpt_dir = str(tmp_path / "ck")
+    first = _jit_model()
+    _fit(first, 2, callbacks=[CheckpointSaver(ckpt_dir, save_freq=1)])
+    # the checkpoint must contain real accumulators, not just global_step
+    state, _ = CheckpointManager(ckpt_dir).load()
+    assert any('.' in k for k in state['opt']), sorted(state['opt'])
+
+    second = _jit_model()
+    _fit(second, epochs, callbacks=[CheckpointSaver(ckpt_dir)],
+         resume_from=ckpt_dir)
+    second._sync_jit_state()
+    ref._sync_jit_state()
+    _assert_bitwise_equal(want, _state_bytes(second))
+
+
+@pytest.mark.fault
+def test_jit_nan_limit_rolls_back_before_raising():
+    """jit path: when NanGuard raises NanStepError at the consecutive-skip
+    limit, the poisoned fused update must STILL be rolled back — otherwise
+    _sync_jit_state would write NaN params into the network."""
+    model = _fresh_model(nan_guard=NanGuard(max_consecutive_skips=1,
+                                            verbose=False))
+    model._use_jit = True
+    model._build_jit_step()
+    data = _ToyData()
+    model.train_batch([data.x[:8]], [data.y[:8]])   # clean step
+    model._sync_jit_state()
+    before = _state_bytes(model)
+    bad = np.full_like(data.x[:8], np.nan)
+    with pytest.raises(NanStepError):
+        model.train_batch([bad], [data.y[:8]])
+    model._sync_jit_state()
+    _assert_bitwise_equal(before, _state_bytes(model))
+
+
+@pytest.mark.fault
+def test_download_retries_mid_body_failures(tmp_path, monkeypatch):
+    """IncompleteRead (dropped connection mid-body) is transient and must be
+    retried even though it is not an OSError subclass."""
+    import http.client
+    import io as _io
+    from paddle_tpu.utils import download
+    monkeypatch.setattr(download, 'WEIGHTS_HOME', str(tmp_path))
+    monkeypatch.setenv('PADDLE_TPU_ALLOW_EGRESS', '1')
+    monkeypatch.setattr(retry_mod, '_sleep', lambda s: None)
+    opener = fi.flaky(lambda url, timeout=30.0: _io.BytesIO(b'ok'),
+                      fail_times=1,
+                      exc_factory=lambda n: http.client.IncompleteRead(b'x'))
+    monkeypatch.setattr(download, '_open_url', opener)
+    path = download.get_weights_path_from_url('https://example.invalid/y.bin')
+    assert opener.state['calls'] == 2
+    with open(path, 'rb') as f:
+        assert f.read() == b'ok'
+
+
+@pytest.mark.fault
+def test_sigterm_handler_uninstalled_after_training_exception(tmp_path):
+    """fit() must uninstall CheckpointSaver's SIGTERM handler even when
+    training dies (try/finally), or the process would ignore the
+    scheduler's next SIGTERM forever."""
+    prev = signal.getsignal(signal.SIGTERM)
+    model = _fresh_model(nan_guard=NanGuard(max_consecutive_skips=1,
+                                            verbose=False))
+    model._loss = fi.poison_loss(model._loss, at_steps=range(100))
+    with pytest.raises(NanStepError):
+        _fit(model, 1, callbacks=[CheckpointSaver(str(tmp_path / "ck"))])
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+@pytest.mark.fault
+def test_download_404_fails_fast_without_retry(tmp_path, monkeypatch):
+    import urllib.error
+    from paddle_tpu.utils import download
+    monkeypatch.setattr(download, 'WEIGHTS_HOME', str(tmp_path))
+    monkeypatch.setenv('PADDLE_TPU_ALLOW_EGRESS', '1')
+    calls = []
+
+    def opener(url, timeout=30.0):
+        calls.append(1)
+        raise urllib.error.HTTPError(url, 404, 'Not Found', {}, None)
+
+    monkeypatch.setattr(download, '_open_url', opener)
+    with pytest.raises(RuntimeError, match="HTTP 404.*not retrying"):
+        download.get_weights_path_from_url('https://example.invalid/x.bin')
+    assert len(calls) == 1   # permanent client errors are not retried
+
+
+@pytest.mark.fault
+def test_download_429_throttle_is_retried(tmp_path, monkeypatch):
+    """429 is the canonical transient backoff error (fleet stampede on one
+    weights URL) — it must go through retry, unlike 404."""
+    import io as _io
+    import urllib.error
+    from paddle_tpu.utils import download
+    monkeypatch.setattr(download, 'WEIGHTS_HOME', str(tmp_path))
+    monkeypatch.setenv('PADDLE_TPU_ALLOW_EGRESS', '1')
+    monkeypatch.setattr(retry_mod, '_sleep', lambda s: None)
+    opener = fi.flaky(
+        lambda url, timeout=30.0: _io.BytesIO(b'w'), fail_times=2,
+        exc_factory=lambda n: urllib.error.HTTPError(
+            'https://example.invalid/z.bin', 429, 'Too Many Requests',
+            {}, None))
+    monkeypatch.setattr(download, '_open_url', opener)
+    path = download.get_weights_path_from_url('https://example.invalid/z.bin')
+    assert opener.state['calls'] == 3
+    assert os.path.exists(path)
+
+
+def test_atomic_write_concurrent_same_destination(tmp_path):
+    """Two threads racing the same destination: the committed file is one
+    writer's COMPLETE payload, never interleaved bytes."""
+    import threading as th
+    path = str(tmp_path / "shared.bin")
+    payloads = [bytes([i]) * 100_000 for i in (1, 2)]
+    threads = [th.Thread(target=lambda p=p: paddle.resilience.atomic_write(
+        path, p)) for p in payloads]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with open(path, 'rb') as f:
+        data = f.read()
+    assert data in payloads
+    assert [f for f in os.listdir(tmp_path) if '.tmp.' in f] == []
+
+
+def test_resume_from_empty_dir_starts_fresh(tmp_path):
+    model = _fresh_model()
+    with pytest.warns(UserWarning, match="no loadable checkpoint"):
+        _fit(model, 1, resume_from=str(tmp_path / "nothing"))
+
+
+# -- rng snapshot round-trip --------------------------------------------------
+
+def test_rng_capture_restore_roundtrip():
+    paddle.seed(55)
+    np.random.seed(55)
+    snap = capture_rng()
+    a1 = paddle.rand([4]).numpy() if hasattr(paddle, 'rand') else None
+    n1 = np.random.rand(4)
+    restore_rng(snap)
+    a2 = paddle.rand([4]).numpy() if hasattr(paddle, 'rand') else None
+    n2 = np.random.rand(4)
+    if a1 is not None:
+        np.testing.assert_array_equal(a1, a2)
+    np.testing.assert_array_equal(n1, n2)
+
+
+# -- lint: bare wb writes on checkpoint paths (CI/tooling satellite) ---------
+
+def test_lint_atomic_writes_tree_is_clean():
+    import importlib.util
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools', 'lint_atomic_writes.py')
+    spec = importlib.util.spec_from_file_location('lint_atomic_writes', tools)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'paddle_tpu')
+    assert mod.run(pkg) == []
+
+
+def test_lint_atomic_writes_flags_violation(tmp_path):
+    import importlib.util
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools', 'lint_atomic_writes.py')
+    spec = importlib.util.spec_from_file_location('lint_atomic_writes', tools)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = tmp_path / "framework.py"
+    bad.write_text("def save(p):\n"
+                   "    with open(p, 'wb') as f:\n"
+                   "        f.write(b'x')\n")
+    ok = tmp_path / "jit"
+    ok.mkdir()
+    (ok / "io.py").write_text(
+        "def save(p):\n"
+        "    # atomic-ok: staged then renamed by caller\n"
+        "    with open(p, 'wb') as f:\n"
+        "        f.write(b'x')\n")
+    vio = mod.run(str(tmp_path))
+    assert len(vio) == 1 and 'framework.py:2' in vio[0]
