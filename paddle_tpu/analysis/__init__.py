@@ -1,0 +1,29 @@
+"""paddle_tpu.analysis — static analysis for TPU-native code.
+
+Two engines over one ``Finding`` type and one reporter pair:
+
+- **AST lint** (``graftlint``): rules GL001–GL010 catch host syncs in traced
+  code, retrace triggers, nondeterminism, leftover debug artifacts and
+  non-atomic checkpoint writes *before* they reach hardware. CLI:
+  ``python tools/graftlint.py`` or ``python -m paddle_tpu.analysis``.
+- **IR verifier**: checks GV001–GV008 validate a captured static-graph
+  Program (dangling inputs, duplicate names, dtype/shape drift, dead ops,
+  unfetchable targets). API: ``verify_program`` / ``Program.verify()`` /
+  ``Executor.run(..., verify=True)`` / ``PADDLE_TPU_VERIFY=1``.
+
+Rule catalog and waiver syntax: docs/ANALYSIS.md.
+"""
+from .finding import Finding, render_json, render_text
+from .rules import RULES, Rule, register, lint_paths, lint_source
+from .verify import (ProgramVerificationError, assert_verified,
+                     set_always_verify, verify_enabled, verify_program)
+from . import ast_rules  # noqa: F401  (registers the GL rule catalog)
+from .cli import main
+
+__all__ = [
+    'Finding', 'render_text', 'render_json',
+    'RULES', 'Rule', 'register', 'lint_paths', 'lint_source',
+    'verify_program', 'assert_verified', 'ProgramVerificationError',
+    'set_always_verify', 'verify_enabled',
+    'main',
+]

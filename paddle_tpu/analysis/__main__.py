@@ -1,0 +1,7 @@
+"""``python -m paddle_tpu.analysis`` — the graftlint CLI."""
+import sys
+
+from .cli import main
+
+if __name__ == '__main__':
+    sys.exit(main())

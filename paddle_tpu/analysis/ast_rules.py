@@ -1,0 +1,427 @@
+"""The graftlint AST rule catalog (GL001–GL010).
+
+Each rule targets a TPU failure mode that is invisible in unit tests on CPU
+but destroys performance or correctness on real hardware:
+
+- GL001–GL003: implicit host↔device syncs inside traced code. One stray
+  ``.numpy()`` under ``jit`` serializes the TPU pipeline on every step.
+- GL004–GL006: retrace triggers. Unhashable/mutable captures and Python
+  branching on traced values recompile the XLA program per call — the
+  "retrace storm" that turns a 2 ms step into a 2 s one.
+- GL007–GL008: nondeterminism in traced paths. Host entropy baked into a
+  trace breaks bitwise-exact resume (see resilience/) and run-to-run parity;
+  randomness must flow through ``paddle_tpu.core.rng`` keys.
+- GL009: leftover debug artifacts (``jax.debug.print``, ``breakpoint()``).
+- GL010: non-atomic checkpoint writes (absorbs tools/lint_atomic_writes.py).
+
+See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
+"""
+import ast
+
+from .rules import Rule, register
+
+_SHAPEY_ATTRS = {'shape', 'ndim', 'dtype'}   # static under tracing: never flag
+
+
+def _root_name(node):
+    """Leftmost Name of a Name/Attribute/Subscript/Call chain, else None."""
+    while True:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return None
+
+
+def _dotted(node):
+    """'np.random.rand'-style dotted string for Name/Attribute chains."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def _param_names(fn):
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(n for n in names if n != 'self')
+
+
+def _mentions_static_attr(node):
+    return any(isinstance(n, ast.Attribute) and n.attr in _SHAPEY_ATTRS
+               for n in ast.walk(node))
+
+
+def _expr_is_traced(expr, tainted):
+    """Heuristic: does ``expr`` produce a traced value? True when a tainted
+    name or a jnp/jax/lax array op appears outside static subtrees
+    (``.shape``/``.ndim``/``.dtype`` access, ``len()``/``range()`` calls)."""
+    stack = [expr]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Attribute) and n.attr in _SHAPEY_ATTRS:
+            continue                      # static under tracing
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and \
+                n.func.id in ('len', 'range', 'enumerate', 'zip',
+                              'isinstance', 'hasattr', 'getattr', 'type'):
+            continue
+        if isinstance(n, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+            continue                      # `x is not None` is a host bool
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+        if isinstance(n, ast.Call) and \
+                _root_name(n.func) in ('jnp', 'jax', 'lax'):
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+def _traced_values(fn, index):
+    """Names holding traced values inside a traced function: the parameters
+    plus locals assigned (to fixpoint) from expressions over them."""
+    tainted = set(_param_names(fn))
+    assigns = [n for n in index.walk_body(fn)
+               if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))]
+    changed = True
+    while changed:
+        changed = False
+        for a in assigns:
+            value = a.value
+            if value is None or not _expr_is_traced(value, tainted):
+                continue
+            targets = a.targets if isinstance(a, ast.Assign) else [a.target]
+            for tgt in targets:
+                for leaf in ast.walk(tgt):
+                    if isinstance(leaf, ast.Name) and leaf.id not in tainted:
+                        tainted.add(leaf.id)
+                        changed = True
+    return tainted
+
+
+@register
+class HostTransferRule(Rule):
+    """GL001: ``.numpy()`` / ``np.asarray`` / ``.tolist()`` inside traced
+    code — forces a device→host transfer and a pipeline stall per step."""
+    id = 'GL001'
+    title = 'host transfer in traced code'
+
+    def check(self, ctx):
+        for fn, node in ctx.traced_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted in ('np.asarray', 'np.array', 'numpy.asarray',
+                          'numpy.array', 'onp.asarray', 'onp.array'):
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}() inside traced code materializes the value "
+                    "on host every step — keep the computation in jnp, or "
+                    "move the conversion outside the traced function")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ('numpy', 'tolist') and not node.args:
+                yield self.finding(
+                    ctx, node,
+                    f".{node.func.attr}() inside traced code is an implicit "
+                    "device→host sync — fetch values outside the traced "
+                    "function (e.g. via Executor.run fetch_list)")
+
+
+@register
+class ScalarCastRule(Rule):
+    """GL002: ``float()``/``int()``/``bool()`` on a traced value — a hidden
+    blocking transfer (and a tracer error under jit)."""
+    id = 'GL002'
+    title = 'python scalar cast on traced value'
+
+    def check(self, ctx):
+        taint = {}
+        for fn, node in ctx.traced_nodes():
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Name) and
+                    node.func.id in ('float', 'int', 'bool') and
+                    len(node.args) == 1):
+                continue
+            arg = node.args[0]
+            if id(fn) not in taint:
+                taint[id(fn)] = _traced_values(fn, ctx.index)
+            if _root_name(arg) in taint[id(fn)] and \
+                    not _mentions_static_attr(arg):
+                yield self.finding(
+                    ctx, node,
+                    f"{node.func.id}() on traced value "
+                    f"'{_root_name(arg)}' blocks on a host readback (and "
+                    "fails under jit) — use jnp casts or compute on device")
+
+
+@register
+class ExplicitSyncRule(Rule):
+    """GL003: explicit ``jax.device_get`` / ``.block_until_ready()`` /
+    ``.item()`` inside traced code."""
+    id = 'GL003'
+    title = 'explicit device sync in traced code'
+
+    def check(self, ctx):
+        for fn, node in ctx.traced_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted in ('jax.device_get', 'device_get'):
+                yield self.finding(
+                    ctx, node,
+                    "jax.device_get inside traced code synchronizes the "
+                    "device every step — fetch after the traced call returns")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ('block_until_ready', 'item'):
+                yield self.finding(
+                    ctx, node,
+                    f".{node.func.attr}() inside traced code is an explicit "
+                    "sync point — move it outside the traced function")
+
+
+@register
+class MutableDefaultRule(Rule):
+    """GL004: mutable default argument on a traced function — a fresh
+    object identity per process, a stale capture across retraces."""
+    id = 'GL004'
+    title = 'mutable default arg on traced function'
+
+    def check(self, ctx):
+        for fn in ctx.index.traced_functions():
+            if isinstance(fn, ast.Lambda):
+                continue
+            defaults = list(fn.args.defaults) + \
+                [d for d in fn.args.kw_defaults if d is not None]
+            for d in defaults:
+                bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call) and
+                    isinstance(d.func, ast.Name) and
+                    d.func.id in ('list', 'dict', 'set'))
+                if bad:
+                    yield self.finding(
+                        ctx, d,
+                        f"traced function '{getattr(fn, 'name', '<lambda>')}'"
+                        " has a mutable default argument — the captured "
+                        "object is baked into the trace; use None + in-body "
+                        "default (or a tuple)")
+
+
+@register
+class UnhashableStaticArgRule(Rule):
+    """GL005: dict/list/set literal passed to a jit-wrapped callable — each
+    distinct object is a new static arg, i.e. a retrace per call."""
+    id = 'GL005'
+    title = 'unhashable container passed to jitted callable'
+
+    def check(self, ctx):
+        jitted = ctx.index.jit_wrapped_names()
+        if not jitted:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            name = callee.id if isinstance(callee, ast.Name) else (
+                callee.attr if isinstance(callee, ast.Attribute) else None)
+            if name not in jitted:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    kind = type(arg).__name__.lower()
+                    yield self.finding(
+                        ctx, arg,
+                        f"{kind} literal passed to jitted callable '{name}' "
+                        "— unhashable static args retrace on every call; "
+                        "pass a tuple / frozen config, or make it a traced "
+                        "array argument")
+
+
+@register
+class PythonBranchOnTracedRule(Rule):
+    """GL006: ``len(x)`` / ``bool(x)`` / bare-value Python branching on a
+    traced value — concretizes the tracer (error) or silently specializes
+    the trace per shape/value (retrace storm)."""
+    id = 'GL006'
+    title = 'python branching on traced value'
+
+    def check(self, ctx):
+        taint = {}
+        for fn, node in ctx.traced_nodes():
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                continue
+            if id(fn) not in taint:
+                taint[id(fn)] = _traced_values(fn, ctx.index)
+            tainted = taint[id(fn)]
+            for test in ast.walk(node.test):
+                bad = None
+                if isinstance(test, ast.Call) and \
+                        isinstance(test.func, ast.Name) and \
+                        test.func.id in ('len', 'bool') and test.args and \
+                        _root_name(test.args[0]) in tainted and \
+                        not _mentions_static_attr(test.args[0]):
+                    bad = f"{test.func.id}({_root_name(test.args[0])})"
+                elif isinstance(test, ast.Name) and test.id in tainted and \
+                        isinstance(node.test, ast.Name):
+                    bad = test.id
+                if bad:
+                    yield self.finding(
+                        ctx, node,
+                        f"Python branch on traced value '{bad}' — under jit "
+                        "this either concretizes (TracerBoolConversionError) "
+                        "or specializes the trace per value; use jnp.where / "
+                        "lax.cond, or hoist the decision out of the traced "
+                        "function")
+                    break
+
+
+@register
+class WallClockRule(Rule):
+    """GL007: wall-clock reads inside traced code — the value is frozen at
+    trace time, so every later call sees the first call's timestamp."""
+    id = 'GL007'
+    title = 'wall clock in traced code'
+
+    def check(self, ctx):
+        for fn, node in ctx.traced_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted in ('time.time', 'time.perf_counter', 'time.monotonic',
+                          'time.time_ns', 'time.process_time'):
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}() inside traced code is evaluated once at "
+                    "trace time and baked into the XLA program — time on "
+                    "the host, outside the traced function")
+
+
+@register
+class HostEntropyRule(Rule):
+    """GL008: ``random.*`` / ``np.random.*`` inside traced code — host
+    entropy baked into the trace breaks determinism and resume parity."""
+    id = 'GL008'
+    title = 'host RNG in traced code'
+
+    def check(self, ctx):
+        for fn, node in ctx.traced_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ''
+            if dotted.startswith(('np.random.', 'numpy.random.',
+                                  'random.')):
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}() inside traced code bakes host entropy into "
+                    "the trace (same 'random' numbers every step, and "
+                    "resume/replica divergence) — thread a key through "
+                    "paddle_tpu.core.rng instead")
+
+
+@register
+class DebugArtifactRule(Rule):
+    """GL009: leftover debug artifacts in library code."""
+    id = 'GL009'
+    title = 'leftover debug artifact'
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ''
+            if dotted in ('jax.debug.print', 'jax.debug.breakpoint'):
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted} left in library code — it host-syncs every "
+                    "step; remove it or route through the Print op / a "
+                    "logging flag")
+            elif dotted == 'breakpoint' or dotted.endswith('.set_trace'):
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}() left in library code — interactive "
+                    "debugger call must not ship")
+
+
+# -- GL010: non-atomic checkpoint writes (absorbed tools/lint_atomic_writes) -
+
+# Modules that persist state a reader would later trust. Dataset caches and
+# bench scratch files are out of scope: a torn cache re-downloads, a torn
+# checkpoint loses a run.
+CHECKPOINT_SCOPE = (
+    'framework.py',
+    'static/io.py',
+    'static/fluid_format.py',
+    'fluid/io.py',
+    'jit/',
+    'hapi/',
+    'incubate/checkpoint.py',
+    'inference/',
+    'slim/',
+    'resilience/',
+    # spawn IPC: workers/parent trust these pickles across process
+    # boundaries — a torn payload is a spurious rank failure (added when
+    # GL010 absorbed tools/lint_atomic_writes.py; the old lint missed it)
+    'distributed/launch.py',
+)
+
+WRITE_MODES = {'wb', 'wb+', 'w+b', 'bw', 'ab', 'ab+', 'a+b'}
+
+
+def _open_mode(call):
+    """The literal mode of an open() call, or None when not literal."""
+    if len(call.args) >= 2:
+        arg = call.args[1]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        return None
+    for kw in call.keywords:
+        if kw.arg == 'mode' and isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str):
+            return kw.value.value
+    return 'r'
+
+
+@register
+class AtomicWriteRule(Rule):
+    """GL010: bare ``open(path, 'wb')`` on a checkpoint path — a crash
+    mid-write tears a file a later load would trust; every persisted byte
+    must go through ``resilience.atomic_io``."""
+    id = 'GL010'
+    title = 'non-atomic checkpoint write'
+
+    def in_scope(self, rel):
+        for prefix in ('paddle_tpu/', ''):
+            if not rel.startswith(prefix):
+                continue
+            sub = rel[len(prefix):]
+            if any(sub == p or (p.endswith('/') and sub.startswith(p))
+                   for p in CHECKPOINT_SCOPE):
+                return True
+        return False
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Name) and
+                    node.func.id == 'open'):
+                continue
+            mode = _open_mode(node)
+            if mode is None or mode not in WRITE_MODES:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"bare open(..., '{mode}') on a checkpoint path — route the "
+                "write through resilience.atomic_io (or annotate the line "
+                "with '# atomic-ok: <why>' if it is staged-then-renamed)")

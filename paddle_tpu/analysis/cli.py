@@ -1,0 +1,104 @@
+"""graftlint CLI — one entry point for both engines.
+
+Spellings (all equivalent)::
+
+    python tools/graftlint.py [paths...]
+    python -m paddle_tpu.analysis [paths...]
+
+Exit codes: 0 clean (waived findings and nothing else), 1 non-waived
+findings, 2 usage/config error. ``--json`` emits the machine format CI
+diffs; humans get one line per finding plus a tally.
+"""
+import argparse
+import os
+import sys
+
+from . import ast_rules  # noqa: F401  (registers GL001..GL010)
+from .config import ConfigError, find_config, load_config
+from .finding import active, render_json, render_text
+from .rules import RULES, lint_paths
+
+
+def _default_target():
+    """paddle_tpu package dir relative to this file — lint the library when
+    invoked bare."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog='graftlint',
+        description='TPU anti-pattern linter for paddle_tpu '
+                    '(rule catalog: docs/ANALYSIS.md)')
+    p.add_argument('paths', nargs='*', help='files or trees to lint '
+                   '(default: the paddle_tpu package)')
+    p.add_argument('--json', action='store_true',
+                   help='emit the JSON report instead of text')
+    p.add_argument('--list-rules', action='store_true',
+                   help='print the rule catalog and exit')
+    p.add_argument('--select', default='',
+                   help='comma-separated rule ids to run (default: all)')
+    p.add_argument('--config', default=None,
+                   help='explicit graftlint.toml (default: nearest one '
+                        'above the first path)')
+    p.add_argument('--no-config', action='store_true',
+                   help='ignore any graftlint.toml')
+    p.add_argument('--show-waived', action='store_true',
+                   help='include waived findings in the text report')
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(RULES.items()):
+            print(f"{rid}  [{rule.severity:7s}]  {rule.title}")
+        return 0
+
+    paths = args.paths or [_default_target()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+        if os.path.isfile(p) and not p.endswith('.py'):
+            # a target that would silently lint nothing is a usage error,
+            # not a clean run
+            print(f"graftlint: not a Python file or directory: {p}",
+                  file=sys.stderr)
+            return 2
+
+    config = None
+    if not args.no_config:
+        cfg_path = args.config or find_config(paths[0])
+        if args.config and not os.path.isfile(args.config):
+            print(f"graftlint: no such config: {args.config}",
+                  file=sys.stderr)
+            return 2
+        if cfg_path:
+            try:
+                config = load_config(cfg_path)
+            except ConfigError as e:
+                print(f"graftlint: {e}", file=sys.stderr)
+                return 2
+
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(',') if s.strip()}
+        unknown = select - set(RULES)
+        if unknown:
+            print(f"graftlint: unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    findings, n_files = lint_paths(paths, config=config, select=select)
+    if args.json:
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_waived=args.show_waived))
+        print(f"graftlint: scanned {n_files} file(s)")
+    return 1 if active(findings) else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,214 @@
+"""graftlint.toml loading + waiver application.
+
+The container pins Python 3.10 (no tomllib) and nothing may be pip-installed,
+so this ships a deliberately tiny TOML-subset reader covering exactly what a
+lint config needs: ``[table]`` / ``[[array-of-tables]]`` headers, string and
+list-of-string values, and ``#`` comments. Anything fancier in the file is a
+config error, reported as such.
+
+Config schema::
+
+    [graftlint]
+    exclude = ["paddle_tpu/version.py"]   # fnmatch globs, config-root relative
+
+    [[graftlint.waiver]]
+    rule = "GL009"
+    path = "paddle_tpu/fluid/control_flow.py"   # fnmatch glob
+    reason = "Print op is the sanctioned debug facility"
+
+Inline waivers use ``# graftlint: disable=GL001[,GL002]`` (or bare
+``disable`` for every rule) on the offending line or the line above. GL010
+additionally honors the legacy ``# atomic-ok: <why>`` spelling so existing
+annotations keep working.
+"""
+import fnmatch
+import os
+import re
+
+CONFIG_NAME = 'graftlint.toml'
+
+# `# graftlint: disable` (bare word => blanket) or `disable=GL001[,GV002]`.
+# Strict on purpose: 'disabled' is not a waiver, and a malformed rule list
+# ('disable=gl0x6') waives NOTHING rather than everything — a typo must
+# fail loudly (the finding stays active), never silently widen the waiver.
+_INLINE_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?![A-Za-z])(?P<eq>\s*=\s*)?"
+    r"(?P<rules>[A-Za-z]{2}\d{3}(?:\s*,\s*[A-Za-z]{2}\d{3})*)?")
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _parse_value(raw, where):
+    raw = raw.strip()
+    if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+        return raw[1:-1]
+    if raw.startswith('['):
+        if not raw.endswith(']'):
+            raise ConfigError(f"{where}: multi-line arrays not supported")
+        items = [s.strip() for s in raw[1:-1].split(',') if s.strip()]
+        return [_parse_value(s, where) for s in items]
+    if raw in ('true', 'false'):
+        return raw == 'true'
+    raise ConfigError(f"{where}: unsupported value {raw!r} "
+                      "(strings and string lists only)")
+
+
+def _strip_comment(line):
+    # no escapes in our subset: a # outside quotes starts a comment
+    out, in_str = [], False
+    for ch in line:
+        if ch == '"':
+            in_str = not in_str
+        if ch == '#' and not in_str:
+            break
+        out.append(ch)
+    return ''.join(out)
+
+
+def parse_toml_min(text, name='graftlint.toml'):
+    """Parse the supported TOML subset into nested dicts/lists."""
+    root, cur = {}, None
+    cur = root
+    for i, raw in enumerate(text.splitlines(), 1):
+        line = _strip_comment(raw).strip()
+        if not line:
+            continue
+        where = f"{name}:{i}"
+        if line.startswith('[['):
+            if not line.endswith(']]'):
+                raise ConfigError(f"{where}: bad table header")
+            parts = line[2:-2].strip().split('.')
+            node = root
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            arr = node.setdefault(parts[-1], [])
+            if not isinstance(arr, list):
+                raise ConfigError(f"{where}: {parts[-1]} is not a table array")
+            cur = {}
+            arr.append(cur)
+        elif line.startswith('['):
+            if not line.endswith(']'):
+                raise ConfigError(f"{where}: bad table header")
+            parts = line[1:-1].strip().split('.')
+            node = root
+            for p in parts:
+                nxt = node.setdefault(p, {})
+                if not isinstance(nxt, dict):
+                    raise ConfigError(f"{where}: {p} is not a table")
+                node = nxt
+            cur = node
+        elif '=' in line:
+            key, _, raw_val = line.partition('=')
+            cur[key.strip()] = _parse_value(raw_val, where)
+        else:
+            raise ConfigError(f"{where}: cannot parse {line!r}")
+    return root
+
+
+class Config:
+    """Resolved lint config: exclusion globs + file-level waivers."""
+
+    def __init__(self, root='.', exclude=(), waivers=()):
+        self.root = os.path.abspath(root)
+        self.exclude = list(exclude)
+        self.waivers = list(waivers)   # dicts: rule, path, reason
+
+    def _rel(self, path):
+        return os.path.relpath(os.path.abspath(path),
+                               self.root).replace(os.sep, '/')
+
+    def is_excluded(self, path):
+        rel = self._rel(path)
+        return any(fnmatch.fnmatch(rel, pat) for pat in self.exclude)
+
+    def waiver_for(self, rule, path):
+        """The matching [[graftlint.waiver]] reason, or None."""
+        rel = self._rel(path)
+        for w in self.waivers:
+            if w.get('rule') not in (rule, '*', None, ''):
+                continue
+            if fnmatch.fnmatch(rel, w.get('path', '*')):
+                return w.get('reason') or 'graftlint.toml'
+        return None
+
+
+def find_config(start):
+    """Nearest graftlint.toml walking up from ``start`` (file or dir)."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        cand = os.path.join(d, CONFIG_NAME)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def load_config(path):
+    """Load a graftlint.toml into a Config rooted at its directory."""
+    with open(path, 'r', encoding='utf-8') as f:
+        data = parse_toml_min(f.read(), name=os.path.basename(path))
+    sec = data.get('graftlint', {})
+    waivers = sec.get('waiver', [])
+    for w in waivers:
+        if 'reason' not in w or not w['reason']:
+            raise ConfigError(
+                f"{CONFIG_NAME}: waiver for {w.get('rule')}/{w.get('path')} "
+                "needs a reason = \"...\" justification")
+    return Config(root=os.path.dirname(os.path.abspath(path)),
+                  exclude=sec.get('exclude', []), waivers=waivers)
+
+
+def inline_disables(lines, lineno):
+    """Rule IDs disabled at ``lineno`` (1-based) by an inline comment on the
+    line itself or anywhere in the contiguous comment block directly above
+    it (so a justification may wrap over several comment lines). Returns
+    (set_of_ids, all_flag)."""
+    candidates = []
+    if 1 <= lineno <= len(lines):
+        candidates.append(lines[lineno - 1])
+    i = lineno - 2
+    while i >= 0 and lines[i].lstrip().startswith('#'):
+        candidates.append(lines[i])
+        i -= 1
+    ids, blanket = set(), False
+    for ln in candidates:
+        m = _INLINE_RE.search(ln)
+        if not m:
+            continue
+        if m.group('rules'):
+            ids.update(r.strip().upper() for r in m.group('rules').split(',')
+                       if r.strip())
+        elif not m.group('eq'):
+            blanket = True
+        # `disable=` with an unparseable rule list: waive nothing
+    return ids, blanket
+
+
+def apply_waivers(findings, lines_by_path, config=None):
+    """Mark findings waived per inline comments and the repo config."""
+    for f in findings:
+        lines = lines_by_path.get(f.path)
+        if lines is not None and f.line:
+            ids, blanket = inline_disables(lines, f.line)
+            if blanket or f.rule in ids:
+                f.waived = True
+                f.waive_reason = 'inline disable'
+                continue
+            if f.rule == 'GL010':
+                near = lines[max(0, f.line - 2):f.line]
+                if any('atomic-ok' in ln for ln in near):
+                    f.waived = True
+                    f.waive_reason = 'atomic-ok annotation'
+                    continue
+        if config is not None and f.path != '<program>':
+            reason = config.waiver_for(f.rule, f.path)
+            if reason is not None:
+                f.waived = True
+                f.waive_reason = reason
+    return findings

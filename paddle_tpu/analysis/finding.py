@@ -1,0 +1,76 @@
+"""Finding: the one result type both graftlint engines emit.
+
+The AST linter (per-file rules GL0xx) and the Program verifier (per-IR
+checks GV0xx) produce the same dataclass, so the text and JSON reporters —
+and therefore CI and humans — consume one format. ``path``/``line`` point at
+source for AST findings and at ``<program>`` (with op index in the message)
+for IR findings.
+"""
+import dataclasses
+import json
+
+SEVERITIES = ('error', 'warning')
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str                   # 'GL001' .. / 'GV001' ..
+    message: str
+    path: str = '<program>'     # source file, or '<program>' for IR findings
+    line: int = 0               # 1-based; 0 = whole-file / whole-program
+    col: int = 0                # 0-based column, AST findings only
+    severity: str = 'error'     # one of SEVERITIES
+    source: str = 'ast'         # 'ast' | 'ir'
+    waived: bool = False        # suppressed by inline comment or graftlint.toml
+    waive_reason: str = ''
+
+    @property
+    def location(self):
+        if self.line:
+            return f"{self.path}:{self.line}"
+        return self.path
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    def render(self):
+        tag = f" [waived: {self.waive_reason or 'inline'}]" if self.waived else ''
+        return (f"{self.location}: {self.rule} {self.severity}: "
+                f"{self.message}{tag}")
+
+
+def active(findings):
+    """Findings that count against the exit code / verification."""
+    return [f for f in findings if not f.waived]
+
+
+def errors(findings):
+    return [f for f in findings if not f.waived and f.severity == 'error']
+
+
+def render_text(findings, show_waived=False):
+    """Human report: one line per finding, sorted by location, plus a tally."""
+    shown = [f for f in findings if show_waived or not f.waived]
+    shown.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    lines = [f.render() for f in shown]
+    n_err = len(errors(findings))
+    n_warn = len(active(findings)) - n_err
+    n_waived = len(findings) - len(active(findings))
+    tally = f"graftlint: {n_err} error(s), {n_warn} warning(s)"
+    if n_waived:
+        tally += f", {n_waived} waived"
+    lines.append(tally)
+    return '\n'.join(lines)
+
+
+def render_json(findings, show_waived=True):
+    """Machine report: stable JSON object CI can diff/parse."""
+    shown = [f for f in findings if show_waived or not f.waived]
+    shown.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return json.dumps({
+        'version': 1,
+        'errors': len(errors(findings)),
+        'warnings': len(active(findings)) - len(errors(findings)),
+        'waived': len(findings) - len(active(findings)),
+        'findings': [f.to_dict() for f in shown],
+    }, indent=2, sort_keys=True)

@@ -1,0 +1,121 @@
+"""Rule framework for the AST engine: registry, contexts, the lint driver.
+
+A rule is a class with a ``GLxxx`` id that inspects one parsed module and
+yields Findings. Registration is by decorator so adding a rule is one file
+edit; the CLI's ``--list-rules`` and docs/ANALYSIS.md catalog both read the
+registry. Waivers (inline ``# graftlint: disable=GLxxx`` and the repo-level
+``graftlint.toml``) are applied centrally here, after rules run, so rule code
+never needs waiver logic.
+"""
+import ast
+import os
+
+from .config import apply_waivers
+from .finding import Finding
+from .traced import TracedIndex
+
+RULES = {}
+
+
+def register(cls):
+    """Class decorator: add a Rule subclass to the global registry."""
+    if not getattr(cls, 'id', None):
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in RULES:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    RULES[cls.id] = cls()
+    return cls
+
+
+class Rule:
+    """Base rule. Subclasses set ``id``/``title``/``severity`` and implement
+    ``check(ctx)`` yielding Findings (use ``ctx.finding`` for brevity)."""
+    id = None
+    title = ''
+    severity = 'error'
+
+    def check(self, ctx):
+        raise NotImplementedError
+
+    def finding(self, ctx, node, message):
+        return Finding(rule=self.id, message=message, path=ctx.path,
+                       line=getattr(node, 'lineno', 0),
+                       col=getattr(node, 'col_offset', 0),
+                       severity=self.severity, source='ast')
+
+
+class ModuleContext:
+    """Everything a rule may inspect about one module, parsed once."""
+
+    def __init__(self, path, source, scan_root=None):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.index = TracedIndex(self.tree)
+        self.scan_root = scan_root or os.path.dirname(os.path.abspath(path))
+
+    @property
+    def rel_path(self):
+        rel = os.path.relpath(os.path.abspath(self.path),
+                              self.scan_root).replace(os.sep, '/')
+        return rel
+
+    def traced_nodes(self):
+        """(fn, node) pairs for every node in a traced function body."""
+        for fn in self.index.traced_functions():
+            for node in self.index.walk_body(fn):
+                yield fn, node
+
+
+def lint_source(path, source, scan_root=None, select=None):
+    """Run every registered rule over one module's source."""
+    try:
+        ctx = ModuleContext(path, source, scan_root=scan_root)
+    except SyntaxError as e:
+        return [Finding(rule='GL000', severity='error', source='ast',
+                        path=path, line=e.lineno or 0,
+                        message=f"unparseable module: {e.msg}")]
+    out = []
+    for rule_id, rule in sorted(RULES.items()):
+        if select and rule_id not in select:
+            continue
+        out.extend(rule.check(ctx))
+    return out
+
+
+def lint_paths(paths, config=None, select=None, scan_root=None):
+    """Lint files/trees. Returns (findings, n_files_scanned).
+
+    Each file's scope root (which path-scoped rules like GL010 match
+    against) is, in order: explicit ``scan_root``, the config's root, or
+    the parent of the path argument the file came from — so
+    ``lint_paths(['…/paddle_tpu'])`` sees ``paddle_tpu/…``-relative paths
+    even with no graftlint.toml in sight.
+    """
+    files = []     # (file, scope_root)
+    for p in paths:
+        root = scan_root or (config.root if config is not None
+                             else os.path.dirname(os.path.abspath(p)))
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in ('__pycache__', '.git'))
+                files.extend((os.path.join(dirpath, n), root)
+                             for n in sorted(filenames) if n.endswith('.py'))
+        elif p.endswith('.py'):
+            files.append((p, root))
+    findings, lines_by_path = [], {}
+    n = 0
+    for path, root in files:
+        if config is not None and config.is_excluded(path):
+            continue
+        with open(path, 'r', encoding='utf-8') as f:
+            source = f.read()
+        n += 1
+        file_findings = lint_source(path, source, scan_root=root,
+                                    select=select)
+        lines_by_path[path] = source.splitlines()
+        findings.extend(file_findings)
+    apply_waivers(findings, lines_by_path, config=config)
+    return findings, n

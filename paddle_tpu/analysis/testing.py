@@ -1,0 +1,141 @@
+"""Seeded malformed-Program constructors for verifier tests.
+
+Same philosophy as ``resilience.faultinject``: produce exactly the
+malformations the verifier defends against, deterministically, on CPU.
+Each constructor builds a small *valid* Program by hand (no tracing, no op
+library — just Program/Block/Variable/Operator) and then applies one seeded
+corruption, so a test can assert "this program yields exactly GVxxx".
+
+>>> prog, expect = malform('dangling_input', seed=3)
+>>> {f.rule for f in prog.verify() if f.severity == 'error'} == {expect}
+True
+
+(Error kinds trip exactly their rule at error severity; the corruption may
+additionally surface benign GV006/GV007 warnings — e.g. a dangling-input op
+chain is also dead code.)
+"""
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..static.graph import Block, Program, Variable, Operator
+
+#: every corruption kind -> the single error/warning rule it must trip
+KINDS = {
+    'dangling_input': 'GV001',
+    'duplicate_var': 'GV002',
+    'dtype_mismatch': 'GV003',
+    'shape_mismatch': 'GV004',
+    'undeclared_output': 'GV005',
+    'dead_op': 'GV006',
+    'unused_var': 'GV007',
+    'bad_fetch': 'GV008',
+}
+
+
+def _mkvar(block, name, shape=(2, 3), dtype=np.float32, concrete=None,
+           is_data=False):
+    v = Variable(jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype)),
+                 name=name, is_data=is_data)
+    if concrete is not None:
+        v.concrete = concrete
+    block.vars[v.name] = v
+    return v
+
+
+def _append_op(block, fn, inputs, outputs, type='jax_op'):
+    op = Operator(fn, inputs, outputs, type=type)
+    for ov in outputs:
+        ov.op = op
+    block.ops.append(op)
+    return op
+
+
+def well_formed_program(seed=0, n_ops=3):
+    """A small valid chain: data x -> relu -> scale -> sum. Deterministic in
+    ``seed`` (names and shapes vary, structure does not)."""
+    rng = random.Random(seed)
+    shape = (rng.randrange(2, 5), rng.randrange(2, 5))
+    prog = Program()
+    block = prog.global_block
+    x = _mkvar(block, f"x_{seed}", shape=shape, is_data=True)
+    cur = x
+    fns = [jnp.abs, jnp.exp, jnp.tanh, jnp.square]
+    for i in range(max(1, n_ops - 1)):
+        out = _mkvar(block, f"t{i}_{seed}", shape=shape)
+        _append_op(block, fns[(seed + i) % len(fns)], [cur], [out],
+                   type=f"unary{i}")
+        cur = out
+    final = _mkvar(block, f"out_{seed}", shape=())
+    _append_op(block, jnp.sum, [cur], [final], type='sum')
+    return prog, final
+
+
+def malform(kind, seed=0):
+    """Build a Program with exactly one seeded malformation.
+
+    Returns ``(program, expected_rule_id)`` — except ``bad_fetch``, which
+    returns ``(program, fetch_list, expected_rule_id)`` since GV008 needs a
+    fetch set to check against.
+    """
+    if kind not in KINDS:
+        raise ValueError(f"unknown malformation {kind!r}; "
+                         f"one of {sorted(KINDS)}")
+    rng = random.Random(seed)
+    prog, final = well_formed_program(seed=seed)
+    block = prog.global_block
+    expect = KINDS[kind]
+
+    if kind == 'dangling_input':
+        # an op reads a var nothing produced, fed, or backed concretely
+        ghost = Variable(jax.ShapeDtypeStruct((2,), np.float32),
+                         name=f"ghost_{seed}")
+        block.vars[ghost.name] = ghost
+        out = _mkvar(block, f"dang_out_{seed}", shape=(2,))
+        _append_op(block, jnp.abs, [ghost], [out], type='reads_ghost')
+        _append_op(block, jnp.sum, [out],
+                   [_mkvar(block, f"dang_sum_{seed}", shape=())],
+                   type='sum2')
+    elif kind == 'duplicate_var':
+        # a second, distinct Variable re-registered under an existing name
+        victim = rng.choice(sorted(v for v in block.vars
+                                   if v.startswith('t')))
+        dup = Variable(jax.ShapeDtypeStruct((7,), np.float32), name=victim,
+                       is_data=True)
+        extra_block = Block(prog, 1)
+        extra_block.vars[victim] = dup
+        prog.blocks.append(extra_block)
+    elif kind == 'dtype_mismatch':
+        # op's recorded output disagrees with the declared var's dtype
+        op = block.ops[0]
+        recorded = op.outputs[0]
+        block.vars[recorded.name] = Variable(
+            jax.ShapeDtypeStruct(tuple(recorded._value.shape), np.int32),
+            name=recorded.name)
+    elif kind == 'shape_mismatch':
+        op = block.ops[0]
+        recorded = op.outputs[0]
+        wrong = tuple(s + rng.randrange(1, 3)
+                      for s in recorded._value.shape)
+        block.vars[recorded.name] = Variable(
+            jax.ShapeDtypeStruct(wrong, recorded._value.dtype),
+            name=recorded.name)
+    elif kind == 'undeclared_output':
+        # op output never registered in Block.vars
+        op = block.ops[0]
+        del block.vars[op.outputs[0].name]
+    elif kind == 'dead_op':
+        # interior op whose result nothing reads or fetches
+        orphan = _mkvar(block, f"orphan_{seed}", shape=(3,))
+        dead = Operator(jnp.cos, [block.vars[f"x_{seed}"]], [orphan],
+                        type='dead_cos')
+        orphan.op = dead
+        block.ops.insert(1, dead)
+    elif kind == 'unused_var':
+        # created, never written, never read
+        _mkvar(block, f"limbo_{seed}", shape=(4,))
+    elif kind == 'bad_fetch':
+        return prog, [f"no_such_var_{seed}"], expect
+    return prog, expect

@@ -1,0 +1,176 @@
+"""Traced-context inference: which functions in a module run under a tracer.
+
+TPU anti-patterns (host syncs, retrace triggers, nondeterminism) are only
+bugs *inside traced code* — the same ``np.asarray`` that is free in a data
+loader is a device→host round-trip inside ``jax.jit``. This module answers
+"is this AST node inside code that JAX will trace?" statically:
+
+- a function is traced if it is decorated with (or wrapped by) ``jit`` /
+  ``pmap`` / ``vmap`` / ``grad`` / ``value_and_grad`` / ``to_static`` /
+  ``declarative`` / ``eval_shape`` / ``remat`` / ``checkpoint`` — including
+  the ``functools.partial(jax.jit, ...)`` decorator spelling — or passed as
+  the function argument of ``lax.scan`` / ``while_loop`` / ``cond`` /
+  ``fori_loop``;
+- traced-ness is transitive over same-module calls (a helper called from a
+  traced body is traced) and lexical nesting (an inner def of a traced
+  function is traced);
+- functions handed to ``jax.debug.callback`` / ``pure_callback`` /
+  ``io_callback`` run on the *host* — they are the sanctioned escape hatch
+  and override traced-ness.
+
+This is a linter, not a type checker: resolution is by dotted-name tail
+within one module, which is exactly the idiom this codebase (and JAX code
+generally) uses.
+"""
+import ast
+
+TRACERS = {
+    'jit', 'pmap', 'vmap', 'grad', 'value_and_grad', 'eval_shape',
+    'to_static', 'declarative', 'remat', 'checkpoint',
+    'scan', 'while_loop', 'cond', 'fori_loop', 'switch',
+    'custom_vjp', 'custom_jvp',
+}
+HOST_CALLBACKS = {'callback', 'pure_callback', 'io_callback',
+                  'host_callback', 'debug_callback'}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _tail(node):
+    """Last dotted component of a Name/Attribute callee, else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_tracer_expr(node):
+    """True for ``jit`` / ``jax.jit`` / ``functools.partial(jax.jit, ...)`` /
+    ``jit(...)``-style decorator or wrapper expressions."""
+    if _tail(node) in TRACERS:
+        return True
+    if isinstance(node, ast.Call):
+        if _tail(node.func) in TRACERS:
+            return True
+        if _tail(node.func) == 'partial' and node.args and \
+                _is_tracer_expr(node.args[0]):
+            return True
+    return False
+
+
+class TracedIndex:
+    """Per-module map from function nodes to traced / host classification."""
+
+    def __init__(self, tree):
+        self.tree = tree
+        self._parents = {}
+        self._funcs = []          # all FunctionDef/Lambda nodes, document order
+        self._by_name = {}        # name -> [FunctionDef nodes]
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        for node in ast.walk(tree):
+            if isinstance(node, _FUNC_NODES):
+                self._funcs.append(node)
+                name = getattr(node, 'name', None)
+                if name:
+                    self._by_name.setdefault(name, []).append(node)
+        self.traced = set()
+        self.host = set()
+        self._classify()
+
+    # -- classification ------------------------------------------------------
+    def _classify(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_tracer_expr(d) for d in node.decorator_list):
+                    self.traced.add(node)
+            if isinstance(node, ast.Call):
+                callee_tail = _tail(node.func)
+                targets = self._func_args(node)
+                if callee_tail in HOST_CALLBACKS:
+                    self.host.update(targets)
+                elif _is_tracer_expr(node.func) or callee_tail in TRACERS:
+                    self.traced.update(targets)
+        self._propagate()
+
+    def _func_args(self, call):
+        """Function defs referenced by a call's positional args (by name or
+        as an inline lambda/def)."""
+        out = []
+        for arg in call.args:
+            if isinstance(arg, ast.Lambda):
+                out.append(arg)
+            elif isinstance(arg, ast.Name):
+                out.extend(self._by_name.get(arg.id, ()))
+            elif isinstance(arg, ast.Attribute):
+                # jax.jit(self._forward): match method defs by attr name
+                out.extend(self._by_name.get(arg.attr, ()))
+        return out
+
+    def _propagate(self):
+        """Fixpoint: traced-ness flows into nested defs and callees."""
+        changed = True
+        while changed:
+            changed = False
+            for fn in self._funcs:
+                if fn in self.traced or fn in self.host:
+                    continue
+                parent = self.enclosing_function(fn)
+                if parent is not None and parent in self.traced:
+                    self.traced.add(fn)
+                    changed = True
+            for fn in list(self.traced):
+                for node in self.walk_body(fn):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Name):
+                        for callee in self._by_name.get(node.func.id, ()):
+                            if callee not in self.traced and \
+                                    callee not in self.host:
+                                self.traced.add(callee)
+                                changed = True
+        self.traced -= self.host
+
+    # -- queries -------------------------------------------------------------
+    def enclosing_function(self, node):
+        cur = self._parents.get(node)
+        while cur is not None and not isinstance(cur, _FUNC_NODES):
+            cur = self._parents.get(cur)
+        return cur
+
+    def walk_body(self, fn):
+        """All nodes lexically inside ``fn``, excluding nested defs' bodies
+        (nested defs are classified and walked on their own)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNC_NODES):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def traced_functions(self):
+        return [fn for fn in self._funcs if fn in self.traced]
+
+    def jit_wrapped_names(self):
+        """Local names bound to jit/pmap-wrapped callables, e.g.
+        ``step = jax.jit(f)`` — calling them with unhashable containers is a
+        retrace trigger (rule GL005)."""
+        def _is_jit(callee):
+            if _tail(callee) in ('jit', 'pmap'):
+                return True
+            return (isinstance(callee, ast.Call) and
+                    _tail(callee.func) == 'partial' and callee.args and
+                    _tail(callee.args[0]) in ('jit', 'pmap'))
+
+        names = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _is_jit(node.value.func):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            names.add(tgt.id)
+                        elif isinstance(tgt, ast.Attribute):
+                            names.add(tgt.attr)
+        return names

@@ -1,0 +1,256 @@
+"""Static-graph Program verifier (GV001–GV008).
+
+A captured Program is a topological op list over named Variables; every
+malformation in that list — a dangling input, a duplicate name, an output
+whose declared var disagrees on dtype/shape — otherwise surfaces only deep
+inside ``Executor.run`` as a KeyError or a silently-skipped op. The verifier
+finds them *before* compilation with actionable, op-indexed messages.
+
+API::
+
+    from paddle_tpu.analysis import verify_program
+    findings = verify_program(program)            # list[Finding]
+    findings = verify_program(program, fetch_list=[loss])   # + GV008
+
+    program.verify()                              # same, as a method
+    exe.run(program, ..., verify=True)            # verify-then-run
+    PADDLE_TPU_VERIFY=1                           # verify on every run
+
+Severities: structural errors (GV001–GV005, GV008) abort a verified run;
+dead-code findings (GV006–GV007) are warnings — fetch-pruning makes unused
+ops legal, just suspicious.
+"""
+import os
+
+import numpy as np
+
+from .finding import Finding, errors as _errors
+
+#: Module-level debug flag: ``set_always_verify(True)`` makes every
+#: ``Executor.run`` verify, same as ``PADDLE_TPU_VERIFY=1``.
+_ALWAYS_VERIFY = [False]
+
+
+def set_always_verify(flag):
+    """Toggle verify-before-every-run (the in-process spelling of
+    ``PADDLE_TPU_VERIFY=1``). Returns the previous value."""
+    old = _ALWAYS_VERIFY[0]
+    _ALWAYS_VERIFY[0] = bool(flag)
+    return old
+
+
+def verify_enabled(explicit=None):
+    """Resolve the effective verify switch for Executor.run."""
+    if explicit is not None:
+        return bool(explicit)
+    if _ALWAYS_VERIFY[0]:
+        return True
+    return os.environ.get('PADDLE_TPU_VERIFY', '').lower() not in (
+        '', '0', 'false', 'off')
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised by ``assert_verified`` when a Program has structural errors."""
+
+    def __init__(self, findings):
+        self.findings = findings
+        lines = ["Program failed verification "
+                 f"({len(findings)} error(s)):"]
+        lines += ["  " + f.render() for f in findings]
+        lines.append("  (set PADDLE_TPU_VERIFY=0 or pass verify=False to "
+                     "run anyway; see docs/ANALYSIS.md for the rule catalog)")
+        super().__init__('\n'.join(lines))
+
+
+def _f(rule, message, severity='error'):
+    return Finding(rule=rule, message=message, severity=severity,
+                   source='ir', path='<program>')
+
+
+def _aval(var):
+    v = getattr(var, '_value', None)
+    return (tuple(getattr(v, 'shape', ())), np.dtype(getattr(v, 'dtype',
+                                                             'float32')))
+
+
+def _available_at_entry(var):
+    """Vars live before any op runs: feeds and concrete-backed vars."""
+    return getattr(var, 'is_data', False) or \
+        getattr(var, 'concrete', None) is not None
+
+
+def verify_program(program, fetch_list=None):
+    """Verify a Program's op list; returns a list[Finding] (possibly empty).
+
+    ``fetch_list`` (Variables or names) additionally enables GV008
+    fetchability checking — Executor.run passes its resolved fetch vars.
+    """
+    findings = []
+    seen_names = {}          # name -> (block_idx, id(var)) of first sighting
+
+    for bi, block in enumerate(program.blocks):
+        # --- GV002: duplicate / inconsistently registered variable names ----
+        for name, var in block.vars.items():
+            if var.name != name:
+                findings.append(_f(
+                    'GV002',
+                    f"block {bi}: var registered under '{name}' but named "
+                    f"'{var.name}' — Block.vars key and Variable.name must "
+                    "agree (rename via create_var, not dict surgery)"))
+            prior = seen_names.get(name)
+            if prior is not None and prior[1] != id(var):
+                findings.append(_f(
+                    'GV002',
+                    f"block {bi}: variable name '{name}' already names a "
+                    f"different Variable in block {prior[0]} — duplicate "
+                    "names make feeds/fetches ambiguous; give one a unique "
+                    "name"))
+            else:
+                seen_names[name] = (bi, id(var))
+
+        produced = set()     # id(var) produced by a prior op in this block
+        consumed = set()     # id(var) read by any op
+        for oi, op in enumerate(block.ops):
+            # --- GV001: dangling inputs ------------------------------------
+            for v in op.inputs:
+                consumed.add(id(v))
+                if id(v) in produced or _available_at_entry(v):
+                    continue
+                declared = block.vars.get(v.name) is v
+                findings.append(_f(
+                    'GV001',
+                    f"block {bi} op #{oi} '{op.type}': input '{v.name}' is "
+                    "dangling — produced by no prior op and not a "
+                    "feed/parameter"
+                    + ("" if declared else " (nor declared in the block)")
+                    + " — feed it, bind a concrete value, or reorder the "
+                    "producing op before this one"))
+            for v in op.outputs:
+                # --- GV005: undeclared outputs ------------------------------
+                declared = block.vars.get(v.name)
+                if declared is None:
+                    findings.append(_f(
+                        'GV005',
+                        f"block {bi} op #{oi} '{op.type}': output '{v.name}' "
+                        "is not declared in the block — ops must register "
+                        "outputs in Block.vars so fetches can resolve them"))
+                elif declared is not v:
+                    # --- GV003/GV004: recorded output vs declared var -------
+                    (oshape, odt), (dshape, ddt) = _aval(v), _aval(declared)
+                    if odt != ddt:
+                        findings.append(_f(
+                            'GV003',
+                            f"block {bi} op #{oi} '{op.type}': output "
+                            f"'{v.name}' has dtype {odt} but the declared "
+                            f"var has {ddt} — the op's recorded result and "
+                            "the block declaration disagree"))
+                    if oshape != dshape:
+                        findings.append(_f(
+                            'GV004',
+                            f"block {bi} op #{oi} '{op.type}': output "
+                            f"'{v.name}' has shape {list(oshape)} but the "
+                            f"declared var has {list(dshape)} — recapture "
+                            "the op or fix the declaration"))
+                produced.add(id(v))
+
+        # --- GV006: unreachable/unused ops (dead unless fetched) ------------
+        fetch_ids = set()
+        if fetch_list:
+            for fv in fetch_list:
+                fv = _resolve_fetch(program, fv)
+                if fv is not None:
+                    fetch_ids.add(id(fv))
+        if fetch_ids:
+            # liveness flows backward from the fetch set: an op is live iff
+            # some output is fetched or feeds a live op. Only runs when at
+            # least one fetch RESOLVED — otherwise (a misspelled fetch,
+            # reported as GV008 below) every op would be flagged dead and
+            # the one real error would drown in warnings.
+            live_vars = set(fetch_ids)
+            dead = []
+            for oi, op in zip(reversed(range(len(block.ops))),
+                              reversed(block.ops)):
+                if any(id(v) in live_vars for v in op.outputs):
+                    live_vars.update(id(v) for v in op.inputs)
+                else:
+                    dead.append((oi, op))
+            for oi, op in reversed(dead):
+                findings.append(_f(
+                    'GV006',
+                    f"block {bi} op #{oi} '{op.type}': unreachable from the "
+                    "fetch targets — dead op; fetch-pruning will skip it",
+                    severity='warning'))
+        else:
+            # no fetch info: terminal ops are presumed outputs; flag only
+            # interior ops nothing ever reads
+            for oi, op in enumerate(block.ops[:-1]):
+                if not any(id(v) in consumed for v in op.outputs):
+                    findings.append(_f(
+                        'GV006',
+                        f"block {bi} op #{oi} '{op.type}': no later op reads "
+                        "any output and it is not terminal — dead op; "
+                        "fetch-pruning will skip it",
+                        severity='warning'))
+
+        # --- GV007: vars never touched by any op ----------------------------
+        for name, var in block.vars.items():
+            if id(var) in consumed or id(var) in produced:
+                continue
+            if _available_at_entry(var) or id(var) in fetch_ids:
+                continue
+            findings.append(_f(
+                'GV007',
+                f"block {bi}: var '{name}' is created but never written or "
+                "read by any op — leftover declaration (create_var without "
+                "a producing op?)",
+                severity='warning'))
+
+    # --- GV008: unfetchable fetch targets -----------------------------------
+    if fetch_list:
+        gb = program.global_block
+        producible = set()
+        for op in gb.ops:
+            producible.update(id(v) for v in op.outputs)
+        for fv in fetch_list:
+            rv = _resolve_fetch(program, fv)
+            if rv is None:
+                findings.append(_f(
+                    'GV008',
+                    f"fetch target {fv!r} names no variable in the program "
+                    "— check the fetch_list spelling against "
+                    "Program.list_vars()"))
+                continue
+            if id(rv) in producible or _available_at_entry(rv):
+                continue
+            findings.append(_f(
+                'GV008',
+                f"fetch target '{rv.name}' is produced by no op and has no "
+                "concrete value — Executor.run would fail; fetch an op "
+                "output or a parameter"))
+    return findings
+
+
+def _resolve_fetch(program, f):
+    from ..core.tensor import Tensor
+    from ..static.graph import Variable
+    if isinstance(f, Variable):
+        return f
+    if isinstance(f, str):
+        return program.global_block.vars.get(f.split('@')[0])
+    if isinstance(f, Tensor):
+        # concrete tensor fetch: Executor resolves it through the block's
+        # identity cache, so it is always available (same var it will use)
+        return program.global_block.concrete_var(f)
+    if hasattr(f, 'name') and f.name in program.global_block.vars:
+        return program.global_block.vars[f.name]
+    return None
+
+
+def assert_verified(program, fetch_list=None):
+    """Raise ProgramVerificationError when the program has error-severity
+    findings; warnings pass. Returns the full finding list."""
+    findings = verify_program(program, fetch_list=fetch_list)
+    errs = _errors(findings)
+    if errs:
+        raise ProgramVerificationError(errs)
+    return findings

@@ -40,20 +40,18 @@ def _worker(rank, nprocs, func, args, result_dir):
     os.environ['FLAGS_selected_gpus'] = str(rank)
     os.environ.setdefault('JAX_PLATFORMS', 'cpu')
     path = os.path.join(result_dir, f"result_{rank}.pkl")
-    # results travel via files (atomic rename), not an mp.Queue — queue FDs
-    # are unreliable under sandboxed/spawn-restricted environments
+    # results travel via files (atomic commit), not an mp.Queue — queue FDs
+    # are unreliable under sandboxed/spawn-restricted environments; the
+    # parent trusts these bytes, so they go through atomic_io (graftlint
+    # GL010), which adds the fsync the old hand-rolled tmp+replace lacked
+    from ..resilience.atomic_io import atomic_pickle_dump
     try:
         result = func(*args)
         payload = ('ok', result)
     except BaseException as e:  # surface the failure to the parent
-        payload = ('error', repr(e))
-        with open(path + '.tmp', 'wb') as f:
-            pickle.dump(payload, f)
-        os.replace(path + '.tmp', path)
+        atomic_pickle_dump(('error', repr(e)), path)
         raise
-    with open(path + '.tmp', 'wb') as f:
-        pickle.dump(payload, f)
-    os.replace(path + '.tmp', path)
+    atomic_pickle_dump(payload, path)
 
 
 class _Proc:
@@ -248,9 +246,11 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
         'nprocs': n,
         'result_dir': result_dir,
     }
+    # every spawned worker trusts this file; a bare write could hand a
+    # half-pickled payload to a fast-starting child (graftlint GL010)
     payload_path = os.path.join(result_dir, 'payload.pkl')
-    with open(payload_path, 'wb') as f:
-        pickle.dump(payload, f)
+    from ..resilience.atomic_io import atomic_pickle_dump
+    atomic_pickle_dump(payload, payload_path)
     for rank in range(n):
         child_env = dict(os.environ)
         child_env.update(_rank_env(rank, n))

@@ -24,6 +24,8 @@ def _block_attn(q, k, v, scale, causal, q_block_idx, kv_block_idx, n_blocks):
     """Attention of local q against one rotating k/v block with causal masking
     at block granularity + within-diagonal-block triangle."""
     s = jnp.einsum('bhld,bhmd->bhlm', q, k) * scale
+    # graftlint: disable=GL006 — causal is a static Python bool (never a
+    # tracer): branching specializes the trace once per mode, by design
     if causal:
         L = q.shape[2]
         M = k.shape[2]

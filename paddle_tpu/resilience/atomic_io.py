@@ -1,6 +1,6 @@
 """Atomic file I/O: the single write path every checkpoint byte goes through.
 
-Rule (enforced by tools/lint_atomic_writes.py): checkpoint-shaped code never
+Rule (enforced by graftlint GL010, docs/ANALYSIS.md): checkpoint-shaped code never
 opens its final destination for writing. It stages bytes in a same-directory
 temp file, fsyncs, and commits with ``os.replace`` — so a reader observes
 either the old complete file or the new complete file, never a torn one.

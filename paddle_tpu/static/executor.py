@@ -83,7 +83,7 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name='feed',
             fetch_var_name='fetch', scope=None, return_numpy=True,
-            use_program_cache=True):
+            use_program_cache=True, verify=None):
         program = program or default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -99,6 +99,15 @@ class Executor:
         # startup program: params were initialized eagerly at creation — no-op
         if not program.global_block.ops and not fetch_list:
             return []
+
+        # static verification before compilation (analysis engine 2):
+        # explicit verify=True/False wins, else PADDLE_TPU_VERIFY=1 or
+        # analysis.set_always_verify(True) turns it on. Malformed programs
+        # raise ProgramVerificationError with op-indexed findings instead of
+        # a KeyError deep inside the jitted interpreter.
+        from ..analysis.verify import assert_verified, verify_enabled
+        if verify_enabled(verify):
+            assert_verified(program, fetch_list=fetch_list)
 
         fetch_vars = [self._resolve(program, f) for f in fetch_list]
         feed_items = sorted(feed.items())

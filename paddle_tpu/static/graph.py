@@ -147,30 +147,78 @@ class Program:
             # (dropout/BN capture one) are swapped; Variables are shared so
             # feeds/fetches/params keep their identity slots
             nb = Block(p, 0)
-            nb.vars = self.global_block.vars
-            nb._concrete_cache = getattr(self.global_block,
-                                         '_concrete_cache', {})
+            src = self.global_block
+            nb.vars = src.vars
+            # the concrete-tensor cache must be the SAME dict as the source
+            # block's (created here if the source never wrapped a concrete
+            # tensor): if the clone got a fresh copy, a tensor wrapped after
+            # cloning would land in two different env slots and in-graph
+            # writes would be invisible across the train/test pair
+            cache = getattr(src, '_concrete_cache', None)
+            if cache is None:
+                cache = src._concrete_cache = {}
+            nb._concrete_cache = cache
             nb.ops = [op if op.eval_fn is None else
                       Operator(op.eval_fn, op.inputs, op.outputs,
                                type=op.type + '_eval')
-                      for op in self.global_block.ops]
+                      for op in src.ops]
             p.blocks = [nb]
         else:
             p.blocks = self.blocks  # shared capture
         p.random_seed = self.random_seed
         p._train_spec = None if for_test else self._train_spec
+        p._dp = getattr(self, '_dp', False)
         p._fingerprint = next(_var_counter)
         return p
 
+    def verify(self, fetch_list=None):
+        """Static verification of the captured op list (analysis engine 2).
+
+        Returns a list of ``analysis.Finding`` — empty when well-formed.
+        Checks: dangling op inputs (GV001), duplicate var names (GV002),
+        dtype/shape drift between recorded outputs and declared vars
+        (GV003/GV004), undeclared outputs (GV005), dead ops/vars
+        (GV006/GV007, warnings) and — when ``fetch_list`` is given —
+        unfetchable targets (GV008). ``Executor.run(..., verify=True)`` (or
+        ``PADDLE_TPU_VERIFY=1``) runs this before compiling.
+        """
+        from ..analysis.verify import verify_program
+        return verify_program(self, fetch_list=fetch_list)
+
     def to_string(self, throw_on_error=False, with_details=False):
-        lines = [f"Program(ops={len(self.global_block.ops)})"]
-        for op in self.global_block.ops:
+        block = self.global_block
+        lines = [f"Program(ops={len(block.ops)}, vars={len(block.vars)})"]
+        written = set()
+        for op in block.ops:
+            written.update(id(v) for v in op.outputs)
             ins = ','.join(v.name for v in op.inputs)
             outs = ','.join(v.name for v in op.outputs)
             lines.append(f"  {op.type}({ins}) -> {outs}")
+        if with_details:
+            for name in sorted(block.vars):
+                v = block.vars[name]
+                if v.is_data:
+                    kind = 'data'
+                elif v.concrete is not None:
+                    kind = ('param' if isinstance(v.concrete, Parameter)
+                            else 'persistable')
+                elif id(v) in written:
+                    kind = 'tmp'
+                else:
+                    # created but never written: verify() flags this as
+                    # GV007 — keep it visible in dumps too
+                    kind = 'never-written'
+                if throw_on_error and kind == 'never-written':
+                    raise ValueError(
+                        f"Program.to_string(throw_on_error=True): var "
+                        f"'{name}' is created but never written by any op")
+                lines.append(
+                    f"  var {name} : shape={v.shape} "
+                    f"dtype={np.dtype(v.dtype).name} [{kind}]")
         return '\n'.join(lines)
 
-    __str__ = to_string
+    def __str__(self):
+        return self.to_string()
 
 
 _default_main = [Program()]

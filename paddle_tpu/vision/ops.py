@@ -30,6 +30,8 @@ __all__ = ['iou_similarity', 'box_coder', 'prior_box', 'density_prior_box',
 
 def _pairwise_iou(x, y, box_normalized=True):
     """x: (N, 4), y: (M, 4) xyxy -> (N, M) IoU."""
+    # graftlint: disable=GL006 — box_normalized is a static Python bool
+    # config flag (never a tracer); the branch picks a compile-time constant
     off = 0.0 if box_normalized else 1.0
     ax1, ay1, ax2, ay2 = [x[:, i] for i in range(4)]
     bx1, by1, bx2, by2 = [y[:, i] for i in range(4)]
@@ -74,6 +76,8 @@ def box_coder(prior_box, prior_box_var, target_box,
             var_const = np.asarray(prior_box_var, np.float32)
         else:
             var_t = _t(prior_box_var)
+    # graftlint: disable=GL006 — box_normalized is a static Python bool
+    # config flag (never a tracer); the branch picks a compile-time constant
     off = 0.0 if box_normalized else 1.0
     encode = code_type.lower() in ("encode_center_size", "encode")
 

@@ -25,6 +25,9 @@ def pytest_configure(config):
         "write failures, corruption, SIGTERM, NaN injection)")
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "lint: static-analysis gates (graftlint over the repo; "
+        "pure AST, no tracing)")
 
 
 @pytest.fixture(autouse=True)

@@ -62,3 +62,62 @@ def test_clone_without_for_test_keeps_training(static_mode):
     xv = np.ones((4, 8), np.float32)
     out = exe.run(train_clone, feed={'x': xv}, fetch_list=[y])[0]
     assert (out == 0).any()                          # still dropping
+
+
+# -- edge cases surfaced by the analysis/verifier work (graftlint PR) --------
+
+def test_clone_for_test_empty_program(static_mode):
+    main = static.Program()
+    t = main.clone(for_test=True)
+    assert t.num_blocks == 1 and t.global_block.ops == []
+    assert t.verify() == []
+    # an empty program still prints and runs (startup no-op)
+    assert str(t).startswith('Program(ops=0')
+    assert static.Executor().run(t) == []
+
+
+def test_clone_for_test_shares_concrete_cache(static_mode):
+    """Regression: the eval clone must share the SOURCE block's concrete
+    cache (not a fresh copy), so a tensor wrapped after cloning resolves to
+    one env slot in both programs."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [2, 2], 'float32')
+        y = x + 1.0
+    t = main.clone(for_test=True)
+    src, dst = main.global_block, t.global_block
+    tensor = paddle.to_tensor(np.ones((2, 2), np.float32))
+    v_src = src.concrete_var(tensor)
+    v_dst = dst.concrete_var(tensor)
+    assert v_src is v_dst
+    assert src._concrete_cache is dst._concrete_cache
+
+
+def test_clone_preserves_data_parallel_flag(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [2, 2], 'float32')
+        y = x * 2.0
+    main._dp = True
+    assert main.clone(for_test=True)._dp is True
+    assert main.clone(for_test=False)._dp is True
+
+
+def test_to_string_with_details_lists_vars(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [2, 3], 'float32')
+        y = x * 2.0
+        limbo = main.global_block.create_var(
+            name='limbo', shape=[4], dtype='float32')
+    plain = main.to_string()
+    assert 'var ' not in plain
+    detailed = main.to_string(with_details=True)
+    assert 'var x' in detailed and '[data]' in detailed
+    assert 'var limbo' in detailed and '[never-written]' in detailed
+    # throw_on_error surfaces the never-written var as an exception
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match='limbo'):
+        main.to_string(throw_on_error=True, with_details=True)
+    # and the verifier reports the same condition as GV007
+    assert any(f.rule == 'GV007' for f in main.verify())

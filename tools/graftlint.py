@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""graftlint: TPU anti-pattern linter + Program verifier CLI.
+
+Thin launcher for ``paddle_tpu.analysis`` so the tool works from a source
+checkout without installation::
+
+    python tools/graftlint.py paddle_tpu/
+    python tools/graftlint.py --json paddle_tpu/ > findings.json
+    python tools/graftlint.py --list-rules
+
+Equivalent: ``python -m paddle_tpu.analysis``. Rule catalog and waiver
+syntax: docs/ANALYSIS.md.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,98 +1,30 @@
 #!/usr/bin/env python
-"""Lint: no bare ``open(path, 'wb')`` on checkpoint write paths.
+"""DEPRECATED shim: the atomic-writes lint is now graftlint rule GL010.
 
-Every persisted-state byte in paddle_tpu must go through
-``resilience.atomic_io`` (temp + fsync + os.replace) so a crash mid-write can
-never tear a file a later load would trust. This check walks the modules that
-write checkpoints/exports and flags direct binary-write opens.
+This check lives in ``paddle_tpu.analysis.ast_rules.AtomicWriteRule``
+(``# atomic-ok: <why>`` annotations still honored, plus the new
+``# graftlint: disable=GL010`` spelling). Prefer::
 
-Suppress a finding with an ``# atomic-ok: <why>`` comment on the offending
-line or the line above — e.g. writes staged into a temp directory that is
-itself committed by one atomic rename.
+    python tools/graftlint.py paddle_tpu/            # all rules
+    python tools/graftlint.py --select GL010 paddle_tpu/
 
-Run standalone (``python tools/lint_atomic_writes.py``) or via tier-1
-(tests/test_resilience.py). Exit code 1 on violations.
+This wrapper keeps the original ``run(root)`` / ``main(argv)`` surface (and
+its ``path:line: message`` strings) so existing tier-1 wiring keeps passing.
 """
-import ast
 import os
 import sys
 
-# Modules that persist state a reader would later trust. Dataset caches and
-# bench scratch files are out of scope: a torn cache re-downloads, a torn
-# checkpoint loses a run.
-CHECKPOINT_SCOPE = (
-    'framework.py',
-    'static/io.py',
-    'static/fluid_format.py',
-    'fluid/io.py',
-    'jit/',
-    'hapi/',
-    'incubate/checkpoint.py',
-    'inference/',
-    'slim/',
-    'resilience/',
-)
-
-WRITE_MODES = {'wb', 'wb+', 'w+b', 'bw', 'ab', 'ab+', 'a+b'}
-
-
-def _mode_of(call):
-    """The literal mode of an open() call, or None when not literal."""
-    if len(call.args) >= 2:
-        arg = call.args[1]
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            return arg.value
-        return None
-    for kw in call.keywords:
-        if kw.arg == 'mode' and isinstance(kw.value, ast.Constant) and \
-                isinstance(kw.value.value, str):
-            return kw.value.value
-    return 'r'
-
-
-def scan_file(path):
-    with open(path, 'r', encoding='utf-8') as f:
-        source = f.read()
-    lines = source.splitlines()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return ['%s:%s: unparseable (%s)' % (path, e.lineno, e.msg)]
-    out = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call) and
-                isinstance(node.func, ast.Name) and node.func.id == 'open'):
-            continue
-        mode = _mode_of(node)
-        if mode is None or mode not in WRITE_MODES:
-            continue
-        nearby = lines[max(0, node.lineno - 2):node.lineno]
-        if any('atomic-ok' in ln for ln in nearby):
-            continue
-        out.append(
-            "%s:%d: bare open(..., '%s') on a checkpoint path — route the "
-            "write through resilience.atomic_io (or annotate the line with "
-            "'# atomic-ok: <why>' if it is staged-then-renamed)"
-            % (path, node.lineno, mode))
-    return out
-
-
-def in_scope(rel):
-    return any(rel == p or (p.endswith('/') and rel.startswith(p))
-               for p in CHECKPOINT_SCOPE)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run(package_root):
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(package_root):
-        for name in sorted(filenames):
-            if not name.endswith('.py'):
-                continue
-            full = os.path.join(dirpath, name)
-            rel = os.path.relpath(full, package_root).replace(os.sep, '/')
-            if in_scope(rel):
-                violations.extend(scan_file(full))
-    return violations
+    """Old API: list of ``path:line: message`` strings for GL010 violations
+    under ``package_root`` (waived findings excluded)."""
+    from paddle_tpu.analysis.rules import lint_paths
+    findings, _ = lint_paths([package_root], select={'GL010'},
+                             scan_root=package_root)
+    return [f"{f.path}:{f.line}: {f.message}"
+            for f in findings if not f.waived]
 
 
 def main(argv=None):
@@ -100,6 +32,8 @@ def main(argv=None):
     root = argv[0] if argv else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         'paddle_tpu')
+    print('lint_atomic_writes is deprecated: use '
+          '`python tools/graftlint.py --select GL010`', file=sys.stderr)
     violations = run(root)
     for v in violations:
         print(v)
