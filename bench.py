@@ -607,10 +607,30 @@ def enable_xla_cache():
         print(f"compile cache unavailable: {e!r}", file=sys.stderr)
 
 
+def _telemetry_counters():
+    """Interposed telemetry counters (retraces, compile time, host-transfer
+    bytes) for BENCH extras, so BENCH_*.json captures them alongside
+    throughput. Enabled at child start; never fatal."""
+    try:
+        from paddle_tpu import observability as obs
+        return obs.counters_summary()
+    except Exception as e:
+        return {'error': repr(e)}
+
+
+def _enable_telemetry():
+    try:
+        from paddle_tpu import observability as obs
+        obs.enable()
+    except Exception as e:
+        print(f"telemetry unavailable: {e!r}", file=sys.stderr)
+
+
 def _child_main(mode, model):
     import jax
 
     enable_xla_cache()
+    _enable_telemetry()
     try:
         on_accel = jax.default_backend() not in ('cpu',)
     except Exception as e:
@@ -628,6 +648,7 @@ def _child_main(mode, model):
             "metric": "resnet50_smoke_cpu_images_per_sec",
             "value": round(ips, 2), "unit": "images/sec",
             "vs_baseline": round(ips / BASELINE_RESNET50_IPS, 4),
+            "extras": {"telemetry": _telemetry_counters()},
             "complete": True}))
         return
     if on_accel and model == 'resnet50':
@@ -640,6 +661,7 @@ def _child_main(mode, model):
             "mode": "train (bf16 compute, SGD+momentum)",
             "batch": _resnet50_batch(),
             "s2d_stem": os.environ.get('PADDLE_TPU_RESNET_S2D', '') == '1',
+            "extras": {"telemetry": _telemetry_counters()},
             "complete": True,
         }))
         return
@@ -691,6 +713,7 @@ def _child_main(mode, model):
         result["value"] = round(sps128, 2)
         result["vs_baseline"] = round(sps128 / BASELINE_SAMPLES_PER_SEC, 4)
         result["batch"] = b128   # echoed so an override can't masquerade
+        result["extras"]["telemetry"] = _telemetry_counters()
         print(json.dumps(result), flush=True)
         record_onchip(result)
         # phase 2: seq512 — attention-dominated, Pallas flash path
@@ -702,6 +725,7 @@ def _child_main(mode, model):
             "seq512_vs_baseline": round(sps512 / BASELINE_SEQ512_SPS, 4),
             "seq512_baseline": BASELINE_SEQ512_SPS,
         })
+        result["extras"]["telemetry"] = _telemetry_counters()
         print(json.dumps(result), flush=True)
         record_onchip(result)
         resnet_ips = _resnet50_accel_ips()
@@ -716,6 +740,7 @@ def _child_main(mode, model):
         })
         result["complete"] = True   # all sections measured: the timeout/
         # crash paths in _run_child must not annotate this line as partial
+        result["extras"]["telemetry"] = _telemetry_counters()
         print(json.dumps(result), flush=True)
         record_onchip(result)
     else:  # local smoke mode: same code path, tiny shapes
@@ -728,6 +753,7 @@ def _child_main(mode, model):
             "value": round(sps, 2),
             "unit": "samples/sec",
             "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
+            "extras": {"telemetry": _telemetry_counters()},
             "complete": True,
         }))
 

@@ -32,6 +32,7 @@ from . import static
 from . import jit
 from . import amp
 from . import incubate
+from . import observability
 from . import resilience
 from . import utils
 from . import dataset

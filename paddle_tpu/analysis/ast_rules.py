@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL010).
+"""The graftlint AST rule catalog (GL001–GL011).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -13,6 +13,9 @@ but destroys performance or correctness on real hardware:
   randomness must flow through ``paddle_tpu.core.rng`` keys.
 - GL009: leftover debug artifacts (``jax.debug.print``, ``breakpoint()``).
 - GL010: non-atomic checkpoint writes (absorbs tools/lint_atomic_writes.py).
+- GL011: raw ``time.time()``/``perf_counter()`` timing in library code —
+  durations measured ad hoc never reach the telemetry spine; route them
+  through ``observability.timer`` (tests/tools/bench harnesses exempt).
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -425,3 +428,48 @@ class AtomicWriteRule(Rule):
                 f"bare open(..., '{mode}') on a checkpoint path — route the "
                 "write through resilience.atomic_io (or annotate the line "
                 "with '# atomic-ok: <why>' if it is staged-then-renamed)")
+
+
+# -- GL011: raw wall-clock timing in library code ---------------------------
+
+# code whose *job* is raw timing or that defines the sanctioned wrappers:
+# the telemetry spine itself, test suites, and dev harnesses (tools/,
+# bench scripts). time.monotonic deadlines are allowed everywhere — the
+# rule targets duration measurement, not timeout math.
+_TIMING_EXEMPT_PREFIXES = ('tests/', 'tools/', 'paddle_tpu/observability/',
+                           'observability/')
+_TIMING_CALLS = ('time.time', 'time.perf_counter', 'time.perf_counter_ns',
+                 'time.time_ns')
+
+
+@register
+class RawTimingRule(Rule):
+    """GL011: ad-hoc ``time.time()``/``time.perf_counter()`` in library
+    code — the measured duration is invisible to the metrics registry, the
+    step-event log, and the Chrome trace. ``observability.timer`` /
+    ``Stopwatch`` cost the same and land in all three; timestamps (not
+    durations) come from ``observability.wall_ts()``."""
+    id = 'GL011'
+    title = 'raw wall-clock timing in library code'
+
+    def in_scope(self, rel):
+        if any(rel.startswith(p) for p in _TIMING_EXEMPT_PREFIXES):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted in _TIMING_CALLS:
+                yield self.finding(
+                    ctx, node,
+                    f"{dotted}() in library code — time the block with "
+                    "paddle_tpu.observability.timer(name) (or Stopwatch for "
+                    "the raw elapsed value) so the duration reaches the "
+                    "metrics registry and the trace; use "
+                    "observability.wall_ts() for event timestamps")

@@ -13,7 +13,7 @@ import argparse
 import os
 import sys
 
-from . import ast_rules  # noqa: F401  (registers GL001..GL010)
+from . import ast_rules  # noqa: F401  (registers GL001..GL011)
 from .config import ConfigError, find_config, load_config
 from .finding import active, render_json, render_text
 from .rules import RULES, lint_paths

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from . import autograd
 from .dtypes import convert_dtype, get_default_dtype, is_floating
 from .place import get_place, CPUPlace, TPUPlace
+from .. import observability as _obs
 
 
 def _is_tracer(v):
@@ -97,7 +98,10 @@ class Tensor:
 
     # -- host interop -------------------------------------------------------
     def numpy(self):
-        return np.asarray(jax.device_get(self._value))
+        a = np.asarray(jax.device_get(self._value))
+        if _obs.enabled():
+            _obs.record_host_transfer(a.nbytes, kind='tensor.numpy')
+        return a
 
     def item(self, *args):
         return self.numpy().item(*args)

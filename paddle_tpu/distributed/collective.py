@@ -22,6 +22,21 @@ from ._compat import shard_map
 from ..core.tensor import Tensor, apply_op
 from ..tensor._helpers import _t
 from . import env
+from .. import observability as _obs
+
+
+def _record_collective(op, t):
+    """Telemetry: count + payload bytes per eager collective launch. Inside
+    a traced region this records once at trace time (a compile-rate signal,
+    not an execution count) — the hot path stays untouched."""
+    if not _obs.enabled():
+        return
+    try:
+        v = t._value if isinstance(t, Tensor) else t
+        nbytes = int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+    except Exception:
+        nbytes = 0
+    _obs.record_collective(op, nbytes)
 
 __all__ = ['ReduceOp', 'all_reduce', 'all_gather', 'broadcast', 'reduce',
            'scatter', 'reduce_scatter', 'alltoall', 'all_to_all', 'barrier',
@@ -86,6 +101,7 @@ def _eager_collective(x, per_shard_fn, axis):
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     t = _t(tensor)
+    _record_collective('all_reduce', t)
     axis = _axis(group)
     op = _normalize_op(op)
     red = _LAX_REDUCE[op]
@@ -139,6 +155,7 @@ def in_jit_all_reduce(value, axis=None, op=ReduceOp.SUM):
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=None):
     t = _t(tensor)
+    _record_collective('all_gather', t)
     ax = axis or _axis(group)
 
     def fn(v):
@@ -180,6 +197,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def reduce_scatter(output, input, op=ReduceOp.SUM, group=None, axis=None):
     t = _t(input)
+    _record_collective('reduce_scatter', t)
     ax = axis or _axis(group)
 
     def fn(v):
@@ -202,6 +220,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, axis=None):
     from ..tensor.manipulation import stack, unstack
 
     stacked = stack(ts, axis=0)
+    _record_collective('alltoall', stacked)
 
     def fn(v):
         if env.axis_bound(ax):

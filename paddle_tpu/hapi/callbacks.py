@@ -1,14 +1,14 @@
 """Training callbacks. Parity: python/paddle/hapi/callbacks.py."""
 import json
 import os
-import time
 
 import numpy as np
 
 from .progressbar import ProgressBar
 
 __all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'LRScheduler',
-           'EarlyStopping', 'VisualDL', 'CallbackList', 'CheckpointSaver']
+           'EarlyStopping', 'VisualDL', 'CallbackList', 'CheckpointSaver',
+           'TelemetryCallback']
 
 
 class Callback:
@@ -303,7 +303,8 @@ class VisualDL(Callback):
 
     def on_train_batch_end(self, step, logs=None):
         logs = logs or {}
-        rec = {'step': self._step, 'ts': time.time()}
+        from ..observability import wall_ts
+        rec = {'step': self._step, 'ts': wall_ts()}
         for k, v in logs.items():
             if isinstance(v, (int, float, np.floating)):
                 rec[k] = float(v)
@@ -313,3 +314,12 @@ class VisualDL(Callback):
     def on_train_end(self, logs=None):
         if self._f:
             self._f.close()
+
+
+def __getattr__(name):
+    # TelemetryCallback lives in observability (which imports Callback from
+    # this module); resolve lazily to keep the import graph acyclic.
+    if name == 'TelemetryCallback':
+        from ..observability.callback import TelemetryCallback
+        return TelemetryCallback
+    raise AttributeError(name)

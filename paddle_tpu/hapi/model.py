@@ -236,8 +236,14 @@ class Model:
         eval_loader = self._to_loader(eval_data, batch_size, False, False,
                                       num_workers) if eval_data is not None \
             else None
-        cbks = CallbackList([ProgBarLogger(log_freq, verbose)] +
-                            (callbacks or []))
+        user_cbks = list(callbacks or [])
+        from .. import observability as _obs
+        if _obs.enabled() and not any(
+                isinstance(c, _obs.TelemetryCallback) for c in user_cbks):
+            # PADDLE_TPU_TELEMETRY=1: every fit() emits step events + spans
+            # without code changes (docs/OBSERVABILITY.md)
+            user_cbks.insert(0, _obs.TelemetryCallback())
+        cbks = CallbackList([ProgBarLogger(log_freq, verbose)] + user_cbks)
         cbks.set_model(self)
         steps = None
         try:

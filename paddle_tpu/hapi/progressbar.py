@@ -1,6 +1,7 @@
 """Progress bar. Parity: python/paddle/hapi/progressbar.py."""
 import sys
-import time
+
+from ..observability import Stopwatch
 
 
 class ProgressBar:
@@ -11,11 +12,10 @@ class ProgressBar:
         self._verbose = verbose
         self.file = file
         self._values = {}
-        self._start = time.time()
+        self._sw = Stopwatch()
         self._last_update = 0
 
     def update(self, current_num, values=None):
-        now = time.time()
         if values:
             for k, v in values:
                 self._values[k] = v
@@ -31,10 +31,9 @@ class ProgressBar:
             msg = f"\rstep {current_num} {info}"
         self.file.write(msg)
         if self._num and current_num >= self._num:
-            elapsed = now - self._start
-            self.file.write(f" - {elapsed:.0f}s\n")
+            self.file.write(f" - {self._sw.elapsed():.0f}s\n")
         self.file.flush()
-        self._last_update = now
+        self._last_update = self._sw.elapsed()
 
     def start(self):
-        self._start = time.time()
+        self._sw.restart()

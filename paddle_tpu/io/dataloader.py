@@ -16,6 +16,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler, SequenceSampler, RandomSampler
+from .. import observability as _obs
 
 __all__ = ['DataLoader', 'default_collate_fn', 'default_convert_fn']
 
@@ -148,6 +149,8 @@ class DataLoader:
         next_seq = 0
         try:
             while finished < self.num_workers:
+                if _obs.enabled():
+                    _obs.gauge('dataloader.queue_depth').set(out_q.qsize())
                 s, batch = out_q.get()
                 if batch is done:
                     finished += 1
@@ -217,9 +220,27 @@ class DataLoader:
             pass
         return self._threaded_batches()
 
+    def _timed(self, source):
+        """Telemetry wrapper: how long the consumer waits for each host
+        batch (assembly + collate stall the device would see)."""
+        it = iter(source)
+        while True:
+            sw = _obs.Stopwatch()
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+            if _obs.enabled():
+                _obs.histogram('dataloader.next_wait_ms').observe(
+                    sw.elapsed_ms())
+                _obs.counter('dataloader.batches').inc()
+            yield b
+
     def __iter__(self):
         source = self._parallel_batches() if self.num_workers > 0 else \
             self._raw_batches()
+        if _obs.enabled():
+            source = self._timed(source)
         if not self.use_buffer_reader:
             for b in source:
                 yield _to_device(b)

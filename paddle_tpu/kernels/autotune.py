@@ -115,15 +115,17 @@ def clear_cache():
 
 
 def _time_step(fn, args, iters=5, warmup=2):
+    from ..observability import Stopwatch
     for _ in range(warmup):
         out = fn(*args)
     jax.block_until_ready(out)
     best = float('inf')
+    sw = Stopwatch()
     for _ in range(iters):
-        t0 = time.perf_counter()
+        sw.restart()
         out = fn(*args)
         jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - t0)
+        best = min(best, sw.elapsed())
     return best
 
 

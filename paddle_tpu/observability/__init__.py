@@ -1,0 +1,80 @@
+"""paddle_tpu.observability: one telemetry spine for the whole runtime.
+
+Three cooperating pieces (docs/OBSERVABILITY.md has the operator guide):
+
+- a process-wide **metrics registry** (``counter``/``gauge``/``histogram``)
+  with JSONL **step-event** export and Prometheus-style text exposition;
+- a **span tracer** emitting Chrome trace-event JSON (Perfetto-loadable)
+  that bridges into ``jax.profiler.TraceAnnotation`` while a device trace is
+  active, with a sampled ``block_until_ready`` discipline;
+- **interposed counters** for jit retraces/compiles (via ``jax.monitoring``)
+  and host-transfer bytes (``Tensor.numpy()``, Executor fetches).
+
+Built-in instrumentation rides the narrow waists: ``Executor.run`` (program
+cache, verify/compile time), ``hapi.Model.fit`` (``TelemetryCallback``),
+``io.DataLoader`` / ``reader.buffered`` (queue depth, wait time),
+``optimizer.step``, the resilience layer (NaN skips, retries, checkpoint
+durations), and ``distributed.collective``.
+
+Everything is off (near-zero overhead: one flag check per site) until
+``PADDLE_TPU_TELEMETRY=1`` or an explicit ``observability.enable()``.
+
+This package is imported by ``core.tensor`` at interpreter start: modules
+here must stay stdlib-only at import time (jax strictly lazy) and must not
+import other ``paddle_tpu`` modules at the top level.
+"""
+from . import events as _events
+from . import interpose, registry, spans, state, timing  # noqa: F401
+from .state import enable, disable, enabled, log_dir, sync_every
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       get_registry, counter, gauge, histogram, snapshot,
+                       to_prometheus)
+from .registry import reset as reset_metrics
+from .spans import span, Span, dump_chrome_trace, trace_events
+from .timing import Stopwatch, timer
+from .interpose import (install_jax_hooks, record_host_transfer,
+                        record_collective)
+from .interpose import summary as counters_summary
+
+# event-log surface (module name 'events' is kept for the submodule; the
+# buffered-event accessor is exported as event_log to avoid shadowing it)
+event = _events.emit
+event_log = _events.events
+dump_jsonl = _events.dump_jsonl
+set_sink = _events.set_sink
+close_sink = _events.close_sink
+wall_ts = _events.wall_ts
+
+__all__ = [
+    'enable', 'disable', 'enabled', 'log_dir', 'sync_every',
+    'Counter', 'Gauge', 'Histogram', 'MetricsRegistry', 'get_registry',
+    'counter', 'gauge', 'histogram', 'snapshot', 'to_prometheus',
+    'reset_metrics', 'reset',
+    'span', 'Span', 'dump_chrome_trace', 'trace_events',
+    'event', 'event_log', 'dump_jsonl', 'set_sink', 'close_sink', 'wall_ts',
+    'Stopwatch', 'timer',
+    'install_jax_hooks', 'record_host_transfer', 'record_collective',
+    'counters_summary', 'TelemetryCallback',
+]
+
+
+def reset():
+    """Clear every buffer (metrics, events, spans) — test isolation hook."""
+    reset_metrics()
+    _events.clear()
+    spans.clear()
+
+
+def __getattr__(name):
+    # TelemetryCallback subclasses hapi.Callback; resolving it lazily keeps
+    # this package importable from core.tensor before hapi exists.
+    if name == 'TelemetryCallback':
+        from .callback import TelemetryCallback
+        return TelemetryCallback
+    raise AttributeError(name)
+
+
+# honor PADDLE_TPU_TELEMETRY=1 from the environment: state already read the
+# flag; bring the jax hooks up with it
+if enabled():
+    install_jax_hooks()

@@ -1,0 +1,139 @@
+"""TelemetryCallback: hapi.Model.fit instrumentation.
+
+Wires the high-level training loop into the telemetry spine: a span per
+step and per epoch, ``hapi.steps``/``hapi.step_ms``/``hapi.steps_per_sec``
+metrics, a ``step`` event per batch (epoch, step, loss, step_ms) in the
+step-event log, and — at train end — the JSONL event log plus the Chrome
+trace written under ``log_dir`` and a ``train_end`` summary event carrying
+the interposed counters (retraces, compiles, host-transfer bytes).
+
+``Model.fit`` attaches one automatically while telemetry is enabled
+(``PADDLE_TPU_TELEMETRY=1``), so a production run gets step events without
+code changes; pass your own instance to control ``log_dir``.
+"""
+import os
+
+from ..hapi.callbacks import Callback
+from . import events, interpose, registry, spans, state, timing
+
+__all__ = ['TelemetryCallback']
+
+
+class TelemetryCallback(Callback):
+    def __init__(self, log_dir=None, live_events=False):
+        """``log_dir``: where ``events.jsonl`` / ``trace.json`` land at train
+        end (default ``PADDLE_TPU_TELEMETRY_DIR``). ``live_events=True``
+        additionally streams each event to ``events.jsonl`` as it is emitted
+        (crash-tolerant, one extra host write per step)."""
+        super().__init__()
+        self.log_dir = log_dir
+        self.live_events = live_events
+        self._epoch = 0
+        self._step_span = None
+        self._epoch_timer = None
+        self._train_sw = None
+        self._steps_per_sec = None
+
+    def _dir(self):
+        return self.log_dir or state.log_dir()
+
+    # -- train lifecycle ----------------------------------------------------
+    def on_train_begin(self, logs=None):
+        if not state.enabled():
+            return
+        self._train_sw = timing.Stopwatch()
+        if self.live_events:
+            d = self._dir()
+            os.makedirs(d, exist_ok=True)
+            events.set_sink(os.path.join(d, 'events.jsonl'))
+        events.emit('train_begin', epochs=self.params.get('epochs'),
+                    steps=self.params.get('steps'))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        if not state.enabled():
+            return
+        self._epoch_timer = timing.timer('hapi.epoch', epoch=epoch)
+        self._epoch_timer.__enter__()
+        events.emit('epoch_begin', epoch=epoch)
+
+    def on_train_batch_begin(self, step, logs=None):
+        if not state.enabled():
+            return
+        self._step_span = timing.timer('hapi.step', epoch=self._epoch,
+                                       step=step)
+        self._step_span.__enter__()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._step_span is None:
+            return
+        t = self._step_span
+        self._step_span = None
+        t.__exit__(None, None, None)
+        if not state.enabled():
+            return
+        registry.counter('hapi.steps').inc()
+        step_s = t.elapsed_ms / 1e3
+        if step_s > 0:
+            sps = 1.0 / step_s
+            # EMA so the gauge reads steady-state throughput, not the last
+            # batch's jitter
+            self._steps_per_sec = sps if self._steps_per_sec is None else \
+                0.9 * self._steps_per_sec + 0.1 * sps
+            registry.gauge('hapi.steps_per_sec').set(
+                round(self._steps_per_sec, 3))
+        rec = {'epoch': self._epoch, 'step': step,
+               'step_ms': round(t.elapsed_ms, 3)}
+        loss = (logs or {}).get('loss')
+        if loss is not None:
+            rec['loss'] = float(loss)
+        events.emit('step', **rec)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._epoch_timer is not None:
+            self._epoch_timer.__exit__(None, None, None)
+            self._epoch_timer = None
+        if not state.enabled():
+            return
+        rec = {'epoch': epoch}
+        for k, v in (logs or {}).items():
+            if isinstance(v, (int, float)):
+                rec[k] = float(v)
+        events.emit('epoch_end', **rec)
+
+    def on_eval_end(self, logs=None):
+        if not state.enabled():
+            return
+        rec = {k: float(v) for k, v in (logs or {}).items()
+               if isinstance(v, (int, float))}
+        events.emit('eval_end', **rec)
+
+    def on_train_end(self, logs=None):
+        if self._step_span is not None:   # interrupted mid-batch
+            self._step_span.__exit__(None, None, None)
+            self._step_span = None
+        if self._epoch_timer is not None:
+            self._epoch_timer.__exit__(None, None, None)
+            self._epoch_timer = None
+        if not state.enabled():
+            return
+        jit_fn = getattr(self.model, '_jit_step_fn', None)
+        if jit_fn is not None:
+            try:
+                registry.gauge('hapi.jit_cache_size').set(
+                    jit_fn._cache_size())
+            except Exception:
+                pass
+        events.emit('train_end',
+                    total_s=round(self._train_sw.elapsed(), 3)
+                    if self._train_sw else None,
+                    counters=interpose.summary())
+        if self.live_events:
+            events.close_sink()
+        d = self._dir()
+        try:
+            os.makedirs(d, exist_ok=True)
+            events.dump_jsonl(os.path.join(d, 'events.jsonl'))
+            spans.dump_chrome_trace(os.path.join(d, 'trace.json'))
+        except OSError:
+            pass   # telemetry export must never fail a training run

@@ -1,0 +1,106 @@
+"""Step-event log: bounded in-memory buffer of structured events + JSONL.
+
+Every noteworthy runtime occurrence — a training step, a NaN-guard skip, a
+retry, a checkpoint commit — is one flat dict ``{'ev': kind, 'ts': wall
+seconds, ...fields}``. Events accumulate in a bounded ring (newest win) and
+are exported as JSONL by ``dump_jsonl()`` (the ``TelemetryCallback`` does
+this at train end; ``tools/telemetry_dump.py`` pretty-prints / converts the
+file). An optional live sink streams each event to disk as it is emitted —
+for long runs where losing the tail on a crash matters more than the extra
+write per event.
+"""
+import collections
+import json
+import threading
+import time
+
+from . import state
+
+__all__ = ['emit', 'events', 'clear', 'dump_jsonl', 'set_sink',
+           'close_sink', 'wall_ts', 'MAX_EVENTS']
+
+MAX_EVENTS = 16384
+
+_lock = threading.Lock()
+_buf = collections.deque(maxlen=MAX_EVENTS)
+_sink = None          # open file object, or None
+_dropped = [0]
+
+
+def wall_ts():
+    """Wall-clock timestamp for event records (seconds since epoch). The one
+    sanctioned raw-clock read for library code that needs a *timestamp*
+    rather than a duration (durations go through ``observability.timer``)."""
+    return time.time()
+
+
+def emit(kind, **fields):
+    """Record one event. No-op unless telemetry is enabled."""
+    if not state.enabled():
+        return None
+    rec = {'ev': str(kind), 'ts': round(wall_ts(), 6)}
+    rec.update(fields)
+    with _lock:
+        if len(_buf) == _buf.maxlen:
+            _dropped[0] += 1
+        _buf.append(rec)
+        if _sink is not None:
+            try:
+                _sink.write(json.dumps(rec, sort_keys=True,
+                                       default=_jsonable) + '\n')
+                _sink.flush()
+            except (OSError, ValueError):
+                pass
+    return rec
+
+
+def events():
+    """Snapshot of the buffered events, oldest first."""
+    with _lock:
+        return list(_buf)
+
+
+def dropped():
+    return _dropped[0]
+
+
+def clear():
+    with _lock:
+        _buf.clear()
+        _dropped[0] = 0
+
+
+def dump_jsonl(path):
+    """Write every buffered event to ``path`` as JSON-lines; returns the
+    number of events written."""
+    recs = events()
+    with open(path, 'w', encoding='utf-8') as f:
+        for rec in recs:
+            f.write(json.dumps(rec, sort_keys=True, default=_jsonable) + '\n')
+    return len(recs)
+
+
+def set_sink(path):
+    """Stream subsequent events live to ``path`` (append). Returns the path."""
+    global _sink
+    with _lock:
+        if _sink is not None:
+            _sink.close()
+        _sink = open(path, 'a', encoding='utf-8')
+    return path
+
+
+def close_sink():
+    global _sink
+    with _lock:
+        if _sink is not None:
+            _sink.close()
+            _sink = None
+
+
+def _jsonable(o):
+    """Last-resort encoder: numpy scalars -> python, everything else repr."""
+    try:
+        return o.item()
+    except (AttributeError, ValueError):
+        return repr(o)

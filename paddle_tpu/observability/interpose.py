@@ -1,0 +1,85 @@
+"""Interposed runtime counters: jit retraces/compiles and host transfers.
+
+Two families of counters no library author has to remember to bump:
+
+- **retrace/compile**: ``install_jax_hooks()`` registers a
+  ``jax.monitoring`` duration listener; every jaxpr trace and every backend
+  compile anywhere in the process (Executor programs, hapi jit steps, bench
+  loops, user code) increments ``jax.traces`` / ``jax.compiles`` and
+  accumulates ``jax.compile_ms``. A growing ``jax.traces`` count on a
+  steady-state loop is the retrace-storm signal GL004–GL006 lint for
+  statically.
+- **host transfers**: the narrow host-boundary waists (``Tensor.numpy()``,
+  ``Executor.run``'s fetch) call ``record_host_transfer(nbytes)``; the
+  ``host_transfer.bytes`` counter is the "how much crosses PCIe/ICI per
+  step" number the ROADMAP's serving goal needs.
+
+Collectives report through ``record_collective(op, nbytes)`` from the eager
+wrappers (inside a traced region the record happens once at trace time, so
+counts there reflect compilations, not executions).
+"""
+from . import registry, state
+
+__all__ = ['install_jax_hooks', 'record_host_transfer', 'record_collective',
+           'summary']
+
+_installed = [False]
+
+
+def install_jax_hooks():
+    """Register the jax.monitoring listener once. Safe to call repeatedly;
+    returns True when the hooks are (already) in place. The listener guards
+    on ``state.enabled()`` so a later ``disable()`` silences it without an
+    unregister API."""
+    if _installed[0]:
+        return True
+    try:
+        import jax
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _installed[0] = True
+    return True
+
+
+def _on_duration(name, secs, **kwargs):
+    if not state.enabled():
+        return
+    if name.endswith('jaxpr_trace_duration'):
+        registry.counter('jax.traces').inc()
+        registry.histogram('jax.trace_ms').observe(secs * 1e3)
+    elif name.endswith('backend_compile_duration'):
+        registry.counter('jax.compiles').inc()
+        registry.counter('jax.compile_ms').inc(secs * 1e3)
+        registry.histogram('jax.compile_duration_ms').observe(secs * 1e3)
+
+
+def record_host_transfer(nbytes, kind='device_get'):
+    """Count one device→host materialization of ``nbytes`` bytes."""
+    if not state.enabled():
+        return
+    registry.counter('host_transfer.calls').inc()
+    registry.counter('host_transfer.bytes').inc(int(nbytes))
+    registry.counter(f'host_transfer.{kind}.bytes').inc(int(nbytes))
+
+
+def record_collective(op, nbytes):
+    """Count one collective launch of ``nbytes`` payload bytes."""
+    if not state.enabled():
+        return
+    registry.counter(f'collective.{op}.calls').inc()
+    registry.counter(f'collective.{op}.bytes').inc(int(nbytes))
+
+
+def summary():
+    """The headline interposed counters, for bench extras / train_end
+    events: retraces (jaxpr traces), compiles, total compile ms, and
+    host-transfer traffic."""
+    snap = registry.snapshot()['counters']
+    return {
+        'jax_traces': snap.get('jax.traces', 0),
+        'jax_compiles': snap.get('jax.compiles', 0),
+        'jax_compile_ms': round(float(snap.get('jax.compile_ms', 0)), 3),
+        'host_transfer_bytes': snap.get('host_transfer.bytes', 0),
+        'host_transfer_calls': snap.get('host_transfer.calls', 0),
+    }

@@ -1,0 +1,235 @@
+"""Process-wide metrics registry: counters, gauges, bounded histograms.
+
+One thread-safe singleton (``get_registry()``) shared by every instrumented
+layer; exporters read a consistent ``snapshot()`` or the Prometheus-style
+text exposition (``to_prometheus()``). All instruments are created lazily by
+name — ``counter('executor.program_cache.misses').inc()`` is the whole API
+at a call site — so instrumentation never needs registration boilerplate.
+
+Updates are metric-local locks (an ``inc()`` never contends with an
+unrelated ``observe()``); creation takes the registry lock once per name.
+"""
+import math
+import random
+import re
+import threading
+
+__all__ = ['Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
+           'get_registry', 'counter', 'gauge', 'histogram',
+           'reset', 'snapshot', 'to_prometheus']
+
+
+class Counter:
+    """Monotonically increasing value (int or float increments)."""
+
+    kind = 'counter'
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative increment {n}")
+        with self._lock:
+            self._value += n
+            return self._value
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (queue depth, cache size, ...)."""
+
+    kind = 'gauge'
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1):
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Streaming distribution with a bounded reservoir.
+
+    Exact count/sum/min/max plus a ``reservoir_size``-bounded uniform sample
+    (Vitter's algorithm R, deterministic per-instrument seed) for quantile
+    estimates — memory stays O(reservoir) over arbitrarily long runs.
+    """
+
+    kind = 'histogram'
+
+    def __init__(self, name, reservoir_size=512):
+        self.name = name
+        self.reservoir_size = int(reservoir_size)
+        self._lock = threading.Lock()
+        self._rng = random.Random(hash(name) & 0xffffffff)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._reservoir = []
+
+    def observe(self, x):
+        x = float(x)
+        with self._lock:
+            self.count += 1
+            self.sum += x
+            self.min = min(self.min, x)
+            self.max = max(self.max, x)
+            if len(self._reservoir) < self.reservoir_size:
+                self._reservoir.append(x)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.reservoir_size:
+                    self._reservoir[j] = x
+
+    @property
+    def mean(self):
+        return self.sum / self.count if self.count else 0.0
+
+    def percentile(self, p):
+        """Estimated p-th percentile (0..100) from the reservoir."""
+        with self._lock:
+            if not self._reservoir:
+                return 0.0
+            vals = sorted(self._reservoir)
+        k = min(len(vals) - 1, max(0, int(round(p / 100.0 * (len(vals) - 1)))))
+        return vals[k]
+
+    def stats(self):
+        with self._lock:
+            if not self.count:
+                return {'count': 0, 'sum': 0.0, 'min': 0.0, 'max': 0.0,
+                        'mean': 0.0, 'p50': 0.0, 'p99': 0.0}
+        return {'count': self.count, 'sum': self.sum, 'min': self.min,
+                'max': self.max, 'mean': self.mean,
+                'p50': self.percentile(50), 'p99': self.percentile(99)}
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get(self, cls, name, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested as {cls.kind}")
+            return m
+
+    def counter(self, name):
+        return self._get(Counter, name)
+
+    def gauge(self, name):
+        return self._get(Gauge, name)
+
+    def histogram(self, name, reservoir_size=512):
+        return self._get(Histogram, name, reservoir_size=reservoir_size)
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+
+    def snapshot(self):
+        """Consistent point-in-time dict: counters/gauges as scalars,
+        histograms as their stats dicts."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out = {'counters': {}, 'gauges': {}, 'histograms': {}}
+        for name, m in sorted(items):
+            if m.kind == 'counter':
+                out['counters'][name] = m.value
+            elif m.kind == 'gauge':
+                out['gauges'][name] = m.value
+            else:
+                out['histograms'][name] = m.stats()
+        return out
+
+    def to_prometheus(self, prefix='paddle_tpu'):
+        """Prometheus-style text exposition (metric names sanitized to
+        ``[a-z0-9_]``; histograms exposed summary-style)."""
+        lines = []
+        snap = self.snapshot()
+        for name, v in snap['counters'].items():
+            n = _sanitize(prefix, name)
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {_fmt(v)}")
+        for name, v in snap['gauges'].items():
+            n = _sanitize(prefix, name)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {_fmt(v)}")
+        for name, st in snap['histograms'].items():
+            n = _sanitize(prefix, name)
+            lines.append(f"# TYPE {n} summary")
+            lines.append(f"{n}_count {st['count']}")
+            lines.append(f"{n}_sum {_fmt(st['sum'])}")
+            for q, key in (('0.5', 'p50'), ('0.99', 'p99')):
+                lines.append(f'{n}{{quantile="{q}"}} {_fmt(st[key])}')
+        return '\n'.join(lines) + ('\n' if lines else '')
+
+
+def _sanitize(prefix, name):
+    return re.sub(r'[^a-zA-Z0-9_]', '_', f"{prefix}_{name}").lower()
+
+
+def _fmt(v):
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry():
+    return _REGISTRY
+
+
+def counter(name):
+    return _REGISTRY.counter(name)
+
+
+def gauge(name):
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name, reservoir_size=512):
+    return _REGISTRY.histogram(name, reservoir_size=reservoir_size)
+
+
+def reset():
+    _REGISTRY.reset()
+
+
+def snapshot():
+    return _REGISTRY.snapshot()
+
+
+def to_prometheus(prefix='paddle_tpu'):
+    return _REGISTRY.to_prometheus(prefix=prefix)

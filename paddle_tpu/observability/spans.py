@@ -1,0 +1,158 @@
+"""Span tracer: Chrome trace-event JSON + jax.profiler bridge.
+
+``span(name)`` times a host-side region and records a Chrome trace "complete"
+event (``ph: "X"``, microsecond ``ts``/``dur``) into a bounded in-process
+buffer; ``dump_chrome_trace(path)`` writes the buffer as a JSON array that
+loads directly in Perfetto / chrome://tracing. Two disciplines keep the
+tracer honest on an async accelerator runtime:
+
+- **device-trace bridging**: while a ``jax.profiler`` trace is active
+  (``utils.profiler.start_profiler``), every span also enters a
+  ``jax.profiler.TraceAnnotation`` so the same region shows up in the xplane
+  dump — one set of annotations, two viewers.
+- **sampled sync**: a span wrapping dispatched device work measures only
+  host dispatch time unless it blocks. ``span(name, sync=value)`` calls
+  ``jax.block_until_ready(value)`` on a *sampled* subset of occurrences (the
+  1st and every ``PADDLE_TPU_TELEMETRY_SYNC_EVERY``-th per span name, default
+  16) so timing never adds an unsampled host sync to the steady-state step.
+  Synced occurrences carry ``args.synced: true`` so readers can tell real
+  latencies from dispatch times.
+"""
+import json
+import os
+import threading
+import time
+
+from . import state
+
+__all__ = ['span', 'Span', 'dump_chrome_trace', 'trace_events',
+           'clear', 'MAX_TRACE_EVENTS']
+
+MAX_TRACE_EVENTS = 65536
+
+_lock = threading.Lock()
+_events = []
+_dropped = [0]
+_sync_counts = {}
+_EPOCH = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _EPOCH) * 1e6
+
+
+def _device_trace_active():
+    """True while utils.profiler has a jax device trace running."""
+    try:
+        from ..utils import profiler
+        return profiler._active.get('dir') is not None
+    except Exception:
+        return False
+
+
+def _should_sync(name):
+    every = state.sync_every()
+    if every <= 0:
+        return False
+    with _lock:
+        n = _sync_counts.get(name, 0)
+        _sync_counts[name] = n + 1
+    return n % every == 0
+
+
+def _record(name, ts_us, dur_us, args):
+    ev = {'name': name, 'ph': 'X', 'ts': round(ts_us, 3),
+          'dur': round(dur_us, 3), 'pid': os.getpid(),
+          'tid': threading.get_ident()}
+    if args:
+        ev['args'] = args
+    with _lock:
+        if len(_events) >= MAX_TRACE_EVENTS:
+            _dropped[0] += 1
+            return
+        _events.append(ev)
+
+
+class Span:
+    """Reentrant-per-instance context manager; use via ``span(name, ...)``.
+
+    The jax.profiler bridge engages whenever a device trace is active —
+    independent of the telemetry switch — so ``utils.profiler.annotate``
+    keeps its xplane contract even with telemetry off; the Chrome-trace
+    record is only kept while telemetry is enabled.
+    """
+
+    __slots__ = ('name', 'sync', 'args', '_t0', '_bridge', '_recording')
+
+    def __init__(self, name, sync=None, **attrs):
+        self.name = name
+        self.sync = sync
+        self.args = dict(attrs) if attrs else None
+        self._t0 = 0.0
+        self._bridge = None
+        self._recording = False
+
+    def __enter__(self):
+        self._recording = state.enabled()
+        if _device_trace_active():
+            try:
+                import jax
+                self._bridge = jax.profiler.TraceAnnotation(self.name)
+                self._bridge.__enter__()
+            except Exception:
+                self._bridge = None
+        if self._recording:
+            self._t0 = _now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._recording:
+            if self.sync is not None and exc_type is None and \
+                    _should_sync(self.name):
+                try:
+                    import jax
+                    # a callable defers capture to exit time, for values
+                    # that only exist once the wrapped block ran
+                    val = self.sync() if callable(self.sync) else self.sync
+                    if val is not None:
+                        jax.block_until_ready(val)
+                        self.args = dict(self.args or {})
+                        self.args['synced'] = True
+                except Exception:
+                    pass
+            t1 = _now_us()
+            _record(self.name, self._t0, t1 - self._t0, self.args)
+        if self._bridge is not None:
+            self._bridge.__exit__(exc_type, exc, tb)
+            self._bridge = None
+        return False
+
+
+def span(name, sync=None, **attrs):
+    """Context manager timing a named host region (see module docstring)."""
+    return Span(name, sync=sync, **attrs)
+
+
+def trace_events():
+    with _lock:
+        return list(_events)
+
+
+def dropped():
+    return _dropped[0]
+
+
+def clear():
+    with _lock:
+        _events.clear()
+        _sync_counts.clear()
+        _dropped[0] = 0
+
+
+def dump_chrome_trace(path):
+    """Write buffered spans as a Chrome trace-event JSON array (loads in
+    Perfetto / chrome://tracing). Returns the number of events written."""
+    evs = trace_events()
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(evs, f)
+    return len(evs)

@@ -1,0 +1,71 @@
+"""Telemetry runtime state: the process-wide on/off switch and knobs.
+
+Stdlib-only and import-cycle-free on purpose: every instrumented narrow
+waist (``core.tensor``, ``static.executor``, ``io.dataloader``, ...) imports
+the observability package at module load, so nothing here may import jax or
+any other ``paddle_tpu`` module at import time.
+
+Env vars (read once at import; ``enable()``/``disable()`` override):
+
+- ``PADDLE_TPU_TELEMETRY=1``       turn telemetry on for the process
+- ``PADDLE_TPU_TELEMETRY_DIR``     where exporters write events.jsonl /
+                                   trace.json (default /tmp/paddle_tpu_telemetry)
+- ``PADDLE_TPU_TELEMETRY_SYNC_EVERY``
+                                   sampled block_until_ready cadence for
+                                   spans carrying device values: sample the
+                                   1st and every Nth occurrence of a span
+                                   name (default 16; 0 disables syncing)
+"""
+import os
+import threading
+
+_DEFAULT_DIR = '/tmp/paddle_tpu_telemetry'
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+class _State:
+    def __init__(self):
+        self.enabled = os.environ.get('PADDLE_TPU_TELEMETRY', '') == '1'
+        self.log_dir = os.environ.get('PADDLE_TPU_TELEMETRY_DIR',
+                                      _DEFAULT_DIR)
+        self.sync_every = _env_int('PADDLE_TPU_TELEMETRY_SYNC_EVERY', 16)
+        self.lock = threading.Lock()
+
+
+_STATE = _State()
+
+
+def enabled():
+    """Cheap hot-path guard; every instrumentation site checks this first."""
+    return _STATE.enabled
+
+
+def enable(log_dir=None, sync_every=None):
+    """Turn telemetry on (also installs the jax compile/retrace hooks)."""
+    if log_dir is not None:
+        _STATE.log_dir = log_dir
+    if sync_every is not None:
+        _STATE.sync_every = int(sync_every)
+    _STATE.enabled = True
+    from . import interpose
+    interpose.install_jax_hooks()
+
+
+def disable():
+    """Turn telemetry off. Hooks stay registered (they are no-ops while
+    disabled; jax.monitoring has no targeted unregister)."""
+    _STATE.enabled = False
+
+
+def log_dir():
+    return _STATE.log_dir
+
+
+def sync_every():
+    return _STATE.sync_every

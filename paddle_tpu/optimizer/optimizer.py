@@ -18,6 +18,7 @@ from ..core.autograd import no_grad
 from ..nn.clip import ClipGradBase
 from ..nn.regularizer import WeightDecayRegularizer
 from .lr import LRScheduler
+from .. import observability as _obs
 
 
 class Optimizer:
@@ -184,17 +185,18 @@ class Optimizer:
         if params is None:
             raise ValueError("Optimizer created without parameters; pass "
                              "parameters=model.parameters()")
-        params_grads = [(p, p.grad._value) for p in params
-                        if p.grad is not None and p.trainable]
-        params_grads = self._apply_decay_and_clip(params_grads)
-        lr = self.get_lr()
-        for p, g in params_grads:
-            key, state = self._param_state(p)
-            p_lr = lr * p.optimize_attr.get('learning_rate', 1.0)
-            new_val, new_state = self._rule(g, p._value, state, p_lr)
-            p._inplace_value(new_val)
-            self._accumulators[key] = new_state
-        self._global_step += 1
+        with _obs.timer('optimizer.step', optimizer=type(self).__name__):
+            params_grads = [(p, p.grad._value) for p in params
+                            if p.grad is not None and p.trainable]
+            params_grads = self._apply_decay_and_clip(params_grads)
+            lr = self.get_lr()
+            for p, g in params_grads:
+                key, state = self._param_state(p)
+                p_lr = lr * p.optimize_attr.get('learning_rate', 1.0)
+                new_val, new_state = self._rule(g, p._value, state, p_lr)
+                p._inplace_value(new_val)
+                self._accumulators[key] = new_state
+            self._global_step += 1
 
     _static_state = None
 
@@ -408,21 +410,23 @@ class AdamW(Adam):
     def step(self):
         # decoupled decay with per-param predicate
         params = self._parameters
-        params_grads = [(p, p.grad._value) for p in params
-                        if p.grad is not None and p.trainable]
-        params_grads = self._apply_decay_and_clip(params_grads)
-        lr = self.get_lr()
-        for p, g in params_grads:
-            key, state = self._param_state(p)
-            p_lr = lr * p.optimize_attr.get('learning_rate', 1.0)
-            decay = (self._apply_decay_fn is None or
-                     self._apply_decay_fn(p.name))
-            new_val, new_state = Adam._rule(self, g, p._value, state, p_lr)
-            if decay:
-                new_val = new_val - p_lr * self._coeff * p._value
-            p._inplace_value(new_val)
-            self._accumulators[key] = new_state
-        self._global_step += 1
+        with _obs.timer('optimizer.step', optimizer=type(self).__name__):
+            params_grads = [(p, p.grad._value) for p in params
+                            if p.grad is not None and p.trainable]
+            params_grads = self._apply_decay_and_clip(params_grads)
+            lr = self.get_lr()
+            for p, g in params_grads:
+                key, state = self._param_state(p)
+                p_lr = lr * p.optimize_attr.get('learning_rate', 1.0)
+                decay = (self._apply_decay_fn is None or
+                         self._apply_decay_fn(p.name))
+                new_val, new_state = Adam._rule(self, g, p._value, state,
+                                                p_lr)
+                if decay:
+                    new_val = new_val - p_lr * self._coeff * p._value
+                p._inplace_value(new_val)
+                self._accumulators[key] = new_state
+            self._global_step += 1
 
 
 class Adamax(Optimizer):

@@ -8,6 +8,8 @@ import queue
 import random
 import threading
 
+from .. import observability as _obs
+
 __all__ = ['map_readers', 'shuffle', 'chain', 'buffered', 'compose',
            'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
            'ComposeNotAligned']
@@ -114,7 +116,17 @@ def buffered(reader, size):
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         while True:
-            e = q.get()
+            if _obs.enabled():
+                # consumer-side starvation signal: how long the training
+                # loop sat waiting on the producer, and how full the
+                # read-ahead buffer is when a sample is taken
+                sw = _obs.Stopwatch()
+                e = q.get()
+                _obs.histogram('reader.buffered.wait_ms').observe(
+                    sw.elapsed_ms())
+                _obs.gauge('reader.buffered.depth').set(q.qsize())
+            else:
+                e = q.get()
             if e is end:
                 if err:
                     raise err[0]

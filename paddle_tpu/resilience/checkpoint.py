@@ -21,6 +21,7 @@ import warnings
 import zlib
 
 from .atomic_io import atomic_open, atomic_write, crc32_file
+from .. import observability as _obs
 
 __all__ = ['CheckpointManager', 'capture_rng', 'restore_rng']
 
@@ -72,6 +73,7 @@ class CheckpointManager:
             step = 0 if latest is None else latest + 1
         step = int(step)
         pay_path = self._payload(step)
+        sw = _obs.Stopwatch()
         with atomic_open(pay_path) as f:   # streamed: no full blob in RAM
             w = _Crc32Writer(f)
             pickle.dump(_to_saveable(state), w, protocol=4)
@@ -82,6 +84,12 @@ class CheckpointManager:
         atomic_write(self._manifest(step),
                      json.dumps(manifest, sort_keys=True).encode())
         self._rotate()
+        if _obs.enabled():
+            ms = sw.elapsed_ms()
+            _obs.histogram('checkpoint.save_ms').observe(ms)
+            _obs.counter('checkpoint.saves').inc()
+            _obs.event('checkpoint.save', step=step, bytes=w.size,
+                       duration_ms=round(ms, 3), meta=dict(meta or {}))
         return step
 
     def _rotate(self):
@@ -127,6 +135,7 @@ class CheckpointManager:
         from ..framework import _from_saveable
         candidates = [step] if step is not None else \
             list(reversed(self.steps()))
+        sw = _obs.Stopwatch()
         for s in candidates:
             defect = self._check(s)
             if defect is None:
@@ -138,7 +147,16 @@ class CheckpointManager:
                 else:
                     with open(self._manifest(s), 'rb') as f:
                         meta = json.loads(f.read().decode()).get('meta', {})
+                    if _obs.enabled():
+                        ms = sw.elapsed_ms()
+                        _obs.histogram('checkpoint.restore_ms').observe(ms)
+                        _obs.counter('checkpoint.restores').inc()
+                        _obs.event('checkpoint.restore', step=s,
+                                   duration_ms=round(ms, 3))
                     return _from_saveable(state, return_numpy), meta
+            if _obs.enabled():
+                _obs.counter('checkpoint.corrupt_skips').inc()
+                _obs.event('checkpoint.corrupt', step=s, defect=str(defect))
             warnings.warn(
                 "CheckpointManager: checkpoint step %d at %r is corrupt "
                 "(%s) — falling back to the previous good checkpoint"

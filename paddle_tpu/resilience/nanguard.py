@@ -9,6 +9,8 @@ mechanisms see a consistent count of bad steps.
 """
 import numpy as np
 
+from .. import observability as _obs
+
 __all__ = ['NanGuard', 'NanStepError']
 
 
@@ -48,6 +50,11 @@ class NanGuard:
             return False
         self.skipped_steps += 1
         self.consecutive_skips += 1
+        if _obs.enabled():
+            _obs.counter('nan_guard.skips').inc()
+            _obs.event('nan_guard.skip', step=self.total_steps,
+                       skipped=self.skipped_steps,
+                       consecutive=self.consecutive_skips)
         if self._scaler is not None and self._scaler.is_enable():
             self._scaler.mark_found_inf()
         if self._verbose:
@@ -58,6 +65,8 @@ class NanGuard:
                 % (self.total_steps, self.skipped_steps,
                    self.consecutive_skips))
         if self.consecutive_skips >= self.max_consecutive_skips:
+            _obs.event('nan_guard.abort', step=self.total_steps,
+                       consecutive=self.consecutive_skips)
             raise NanStepError(
                 "NanGuard: %d consecutive non-finite steps (limit %d) — "
                 "the run is diverging; lower the learning rate or inspect "

@@ -8,6 +8,8 @@ import functools
 import random
 import time
 
+from .. import observability as _obs
+
 __all__ = ['retry', 'RetryError']
 
 # seam for tests/faultinject: patch to a recorder to assert backoff schedules
@@ -74,6 +76,12 @@ def retry(max_attempts=3, backoff=0.1, factor=2.0, max_backoff=30.0,
                             last_exception=e, attempts=attempt) from e
                     if on_retry is not None:
                         on_retry(attempt, e, delay)
+                    if _obs.enabled():
+                        _obs.counter('retry.attempts').inc()
+                        _obs.event('retry.attempt',
+                                   fn=getattr(fn, '__name__', str(fn)),
+                                   attempt=attempt, delay=round(delay, 3),
+                                   error=repr(e))
                     _retry_sleep(delay)
             if reraise:
                 raise last

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, Parameter
 from ..core.dtypes import convert_dtype
 from .graph import Program, Variable, default_main_program
+from .. import observability as _obs
 
 
 def _program_params(program):
@@ -107,7 +108,8 @@ class Executor:
         # a KeyError deep inside the jitted interpreter.
         from ..analysis.verify import assert_verified, verify_enabled
         if verify_enabled(verify):
-            assert_verified(program, fetch_list=fetch_list)
+            with _obs.timer('executor.verify'):
+                assert_verified(program, fetch_list=fetch_list)
 
         fetch_vars = [self._resolve(program, f) for f in fetch_list]
         feed_items = sorted(feed.items())
@@ -132,25 +134,41 @@ class Executor:
         key = (program._fingerprint, tuple(feed_names),
                tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
                tuple(v.name for v in fetch_vars), train_spec is not None, dp)
+        telemetry = _obs.enabled()
         if key not in self._cache:
-            self._cache[key] = self._compile(program, feed_names, fetch_vars,
-                                             param_names, train_spec, dp=dp)
+            if telemetry:
+                _obs.counter('executor.program_cache.misses').inc()
+            with _obs.timer('executor.build'):
+                self._cache[key] = self._compile(program, feed_names,
+                                                 fetch_vars, param_names,
+                                                 train_spec, dp=dp)
+        elif telemetry:
+            _obs.counter('executor.program_cache.hits').inc()
         compiled = self._cache[key]
-        if train_spec is not None:
-            optimizer = train_spec[1]
-            if getattr(optimizer, '_static_state', None) is None:
-                optimizer._static_state = optimizer.init_state_values(
-                    {v.name: val for v, val in zip(params, param_vals)})
-            outs, new_param_vals, new_state = compiled(
-                feed_vals, param_vals, optimizer._static_state)
-            optimizer._static_state = new_state
-        else:
-            outs, new_param_vals = compiled(feed_vals, param_vals)
+        # sampled sync: the run span blocks on the fetched outputs only on
+        # sampled occurrences, so timing the step never adds a host sync the
+        # steady-state pipeline would not have had
+        outs = None
+        with _obs.timer('executor.run', sync=lambda: outs):
+            if train_spec is not None:
+                optimizer = train_spec[1]
+                if getattr(optimizer, '_static_state', None) is None:
+                    optimizer._static_state = optimizer.init_state_values(
+                        {v.name: val for v, val in zip(params, param_vals)})
+                outs, new_param_vals, new_state = compiled(
+                    feed_vals, param_vals, optimizer._static_state)
+                optimizer._static_state = new_state
+            else:
+                outs, new_param_vals = compiled(feed_vals, param_vals)
         if new_param_vals is not None:
             for v, nv in zip(params, new_param_vals):
                 v.concrete._inplace_value(nv)
         if return_numpy:
-            return [np.asarray(jax.device_get(o)) for o in outs]
+            fetched = [np.asarray(jax.device_get(o)) for o in outs]
+            if telemetry:
+                _obs.record_host_transfer(
+                    sum(a.nbytes for a in fetched), kind='executor.fetch')
+            return fetched
         return [Tensor(o) for o in outs]
 
     # -- dataset-driven training (the reference's train/ device-worker

@@ -19,12 +19,18 @@ _active = {'dir': None, 'py': None}
 
 def start_profiler(state='All', tracer_option='Default',
                    log_dir='/tmp/paddle_tpu_profile'):
+    from .. import observability as _obs
     try:
         jax.profiler.start_trace(log_dir)
         _active['dir'] = log_dir
-    except Exception:
+        _obs.event('profiler.start_trace', log_dir=log_dir)
+    except Exception as e:
+        # device trace unavailable (or already running): cProfile fallback
+        # still gives a host-side picture. stop_profiler clears BOTH states,
+        # so a failed double-start cannot leak an enabled profile.
         _active['py'] = cProfile.Profile()
         _active['py'].enable()
+        _obs.event('profiler.fallback_cprofile', error=repr(e))
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
@@ -40,6 +46,8 @@ def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
         jax.profiler.stop_trace()
         log_dir = _active['dir']
         _active['dir'] = None
+        from .. import observability as _obs
+        _obs.event('profiler.stop_trace', log_dir=log_dir)
         print(f"profile trace written to {log_dir}")
         table = _op_summary(log_dir, sorted_key)
         if table:
@@ -101,8 +109,16 @@ profile_scope = profiler
 
 
 def annotate(name):
-    """Named trace region (shows up in xplane/TensorBoard)."""
-    return jax.profiler.TraceAnnotation(name)
+    """Named trace region. Shows up in the xplane/TensorBoard dump while a
+    device trace is active (the observability span bridges into
+    ``jax.profiler.TraceAnnotation`` then) AND in the telemetry Chrome trace
+    whenever ``PADDLE_TPU_TELEMETRY=1`` — one annotation, both viewers."""
+    from .. import observability as _obs
+    if _active['dir'] is None and not _obs.enabled():
+        # no device trace, no telemetry: keep the raw TraceAnnotation so
+        # user-driven jax.profiler workflows see the region regardless
+        return jax.profiler.TraceAnnotation(name)
+    return _obs.span(name)
 
 
 def get_hlo(fn, *args, optimized=False):

@@ -28,6 +28,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "lint: static-analysis gates (graftlint over the repo; "
         "pure AST, no tracing)")
+    config.addinivalue_line(
+        "markers", "obs: observability/telemetry tests (metrics registry, "
+        "spans, step events, interposed counters)")
 
 
 @pytest.fixture(autouse=True)

@@ -74,6 +74,11 @@ AST_FIXTURES = {
     'GL010': ("def save(path, blob):\n"
               "    with open(path, 'wb') as f:\n"
               "        f.write(blob)\n", "open(path, 'wb')"),
+    'GL011': ("import time\n"
+              "def run_step(fn):\n"
+              "    t0 = time.perf_counter()\n"
+              "    fn()\n"
+              "    return time.perf_counter() - t0\n", "time.perf_counter"),
 }
 
 
@@ -209,6 +214,48 @@ def test_gl010_scope_without_config(tmp_path):
         "def save(p):\n    with open(p, 'wb') as f:\n        f.write(b'x')\n")
     findings, _ = lint_paths([str(tmp_path / 'paddle_tpu')])
     assert any(f.rule == 'GL010' for f in findings)
+
+
+TIMING_SRC = ("import time\n"
+              "def f():\n"
+              "    return time.perf_counter()\n")
+
+
+def test_gl011_exempts_tests_tools_bench_and_observability(tmp_path):
+    # tests/tools/bench harnesses and the telemetry package itself may read
+    # raw clocks; library code may not
+    for sub in ('tests', 'tools', 'paddle_tpu/observability'):
+        d = tmp_path / sub
+        d.mkdir(parents=True, exist_ok=True)
+        (d / 'mod.py').write_text(TIMING_SRC)
+        findings, _ = lint_paths([str(d / 'mod.py')],
+                                 scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL011'] == [], sub
+    (tmp_path / 'bench_thing.py').write_text(TIMING_SRC)
+    findings, _ = lint_paths([str(tmp_path / 'bench_thing.py')],
+                             scan_root=str(tmp_path))
+    assert [f for f in findings if f.rule == 'GL011'] == []
+    lib = tmp_path / 'paddle_tpu'
+    (lib / 'mod.py').write_text(TIMING_SRC)
+    findings, _ = lint_paths([str(lib / 'mod.py')],
+                             scan_root=str(tmp_path))
+    hits = [f for f in findings if f.rule == 'GL011']
+    assert len(hits) == 1 and hits[0].line == 3
+    assert 'observability.timer' in hits[0].message
+
+
+def test_gl011_allows_monotonic_deadlines(tmp_path):
+    # timeout/deadline math is not duration measurement
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'deadline.py').write_text(
+        "import time\n"
+        "def wait(timeout):\n"
+        "    deadline = time.monotonic() + timeout\n"
+        "    return deadline\n")
+    findings, _ = lint_paths([str(lib / 'deadline.py')],
+                             scan_root=str(tmp_path))
+    assert [f for f in findings if f.rule == 'GL011'] == []
 
 
 def test_unresolvable_fetch_does_not_flood_gv006():

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""telemetry_dump: pretty-print a telemetry JSONL event log, or convert it
+to Chrome trace-event format (loadable in Perfetto / chrome://tracing).
+
+Usage::
+
+    python tools/telemetry_dump.py <events.jsonl>               # table
+    python tools/telemetry_dump.py <events.jsonl> --tail 50     # last 50
+    python tools/telemetry_dump.py <events.jsonl> --ev step     # filter kind
+    python tools/telemetry_dump.py <events.jsonl> --chrome out.json
+
+The input is what ``observability.dump_jsonl`` / ``TelemetryCallback`` write
+(one JSON object per line with ``ev`` and ``ts`` keys). Conversion maps
+events carrying a ``duration_ms``/``step_ms`` field to complete ("X") trace
+events and everything else to instant ("i") events, timestamped relative to
+the first event. Stdlib-only: usable on a machine with no jax installed.
+"""
+import argparse
+import json
+import sys
+
+
+def load_events(path):
+    """Parse a JSONL event log; malformed lines are skipped with a count."""
+    events, bad = [], 0
+    with open(path, 'r', encoding='utf-8') as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if isinstance(rec, dict):
+                events.append(rec)
+            else:
+                bad += 1
+    return events, bad
+
+
+_DUR_KEYS = ('duration_ms', 'step_ms')
+
+
+def to_chrome_trace(events):
+    """Chrome trace-event list: durations as 'X' events, the rest instant."""
+    if not events:
+        return []
+    t0 = min(e.get('ts', 0) for e in events)
+    out = []
+    for e in events:
+        ts_us = (e.get('ts', t0) - t0) * 1e6
+        args = {k: v for k, v in e.items() if k not in ('ev', 'ts')}
+        ev = {'name': e.get('ev', '?'), 'pid': 0, 'tid': 0, 'args': args}
+        dur_ms = next((e[k] for k in _DUR_KEYS if isinstance(
+            e.get(k), (int, float))), None)
+        if dur_ms is not None:
+            # the event is stamped at completion: start the slice dur earlier
+            ev.update(ph='X', ts=round(ts_us - dur_ms * 1e3, 3),
+                      dur=round(dur_ms * 1e3, 3))
+        else:
+            ev.update(ph='i', ts=round(ts_us, 3), s='p')
+        out.append(ev)
+    out.sort(key=lambda e: e['ts'])
+    return out
+
+
+def render_table(events, limit=None):
+    """Aligned human listing: relative time, kind, then the fields."""
+    if not events:
+        return '(no events)'
+    t0 = min(e.get('ts', 0) for e in events)
+    shown = events[-limit:] if limit else events
+    kw = max(len(e.get('ev', '?')) for e in shown)
+    lines = []
+    for e in shown:
+        rel = e.get('ts', t0) - t0
+        fields = ' '.join(f"{k}={_short(v)}" for k, v in sorted(e.items())
+                          if k not in ('ev', 'ts'))
+        lines.append(f"{rel:>10.3f}s  {e.get('ev', '?'):<{kw}}  {fields}")
+    if limit and len(events) > limit:
+        lines.insert(0, f"... ({len(events) - limit} earlier event(s))")
+    return '\n'.join(lines)
+
+
+def _short(v, n=60):
+    s = json.dumps(v, sort_keys=True) if isinstance(v, (dict, list)) \
+        else str(v)
+    return s if len(s) <= n else s[:n - 3] + '...'
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='telemetry_dump',
+        description='pretty-print / convert a paddle_tpu telemetry JSONL '
+                    'event log (docs/OBSERVABILITY.md)')
+    p.add_argument('log', help='events.jsonl written by TelemetryCallback / '
+                               'observability.dump_jsonl')
+    p.add_argument('--chrome', metavar='OUT',
+                   help='write Chrome trace-event JSON to OUT instead of '
+                        'printing a table')
+    p.add_argument('--ev', default=None,
+                   help='only events of this kind (e.g. step, retry.attempt)')
+    p.add_argument('--tail', type=int, default=None,
+                   help='show only the last N events')
+    args = p.parse_args(argv)
+
+    try:
+        events, bad = load_events(args.log)
+    except OSError as e:
+        print(f"telemetry_dump: cannot read {args.log}: {e}",
+              file=sys.stderr)
+        return 2
+    if bad:
+        print(f"telemetry_dump: skipped {bad} malformed line(s)",
+              file=sys.stderr)
+    if args.ev:
+        events = [e for e in events if e.get('ev') == args.ev]
+
+    if args.chrome:
+        trace = to_chrome_trace(events)
+        with open(args.chrome, 'w', encoding='utf-8') as f:
+            json.dump(trace, f)
+        print(f"wrote {len(trace)} trace event(s) to {args.chrome}")
+        return 0
+
+    print(render_table(events, limit=args.tail))
+    counts = {}
+    for e in events:
+        counts[e.get('ev', '?')] = counts.get(e.get('ev', '?'), 0) + 1
+    tally = ', '.join(f"{k}: {v}" for k, v in sorted(counts.items()))
+    print(f"-- {len(events)} event(s){' (' + tally + ')' if tally else ''}")
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
