@@ -609,8 +609,10 @@ def enable_xla_cache():
 
 def _telemetry_counters():
     """Interposed telemetry counters (retraces, compile time, host-transfer
-    bytes) for BENCH extras, so BENCH_*.json captures them alongside
-    throughput. Enabled at child start; never fatal."""
+    bytes, and the fault-tolerance tallies: DataLoader worker restarts,
+    quarantined samples, watchdog/collective timeouts) for BENCH extras, so
+    BENCH_*.json captures them alongside throughput — a run that self-healed
+    is flagged as such. Enabled at child start; never fatal."""
     try:
         from paddle_tpu import observability as obs
         return obs.counters_summary()

@@ -10,9 +10,20 @@ each slot, copies out, releases. Array payloads never touch a pipe.
 Workers are data-only processes: they run dataset[i] + collate (numpy) and
 must not touch jax. Index batches and error strings travel over small
 multiprocessing queues; bulk bytes travel through the ring.
+
+Self-healing (docs/RESILIENCE.md): a worker killed mid-batch is detected by
+the parent's bounded ring wait, respawned (up to ``max_restarts``), and its
+orphaned batch is requeued — the ordered ring guarantees the stalled
+sequence number is exactly the number of items delivered so far. Poisoned
+samples are dropped worker-side and reported through ``err_q``; the parent
+charges them to the shared quarantine budget. Workers poll their task queue
+in bounded ticks and exit when the parent disappears (no orphan processes).
 """
 import multiprocessing as mp
 import os
+import queue as _queue
+import threading
+import time
 import traceback
 
 import numpy as np
@@ -20,8 +31,40 @@ import numpy as np
 from .prefetch import NativePrefetchRing, serialized_size, native_available
 
 
-def _worker_main(shm_name, task_q, err_q, dataset, collate_fn,
-                 worker_init_fn, wid):
+def _produce_batch(ring, err_q, dataset, collate_fn, seq, indices):
+    """Fetch + collate one batch and commit it to ring slot ``seq``,
+    reporting poisoned samples ('quarantine') and build failures ('fatal')
+    through ``err_q``. One protocol shared by worker processes and the
+    parent-side orphan rebuild so the two can never diverge. Returns False
+    only when the ring was closed mid-put (producer should stop)."""
+    try:
+        samples = []
+        for i in indices:
+            try:
+                samples.append(dataset[i])
+            except Exception:
+                err_q.put(('quarantine', seq, [i],
+                           traceback.format_exc()))
+        if not samples:
+            ring.skip(seq)      # consumer sees an empty slot
+            return True
+        batch = collate_fn(samples)
+        arrays = [np.asarray(a) for a in
+                  (batch if isinstance(batch, (list, tuple))
+                   else [batch])]
+        return ring.put(arrays, seq)
+    except Exception:
+        try:
+            err_q.put(('fatal', seq, list(indices),
+                       traceback.format_exc()))
+            ring.skip(seq)
+        except Exception:
+            pass
+        return True
+
+
+def _worker_main(shm_name, task_q, err_q, claims, dataset, collate_fn,
+                 worker_init_fn, wid, parent_pid):
     try:
         if worker_init_fn is not None:
             worker_init_fn(wid)
@@ -29,23 +72,25 @@ def _worker_main(shm_name, task_q, err_q, dataset, collate_fn,
         shm = shared_memory.SharedMemory(name=shm_name)
         ring = NativePrefetchRing.attach(shm.buf)
         while True:
-            task = task_q.get()
+            try:
+                task = task_q.get(timeout=1.0)
+            except _queue.Empty:
+                if os.getppid() != parent_pid:
+                    break    # parent died: do not linger as an orphan
+                continue
             if task is None:
                 break
             seq, indices = task
-            try:
-                batch = collate_fn([dataset[i] for i in indices])
-                arrays = [np.asarray(a) for a in
-                          (batch if isinstance(batch, (list, tuple))
-                           else [batch])]
-                if not ring.put(arrays, seq):
-                    break
-            except Exception:
-                err_q.put((seq, traceback.format_exc()))
-                ring.skip(seq)
+            # claim before building: if this process dies mid-batch the
+            # parent reads the claim to know exactly which seq was orphaned
+            claims[wid] = seq
+            if not _produce_batch(ring, err_q, dataset, collate_fn,
+                                  seq, indices):
+                break
+            claims[wid] = -1
     except Exception:
         try:
-            err_q.put((-1, traceback.format_exc()))
+            err_q.put(('fatal', -1, [], traceback.format_exc()))
         except Exception:
             pass
 
@@ -54,12 +99,21 @@ class ProcessWorkerPool:
     """Iterator over collated batches produced by fork()ed workers."""
 
     def __init__(self, dataset, batch_indices, collate_fn, num_workers,
-                 capacity=None, worker_init_fn=None, sample_batch=None):
+                 capacity=None, worker_init_fn=None, sample_batch=None,
+                 max_restarts=0, watchdog_timeout=300.0, quarantine=None):
         from multiprocessing import shared_memory
         if not native_available():
             raise RuntimeError("native ring unavailable")
         self._ctx = mp.get_context('fork')
         self._batches = list(batch_indices)
+        self._max_restarts = int(max_restarts)
+        self._watchdog_timeout = float(watchdog_timeout)
+        # quarantine(index, exc_repr) -> bool: shared budget owned by the
+        # DataLoader; None = no budget, first poisoned sample is fatal
+        self._quarantine = quarantine
+        self.restarts = 0
+        self._dataset = dataset
+        self._collate_fn = collate_fn
         if not self._batches:
             self._procs = []
             self._closed = True
@@ -83,6 +137,11 @@ class ProcessWorkerPool:
                                         _buf=self._shm.buf)
         self._task_q = self._ctx.Queue()
         self._err_q = self._ctx.Queue()
+        # per-worker claimed seq (-1 = idle): lets the parent tell an
+        # orphaned batch (claimed by a now-dead worker — rebuild it) from
+        # one a slow-but-live worker is still producing (leave it alone)
+        self._claims = self._ctx.Array('q', [-1] * num_workers)
+        self._orphaned = set()
         # batch 0 was already collated above for slot sizing: the parent
         # seeds it as seq 0 rather than having a worker recompute it
         self._ring.put(arrays, 0)
@@ -90,49 +149,153 @@ class ProcessWorkerPool:
             self._task_q.put((seq, list(indices)))
         for _ in range(num_workers):
             self._task_q.put(None)
-        self._procs = [
-            self._ctx.Process(
+        parent_pid = os.getpid()
+
+        def spawn_worker(wid):
+            return self._ctx.Process(
                 target=_worker_main,
-                args=(self._shm.name, self._task_q, self._err_q, dataset,
-                      collate_fn, worker_init_fn, w),
+                args=(self._shm.name, self._task_q, self._err_q,
+                      self._claims, dataset, collate_fn, worker_init_fn,
+                      wid, parent_pid),
                 daemon=True)
-            for w in range(num_workers)]
+
+        self._spawn_worker = spawn_worker
+        self._procs = [spawn_worker(w) for w in range(num_workers)]
         for p in self._procs:
             p.start()
         self._consumed = 0
+        self._requeued = set()
+        self._rebuild_t = None
         self._closed = False
+
+    def _harvest_orphans(self):
+        """Record the seq each now-dead worker had claimed but never
+        committed. Task seqs are handed out uniquely, so an orphaned seq
+        can only ever be produced by the parent-side rebuild."""
+        for i, p in enumerate(self._procs):
+            if p.exitcode is not None and self._claims[i] >= 0:
+                self._orphaned.add(self._claims[i])
+                self._claims[i] = -1
+
+    def _drain_errors(self):
+        """Pull every pending worker report; quarantine within budget,
+        raise on the first fatal (or budget-exceeding) one."""
+        while True:
+            try:
+                kind, seq, indices, tb = self._err_q.get_nowait()
+            except Exception:
+                return
+            if kind == 'quarantine' and self._quarantine is not None and \
+                    all(self._quarantine(i, tb.strip().splitlines()[-1])
+                        for i in indices):
+                continue
+            raise RuntimeError(
+                f"DataLoader worker failed on batch {seq} "
+                f"(indices {indices}):\n{tb}")
+
+    def _respawn_dead(self):
+        """Replace crashed workers (non-zero exitcode). Returns True when a
+        replacement was started."""
+        dead = [(i, p) for i, p in enumerate(self._procs)
+                if p.exitcode not in (None, 0)]
+        if not dead or self.restarts >= self._max_restarts:
+            return False
+        from .. import observability as _obs
+        started = False
+        for i, p in dead:
+            if self.restarts >= self._max_restarts:
+                break
+            self.restarts += 1
+            fresh = self._spawn_worker(i)
+            fresh.start()
+            self._procs[i] = fresh
+            started = True
+            if _obs.enabled():
+                _obs.counter('dataloader.worker_restarts').inc()
+                _obs.event('worker_restart', worker=i,
+                           exitcode=p.exitcode, restarts=self.restarts)
+        return started
+
+    def _reproduce_stalled(self):
+        """Produce the stalled batch from the parent (same path that seeds
+        batch 0) — only when ``_harvest_orphans`` proved the seq the
+        ordered ring is waiting on was orphaned by a dead worker, so no
+        live straggler can ever race the rebuild's ring.put.
+
+        Runs on a daemon helper thread: in the rare case the dead worker
+        had already claimed the write slot, the native acquire can block
+        until shutdown closes the ring — the thread is abandoned then and
+        the outer watchdog raises. Each seq is reproduced at most once."""
+        stalled = self._consumed
+        if stalled >= len(self._batches) or stalled in self._requeued \
+                or stalled not in self._orphaned:
+            return
+        self._requeued.add(stalled)
+        indices = list(self._batches[stalled])
+        self._rebuild_t = threading.Thread(
+            target=_produce_batch,
+            args=(self._ring, self._err_q, self._dataset, self._collate_fn,
+                  stalled, indices),
+            daemon=True, name='paddle-tpu-batch-rebuild')
+        self._rebuild_t.start()
 
     def __iter__(self):
         if self._closed:
             return
-        stalls = 0   # consecutive ring timeouts with zero progress
+        last_progress = time.monotonic()
+        respawned_this_stall = False
         try:
             while self._consumed < len(self._batches):
-                item = self._ring.get(timeout_ms=2000)
+                self._drain_errors()
+                item = self._ring.get(timeout_ms=1000)
                 if item == 'timeout':
                     # a worker that crashed AFTER claiming a batch never
-                    # commits/aborts its seq, so the ordered ring stalls on
-                    # that slot forever — raise once a dead (nonzero-exit)
-                    # worker coincides with sustained zero progress. A worker
-                    # killed while idle loses no batch: siblings keep
-                    # draining the shared task queue, progress continues,
-                    # and no error is raised.
-                    stalls += 1
+                    # commits its seq, so the ordered ring stalls on that
+                    # slot: harvest the dead worker's claim, respawn it,
+                    # then rebuild the orphaned batch parent-side (the
+                    # claim proves no live straggler can race the
+                    # rebuild); raise once an orphaned stall has no
+                    # restart budget left, every producer is gone, or the
+                    # watchdog expires.
+                    stalled_s = time.monotonic() - last_progress
+                    self._harvest_orphans()
+                    if self._respawn_dead():
+                        respawned_this_stall = True
+                        continue
+                    if respawned_this_stall:
+                        self._reproduce_stalled()
+                    orphan_stall = self._consumed in self._orphaned \
+                        and self._consumed not in self._requeued
                     dead = [p for p in self._procs
                             if p.exitcode not in (None, 0)]
-                    if (dead and stalls >= 3 and
-                            self._consumed < len(self._batches)):
+                    if dead and orphan_stall and stalled_s >= 2.0:
+                        # the stalled seq died with its worker and the
+                        # restart budget is spent: nobody will heal it
                         self._raise_worker_error(dead)
-                    if (self._consumed < len(self._batches) and
-                            not any(p.is_alive() for p in self._procs)):
+                    rebuilding = self._rebuild_t is not None \
+                        and self._rebuild_t.is_alive()
+                    if not any(p.is_alive() for p in self._procs) \
+                            and not rebuilding:
                         self._raise_worker_error(dead or None)
+                    if self._watchdog_timeout > 0 \
+                            and stalled_s >= self._watchdog_timeout:
+                        raise RuntimeError(
+                            f"DataLoader watchdog: no batch for "
+                            f"{stalled_s:.0f}s with "
+                            f"{sum(p.is_alive() for p in self._procs)} "
+                            "live worker(s) — hung worker or deadlocked "
+                            "pipeline")
                     continue
-                stalls = 0
+                last_progress = time.monotonic()
+                respawned_this_stall = False
                 self._consumed += 1
                 if item is None:
                     break
                 if item == 'skip':
-                    self._raise_worker_error()
+                    # producer aborted the slot: every sample quarantined
+                    # (budget already charged via err_q) or a fatal error
+                    # (raised by the drain above on the next loop)
+                    self._drain_errors()
                     continue
                 arrays, release = item
                 try:
@@ -140,21 +303,35 @@ class ProcessWorkerPool:
                 finally:
                     release()
                 yield out[0] if self._single and len(out) == 1 else out
+            # the last batch's error report can still be in the err_q
+            # feeder pipe when its 'skip' slot unblocks the ring: wait for
+            # the exiting workers to flush, then drain once more so a
+            # final-batch fatal (or quarantine charge) is never swallowed
+            deadline = time.monotonic() + 2.0
+            while any(p.is_alive() for p in self._procs) \
+                    and time.monotonic() < deadline:
+                self._drain_errors()
+                time.sleep(0.02)
+            self._drain_errors()
         finally:
             self.shutdown()
 
     def _raise_worker_error(self, dead=None):
         try:
-            seq, tb = self._err_q.get_nowait()
+            kind, seq, indices, tb = self._err_q.get_nowait()
         except Exception:
             if dead:   # killed without a traceback (segfault, OOM, kill -9)
                 codes = ', '.join('worker %d exitcode %s'
                                   % (self._procs.index(p), p.exitcode)
                                   for p in dead)
                 raise RuntimeError(
-                    "DataLoader worker died without a traceback (%s)" % codes)
+                    "DataLoader worker died without a traceback (%s) and "
+                    "the restart budget (%d) is exhausted"
+                    % (codes, self._max_restarts))
             raise RuntimeError("DataLoader worker failed (no traceback)")
-        raise RuntimeError(f"DataLoader worker failed on batch {seq}:\n{tb}")
+        raise RuntimeError(
+            f"DataLoader worker failed on batch {seq} "
+            f"(indices {indices}):\n{tb}")
 
     def shutdown(self):
         if self._closed:
@@ -165,6 +342,9 @@ class ProcessWorkerPool:
             p.join(timeout=2)
             if p.is_alive():
                 p.terminate()
+                p.join(timeout=1)
+            if p.is_alive():
+                p.kill()
         self._ring.destroy()
         try:
             self._shm.close()

@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL011).
+"""The graftlint AST rule catalog (GL001–GL012).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -16,6 +16,10 @@ but destroys performance or correctness on real hardware:
 - GL011: raw ``time.time()``/``perf_counter()`` timing in library code —
   durations measured ad hoc never reach the telemetry spine; route them
   through ``observability.timer`` (tests/tools/bench harnesses exempt).
+- GL012: unbounded blocking waits (``Queue.get()``/``Thread.join()``/
+  ``Popen.wait()`` with no timeout) in library code — one dead producer
+  silently hangs the consumer forever; use ``resilience.watchdog``
+  (``bounded_get``/``join_thread``/``wait_proc``) or pass a timeout.
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -473,3 +477,141 @@ class RawTimingRule(Rule):
                     "the raw elapsed value) so the duration reaches the "
                     "metrics registry and the trace; use "
                     "observability.wall_ts() for event timestamps")
+
+
+# -- GL012: unbounded blocking waits in library code ------------------------
+
+# the watchdog module itself (defines the sanctioned bounded waits), test
+# suites, and dev harnesses are exempt; everything else a training job
+# imports must not be able to block forever on one dead peer
+_WAIT_EXEMPT_PREFIXES = ('tests/', 'tools/',
+                         'paddle_tpu/resilience/watchdog.py',
+                         'resilience/watchdog.py')
+
+# constructor name suffix -> the blocking methods that need a timeout
+_BLOCKING_KINDS = {
+    'Queue': ('get', 'join'),
+    'SimpleQueue': ('get',),
+    'JoinableQueue': ('get', 'join'),
+    'LifoQueue': ('get',),
+    'PriorityQueue': ('get',),
+    'Thread': ('join',),
+    'Process': ('join',),
+    'Popen': ('wait',),
+}
+
+
+def _blocking_kind(call):
+    """'Queue'/'Thread'/... when ``call`` constructs a known blocking type
+    (queue.Queue(), threading.Thread(), ctx.Queue(), subprocess.Popen())."""
+    dotted = _dotted(call.func)
+    if not dotted:
+        return None
+    tail = dotted.rsplit('.', 1)[-1]
+    return tail if tail in _BLOCKING_KINDS else None
+
+
+@register
+class UnboundedWaitRule(Rule):
+    """GL012: ``q.get()`` / ``t.join()`` / ``p.wait()`` with no timeout on
+    a Queue/Thread/Process/Popen — if the counterparty died (worker crash,
+    SIGKILL, poisoned sample killing the producer thread) the caller
+    blocks forever and the job hangs instead of failing. Bound every wait:
+    ``resilience.watchdog.bounded_get``/``join_thread``/``wait_proc``, or
+    an explicit ``timeout=`` with liveness handling."""
+    id = 'GL012'
+    title = 'unbounded blocking wait in library code'
+
+    def in_scope(self, rel):
+        if any(rel == p or rel.startswith(p)
+               for p in _WAIT_EXEMPT_PREFIXES):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def _tracked_names(self, tree):
+        """name -> kind for variables/attributes holding blocking objects,
+        including containers of them (``threads = [Thread(...) ...]``) and
+        loop variables iterating those containers."""
+        tracked = {}       # 'q' / 'self._q' -> kind
+        containers = {}    # 'threads' / 'self._procs' -> element kind
+
+        def target_key(tgt):
+            if isinstance(tgt, ast.Name):
+                return tgt.id
+            return _dotted(tgt)
+
+        def value_kind(value):
+            """(kind, is_container) for an assignment RHS."""
+            if isinstance(value, ast.Call):
+                return _blocking_kind(value), False
+            if isinstance(value, (ast.List, ast.Tuple)):
+                for elt in value.elts:
+                    k, _ = value_kind(elt)
+                    if k:
+                        return k, True
+                return None, False
+            if isinstance(value, ast.ListComp):
+                return value_kind(value.elt)[0], True
+            return None, False
+
+        changed = True
+        while changed:     # fixpoint: `procs = list(self._procs)` chains
+            changed = False
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign):
+                    kind, is_cont = value_kind(node.value)
+                    if kind is None and isinstance(node.value,
+                                                   (ast.Name,
+                                                    ast.Attribute)):
+                        src = target_key(node.value)
+                        if src in containers:
+                            kind, is_cont = containers[src], True
+                        elif src in tracked:
+                            kind, is_cont = tracked[src], False
+                    if kind is None:
+                        continue
+                    for tgt in node.targets:
+                        key = target_key(tgt)
+                        dest = containers if is_cont else tracked
+                        if key and dest.get(key) != kind:
+                            dest[key] = kind
+                            changed = True
+                elif isinstance(node, ast.For):
+                    src = target_key(node.iter) if isinstance(
+                        node.iter, (ast.Name, ast.Attribute)) else None
+                    key = target_key(node.target) if isinstance(
+                        node.target, ast.Name) else None
+                    if src in containers and key and \
+                            tracked.get(key) != containers[src]:
+                        tracked[key] = containers[src]
+                        changed = True
+        return tracked
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        tracked = self._tracked_names(ctx.tree)
+        if not tracked:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute)):
+                continue
+            method = node.func.attr
+            recv = _dotted(node.func.value)
+            kind = tracked.get(recv)
+            if kind is None or method not in _BLOCKING_KINDS[kind]:
+                continue
+            if node.args or any(kw.arg in ('timeout', None)
+                                for kw in node.keywords):
+                continue   # a timeout (or **kwargs) is supplied
+            helper = {'get': 'watchdog.bounded_get(q, alive=...)',
+                      'join': 'watchdog.join_thread/join_proc',
+                      'wait': 'watchdog.wait_proc'}[method]
+            yield self.finding(
+                ctx, node,
+                f"unbounded {recv}.{method}() on a {kind} — if the "
+                "counterparty died this blocks forever (silent job hang); "
+                f"use paddle_tpu.resilience.{helper} or pass timeout= "
+                "and handle expiry")

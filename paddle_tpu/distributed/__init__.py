@@ -15,7 +15,9 @@ from .pipeline import pipeline_apply
 from .recompute import recompute
 from . import ps
 from .ps import SparseShardedTable
-from .launch import spawn, launch
+from .launch import spawn, launch, RankFailedError
+from . import deadline
+from .deadline import (set_timeout, get_timeout, DistributedTimeoutError)
 
 # -- 2.0-beta distributed top-level surface ----------------------------------
 from .fleet import Fleet, DistributedStrategy  # noqa: F401,E402

@@ -22,7 +22,20 @@ from ._compat import shard_map
 from ..core.tensor import Tensor, apply_op
 from ..tensor._helpers import _t
 from . import env
+from . import deadline as _deadline
 from .. import observability as _obs
+
+
+def _run_collective(op, thunk, operand=None, group=None):
+    """Run an eager collective body under the process-wide deadline policy
+    (distributed.set_timeout / PADDLE_TPU_DIST_TIMEOUT). Inside a traced
+    region the thunk always runs inline — tracers are thread-local, and a
+    traced launch is a compile-time event, not a blocking device wait."""
+    if _deadline.get_timeout() or _deadline._delay_hook[0] is not None:
+        v = operand._value if isinstance(operand, Tensor) else operand
+        if v is None or not _in_trace(v):
+            return _deadline.run_with_deadline(op, thunk, group=group)
+    return thunk()
 
 
 def _record_collective(op, t):
@@ -141,7 +154,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         if op == ReduceOp.PROD:
             return v ** n
         return v  # MAX / MIN of identical copies
-    out = apply_op(fn, (t,))
+    out = _run_collective('all_reduce', lambda: apply_op(fn, (t,)),
+                          operand=t, group=axis)
     if isinstance(tensor, Tensor):
         tensor._inplace_value(out._value)
         return tensor
@@ -169,7 +183,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=None):
         # each rank contributes its tensor).
         n = env.get_world_size(ax)
         return jnp.stack([v] * max(n, 1))
-    out = apply_op(fn, (t,))
+    out = _run_collective('all_gather', lambda: apply_op(fn, (t,)),
+                          operand=t, group=ax)
     if tensor_list is not None:
         n = out.shape[0]
         from ..tensor.manipulation import unstack
@@ -208,7 +223,8 @@ def reduce_scatter(output, input, op=ReduceOp.SUM, group=None, axis=None):
                 f"reduce_scatter over unbound axis '{ax}' inside a traced "
                 f"region; wrap in shard_map over '{ax}'")
         return v
-    out = apply_op(fn, (t,))
+    out = _run_collective('reduce_scatter', lambda: apply_op(fn, (t,)),
+                          operand=t, group=ax)
     if output is not None and isinstance(output, Tensor):
         output._inplace_value(out._value)
     return out
@@ -230,7 +246,8 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, axis=None):
                 f"alltoall over unbound axis '{ax}' inside a traced region; "
                 f"wrap in shard_map over '{ax}'")
         return v
-    out = apply_op(fn, (stacked,))
+    out = _run_collective('alltoall', lambda: apply_op(fn, (stacked,)),
+                          operand=stacked, group=ax)
     outs = unstack(out, axis=0)
     if out_tensor_list is not None:
         out_tensor_list.extend(outs)
@@ -256,7 +273,13 @@ recv = send
 
 
 def barrier(group=None):
-    (jax.device_put(0) + 0).block_until_ready()
+    """Block until every participant reaches the barrier (device round-trip
+    on this controller). Under the deadline policy a barrier that cannot
+    complete raises ``DistributedTimeoutError`` naming the op and the ranks
+    whose supervisor heartbeats went stale, instead of hanging the slice."""
+    _run_collective(
+        'barrier', lambda: (jax.device_put(0) + 0).block_until_ready(),
+        group=_axis(group))
 
 
 def new_group(ranks=None, backend=None):

@@ -1,0 +1,146 @@
+"""Process-wide collective deadline policy.
+
+A collective that can never complete (a peer rank died, a network partition,
+a wedged device) must fail the job loudly within a bounded time, not stall a
+TPU slice forever. This module holds the policy and the enforcement
+primitive:
+
+- ``set_timeout(seconds)`` / ``get_timeout()`` — process-wide deadline for
+  eager collectives, ``barrier()``, and rendezvous. ``None``/``0`` disables
+  (the default: a deadline on the hot path is an operator decision).
+  ``PADDLE_TPU_DIST_TIMEOUT`` seeds the default from the environment.
+- ``run_with_deadline(op, thunk, ...)`` — run a blocking collective body on
+  a worker thread and give up after the deadline, raising a
+  ``DistributedTimeoutError`` that names the op, the group/axis, and the
+  ranks believed missing (from supervisor heartbeats when available).
+
+The enforcement thread is only used when a deadline is set AND the value is
+concrete (never inside a jax trace — tracers are thread-local); with no
+deadline configured the thunk runs inline with zero overhead.
+"""
+import os
+import threading
+
+from .. import observability as _obs
+
+__all__ = ['DistributedTimeoutError', 'set_timeout', 'get_timeout',
+           'run_with_deadline']
+
+
+class DistributedTimeoutError(RuntimeError):
+    """A collective/rendezvous did not complete within the deadline.
+
+    Attributes: ``op`` (collective name), ``group`` (axis/group label),
+    ``timeout`` (seconds), ``missing_ranks`` (list, possibly empty when
+    unknown).
+    """
+
+    def __init__(self, op, group=None, timeout=None, missing_ranks=()):
+        self.op = op
+        self.group = group
+        self.timeout = timeout
+        self.missing_ranks = list(missing_ranks)
+        missing = (f"; ranks believed missing: {self.missing_ranks}"
+                   if self.missing_ranks else
+                   "; no rank reported missing — suspect a wedged device "
+                   "or network partition")
+        super().__init__(
+            f"distributed: '{op}' over group "
+            f"{group if group is not None else '<default>'} did not "
+            f"complete within {timeout}s{missing}. The job is failing fast "
+            "instead of hanging; inspect the slowest/missing rank's log, "
+            "or raise the deadline via distributed.set_timeout() / "
+            "PADDLE_TPU_DIST_TIMEOUT.")
+
+
+def _env_timeout():
+    raw = os.environ.get('PADDLE_TPU_DIST_TIMEOUT', '').strip()
+    if not raw:
+        return None
+    try:
+        val = float(raw)
+    except ValueError:
+        return None
+    return val if val > 0 else None
+
+
+_timeout = [_env_timeout()]
+
+# test/chaos hook (faultinject.slow_collective): called with the op name
+# before the real work; sleeping here models a slow/absent peer
+_delay_hook = [None]
+
+
+def set_timeout(seconds):
+    """Set the process-wide collective deadline (seconds). ``None`` or
+    ``0`` disables. Returns the previous value."""
+    prev = _timeout[0]
+    if seconds is not None and seconds <= 0:
+        seconds = None
+    _timeout[0] = seconds
+    return prev
+
+
+def get_timeout():
+    """The active collective deadline in seconds, or None when disabled."""
+    return _timeout[0]
+
+
+def _missing_ranks():
+    """Ranks whose supervisor heartbeat has gone stale — best effort; []
+    when no supervised launch is active."""
+    hb_dir = os.environ.get('PADDLE_TPU_HEARTBEAT_DIR')
+    if not hb_dir:
+        return []
+    from ..resilience.watchdog import heartbeat_age
+    try:
+        world = int(os.environ.get('PADDLE_TRAINERS_NUM', '0'))
+    except ValueError:
+        return []
+    stale_after = max((get_timeout() or 10.0) / 2.0, 2.0)
+    missing = []
+    for rank in range(world):
+        age = heartbeat_age(os.path.join(hb_dir, f'hb_{rank}'))
+        if age is None or age > stale_after:
+            missing.append(rank)
+    return missing
+
+
+def run_with_deadline(op, thunk, group=None, timeout=None):
+    """Run ``thunk()`` under the collective deadline.
+
+    ``timeout=None`` uses the process-wide policy; with no deadline set the
+    thunk runs inline. Otherwise the thunk runs on a daemon thread joined
+    with the deadline — on expiry a ``DistributedTimeoutError`` is raised
+    (the thread is abandoned: a wedged device call cannot be cancelled from
+    Python, and the process is expected to exit on this error)."""
+    budget = get_timeout() if timeout is None else timeout
+    hook = _delay_hook[0]
+    if not budget:
+        if hook is not None:
+            hook(op)
+        return thunk()
+    box = {}
+
+    def run():
+        try:
+            if hook is not None:   # chaos delay counts against the deadline
+                hook(op)
+            box['result'] = thunk()
+        except BaseException as e:   # re-raised in the caller below
+            box['error'] = e
+
+    t = threading.Thread(target=run, name=f'paddle-tpu-{op}', daemon=True)
+    t.start()
+    from ..resilience.watchdog import join_thread
+    if not join_thread(t, timeout=budget, tick=min(0.1, budget)):
+        if _obs.enabled():
+            _obs.counter('distributed.timeouts').inc()
+            _obs.event('dist_timeout', op=op,
+                       group=str(group) if group is not None else None,
+                       timeout_s=budget)
+        raise DistributedTimeoutError(op, group=group, timeout=budget,
+                                      missing_ranks=_missing_ranks())
+    if 'error' in box:
+        raise box['error']
+    return box['result']

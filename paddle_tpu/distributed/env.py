@@ -26,8 +26,26 @@ PIPE_AXIS = 'pipe'
 SEQ_AXIS = 'seq'
 
 
+def _announce_to_supervisor():
+    """Under a supervised launch (launch.py sets PADDLE_TPU_HEARTBEAT_DIR /
+    PADDLE_TPU_STARTED_FILE) start this rank's heartbeat and write the
+    started marker — the marker ends boot-phase restart eligibility, since
+    a rank past mesh init may have joined collectives. Idempotent."""
+    started = os.environ.get('PADDLE_TPU_STARTED_FILE')
+    if started and not os.path.exists(started):
+        with open(started, 'w'):
+            pass   # zero-byte phase marker; existence is the datum
+    hb_dir = os.environ.get('PADDLE_TPU_HEARTBEAT_DIR')
+    rank = os.environ.get('PADDLE_TRAINER_ID')
+    if hb_dir and rank is not None and not _global.get('heartbeat'):
+        from ..resilience.watchdog import Heartbeat
+        _global['heartbeat'] = Heartbeat(
+            os.path.join(hb_dir, f'hb_{rank}')).start()
+
+
 def init_parallel_env(mesh_shape=None, axis_names=None):
     """Create the global device mesh. Default: 1-D 'data' mesh over all devices."""
+    _announce_to_supervisor()
     devices = np.asarray(jax.devices())
     if mesh_shape is None:
         mesh_shape = (len(devices),)
@@ -59,7 +77,7 @@ def _reset_partial_distributed_state():
 
 
 def init_distributed(coordinator_address=None, num_processes=None,
-                     process_id=None, max_init_retries=3):
+                     process_id=None, max_init_retries=3, timeout=None):
     """Multi-host bring-up (parity: paddle.distributed.launch env wiring).
 
     Coordinator connection is retried with exponential backoff + jitter
@@ -67,8 +85,15 @@ def init_distributed(coordinator_address=None, num_processes=None,
     routinely comes up seconds after the workers, and one-shot initialize
     turns that race into a permanent job failure. Between attempts the
     partial distributed state is torn down so re-initialize is legal.
+
+    The whole rendezvous (all attempts + backoff) runs under the collective
+    deadline policy: ``timeout`` seconds, or the process-wide
+    ``distributed.set_timeout()`` / ``PADDLE_TPU_DIST_TIMEOUT`` value, and
+    raises ``DistributedTimeoutError('rendezvous')`` instead of hanging on
+    a coordinator that will never come up.
     """
     from ..resilience.retry import retry as _retry
+    from . import deadline as _deadline
     kwargs = {}
     if coordinator_address:
         kwargs = dict(coordinator_address=coordinator_address,
@@ -80,7 +105,8 @@ def init_distributed(coordinator_address=None, num_processes=None,
                      on_retry=lambda attempt, exc, delay:
                          _reset_partial_distributed_state())(
                              jax.distributed.initialize)
-    connect(**kwargs)
+    _deadline.run_with_deadline('rendezvous', lambda: connect(**kwargs),
+                                group=coordinator_address, timeout=timeout)
     return init_parallel_env()
 
 

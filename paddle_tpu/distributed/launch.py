@@ -13,18 +13,86 @@ real modes here:
   semantics 1.8 scripts expect from spawn (per-rank data pipelines,
   parameter servers, launch tests).
 
+Both multi-process modes run under a SUPERVISOR (docs/RESILIENCE.md,
+"Distributed fault tolerance"): children heartbeat into the run dir, the
+parent polls them concurrently, the first non-zero exit kills the surviving
+siblings (fail-fast — one dead rank must not deadlock a slice), and the
+failure surfaces as a structured ``RankFailedError`` carrying the rank, the
+exit code / signal name, the heartbeat age, and the tail of the rank's
+stderr log. Ranks that die *before marking themselves started* (i.e. before
+any collective could have run) are optionally restarted up to
+``max_restarts`` times.
+
 Multi-host pods use init_distributed() (jax.distributed) with one process
 per host.
 """
 import os
 import pickle
+import signal
 import subprocess
 import sys
 import tempfile
+import time
 
 from . import env
 
-__all__ = ['spawn', 'launch', 'get_cluster_and_pod']
+__all__ = ['spawn', 'launch', 'get_cluster_and_pod', 'RankFailedError']
+
+_HB_INTERVAL = 0.25     # worker heartbeat period (seconds)
+_POLL_TICK = 0.1        # supervisor poll period (seconds)
+_KILL_GRACE = 1.5       # SIGTERM → SIGKILL escalation window (seconds)
+_LOG_TAIL_BYTES = 2048
+
+
+class RankFailedError(RuntimeError):
+    """One rank of a supervised multi-process job failed; its siblings were
+    terminated (fail-fast). Attributes: ``rank``, ``exitcode``,
+    ``signal_name`` (when killed by a signal), ``heartbeat_age`` (seconds,
+    or None), ``log_tail`` (rank stderr tail, possibly ''), ``statuses``
+    (per-rank exit code map at the time of failure)."""
+
+    def __init__(self, rank, exitcode, signal_name=None, heartbeat_age=None,
+                 log_tail='', statuses=None, detail=None):
+        self.rank = rank
+        self.exitcode = exitcode
+        self.signal_name = signal_name
+        self.heartbeat_age = heartbeat_age
+        self.log_tail = log_tail or ''
+        self.statuses = dict(statuses or {})
+        died = (f"killed by {signal_name}" if signal_name
+                else f"exit code {exitcode}")
+        hb = ("no heartbeat ever written" if heartbeat_age is None
+              else f"last heartbeat {heartbeat_age:.1f}s before death")
+        msg = (f"spawn: rank {rank} failed ({died}; {hb}); "
+               "surviving ranks were terminated (fail-fast)")
+        if detail:
+            msg += f": {detail}"
+        if self.statuses:
+            msg += f"; per-rank exit codes: {self.statuses}"
+        if self.log_tail:
+            msg += f"\n--- rank {rank} log tail ---\n{self.log_tail}"
+        super().__init__(msg)
+
+
+def _signal_name(exitcode):
+    """'SIGKILL' for exitcode -9, None for normal exits."""
+    if exitcode is None or exitcode >= 0:
+        return None
+    try:
+        return signal.Signals(-exitcode).name
+    except ValueError:
+        return f"signal {-exitcode}"
+
+
+def _log_tail(path, nbytes=_LOG_TAIL_BYTES):
+    try:
+        with open(path, 'rb') as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(size - nbytes, 0))
+            return f.read().decode('utf-8', 'replace').strip()
+    except OSError:
+        return ''
 
 
 def _rank_env(rank, nprocs):
@@ -35,11 +103,46 @@ def _rank_env(rank, nprocs):
             'PADDLE_CURRENT_ENDPOINT': f"127.0.0.1:{6170 + rank}"}
 
 
+def _maybe_inject_boot_failure(rank, result_dir):
+    """Chaos hook (resilience.faultinject.boot_fail): die with exit 43
+    BEFORE the started marker, at most ``times`` times per run dir — models
+    the transient bootstrap crash (port clash, half-ready filesystem) that
+    bounded restart exists for."""
+    arm = os.environ.get('PADDLE_TPU_FI_BOOT_FAIL', '')
+    if not arm:
+        return
+    try:
+        want_rank, times = (int(x) for x in arm.split(':'))
+    except ValueError:
+        return
+    if rank != want_rank:
+        return
+    counter = os.path.join(result_dir, f'bootfail_{rank}')
+    fired = 0
+    if os.path.exists(counter):
+        with open(counter) as f:
+            fired = len(f.read().splitlines())
+    if fired < times:
+        with open(counter, 'a') as f:   # atomic-ok: chaos counter, append
+            f.write('x\n')
+        os._exit(43)
+
+
 def _worker(rank, nprocs, func, args, result_dir):
     os.environ.update(_rank_env(rank, nprocs))
     os.environ['FLAGS_selected_gpus'] = str(rank)
     os.environ.setdefault('JAX_PLATFORMS', 'cpu')
     path = os.path.join(result_dir, f"result_{rank}.pkl")
+    _maybe_inject_boot_failure(rank, result_dir)
+    # liveness + phase markers for the supervisor: heartbeats let it tell a
+    # busy rank from a wedged one; the started marker bounds restart
+    # eligibility (a rank that reached func may have joined collectives —
+    # restarting it alone would wedge its peers)
+    from ..resilience.watchdog import Heartbeat
+    hb = Heartbeat(os.path.join(result_dir, f'hb_{rank}'),
+                   interval=_HB_INTERVAL).start()
+    with open(os.path.join(result_dir, f'started_{rank}'), 'w'):
+        pass   # atomic-ok: zero-byte phase marker, existence is the datum
     # results travel via files (atomic commit), not an mp.Queue — queue FDs
     # are unreliable under sandboxed/spawn-restricted environments; the
     # parent trusts these bytes, so they go through atomic_io (graftlint
@@ -51,6 +154,8 @@ def _worker(rank, nprocs, func, args, result_dir):
     except BaseException as e:  # surface the failure to the parent
         atomic_pickle_dump(('error', repr(e)), path)
         raise
+    finally:
+        hb.stop()
     atomic_pickle_dump(payload, path)
 
 
@@ -63,10 +168,8 @@ class _Proc:
         self.pid = popen.pid
 
     def join(self, timeout=None):
-        try:
-            self._p.wait(timeout)
-        except subprocess.TimeoutExpired:
-            pass
+        from ..resilience.watchdog import wait_proc
+        wait_proc(self._p, timeout)
 
     def is_alive(self):
         return self._p.poll() is None
@@ -144,12 +247,141 @@ class _SpawnMainUnpickler(pickle.Unpickler):
         return super().find_class(module, name)
 
 
+def _kill_tree(procs, grace=_KILL_GRACE):
+    """Fail-fast teardown: SIGTERM every live proc, escalate to SIGKILL
+    after ``grace`` seconds."""
+    live = [p for p in procs if p.is_alive()]
+    for p in live:
+        try:
+            p.terminate()
+        except OSError:
+            pass
+    deadline = time.monotonic() + grace
+    while any(p.is_alive() for p in live) and time.monotonic() < deadline:
+        time.sleep(_POLL_TICK / 2)
+    for p in live:
+        if p.is_alive():
+            try:
+                p.kill()
+            except OSError:
+                pass
+
+
+class _Supervisor:
+    """Concurrent monitor over one multi-process run.
+
+    Polls every rank, restarts boot-phase failures up to ``max_restarts``
+    (total across ranks), and on any other non-zero exit kills the
+    surviving siblings and raises ``RankFailedError`` with per-rank
+    diagnostics. Used by both spawn's ``_Context.join`` and the
+    ``launch()`` CLI."""
+
+    def __init__(self, procs, run_dir, respawn=None, max_restarts=0):
+        self.procs = list(procs)            # rank -> _Proc-like
+        self.run_dir = run_dir
+        self.respawn = respawn              # rank -> new proc, or None
+        self.max_restarts = int(max_restarts)
+        self.restarts_used = 0
+
+    def _rank_started(self, rank):
+        return os.path.exists(
+            os.path.join(self.run_dir, f'started_{rank}'))
+
+    def _statuses(self):
+        return {r: p.exitcode for r, p in enumerate(self.procs)}
+
+    def _diagnose(self, rank, killed_by_us=()):
+        p = self.procs[rank]
+        from ..resilience.watchdog import heartbeat_age
+        detail = None
+        result_path = os.path.join(self.run_dir, f"result_{rank}.pkl")
+        if os.path.exists(result_path):
+            try:
+                with open(result_path, 'rb') as f:
+                    status, payload = _SpawnMainUnpickler(f).load()
+                if status == 'error':
+                    detail = payload
+            except Exception:
+                pass
+        statuses = {r: c for r, c in self._statuses().items()
+                    if r not in killed_by_us}
+        return RankFailedError(
+            rank, p.exitcode,
+            signal_name=_signal_name(p.exitcode),
+            heartbeat_age=heartbeat_age(
+                os.path.join(self.run_dir, f'hb_{rank}')),
+            log_tail=_log_tail(os.path.join(self.run_dir,
+                                            f'rank_{rank}.log')),
+            statuses=statuses, detail=detail)
+
+    def _try_restart(self, rank):
+        """Restart a boot-phase failure. True when a replacement is
+        running."""
+        if (self.respawn is None or self.restarts_used >= self.max_restarts
+                or self._rank_started(rank)):
+            return False
+        self.restarts_used += 1
+        stale = os.path.join(self.run_dir, f"result_{rank}.pkl")
+        if os.path.exists(stale):
+            os.unlink(stale)
+        old = self.procs[rank]
+        _daemon_procs.discard(old)
+        self.procs[rank] = self.respawn(rank)
+        from .. import observability as _obs
+        if _obs.enabled():
+            _obs.counter('distributed.rank_restarts').inc()
+            _obs.event('rank_restart', rank=rank,
+                       restarts_used=self.restarts_used)
+        return True
+
+    def wait(self, timeout=None):
+        """Supervise until every rank exits 0 (returns), one fails
+        (``RankFailedError``), or ``timeout`` expires (stragglers are
+        terminated and a RuntimeError reports per-rank exit codes)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            running = False
+            for rank, p in enumerate(self.procs):
+                code = p.exitcode
+                if code is None:
+                    running = True
+                elif code != 0:
+                    if self._try_restart(rank):
+                        running = True
+                        continue
+                    survivors = [r for r, q in enumerate(self.procs)
+                                 if q.is_alive()]
+                    err = self._diagnose(rank, killed_by_us=survivors)
+                    _kill_tree(self.procs)
+                    from .. import observability as _obs
+                    if _obs.enabled():
+                        _obs.counter('distributed.rank_failures').inc()
+                        _obs.event('rank_failed', rank=rank, exitcode=code,
+                                   signal=err.signal_name)
+                    raise err
+            if not running:
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                statuses = self._statuses()
+                stragglers = [r for r, c in statuses.items() if c is None]
+                _kill_tree(self.procs)
+                raise RuntimeError(
+                    f"spawn: ranks {stragglers} still running after "
+                    f"join(timeout={timeout}); they were terminated. "
+                    f"Per-rank exit codes before termination: {statuses} "
+                    "(None = still running)")
+            time.sleep(_POLL_TICK)
+
+
 class _Context:
-    def __init__(self, procs, result_dir, result=None):
+    def __init__(self, procs, result_dir, result=None, respawn=None,
+                 max_restarts=0):
         self.processes = procs
         self._result_dir = result_dir
         self._result = result
         self._joined = None
+        self._supervisor = None if not procs else _Supervisor(
+            procs, result_dir, respawn=respawn, max_restarts=max_restarts)
 
     def join(self, timeout=None):
         if not self.processes:
@@ -159,17 +391,11 @@ class _Context:
             # join() must see the same results (the files are consumed and
             # the tempdir removed on the first pass)
             return self._joined
-        import time as _time
-        deadline = None if timeout is None else _time.monotonic() + timeout
-        for p in self.processes:
-            p.join(None if deadline is None
-                   else max(deadline - _time.monotonic(), 0.001))
-        alive = [i for i, p in enumerate(self.processes) if p.is_alive()]
-        if alive:
-            raise RuntimeError(
-                f"spawn: ranks {alive} still running after "
-                f"join(timeout={timeout}) — terminate them or join "
-                "without a timeout")
+        try:
+            self._supervisor.wait(timeout=timeout)
+        finally:
+            # supervision may have replaced restarted ranks' proc objects
+            self.processes = self._supervisor.procs
         for p in self.processes:
             _daemon_procs.discard(p)
         results = {}
@@ -195,9 +421,14 @@ class _Context:
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
-          **options):
+          max_restarts=0, **options):
     """Run func on nprocs workers (spawn.py parity; see module docstring
-    for the TPU execution model)."""
+    for the TPU execution model and the supervisor semantics).
+
+    ``max_restarts``: total replacement budget for ranks that die before
+    writing their started marker (i.e. before ``func`` — and therefore any
+    collective — began). Default 0; ``PADDLE_TPU_MAX_RESTARTS`` overrides
+    the default."""
     if os.environ.get('PADDLE_TPU_SPAWN_WORKER') == '1':
         # a worker re-executing the parent's entry script reached an
         # unguarded spawn() call (any nprocs — the in-process fast path
@@ -213,8 +444,10 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
         return _Context([], None, result)
 
     n = max(int(nprocs), 1)
+    if not max_restarts:
+        max_restarts = int(os.environ.get('PADDLE_TPU_MAX_RESTARTS', '0')
+                           or 0)
     result_dir = tempfile.mkdtemp(prefix='paddle_tpu_spawn_')
-    procs = []
     # Workers are fresh interpreters started via subprocess (the posix_spawn
     # fast path: no preexec_fn, close_fds=False, no cwd/session changes) —
     # NOT multiprocessing children. multiprocessing's fork/fork+exec startup
@@ -251,7 +484,8 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
     payload_path = os.path.join(result_dir, 'payload.pkl')
     from ..resilience.atomic_io import atomic_pickle_dump
     atomic_pickle_dump(payload, payload_path)
-    for rank in range(n):
+
+    def make_proc(rank):
         child_env = dict(os.environ)
         child_env.update(_rank_env(rank, n))
         child_env['FLAGS_selected_gpus'] = str(rank)
@@ -261,10 +495,21 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
         # every worker is wasted startup at best
         child_env['PALLAS_AXON_POOL_IPS'] = ''
         child_env['PADDLE_TPU_SPAWN_WORKER'] = '1'
-        p = subprocess.Popen(
-            [sys.executable, '-m', 'paddle_tpu.distributed._spawn_entry',
-             payload_path, str(rank)],
-            env=child_env, close_fds=False)
+        # supervisor contract: heartbeats + started markers live here, and
+        # DistributedTimeoutError reads them to name missing ranks
+        child_env['PADDLE_TPU_HEARTBEAT_DIR'] = result_dir
+        # stderr (tracebacks, native crash reports) is captured per rank so
+        # RankFailedError can quote the tail; stdout stays on the console
+        # atomic-ok: append-only diagnostics stream, never a trusted load
+        log = open(os.path.join(result_dir, f'rank_{rank}.log'), 'ab')
+        try:
+            p = subprocess.Popen(
+                [sys.executable, '-m',
+                 'paddle_tpu.distributed._spawn_entry',
+                 payload_path, str(rank)],
+                env=child_env, close_fds=False, stderr=log)
+        finally:
+            log.close()   # the child holds its own fd now
         proc = _Proc(p)
         if daemon:
             # multiprocessing's daemon contract: the child must not outlive
@@ -272,8 +517,11 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
             # ONE atexit handler over a live-process set (joined/exited
             # workers are discarded — see _Context.join).
             _daemon_procs.add(proc)
-        procs.append(proc)
-    context = _Context(procs, result_dir)
+        return proc
+
+    procs = [make_proc(rank) for rank in range(n)]
+    context = _Context(procs, result_dir, respawn=make_proc,
+                       max_restarts=max_restarts)
     if join:
         context.join()
     return context
@@ -281,14 +529,20 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
 
 def launch():
     """`python -m paddle_tpu.distributed.launch [--nproc_per_node N]
-    script.py args...` — run a training script under the spawn env
-    (launch.py parity; one process per rank, CPU backend per worker when
-    N > 1)."""
+    [--max_restarts R] [--log_dir D] script.py args...` — run a training
+    script once per rank under the spawn env (launch.py parity), SUPERVISED:
+    the first rank to exit non-zero terminates its siblings and the launcher
+    exits with that rank's diagnostics; boot-phase failures are restarted up
+    to --max_restarts."""
     import argparse
     import runpy
 
     parser = argparse.ArgumentParser('paddle_tpu.distributed.launch')
     parser.add_argument('--nproc_per_node', type=int, default=1)
+    parser.add_argument('--max_restarts', type=int, default=0)
+    parser.add_argument('--log_dir', default=None,
+                        help='per-rank stderr logs (default: a temp run '
+                             'dir, quoted in failure diagnostics)')
     parser.add_argument('script')
     parser.add_argument('script_args', nargs=argparse.REMAINDER)
     ns = parser.parse_args()
@@ -298,16 +552,36 @@ def launch():
         runpy.run_path(ns.script, run_name='__main__')
         return
 
-    procs = []
-    for rank in range(ns.nproc_per_node):
+    run_dir = ns.log_dir or tempfile.mkdtemp(prefix='paddle_tpu_launch_')
+    os.makedirs(run_dir, exist_ok=True)
+
+    def make_proc(rank):
         child = dict(os.environ)
         child.update(_rank_env(rank, ns.nproc_per_node))
         child.setdefault('JAX_PLATFORMS', 'cpu')
-        procs.append(subprocess.Popen(
-            [sys.executable, ns.script] + ns.script_args, env=child))
-    rcs = [p.wait() for p in procs]
-    if any(rcs):
-        raise SystemExit(f"launch: worker exit codes {rcs}")
+        # scripts that call init_parallel_env() heartbeat + mark started
+        # through these (distributed.env); scripts that never do are
+        # supervised on process liveness alone
+        child['PADDLE_TPU_HEARTBEAT_DIR'] = run_dir
+        child['PADDLE_TPU_STARTED_FILE'] = os.path.join(
+            run_dir, f'started_{rank}')
+        # atomic-ok: append-only stderr stream for diagnostics
+        log = open(os.path.join(run_dir, f'rank_{rank}.log'), 'ab')
+        try:
+            p = subprocess.Popen(
+                [sys.executable, ns.script] + ns.script_args, env=child,
+                stderr=log)
+        finally:
+            log.close()
+        return _Proc(p)
+
+    procs = [make_proc(rank) for rank in range(ns.nproc_per_node)]
+    sup = _Supervisor(procs, run_dir, respawn=make_proc,
+                      max_restarts=ns.max_restarts)
+    try:
+        sup.wait()
+    except RankFailedError as e:
+        raise SystemExit(f"launch: {e}")
 
 
 def get_cluster_and_pod(*a, **k):

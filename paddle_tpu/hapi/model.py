@@ -268,6 +268,17 @@ class Model:
             # would leave the process ignoring the scheduler's SIGTERM
             self._sync_jit_state()
             cbks.on_train_end()
+            # a run that silently skipped poisoned samples is not the same
+            # run as a clean one: surface the DataLoader quarantine report
+            # (docs/RESILIENCE.md) instead of leaving it in a loss curve
+            report = getattr(train_loader, 'quarantine_report', None)
+            quarantined = report() if callable(report) else []
+            if quarantined:
+                import warnings
+                warnings.warn(
+                    f"DataLoader quarantined {len(quarantined)} poisoned "
+                    f"sample(s) during fit(): {quarantined}",
+                    RuntimeWarning, stacklevel=2)
 
     def _fit_loop(self, train_loader, eval_loader, cbks, epochs, start_epoch,
                   skip_steps, resume_rng, eval_freq, save_dir, save_freq,

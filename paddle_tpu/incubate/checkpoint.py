@@ -140,7 +140,10 @@ class AsyncCheckpointer:
         with self._lock:
             t = self._pending
         if t is not None:
-            t.join()
+            # tick-based join (watchdog): stays signal-interruptible while
+            # a large checkpoint drains to disk
+            from ..resilience.watchdog import join_thread
+            join_thread(t, timeout=None)
             with self._lock:
                 if self._pending is t:
                     self._pending = None

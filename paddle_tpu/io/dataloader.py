@@ -6,8 +6,18 @@ prefetcher overlaps host batch assembly and host->HBM transfer with device
 compute (the reference overlaps via pinned-memory + CUDA streams; here the
 async dispatch of jax.device_put plays that role). A native C++ prefetch ring
 (csrc/prefetch.cpp) backs the queue when built.
+
+Self-healing (docs/RESILIENCE.md, "Distributed fault tolerance"): a worker
+that raises propagates the exception to the consumer instead of dying
+silently; a worker that hangs or is killed trips a deadlock watchdog
+(bounded queue waits + liveness checks, budget = ``timeout`` seconds or
+``PADDLE_TPU_DATA_TIMEOUT``); poisoned samples are quarantined up to a
+bounded skip budget (``skip_bad_samples`` / ``PADDLE_TPU_DATA_SKIP_BUDGET``)
+with a per-index report; crashed process workers are respawned up to
+``worker_max_restarts`` times.
 """
 import itertools
+import os
 import queue
 import threading
 
@@ -17,8 +27,41 @@ from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler, SequenceSampler, RandomSampler
 from .. import observability as _obs
+from ..resilience import watchdog as _watchdog
 
-__all__ = ['DataLoader', 'default_collate_fn', 'default_convert_fn']
+__all__ = ['DataLoader', 'default_collate_fn', 'default_convert_fn',
+           'DataLoaderWorkerError']
+
+# consumer-side stall budget when DataLoader(timeout=0): generous enough
+# for any real batch assembly, small enough that a wedged pipeline fails
+# the job the same hour it wedges
+_DEFAULT_WATCHDOG_S = 300.0
+
+
+class DataLoaderWorkerError(RuntimeError):
+    """A DataLoader worker failed (raised, hung past the watchdog budget,
+    or died) and the loader could not self-heal within its budgets.
+    ``quarantined`` carries the (index, error) pairs skipped so far."""
+
+    def __init__(self, message, quarantined=()):
+        self.quarantined = list(quarantined)
+        if self.quarantined:
+            message += (f"; {len(self.quarantined)} sample(s) were "
+                        f"quarantined first: {self.quarantined}")
+        super().__init__(message)
+
+
+class _WorkerFailure:
+    """A worker-side exception in transit to the consumer thread."""
+
+    def __init__(self, exc, where):
+        import traceback
+        self.where = where
+        self.exc = exc
+        self.tb = traceback.format_exc()
+
+
+_SKIPPED_BATCH = object()   # every sample of the batch was quarantined
 
 
 def default_collate_fn(batch):
@@ -64,7 +107,9 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False,
                  drop_last=False, collate_fn=None, num_workers=0,
                  use_buffer_reader=True, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, prefetch_factor=2, persistent_workers=False):
+                 worker_init_fn=None, prefetch_factor=2,
+                 persistent_workers=False, skip_bad_samples=None,
+                 worker_max_restarts=None):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -73,6 +118,27 @@ class DataLoader:
         self.prefetch_factor = max(int(prefetch_factor), 1)
         self.use_buffer_reader = use_buffer_reader
         self.use_shared_memory = use_shared_memory
+        # fault-tolerance budgets (module docstring): watchdog wait, poison
+        # quarantine, crashed-process-worker respawn. timeout=0 means
+        # "unspecified" (env, then the 300s default); PADDLE_TPU_DATA_TIMEOUT=0
+        # or a negative timeout= disables the deadline — consumer waits stay
+        # liveness-probed but unbounded.
+        if timeout:
+            self.timeout = max(float(timeout), 0.0)
+        else:
+            self.timeout = float(
+                os.environ.get('PADDLE_TPU_DATA_TIMEOUT', '')
+                or _DEFAULT_WATCHDOG_S)
+        if skip_bad_samples is None:
+            skip_bad_samples = int(
+                os.environ.get('PADDLE_TPU_DATA_SKIP_BUDGET', 0) or 0)
+        self.skip_bad_samples = max(int(skip_bad_samples), 0)
+        if worker_max_restarts is None:
+            worker_max_restarts = int(
+                os.environ.get('PADDLE_TPU_WORKER_RESTARTS', 2) or 0)
+        self.worker_max_restarts = max(int(worker_max_restarts), 0)
+        self._quarantined = []       # (index, repr(exc)) of skipped samples
+        self._q_lock = threading.Lock()
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -96,6 +162,40 @@ class DataLoader:
             return len(self.dataset)
         return len(self.batch_sampler)
 
+    # -- poison-sample quarantine ------------------------------------------
+
+    def quarantine_report(self):
+        """(index, error) pairs for every sample skipped under the
+        ``skip_bad_samples`` budget, in the order they were quarantined."""
+        with self._q_lock:
+            return list(self._quarantined)
+
+    def _quarantine(self, index, exc):
+        """Record one poisoned sample. True when the budget covered it;
+        False when the budget is exhausted (caller must fail)."""
+        with self._q_lock:
+            if len(self._quarantined) >= self.skip_bad_samples:
+                return False
+            self._quarantined.append((index, repr(exc)))
+        if _obs.enabled():
+            _obs.counter('dataloader.quarantined').inc()
+            _obs.event('quarantine', index=index, error=repr(exc))
+        return True
+
+    def _fetch_samples(self, indices):
+        """dataset[i] for each index, quarantining poisoned samples within
+        budget. Returns (samples, None) or (None, _WorkerFailure)."""
+        samples = []
+        for i in indices:
+            try:
+                samples.append(self.dataset[i])
+            except Exception as e:
+                if not self._quarantine(i, e):
+                    return None, _WorkerFailure(
+                        e, f"dataset[{i}] (skip budget "
+                           f"{self.skip_bad_samples} exhausted)")
+        return samples, None
+
     def _raw_batches(self):
         if self._iterable_mode:
             it = iter(self.dataset)
@@ -106,15 +206,30 @@ class DataLoader:
                 if len(batch) < self.batch_size and self.drop_last:
                     return
                 yield self.collate_fn(batch)
-        elif self.batch_sampler is None:
-            for i in range(len(self.dataset)):
-                yield self.collate_fn([self.dataset[i]])
         else:
-            for indices in self.batch_sampler:
-                yield self.collate_fn([self.dataset[i] for i in indices])
+            batches = self.batch_sampler if self.batch_sampler is not None \
+                else ([i] for i in range(len(self.dataset)))
+            for indices in batches:
+                samples, failure = self._fetch_samples(indices)
+                if failure is not None:
+                    raise DataLoaderWorkerError(
+                        f"DataLoader failed in {failure.where}: "
+                        f"{failure.exc!r}", self.quarantine_report()) \
+                        from failure.exc
+                if samples:     # skip a batch that was quarantined whole
+                    yield self.collate_fn(samples)
 
     def _threaded_batches(self):
-        """num_workers>0: worker threads build batches, main thread uploads."""
+        """num_workers>0: worker threads build batches, main thread uploads.
+
+        Failure contract: a worker that raises ships the exception to the
+        consumer (re-raised as ``DataLoaderWorkerError``) and ALWAYS posts
+        its done sentinel from a finally block — the silent-hang mode where
+        a raising ``dataset[i]``/``collate_fn`` killed the thread and left
+        the consumer blocked forever is structurally impossible. The
+        consumer's queue wait is bounded (watchdog): dead workers are
+        detected within a poll tick, hung workers within ``self.timeout``
+        seconds."""
         if self._iterable_mode:
             yield from self._raw_batches()
             return
@@ -127,49 +242,90 @@ class DataLoader:
         done = object()
 
         def worker(wid):
-            if self.worker_init_fn:
-                self.worker_init_fn(wid)
-            while True:
-                with lock:
-                    try:
-                        my_seq = seq[0]
-                        indices = next(indices_iter)
-                        seq[0] += 1
-                    except StopIteration:
-                        out_q.put((None, done))
+            try:
+                if self.worker_init_fn:
+                    self.worker_init_fn(wid)
+                while True:
+                    with lock:
+                        try:
+                            my_seq = seq[0]
+                            indices = next(indices_iter)
+                            seq[0] += 1
+                        except StopIteration:
+                            return
+                    samples, failure = self._fetch_samples(indices)
+                    if failure is not None:
+                        out_q.put((my_seq, failure))
                         return
-                batch = self.collate_fn([self.dataset[i] for i in indices])
-                out_q.put((my_seq, batch))
+                    if not samples:     # whole batch quarantined
+                        out_q.put((my_seq, _SKIPPED_BATCH))
+                        continue
+                    try:
+                        batch = self.collate_fn(samples)
+                    except Exception as e:
+                        out_q.put((my_seq, _WorkerFailure(e, 'collate_fn')))
+                        return
+                    out_q.put((my_seq, batch))
+            except BaseException as e:   # worker_init_fn, sampler, ...
+                out_q.put((None, _WorkerFailure(e, 'worker')))
+            finally:
+                # the sentinel is unconditional: the consumer must never
+                # wait on a thread that already died
+                out_q.put((None, done))
 
         threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                    for w in range(self.num_workers)]
         for t in threads:
             t.start()
+
+        def workers_alive():
+            return any(t.is_alive() for t in threads)
+
         finished = 0
         next_seq = 0
-        try:
-            while finished < self.num_workers:
+        while finished < self.num_workers:
+            if _obs.enabled():
+                _obs.gauge('dataloader.queue_depth').set(out_q.qsize())
+            try:
+                s, batch = _watchdog.bounded_get(
+                    out_q, timeout=self.timeout, alive=workers_alive,
+                    what='DataLoader batch')
+            except _watchdog.WatchdogTimeout as e:
                 if _obs.enabled():
-                    _obs.gauge('dataloader.queue_depth').set(out_q.qsize())
-                s, batch = out_q.get()
-                if batch is done:
-                    finished += 1
-                    continue
-                pending[s] = batch
-                while next_seq in pending:
-                    yield pending.pop(next_seq)
-                    next_seq += 1
+                    _obs.counter('dataloader.watchdog_timeouts').inc()
+                    _obs.event('dataloader_watchdog', error=str(e))
+                raise DataLoaderWorkerError(
+                    f"DataLoader wedged: {e}", self.quarantine_report()) \
+                    from e
+            if batch is done:
+                finished += 1
+                continue
+            if isinstance(batch, _WorkerFailure):
+                raise DataLoaderWorkerError(
+                    f"DataLoader worker failed in {batch.where}: "
+                    f"{batch.exc!r}\n{batch.tb}", self.quarantine_report())
+            pending[s] = batch
             while next_seq in pending:
-                yield pending.pop(next_seq)
+                b = pending.pop(next_seq)
                 next_seq += 1
-        finally:
-            pass
+                if b is not _SKIPPED_BATCH:
+                    yield b
+        while next_seq in pending:
+            b = pending.pop(next_seq)
+            next_seq += 1
+            if b is not _SKIPPED_BATCH:
+                yield b
 
     def _process_batches(self):
         """num_workers>0 + shared memory: fork()ed worker processes collate
         batches into the native shm prefetch ring (csrc/prefetch.cpp) — no
         pickling of array payloads. Falls back to the threaded path when the
-        native lib is unavailable or batches are not plain ndarray tuples."""
+        native lib is unavailable or batches are not plain ndarray tuples.
+
+        The pool self-heals: crashed workers are respawned (up to
+        ``worker_max_restarts``) with their in-flight batch requeued,
+        poisoned samples are quarantined through the shared budget, and a
+        stall past the watchdog budget raises instead of hanging."""
         from .._native.process_pool import ProcessWorkerPool
         indices = list(self.batch_sampler) if self.batch_sampler is not None \
             else [[i] for i in range(len(self.dataset))]
@@ -177,7 +333,10 @@ class DataLoader:
                                  self.num_workers,
                                  capacity=self.num_workers *
                                  self.prefetch_factor,
-                                 worker_init_fn=self.worker_init_fn)
+                                 worker_init_fn=self.worker_init_fn,
+                                 max_restarts=self.worker_max_restarts,
+                                 watchdog_timeout=self.timeout,
+                                 quarantine=self._quarantine)
         yield from pool
 
     def _shm_compatible(self):

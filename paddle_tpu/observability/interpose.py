@@ -73,8 +73,11 @@ def record_collective(op, nbytes):
 
 def summary():
     """The headline interposed counters, for bench extras / train_end
-    events: retraces (jaxpr traces), compiles, total compile ms, and
-    host-transfer traffic."""
+    events: retraces (jaxpr traces), compiles, total compile ms,
+    host-transfer traffic, and the fault-tolerance tallies (worker
+    restarts, quarantined samples, watchdog/collective timeouts, rank
+    failures/restarts) — a run that self-healed is not the same run as one
+    that never faulted, and the record should say so."""
     snap = registry.snapshot()['counters']
     return {
         'jax_traces': snap.get('jax.traces', 0),
@@ -82,4 +85,10 @@ def summary():
         'jax_compile_ms': round(float(snap.get('jax.compile_ms', 0)), 3),
         'host_transfer_bytes': snap.get('host_transfer.bytes', 0),
         'host_transfer_calls': snap.get('host_transfer.calls', 0),
+        'worker_restarts': snap.get('dataloader.worker_restarts', 0),
+        'quarantined_samples': snap.get('dataloader.quarantined', 0),
+        'watchdog_timeouts': snap.get('dataloader.watchdog_timeouts', 0),
+        'dist_timeouts': snap.get('distributed.timeouts', 0),
+        'rank_failures': snap.get('distributed.rank_failures', 0),
+        'rank_restarts': snap.get('distributed.rank_restarts', 0),
     }

@@ -9,6 +9,7 @@ import random
 import threading
 
 from .. import observability as _obs
+from ..resilience.watchdog import bounded_get
 
 __all__ = ['map_readers', 'shuffle', 'chain', 'buffered', 'compose',
            'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
@@ -116,17 +117,22 @@ def buffered(reader, size):
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         while True:
+            # bounded wait (watchdog): the producer posts its sentinel
+            # from a finally block, and the liveness probe catches the one
+            # remaining hang mode (a producer that died uncleanly)
             if _obs.enabled():
                 # consumer-side starvation signal: how long the training
                 # loop sat waiting on the producer, and how full the
                 # read-ahead buffer is when a sample is taken
                 sw = _obs.Stopwatch()
-                e = q.get()
+                e = bounded_get(q, alive=t.is_alive,
+                                what='buffered reader sample')
                 _obs.histogram('reader.buffered.wait_ms').observe(
                     sw.elapsed_ms())
                 _obs.gauge('reader.buffered.depth').set(q.qsize())
             else:
-                e = q.get()
+                e = bounded_get(q, alive=t.is_alive,
+                                what='buffered reader sample')
             if e is end:
                 if err:
                     raise err[0]
@@ -187,7 +193,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
 
         def work():
             while True:
-                item = in_q.get()
+                item = bounded_get(in_q, alive=threads[0].is_alive,
+                                   what='xmap input sample')
                 if item is end:
                     out_q.put(end)
                     return
@@ -208,8 +215,11 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         finished = 0
         pending = {}
         next_i = 0
+        workers = threads[1:]
         while finished < process_num:
-            item = out_q.get()
+            item = bounded_get(
+                out_q, alive=lambda: any(w.is_alive() for w in workers),
+                what='xmap mapped sample')
             if item is end:
                 finished += 1
                 continue
@@ -263,7 +273,12 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         done = 0
         try:
             while done < len(procs):
-                kind, payload = q.get()
+                # liveness-bounded: a worker SIGKILLed mid-sample never
+                # posts its 'd' sentinel; without the probe this loop hung
+                # forever on q.get()
+                kind, payload = bounded_get(
+                    q, alive=lambda: any(p.is_alive() for p in procs),
+                    what='multiprocess_reader sample')
                 if kind == 'd':
                     done += 1
                 elif kind == 'e':

@@ -13,7 +13,12 @@ distributed.{env,fs}):
   bitwise-identical continuation;
 - a NaN/Inf step guard (``NanGuard``) that skips poisoned updates and
   reports them to the dynamic GradScaler;
-- bounded ``retry`` with exponential backoff + jitter for transient I/O.
+- bounded ``retry`` with exponential backoff + jitter for transient I/O;
+- bounded waits + liveness (``watchdog``): ``bounded_get``/``join_thread``/
+  ``wait_proc`` and the supervisor ``Heartbeat`` — the primitives behind
+  the self-healing DataLoader, the supervised launcher, and collective
+  deadlines (graftlint GL012 enforces their use over unbounded stdlib
+  waits).
 
 ``faultinject`` produces each of the failures above deterministically so the
 whole layer is testable on CPU (tier-1, ``-m fault``).
@@ -24,11 +29,16 @@ from .retry import retry, RetryError
 from .preempt import PreemptionGuard
 from .nanguard import NanGuard, NanStepError
 from .checkpoint import CheckpointManager, capture_rng, restore_rng
+from .watchdog import (WatchdogTimeout, bounded_get, join_thread, join_proc,
+                       wait_proc, Heartbeat, heartbeat_age)
 from . import atomic_io
 from . import faultinject
+from . import watchdog
 
 __all__ = ['atomic_open', 'atomic_write', 'atomic_pickle_dump',
            'crc32_file', 'crc32_bytes',
            'AtomicWriteError', 'retry', 'RetryError', 'PreemptionGuard',
            'NanGuard', 'NanStepError', 'CheckpointManager', 'capture_rng',
-           'restore_rng', 'atomic_io', 'faultinject']
+           'restore_rng', 'atomic_io', 'faultinject', 'watchdog',
+           'WatchdogTimeout', 'bounded_get', 'join_thread', 'join_proc',
+           'wait_proc', 'Heartbeat', 'heartbeat_age']

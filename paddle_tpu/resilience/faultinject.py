@@ -12,18 +12,31 @@ against, on CPU, deterministically:
 - ``poison_loss`` — wrap a loss fn to return NaN at chosen global steps
   (NaN-guard model);
 - ``PreemptAtStep`` — a hapi callback that delivers a real SIGTERM to this
-  process at a chosen global batch (preemption model).
+  process at a chosen global batch (preemption model);
+- ``poison_sample`` / ``kill_worker`` / ``hang_worker`` — Dataset wrappers
+  producing a raising sample, a worker-process SIGKILL, or a worker hang at
+  chosen indices (DataLoader quarantine / respawn / watchdog models);
+- ``slow_rank`` — a picklable spawn-func wrapper adding a delay on one rank
+  (straggler model for collective deadlines);
+- ``slow_collective`` — context manager delaying named eager collectives in
+  this process (DistributedTimeoutError model);
+- ``boot_fail`` — context manager arming rank bootstrap crashes (exit 43
+  before the started marker) for supervised-launch restart tests.
 
 All injectors are context-managed or idempotent to deactivate, so a failing
 test cannot leak faults into the next one.
 """
+import contextlib
 import os
 import signal
+import time
 
 from . import atomic_io
 
 __all__ = ['FaultInjector', 'flaky', 'poison_loss', 'corrupt_file',
-           'truncate_file', 'PreemptAtStep', 'InjectedWriteError']
+           'truncate_file', 'PreemptAtStep', 'InjectedWriteError',
+           'poison_sample', 'kill_worker', 'hang_worker', 'slow_rank',
+           'slow_collective', 'boot_fail', 'PoisonedSampleError']
 
 
 class InjectedWriteError(OSError):
@@ -134,6 +147,146 @@ def truncate_file(path, keep_bytes=None, drop_bytes=None):
     with open(path, 'r+b') as f:
         f.truncate(keep_bytes)
     return path
+
+
+class PoisonedSampleError(ValueError):
+    """The injected failure for poisoned dataset samples."""
+
+
+class _DatasetWrapper:
+    """Picklable (top-level class) Dataset wrapper base: forwards len() and
+    __getitem__, letting subclasses inject at chosen indices. Fork-safe —
+    state is plain attributes copied into each worker."""
+
+    def __init__(self, dataset, at_indices):
+        self._dataset = dataset
+        self._at = set(int(i) for i in at_indices)
+
+    def __len__(self):
+        return len(self._dataset)
+
+    def __getitem__(self, i):
+        if int(i) in self._at:
+            self._inject(i)
+        return self._dataset[i]
+
+    def _inject(self, i):
+        raise NotImplementedError
+
+
+class _PoisonedDataset(_DatasetWrapper):
+    def _inject(self, i):
+        raise PoisonedSampleError(
+            f"fault injection: poisoned sample at index {i}")
+
+
+def poison_sample(dataset, at_indices):
+    """Dataset wrapper raising ``PoisonedSampleError`` for the given
+    indices — the corrupt-record model the DataLoader quarantine defends
+    against."""
+    return _PoisonedDataset(dataset, at_indices)
+
+
+class _KillerDataset(_DatasetWrapper):
+    """SIGKILL the current process when a chosen index is fetched — but
+    only in a process that is NOT the one that built the wrapper, so a
+    threaded DataLoader (or the parent's shm-probe fetch) can never shoot
+    the trainer itself. ``once_file`` (required) makes the kill one-shot
+    across respawns: the first victim leaves a marker, the respawned
+    worker survives the same index."""
+
+    def __init__(self, dataset, at_indices, once_file):
+        super().__init__(dataset, at_indices)
+        self._builder_pid = os.getpid()
+        self._once_file = os.fspath(once_file)
+
+    def _inject(self, i):
+        if os.getpid() == self._builder_pid:
+            return   # parent/threaded fetch: never kill the trainer
+        if os.path.exists(self._once_file):
+            return   # already fired once; the respawned worker survives
+        with open(self._once_file, 'w'):
+            pass
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def kill_worker(dataset, at_index, once_file):
+    """Dataset wrapper that SIGKILLs the (process) worker fetching
+    ``at_index``, once — the crashed-worker model for respawn tests."""
+    return _KillerDataset(dataset, [at_index], once_file)
+
+
+class _HangingDataset(_DatasetWrapper):
+    def __init__(self, dataset, at_indices, hang_s):
+        super().__init__(dataset, at_indices)
+        self._hang_s = float(hang_s)
+
+    def _inject(self, i):
+        time.sleep(self._hang_s)
+
+
+def hang_worker(dataset, at_index, hang_s=5.0):
+    """Dataset wrapper that sleeps ``hang_s`` seconds fetching
+    ``at_index`` — the wedged-worker model for the deadlock watchdog."""
+    return _HangingDataset(dataset, [at_index], hang_s)
+
+
+class _SlowRankFn:
+    """Picklable spawn-func wrapper: rank ``rank`` sleeps ``delay_s``
+    before running — the straggler model for collective deadlines and
+    join(timeout) supervision."""
+
+    def __init__(self, fn, rank, delay_s):
+        self.fn = fn
+        self.rank = int(rank)
+        self.delay_s = float(delay_s)
+
+    def __call__(self, *args, **kwargs):
+        if int(os.environ.get('PADDLE_TRAINER_ID', '0')) == self.rank:
+            time.sleep(self.delay_s)
+        return self.fn(*args, **kwargs)
+
+
+def slow_rank(fn, rank, delay_s):
+    return _SlowRankFn(fn, rank, delay_s)
+
+
+@contextlib.contextmanager
+def slow_collective(delay_s, ops=None):
+    """Delay every eager collective launch in this process by ``delay_s``
+    seconds (optionally only the named ``ops``) — deterministically drives
+    ``distributed.set_timeout`` deadlines to expiry on CPU."""
+    from ..distributed import deadline as _deadline
+    only = set(ops) if ops else None
+
+    def hook(op):
+        if only is None or op in only:
+            time.sleep(delay_s)
+
+    prev = _deadline._delay_hook[0]
+    _deadline._delay_hook[0] = hook
+    try:
+        yield
+    finally:
+        _deadline._delay_hook[0] = prev
+
+
+@contextlib.contextmanager
+def boot_fail(rank, times=1):
+    """Arm ``times`` bootstrap crashes (os._exit(43) before the started
+    marker) for ``rank`` in every supervised spawn/launch child started
+    inside the context — the transient-bringup model bounded restart
+    (max_restarts) exists for."""
+    key = 'PADDLE_TPU_FI_BOOT_FAIL'
+    prev = os.environ.get(key)
+    os.environ[key] = f"{int(rank)}:{int(times)}"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev
 
 
 class PreemptAtStep:

@@ -79,6 +79,10 @@ AST_FIXTURES = {
               "    t0 = time.perf_counter()\n"
               "    fn()\n"
               "    return time.perf_counter() - t0\n", "time.perf_counter"),
+    'GL012': ("import queue\n"
+              "def consume():\n"
+              "    q = queue.Queue()\n"
+              "    return q.get()\n", "q.get()"),
 }
 
 
@@ -256,6 +260,53 @@ def test_gl011_allows_monotonic_deadlines(tmp_path):
     findings, _ = lint_paths([str(lib / 'deadline.py')],
                              scan_root=str(tmp_path))
     assert [f for f in findings if f.rule == 'GL011'] == []
+
+
+_WAIT_SRC = ("import queue, threading, subprocess\n"
+             "def pipeline():\n"
+             "    q = queue.Queue()\n"
+             "    q.get()\n"                          # flagged
+             "    q.get(timeout=1)\n"                 # bounded: fine
+             "    q.get_nowait()\n"                   # non-blocking: fine
+             "    threads = [threading.Thread(target=print)\n"
+             "               for _ in range(2)]\n"
+             "    for t in threads:\n"
+             "        t.join()\n"                     # flagged (container)
+             "    p = subprocess.Popen(['ls'])\n"
+             "    p.wait()\n"                         # flagged
+             "    p.wait(5)\n")                       # bounded: fine
+
+
+def test_gl012_flags_only_unbounded_waits(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'pipe.py').write_text(_WAIT_SRC)
+    findings, _ = lint_paths([str(lib / 'pipe.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL012')
+    lines = _WAIT_SRC.splitlines()
+    assert len(hits) == 3, [(f.rule, f.line) for f in findings]
+    assert 'q.get()' in lines[hits[0] - 1]
+    assert 't.join()' in lines[hits[1] - 1]
+    assert 'p.wait()' in lines[hits[2] - 1]
+    msg = [f for f in findings if f.rule == 'GL012'][0].message
+    assert 'watchdog' in msg     # fix-it points at the bounded helpers
+
+
+def test_gl012_exempts_tests_tools_and_watchdog(tmp_path):
+    # harnesses and the watchdog module itself may use raw waits
+    for rel in ('tests/mod.py', 'tools/mod.py',
+                'paddle_tpu/resilience/watchdog.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_WAIT_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL012'] == [], rel
+    # ...but sibling resilience modules may not
+    p = tmp_path / 'paddle_tpu/resilience/other.py'
+    p.write_text(_WAIT_SRC)
+    findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+    assert [f for f in findings if f.rule == 'GL012'] != []
 
 
 def test_unresolvable_fetch_does_not_flood_gv006():
