@@ -268,6 +268,121 @@ def _flash_dropout_check():
         return f'error: {e!r}'
 
 
+def bench_serving(duration_s=3.0, rate_mult=3.0, seed=0):
+    """Synthetic serving traffic on CPU: Poisson arrivals against the
+    continuous-batching engine vs. batch-size-1 serial serving of the SAME
+    model through the SAME Executor program cache.
+
+    Returns the ``extras.serving`` dict: QPS for both modes (and the
+    ratio — the continuous-batching win, provable without a TPU), p50/p99
+    end-to-end latency, mean batch occupancy, program-cache hit rate, shed
+    rate under the bounded admission queue, and the post-warmup compile
+    delta (0 == the closed bucket set held: steady state never retraces).
+    """
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu import serving
+    from paddle_tpu import observability as obs
+
+    rng = np.random.RandomState(seed)
+    was_static = paddle.in_static_mode()
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data('x', shape=[-1, 256], dtype='float32')
+            h = paddle.matmul(x, paddle.to_tensor(
+                (rng.randn(256, 256) * 0.05).astype(np.float32)))
+            h = paddle.nn.functional.relu(h)
+            y = paddle.matmul(h, paddle.to_tensor(
+                (rng.randn(256, 64) * 0.05).astype(np.float32)))
+        exe = static.Executor()
+        example = {'x': np.zeros((256,), np.float32)}
+        model = ((main, ['x'], [y]), exe)
+
+        def snap_counter(name):
+            return obs.snapshot()['counters'].get(name, 0)
+
+        def mk_engine(buckets, capacity):
+            eng = serving.ServingEngine(queue_capacity=capacity)
+            ep = eng.register('mlp', program=model[0], executor=model[1],
+                              example=example,
+                              bucket_spec=serving.BucketSpec(buckets))
+            eng.warmup()
+            return eng, ep
+
+        def one_input():
+            return {'x': rng.randn(256).astype(np.float32)}
+
+        # -- serial baseline: batch 1, strictly sequential ----------------
+        eng_s, ep_s = mk_engine((1,), 10000)
+        n_serial = 0
+        sw = time.perf_counter()
+        while time.perf_counter() - sw < duration_s / 2:
+            f = ep_s.submit(one_input())
+            eng_s.run_until_idle()
+            assert f.result(timeout=30).ok
+            n_serial += 1
+        serial_qps = n_serial / (time.perf_counter() - sw)
+
+        # -- continuous batching under Poisson load -----------------------
+        eng_c, ep_c = mk_engine((1, 2, 4, 8, 16), 64)
+        compiles_after_warmup = snap_counter('jax.compiles')
+        hits0 = snap_counter('executor.program_cache.hits')
+        miss0 = snap_counter('executor.program_cache.misses')
+        eng_c.start()
+        rate = max(serial_qps * rate_mult, 50.0)
+        futs, shed = [], 0
+        t0 = time.perf_counter()
+        next_t = t0
+        while time.perf_counter() - t0 < duration_s:
+            next_t += rng.exponential(1.0 / rate)
+            pause = next_t - time.perf_counter()
+            if pause > 0:
+                time.sleep(pause)
+            try:
+                futs.append(ep_c.submit(one_input(), deadline_ms=10000))
+            except serving.QueueFullError:
+                shed += 1
+        lat = []
+        for f in futs:
+            r = f.result(timeout=60)
+            if r.ok:
+                lat.append(r.latency_ms)
+        wall = time.perf_counter() - t0
+        eng_c.stop()
+        offered = len(futs) + shed
+        compiles_delta = snap_counter('jax.compiles') - compiles_after_warmup
+        hits = snap_counter('executor.program_cache.hits') - hits0
+        misses = snap_counter('executor.program_cache.misses') - miss0
+        stats = eng_c.stats()['models']['mlp']
+        cont_qps = len(lat) / wall if wall > 0 else 0.0
+        return {
+            'serial_qps': round(serial_qps, 2),
+            'continuous_qps': round(cont_qps, 2),
+            'qps_ratio': round(cont_qps / serial_qps, 3) if serial_qps else 0,
+            'offered': offered,
+            'completed': len(lat),
+            'shed': shed,
+            'shed_rate': round(shed / offered, 4) if offered else 0.0,
+            'p50_latency_ms': round(float(np.percentile(lat, 50)), 2)
+            if lat else 0.0,
+            'p99_latency_ms': round(float(np.percentile(lat, 99)), 2)
+            if lat else 0.0,
+            'mean_batch_occupancy': stats['mean_batch_occupancy'],
+            'program_cache_hits': hits,
+            'program_cache_misses': misses,
+            'program_cache_hit_rate': round(hits / (hits + misses), 4)
+            if (hits + misses) else 0.0,
+            'compiles_after_warmup': compiles_delta,
+        }
+    finally:
+        if not was_static:
+            paddle.disable_static()
+
+
 def _env_batch(var, default):
     """Bench batch with env override (for applying batch-sweep results);
     every emitter echoes the batch into its JSON so an override can never
@@ -750,12 +865,17 @@ def _child_main(mode, model):
                     num_attention_heads=4, intermediate_size=256,
                     max_position_embeddings=128)
         sps = bench_bert(tiny, batch=8, seq=64, steps=3, warmup=1)
+        try:
+            serving_extras = bench_serving()
+        except Exception as e:       # serving bench must never sink smoke
+            serving_extras = {'error': repr(e)}
         print(json.dumps({
             "metric": "bert_smoke_cpu_samples_per_sec",
             "value": round(sps, 2),
             "unit": "samples/sec",
             "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
-            "extras": {"telemetry": _telemetry_counters()},
+            "extras": {"telemetry": _telemetry_counters(),
+                       "serving": serving_extras},
             "complete": True,
         }))
 

@@ -41,6 +41,7 @@ from . import inference
 from . import interop
 from . import reader
 from . import slim
+from . import serving
 from . import regularizer
 from . import sysconfig
 from .framework import save, load, in_dynamic_mode, enable_static, disable_static, in_static_mode

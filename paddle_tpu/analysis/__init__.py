@@ -2,7 +2,7 @@
 
 Two engines over one ``Finding`` type and one reporter pair:
 
-- **AST lint** (``graftlint``): rules GL001–GL012 catch host syncs in traced
+- **AST lint** (``graftlint``): rules GL001–GL013 catch host syncs in traced
   code, retrace triggers, nondeterminism, leftover debug artifacts,
   non-atomic checkpoint writes and ad-hoc wall-clock timing *before* they
   reach hardware. CLI:

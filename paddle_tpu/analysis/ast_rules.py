@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL012).
+"""The graftlint AST rule catalog (GL001–GL013).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -20,6 +20,10 @@ but destroys performance or correctness on real hardware:
   ``Popen.wait()`` with no timeout) in library code — one dead producer
   silently hangs the consumer forever; use ``resilience.watchdog``
   (``bounded_get``/``join_thread``/``wait_proc``) or pass a timeout.
+- GL013: unbucketed dynamic shapes (``len(batch)``-derived constructors,
+  slices, reshapes) reaching a jitted predict path — a fresh compile per
+  distinct request size, i.e. a retrace storm exactly when serving load
+  peaks; pad to a fixed bucket with ``paddle_tpu.serving.bucketing``.
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -615,3 +619,156 @@ class UnboundedWaitRule(Rule):
                 "counterparty died this blocks forever (silent job hang); "
                 f"use paddle_tpu.resilience.{helper} or pass timeout= "
                 "and handle expiry")
+
+
+# -- GL013: unbucketed dynamic shapes into a jitted predict path -------------
+
+# calls whose result is bucket-shaped by construction: taint stops here
+_BUCKET_SANCTIONED = {'pad_to_bucket', 'stack_examples', 'select_bucket',
+                      'batch_bucket', 'length_bucket'}
+# array constructors whose FIRST argument is a shape (or a length for the
+# 1-D ones): a len()-derived value there means a fresh shape per call
+_SHAPE_CTORS = {'zeros', 'ones', 'empty', 'full', 'arange'}
+
+
+def _is_sanctioned(node):
+    return (isinstance(node, ast.Call) and
+            _tail_name(node.func) in _BUCKET_SANCTIONED)
+
+
+def _tail_name(node):
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _walk_unsanctioned(node):
+    """Walk a subtree, skipping the insides of bucket-sanctioned calls
+    (their results are fixed-shape regardless of what fed them)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if _is_sanctioned(n):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _mentions_dynlen(node, dyn_scalar):
+    """True when ``node`` (outside sanctioned calls) reads ``len(...)`` or
+    a len()-derived name."""
+    for n in _walk_unsanctioned(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and \
+                n.func.id == 'len':
+            return True
+        if isinstance(n, ast.Name) and n.id in dyn_scalar:
+            return True
+    return False
+
+
+def _is_dynamic_shape_expr(node, dyn_scalar, dyn_array):
+    """Does ``node`` produce an array whose SHAPE depends on a request's
+    length/batch size? Constructors with dyn shape args, slices with dyn
+    bounds, reshapes to dyn sizes, or names already known dynamic."""
+    for n in _walk_unsanctioned(node):
+        if isinstance(n, ast.Name) and n.id in dyn_array:
+            return True
+        if isinstance(n, ast.Call):
+            tail = _tail_name(n.func)
+            if tail in _SHAPE_CTORS and n.args and \
+                    _mentions_dynlen(n.args[0], dyn_scalar):
+                return True
+            if tail == 'reshape' and any(
+                    _mentions_dynlen(a, dyn_scalar) for a in n.args):
+                return True
+        if isinstance(n, ast.Subscript) and isinstance(n.slice, ast.Slice):
+            for bound in (n.slice.lower, n.slice.upper):
+                if bound is not None and \
+                        _mentions_dynlen(bound, dyn_scalar):
+                    return True
+    return False
+
+
+@register
+class UnbucketedDynamicShapeRule(Rule):
+    """GL013: a value whose shape depends on ``len(batch)`` / a request's
+    size reaches a jitted callable — every distinct size is a fresh
+    compile, so serving traffic turns into a retrace storm exactly when
+    load is highest. Pad to a fixed bucket first
+    (``paddle_tpu.serving.bucketing``: ``select_bucket`` +
+    ``pad_to_bucket``/``stack_examples``), keeping the compiled shape set
+    closed. Scalar ``len()`` values are fine (they trace as 0-d inputs);
+    the rule fires only on *shape*-position uses: constructors, slices,
+    reshapes."""
+    id = 'GL013'
+    title = 'unbucketed dynamic shape into jitted callable'
+
+    def in_scope(self, rel):
+        if rel.startswith(('tests/', 'tools/')):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def _taint(self, fn, index):
+        """(dyn_scalar, dyn_array): names carrying len()-derived sizes /
+        len()-shaped arrays within one function, to fixpoint."""
+        dyn_scalar, dyn_array = set(), set()
+        assigns = [n for n in index.walk_body(fn)
+                   if isinstance(n, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign))]
+        changed = True
+        while changed:
+            changed = False
+            for a in assigns:
+                value = a.value
+                if value is None or _is_sanctioned(value):
+                    continue
+                targets = a.targets if isinstance(a, ast.Assign) \
+                    else [a.target]
+                names = [t.id for t in targets if isinstance(t, ast.Name)]
+                if not names:
+                    continue
+                if _is_dynamic_shape_expr(value, dyn_scalar, dyn_array):
+                    new = [n for n in names if n not in dyn_array]
+                    if new:
+                        dyn_array.update(new)
+                        changed = True
+                elif _mentions_dynlen(value, dyn_scalar):
+                    new = [n for n in names if n not in dyn_scalar]
+                    if new:
+                        dyn_scalar.update(new)
+                        changed = True
+        return dyn_scalar, dyn_array
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        jitted = ctx.index.jit_wrapped_names()
+        if not jitted:
+            return
+        taint = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _tail_name(node.func)
+            if name not in jitted:
+                continue
+            fn = ctx.index.enclosing_function(node)
+            if fn is None:
+                continue
+            if id(fn) not in taint:
+                taint[id(fn)] = self._taint(fn, ctx.index)
+            dyn_scalar, dyn_array = taint[id(fn)]
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if _is_dynamic_shape_expr(arg, dyn_scalar, dyn_array):
+                    yield self.finding(
+                        ctx, arg,
+                        f"argument to jitted callable {name!r} has a "
+                        "shape derived from len()/request size — each "
+                        "distinct size compiles a fresh program (retrace "
+                        "storm under serving load); pad to a fixed bucket "
+                        "with paddle_tpu.serving.bucketing "
+                        "(select_bucket + pad_to_bucket/stack_examples)")
+                    break

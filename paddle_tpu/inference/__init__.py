@@ -16,6 +16,10 @@ executable, so the cache layer works at that level:
 - ``Predictor`` — save_inference_model dir -> ready-to-run engine with
   feed/fetch names (AnalysisPredictor analogue), jit-cached per feed
   shape, optionally backed by the persistent cache.
+- ``load_inference_model(dirname)`` — THE documented load path: one call
+  that turns a ``save_inference_model`` directory into a ready
+  ``Predictor``. The serving engine (``paddle_tpu.serving``) and direct
+  users share it, so an export that loads here is guaranteed to serve.
 """
 import os
 import pickle
@@ -25,7 +29,8 @@ import jax
 
 from ..core.tensor import Tensor
 
-__all__ = ['enable_compilation_cache', 'AOTCompiledFunction', 'Predictor']
+__all__ = ['enable_compilation_cache', 'AOTCompiledFunction', 'Predictor',
+           'load_inference_model']
 
 
 def enable_compilation_cache(cache_dir):
@@ -117,9 +122,21 @@ class AOTCompiledFunction:
                 % (n, len(jax.devices())))
         # deserialize onto exactly the compiled device count — the default
         # would map onto every local device and then reject the args
-        return cls(se.deserialize_and_load(
-            serialized, in_tree, out_tree,
-            execution_devices=jax.devices()[:n]))
+        # (execution_devices is newer than some supported jax versions;
+        # those versions also default to the compiled device assignment,
+        # so omitting it is correct there, not just tolerated). Feature-
+        # detect via the signature: a blanket except TypeError would also
+        # swallow unrelated TypeErrors from inside deserialization.
+        import inspect
+        kwargs = {}
+        try:
+            if 'execution_devices' in inspect.signature(
+                    se.deserialize_and_load).parameters:
+                kwargs['execution_devices'] = jax.devices()[:n]
+        except (TypeError, ValueError):
+            pass
+        return cls(se.deserialize_and_load(serialized, in_tree, out_tree,
+                                           **kwargs))
 
 
 class Predictor:
@@ -147,6 +164,8 @@ class Predictor:
                 "model dir has no portable export (save_inference_model "
                 "recorded: %s) — re-export it"
                 % meta.get('export_error', 'unknown reason'))
+        import jax.export  # noqa: F401 — lazy submodule: a bare
+        # `import jax` does not bind the attribute
         self._exported = jax.export.deserialize(
             bytearray(meta['exported']['blob']))
         self._param_vals = [np.asarray(params[n])
@@ -178,4 +197,28 @@ class Predictor:
         feed_vals = [np.asarray(feed[n], dtype=dt)
                      for n, dt in zip(self._feed_names, self._feed_dtypes)]
         outs = self._exported.call(feed_vals, self._param_vals)
-        return [np.asarray(o) for o in outs]
+        fetched = [np.asarray(o) for o in outs]
+        from .. import observability as _obs
+        if _obs.enabled():
+            _obs.record_host_transfer(sum(a.nbytes for a in fetched),
+                                      kind='predictor.fetch')
+        return fetched
+
+
+def load_inference_model(dirname, model_filename=None, params_filename=None,
+                         cache_dir=None):
+    """Load a ``save_inference_model`` directory into a ready ``Predictor``.
+
+    The standalone-process analogue of ``static.io.load_inference_model``
+    (which rebinds params into the *current* Program and therefore only
+    works in the process that built the graph — the save/load asymmetry
+    this entry point closes). Use this one everywhere a fresh process
+    serves an exported model; ``paddle_tpu.serving`` registers its models
+    through the same call::
+
+        predictor = inference.load_inference_model('model_dir')
+        engine.register('m', predictor=predictor,
+                        example={'x': np.zeros((16,), np.float32)})
+    """
+    return Predictor(dirname, model_filename=model_filename,
+                     params_filename=params_filename, cache_dir=cache_dir)

@@ -345,6 +345,10 @@ def save(layer, path, input_spec=None, **configs):
     meta = {'class': type(layer).__name__}
     if input_spec is not None:
         try:
+            # jax.export is a lazy submodule: a bare `import jax` does NOT
+            # bind the attribute, so the export machinery must be imported
+            # explicitly or every save silently degrades to export_error
+            import jax.export  # noqa: F401
             # portable jax.export with the layer state as ARGUMENTS (not
             # baked constants) so TranslatedLayer.forward can run the
             # executable against its reloaded .pdparams in a fresh process
@@ -441,6 +445,7 @@ class TranslatedLayer(Layer):
                 "the model class and set_state_dict()."
                 % self._meta.get('export_error', 'none recorded'))
         if getattr(self, '_exec', None) is None:
+            import jax.export  # noqa: F401 — lazy submodule (see save())
             self._exec = jax.export.deserialize(bytearray(exported['blob']))
         state_vals = []
         for n in exported['state_names']:

@@ -91,4 +91,7 @@ def summary():
         'dist_timeouts': snap.get('distributed.timeouts', 0),
         'rank_failures': snap.get('distributed.rank_failures', 0),
         'rank_restarts': snap.get('distributed.rank_restarts', 0),
+        'serving_requests': snap.get('serving.requests', 0),
+        'serving_shed': snap.get('serving.shed', 0),
+        'serving_deadline_expired': snap.get('serving.deadline_expired', 0),
     }

@@ -18,6 +18,8 @@ against, on CPU, deterministically:
   chosen indices (DataLoader quarantine / respawn / watchdog models);
 - ``slow_rank`` — a picklable spawn-func wrapper adding a delay on one rank
   (straggler model for collective deadlines);
+- ``slow_model`` — wrap a serving batch callable to sleep before every
+  batch (overloaded-backend model for deadline expiry / load shedding);
 - ``slow_collective`` — context manager delaying named eager collectives in
   this process (DistributedTimeoutError model);
 - ``boot_fail`` — context manager arming rank bootstrap crashes (exit 43
@@ -36,7 +38,8 @@ from . import atomic_io
 __all__ = ['FaultInjector', 'flaky', 'poison_loss', 'corrupt_file',
            'truncate_file', 'PreemptAtStep', 'InjectedWriteError',
            'poison_sample', 'kill_worker', 'hang_worker', 'slow_rank',
-           'slow_collective', 'boot_fail', 'PoisonedSampleError']
+           'slow_model', 'slow_collective', 'boot_fail',
+           'PoisonedSampleError']
 
 
 class InjectedWriteError(OSError):
@@ -249,6 +252,19 @@ class _SlowRankFn:
 
 def slow_rank(fn, rank, delay_s):
     return _SlowRankFn(fn, rank, delay_s)
+
+
+def slow_model(fn, delay_s):
+    """Wrap a serving batch callable so every batch sleeps ``delay_s``
+    seconds first — the overloaded-backend model that drives serving
+    deadline expiry and admission-queue load shedding deterministically
+    on CPU (the serving-side sibling of ``slow_rank``)."""
+    delay_s = float(delay_s)
+
+    def slowed(*args, **kwargs):
+        time.sleep(delay_s)
+        return fn(*args, **kwargs)
+    return slowed
 
 
 @contextlib.contextmanager

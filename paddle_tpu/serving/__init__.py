@@ -1,0 +1,47 @@
+"""paddle_tpu.serving: multi-tenant inference with continuous batching.
+
+The "millions of users" layer over the PR 1–5 stack: exported models
+(``jit.load`` / ``inference.load_inference_model``) are kept warm in the
+compiled-program caches and served under load with
+
+- **fixed bucket shapes** (``bucketing``) — a closed compiled-shape set,
+  so steady-state traffic never traces or compiles (``jax.compiles`` flat
+  after ``warmup()``; graftlint GL013 lints for violations statically);
+- **iteration-level continuous batching** (``runners``) — one-shot models
+  re-pack the queue every batch; generative models join/leave fixed
+  KV-cache slots per decode step (``kv_cache``);
+- **production edges** (``scheduler``) — bounded admission queues with
+  429-style shedding, per-request deadlines (expired work is dropped, not
+  run), watchdog-bounded client waits;
+- **telemetry** on the PR 3 spine — ``serving.*`` counters, latency /
+  queue-wait / batch-occupancy histograms, per-request events
+  (``tools/telemetry_dump.py --serving`` summarizes them).
+
+Quick start (docs/SERVING.md has the full guide)::
+
+    engine = serving.ServingEngine(queue_capacity=64)
+    ep = engine.register('clf', layer=model,
+                         example={'x': np.zeros((16,), np.float32)})
+    engine.warmup()          # compile every bucket now
+    engine.start()           # background worker thread
+    resp = ep.predict({'x': features}, deadline_ms=50)
+"""
+from .bucketing import (DEFAULT_BATCH_BUCKETS, BucketSpec, pad_to_bucket,
+                        select_bucket, stack_examples)
+from .engine import Endpoint, ServingEngine
+from .kv_cache import GenerativeSpec, TinyCausalLM
+from .runners import BatchRunner, GenerativeRunner
+from .scheduler import (AdmissionQueue, PendingRequest, QueueFullError,
+                        Request, Response, STATUS_DEADLINE, STATUS_ERROR,
+                        STATUS_OK)
+from . import bucketing, engine, kv_cache, runners, scheduler  # noqa: F401
+
+__all__ = [
+    'ServingEngine', 'Endpoint',
+    'BucketSpec', 'DEFAULT_BATCH_BUCKETS', 'select_bucket', 'pad_to_bucket',
+    'stack_examples',
+    'GenerativeSpec', 'TinyCausalLM',
+    'BatchRunner', 'GenerativeRunner',
+    'AdmissionQueue', 'PendingRequest', 'QueueFullError', 'Request',
+    'Response', 'STATUS_OK', 'STATUS_DEADLINE', 'STATUS_ERROR',
+]

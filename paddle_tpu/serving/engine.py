@@ -1,0 +1,407 @@
+"""ServingEngine: multi-tenant inference on the warm program cache.
+
+One engine serves many models. Each registered model gets a bounded
+admission queue and a runner (``runners.py``); a single worker thread
+round-robins the runners, so every pump is one bounded unit of work per
+model — a flood on one tenant cannot starve another of scheduler
+iterations (it can only fill its own queue and shed).
+
+Registration adapters (all funnel into the two runner shapes):
+
+- ``predict_fn=`` — a batched jnp callable, jit-wrapped here;
+- ``layer=`` — an ``nn.Layer`` (e.g. ``jit.load``'s TranslatedLayer after
+  re-save, or any eager model): wrapped in no-grad eval calls and
+  jit-compiled; ``quantize='int8'`` first routes it through the ``slim``
+  per-channel post-training quantization pass (``calib_data`` required);
+- ``program=`` — a ``(program, feed_names, fetch_vars)`` triple from
+  ``static.io.load_inference_model`` plus an Executor: batches run through
+  ``Executor.run``, so the **Executor program cache** is the warm-program
+  store (hits/misses already counted on the telemetry spine);
+- ``predictor=`` — an ``inference.Predictor`` (portable export);
+- ``generative=`` — a ``kv_cache.GenerativeSpec`` for continuous-batching
+  decode.
+
+Drive it either with ``start()`` (background worker thread; clients block
+on ``Endpoint.predict``) or synchronously with ``pump()`` /
+``run_until_idle()`` for deterministic tests and benches.
+"""
+import threading
+
+import numpy as np
+
+from .. import observability as _obs
+from ..resilience.watchdog import join_thread
+from .runners import BatchRunner, GenerativeRunner, _count
+from .scheduler import (AdmissionQueue, PendingRequest, QueueFullError,
+                        Request)
+
+__all__ = ['ServingEngine', 'Endpoint']
+
+# Idle backstop only: submit() and stop() notify the condition, so the
+# worker wakes immediately on new work — a long tick avoids 100 Hz busy
+# polling in an idle daemon while still bounding any missed wakeup.
+_IDLE_TICK = 0.5
+
+
+class Endpoint:
+    """Client-facing handle for one served model."""
+
+    def __init__(self, engine, model):
+        self._engine = engine
+        self.model = model
+
+    def submit(self, inputs, deadline_ms=None, max_new_tokens=None):
+        """Enqueue one request -> ``PendingRequest``. Raises
+        ``QueueFullError`` when the admission queue sheds it (429-style),
+        ``ValueError`` when inputs don't match the registered spec."""
+        return self._engine.submit(self.model, inputs,
+                                   deadline_ms=deadline_ms,
+                                   max_new_tokens=max_new_tokens)
+
+    def predict(self, inputs, deadline_ms=None, max_new_tokens=None,
+                timeout=None):
+        """Blocking one-call convenience: submit + result."""
+        return self.submit(inputs, deadline_ms=deadline_ms,
+                           max_new_tokens=max_new_tokens).result(
+                               timeout=timeout)
+
+
+class ServingEngine:
+    def __init__(self, queue_capacity=256, default_deadline_ms=None):
+        self.queue_capacity = int(queue_capacity)
+        self.default_deadline_ms = default_deadline_ms
+        self._models = {}              # name -> runner
+        self._queues = {}              # name -> AdmissionQueue
+        self._rr = []                  # round-robin order
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._thread = None
+        self._stop = threading.Event()
+        self._shed = 0
+        self._submitted = 0
+
+    # -- registration ---------------------------------------------------
+    def register(self, name, predict_fn=None, layer=None, program=None,
+                 executor=None, predictor=None, generative=None,
+                 example=None, bucket_spec=None, quantize=None,
+                 calib_data=None, default_max_new_tokens=32,
+                 queue_capacity=None, jit_compile=True):
+        """Register one model under ``name``. Exactly one of
+        ``predict_fn``/``layer``/``program``/``predictor``/``generative``
+        must be given; one-shot kinds also need ``example`` (one request's
+        inputs, no batch axis) to pin the closed shape set."""
+        given = [k for k, v in (('predict_fn', predict_fn), ('layer', layer),
+                                ('program', program),
+                                ('predictor', predictor),
+                                ('generative', generative)) if v is not None]
+        if len(given) != 1:
+            raise ValueError(
+                f"register({name!r}): give exactly one model kind, got "
+                f"{given or 'none'}")
+        if name in self._models:
+            raise ValueError(f"register: model {name!r} already registered")
+        if quantize is not None and layer is None:
+            raise ValueError(
+                f"register({name!r}): quantize= applies only to layer= "
+                "models (slim PTQ rewrites the Layer); quantize the model "
+                "before export for the other kinds")
+        if generative is not None:
+            bad = [k for k, v in (('example', example),
+                                  ('bucket_spec', bucket_spec),
+                                  ('calib_data', calib_data)) if v is not None]
+            if bad:
+                raise ValueError(
+                    f"register({name!r}): {bad} do not apply to "
+                    "generative= models — prompt buckets and batch size "
+                    "come from the GenerativeSpec itself")
+        if queue_capacity is not None and int(queue_capacity) < 1:
+            raise ValueError(
+                f"register({name!r}): queue_capacity must be >= 1, got "
+                f"{queue_capacity!r}")
+        queue = AdmissionQueue(name,
+                               self.queue_capacity if queue_capacity is None
+                               else queue_capacity)
+        if generative is not None:
+            runner = GenerativeRunner(
+                name, queue, generative,
+                default_max_new_tokens=default_max_new_tokens)
+        else:
+            if example is None:
+                raise ValueError(
+                    f"register({name!r}): one-shot models need example= "
+                    "(one request's inputs, no batch axis) to fix the "
+                    "compiled shape set")
+            if predict_fn is not None:
+                # jit_compile=False is for callables that are already
+                # compiled (or host-side wrappers, e.g. faultinject
+                # slow_model around a jitted fn)
+                fn = predict_fn
+            elif layer is not None:
+                fn = self._layer_fn(name, layer, quantize, calib_data,
+                                    example)
+            elif predictor is not None:
+                fn = self._predictor_fn(predictor)
+                jit_compile = False    # export manages its own compilation
+            else:
+                fn = self._program_fn(name, program, executor)
+                jit_compile = False    # Executor program cache owns it
+            runner = BatchRunner(name, queue, fn, example,
+                                 bucket_spec=bucket_spec,
+                                 jit_compile=jit_compile)
+        with self._cond:
+            self._models[name] = runner
+            self._queues[name] = queue
+            self._rr.append(name)
+        if _obs.enabled():
+            _obs.gauge('serving.models').set(len(self._models))
+        return Endpoint(self, name)
+
+    def _layer_fn(self, name, layer, quantize, calib_data, example):
+        import inspect
+        from ..core.tensor import Tensor
+        from ..core import autograd
+        if quantize is not None:
+            if quantize != 'int8':
+                raise ValueError(
+                    f"register({name!r}): quantize must be 'int8', "
+                    f"got {quantize!r}")
+            if calib_data is None:
+                raise ValueError(
+                    f"register({name!r}): quantize='int8' needs "
+                    "calib_data= (iterable of input batches for the slim "
+                    "PTQ calibration pass)")
+            from ..slim import PostTrainingQuantization
+            layer = PostTrainingQuantization(layer, calib_data).quantize()
+        layer.eval()
+        # Bind feeds to forward's parameters BY NAME: a dict has no
+        # positional order, so multi-input layers whose feed names don't
+        # match forward's parameter names must be registered through
+        # predict_fn= (where the caller owns the binding) rather than be
+        # silently miswired by an arbitrary key sort.
+        if len(example) == 1:
+            order = list(example)
+        else:
+            try:
+                params = [
+                    p.name for p in
+                    inspect.signature(layer.forward).parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+            except (TypeError, ValueError):
+                params = []
+            if not set(example) <= set(params):
+                raise ValueError(
+                    f"register({name!r}): multi-input layer — feed names "
+                    f"{sorted(example)} must match {type(layer).__name__}"
+                    f".forward parameter names {params} so arguments bind "
+                    "unambiguously; rename the feeds or register via "
+                    "predict_fn= with explicit binding")
+            order = [p for p in params if p in example]
+
+        def fn(feeds):
+            vals = [Tensor(feeds[k]) for k in order]
+            with autograd.no_grad():
+                out = layer(*vals)
+            if isinstance(out, (tuple, list)):
+                return type(out)(o._value if isinstance(o, Tensor) else o
+                                 for o in out)
+            return out._value if isinstance(out, Tensor) else out
+        return fn
+
+    def _predictor_fn(self, predictor):
+        def fn(feeds):
+            outs = predictor.run({k: np.asarray(v)
+                                  for k, v in feeds.items()})
+            return tuple(outs)
+        return fn
+
+    def _program_fn(self, name, program, executor):
+        if executor is None:
+            raise ValueError(
+                f"register({name!r}): program= also needs executor=")
+        try:
+            prog, feed_names, fetch_vars = program
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"register({name!r}): program= expects the (program, "
+                "feed_names, fetch_vars) triple load_inference_model "
+                "returns") from None
+
+        def fn(feeds):
+            outs = executor.run(prog,
+                                feed={k: np.asarray(v)
+                                      for k, v in feeds.items()},
+                                fetch_list=list(fetch_vars))
+            return tuple(outs)
+        return fn
+
+    # -- client surface -------------------------------------------------
+    def endpoint(self, name):
+        if name not in self._models:
+            raise KeyError(f"serving: no model {name!r} registered "
+                           f"(have {sorted(self._models)})")
+        return Endpoint(self, name)
+
+    def submit(self, model, inputs, deadline_ms=None, max_new_tokens=None):
+        runner = self._models.get(model)
+        if runner is None:
+            raise KeyError(f"serving: no model {model!r} registered")
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        if max_new_tokens is not None and int(max_new_tokens) < 1:
+            raise ValueError(
+                f"serving: max_new_tokens must be >= 1, got "
+                f"{max_new_tokens!r}")
+        req = Request(model, inputs, deadline_ms=deadline_ms,
+                      max_new_tokens=max_new_tokens)
+        runner.validate(req)
+        _count('serving.requests')
+        try:
+            self._queues[model].push(req)
+        except QueueFullError:
+            self._shed += 1
+            _count('serving.shed')
+            if _obs.enabled():
+                _obs.event('serving.shed', model=model, request=req.id)
+            raise
+        self._submitted += 1
+        with self._cond:
+            if _obs.enabled():
+                _obs.gauge('serving.queue_depth').set(
+                    sum(len(q) for q in self._queues.values()))
+            self._cond.notify_all()
+        return PendingRequest(req, self.alive)
+
+    # -- scheduler loop -------------------------------------------------
+    def pump(self):
+        """One scheduler iteration over every model (round-robin order).
+        Returns True when any runner did work."""
+        # snapshot under the lock: register() may grow these dicts from
+        # another thread and iterating a resizing dict raises
+        with self._lock:
+            order = list(self._rr)
+            if order:
+                self._rr.append(self._rr.pop(0))
+            runners = [self._models[n] for n in order]
+            queues = list(self._queues.values())
+        did = False
+        for runner in runners:
+            if runner.has_work():
+                did = runner.step() or did
+        if _obs.enabled():
+            _obs.gauge('serving.queue_depth').set(
+                sum(len(q) for q in queues))
+            _obs.gauge('serving.active_slots').set(sum(
+                sum(1 for s in r.slots if s is not None)
+                for r in runners if isinstance(r, GenerativeRunner)))
+        return did
+
+    def run_until_idle(self, max_steps=100000):
+        """Pump until no runner has work (manual-drive mode for tests and
+        benches). Returns the number of iterations that did work."""
+        steps = 0
+        for _ in range(int(max_steps)):
+            if not self.pump():
+                if not any(r.has_work() for r in self._models.values()):
+                    return steps
+            else:
+                steps += 1
+        return steps
+
+    def warmup(self):
+        """Compile every registered model's closed shape set now, so the
+        first real request never pays an XLA compile. Returns
+        {model: programs_compiled}."""
+        out = {}
+        with _obs.timer('serving.warmup'):
+            for name, runner in self._models.items():
+                out[name] = runner.warmup() if hasattr(runner, 'warmup') \
+                    else 0
+        return out
+
+    def start(self):
+        """Start the background worker thread (idempotent). A worker that
+        died from an escaped exception (counted as serving.worker_crash)
+        is replaced, not silently left dead."""
+        with self._cond:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._worker, name='paddle-tpu-serving', daemon=True)
+            self._thread.start()
+        return self
+
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def stop(self, timeout=10.0):
+        """Stop the worker; queued AND in-flight (KV-slot-resident)
+        requests are completed as errors rather than stranded (their
+        clients' bounded waits would fire anyway, but a shaped answer —
+        with any partial generative output — beats a timeout)."""
+        with self._cond:
+            self._stop.set()
+            self._cond.notify_all()
+            t = self._thread
+        # Join BEFORE clearing _thread: alive() must stay True while the
+        # worker finishes its current batch, or clients blocked in
+        # result() race into a spurious "engine stopped" WatchdogTimeout
+        # for a request that completes milliseconds later. A join timeout
+        # must abort the shutdown — evicting KV slots under a live worker
+        # would have two threads mutating runner state.
+        if t is not None and not join_thread(t, timeout=timeout):
+            from ..resilience.watchdog import WatchdogTimeout
+            raise WatchdogTimeout(
+                f"serving: worker thread still running {timeout:.1f}s "
+                "after stop() — a batch is stuck; not evicting in-flight "
+                "requests under a live worker", what='serving worker join',
+                waited=timeout)
+        with self._cond:
+            self._thread = None
+        from .runners import finish_request
+        from .scheduler import STATUS_ERROR
+        for name, runner in self._models.items():
+            for req, outputs in runner.evict_in_flight():
+                finish_request(
+                    req, STATUS_ERROR, outputs,
+                    error=RuntimeError(
+                        f"serving: engine stopped with request {req.id} "
+                        "mid-decode"))
+        for name, q in self._queues.items():
+            for req in q.drain():
+                finish_request(
+                    req, STATUS_ERROR,
+                    error=RuntimeError(
+                        f"serving: engine stopped before request "
+                        f"{req.id} ran"))
+
+    def _worker(self):
+        try:
+            while not self._stop.is_set():
+                did = self.pump()
+                if not did:
+                    with self._cond:
+                        if self._stop.is_set():
+                            break
+                        has = any(r.has_work()
+                                  for r in self._models.values())
+                        if not has:
+                            self._cond.wait(_IDLE_TICK)
+        except BaseException as e:
+            # Runners contain model errors, so nothing should escape pump();
+            # if something does, leave a trace — a dead worker otherwise
+            # looks like an idle engine while every client times out.
+            _count('serving.worker_crash')
+            if _obs.enabled():
+                _obs.event('serving.worker_crash', error=repr(e))
+            raise
+
+    # -- introspection --------------------------------------------------
+    def stats(self):
+        return {
+            'submitted': self._submitted,
+            'shed': self._shed,
+            'queue_depth': {n: len(q) for n, q in self._queues.items()},
+            'models': {n: r.stats.as_dict()
+                       for n, r in self._models.items()},
+        }

@@ -1,0 +1,209 @@
+"""Slot-based KV cache for iteration-level (continuous) batch decoding.
+
+The decode hot path of a text model is one token per step per sequence;
+recomputing attention over the whole prefix each step is O(S^2) per token.
+The KV cache stores every layer's keys/values at fixed ``[max_batch,
+max_seq]`` slots so one decode step is O(S) — and, crucially for the
+serving engine, the cache shapes are **static**: requests join by writing
+their prefill K/V into a free slot and leave by freeing it, while the
+jitted decode step always runs at ``[max_batch]``. No shape ever changes,
+so nothing ever recompiles (the Orca/vLLM iteration-level scheduling idea,
+restricted to fixed slots — the right size for this runtime).
+
+Everything here is pure ``jnp`` — safe inside ``jax.jit``; the cache is a
+plain dict pytree threaded through the jitted prefill/decode calls.
+
+``TinyCausalLM`` is the reference ``GenerativeSpec`` implementation (one
+pre-LN attention block + tied output head): small enough to read in one
+sitting, real enough that tests verify cached decode against a full
+no-cache forward, token for token.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ['create_cache', 'write_prompt', 'write_token', 'attend',
+           'attend_prompt', 'GenerativeSpec', 'TinyCausalLM']
+
+
+def create_cache(num_layers, max_batch, max_seq, num_heads, head_dim,
+                 dtype=jnp.float32):
+    """Zeroed cache pytree: ``{'k','v'}`` of ``[L, B, S, H, D]``."""
+    shape = (int(num_layers), int(max_batch), int(max_seq),
+             int(num_heads), int(head_dim))
+    return {'k': jnp.zeros(shape, dtype), 'v': jnp.zeros(shape, dtype)}
+
+
+def write_prompt(cache, layer, slot, k, v):
+    """Write one sequence's prefill K/V (``[Lp, H, D]``) into ``slot`` at
+    positions ``0..Lp-1``. ``Lp`` is the (static) prompt bucket length;
+    rows beyond the real length hold padding garbage that ``attend`` masks
+    out by position. ``slot`` may be a traced scalar — joining a different
+    slot is not a recompile."""
+    k = jnp.asarray(k)[None]           # [1, Lp, H, D]
+    v = jnp.asarray(v)[None]
+    start = (layer, slot, 0, 0, 0)
+    return {
+        'k': jax.lax.dynamic_update_slice(cache['k'], k[None], start),
+        'v': jax.lax.dynamic_update_slice(cache['v'], v[None], start),
+    }
+
+
+def write_token(cache, layer, k, v, positions):
+    """Write one decode step's K/V (``[B, H, D]``) at per-slot
+    ``positions`` (``[B]`` int). Inactive slots write at position 0 —
+    harmless garbage that the next prefill into that slot overwrites."""
+    b = jnp.arange(cache['k'].shape[1])
+    return {
+        'k': cache['k'].at[layer, b, positions].set(k),
+        'v': cache['v'].at[layer, b, positions].set(v),
+    }
+
+
+def attend(cache, layer, q, lengths):
+    """Masked attention read over the cache: ``q`` ``[B, H, D]``,
+    ``lengths`` ``[B]`` = number of valid positions per slot (the current
+    token's K/V already written). Returns ``[B, H, D]``."""
+    k = cache['k'][layer]              # [B, S, H, D]
+    v = cache['v'][layer]
+    d = q.shape[-1]
+    scores = jnp.einsum('bhd,bshd->bhs', q, k) / jnp.sqrt(float(d))
+    mask = jnp.arange(k.shape[1])[None, None, :] < lengths[:, None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum('bhs,bshd->bhd', w, v)
+
+
+def attend_prompt(q, k, v):
+    """Causal self-attention within one prompt (prefill): ``[Lp, H, D]``
+    each. Padded rows beyond the real length produce garbage outputs the
+    caller never reads (only the last *real* row's logits matter)."""
+    d = q.shape[-1]
+    lp = q.shape[0]
+    scores = jnp.einsum('ihd,jhd->hij', q, k) / jnp.sqrt(float(d))
+    causal = jnp.tril(jnp.ones((lp, lp), bool))[None]
+    scores = jnp.where(causal, scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum('hij,jhd->ihd', w, v)
+
+
+class GenerativeSpec:
+    """What a model must provide to decode under continuous batching.
+
+    Subclasses implement three pure functions (all jitted by the runner,
+    so bodies must be trace-safe — no Python branching on traced values):
+
+    - ``init_cache() -> pytree`` of ``[.., max_batch, max_seq, ..]`` arrays
+    - ``prefill(cache, tokens[Lp], length, slot) -> (cache, logits[V])``
+      — process one padded prompt into ``slot``, return the next-token
+      logits at the last real position. ``length``/``slot`` are traced
+      scalars; ``Lp`` is one of ``prompt_buckets`` (static).
+    - ``decode(cache, tokens[B], positions[B]) -> (cache, logits[B, V])``
+      — one token step for every slot at once, ``B == max_batch`` fixed.
+    """
+
+    max_batch = 1
+    max_seq = 128
+    eos_id = None                      # None: stop only on max_new_tokens
+    prompt_buckets = (16, 32, 64)
+
+    def init_cache(self):
+        raise NotImplementedError
+
+    def prefill(self, cache, tokens, length, slot):
+        raise NotImplementedError
+
+    def decode(self, cache, tokens, positions):
+        raise NotImplementedError
+
+
+class TinyCausalLM(GenerativeSpec):
+    """Reference spec: embed + learned positions, one pre-LN causal
+    attention block with residual, tied vocab head.
+
+    ``params`` maps ``emb [V,E]``, ``pos [max_seq,E]``, ``wq/wk/wv/wo
+    [E,E]``; the output head reuses ``emb`` transposed. Deterministic
+    (greedy decode happens in the runner); everything trace-safe.
+    """
+
+    def __init__(self, params, num_heads, max_batch=4, max_seq=128,
+                 eos_id=None, prompt_buckets=(8, 16, 32)):
+        self.p = {k: jnp.asarray(v) for k, v in params.items()}
+        vocab, embed = self.p['emb'].shape
+        if embed % num_heads:
+            raise ValueError("embed dim must divide num_heads")
+        self.num_heads = int(num_heads)
+        self.head_dim = embed // num_heads
+        self.vocab = vocab
+        self.max_batch = int(max_batch)
+        self.max_seq = int(max_seq)
+        self.eos_id = eos_id
+        self.prompt_buckets = tuple(sorted(prompt_buckets))
+
+    @classmethod
+    def random(cls, vocab=64, embed=32, num_heads=4, max_seq=64, seed=0,
+               **kw):
+        """Small random instance for tests/benches (numpy RNG, host-side)."""
+        r = np.random.RandomState(seed)
+
+        def w(*s):
+            return (r.randn(*s) * 0.1).astype(np.float32)
+        params = {'emb': w(vocab, embed), 'pos': w(max_seq, embed),
+                  'wq': w(embed, embed), 'wk': w(embed, embed),
+                  'wv': w(embed, embed), 'wo': w(embed, embed)}
+        return cls(params, num_heads, max_seq=max_seq, **kw)
+
+    # -- shared block ---------------------------------------------------
+    def _norm(self, x):
+        m = jnp.mean(x, axis=-1, keepdims=True)
+        v = jnp.var(x, axis=-1, keepdims=True)
+        return (x - m) / jnp.sqrt(v + 1e-5)
+
+    def _qkv(self, x):
+        h, d = self.num_heads, self.head_dim
+        n = self._norm(x)
+
+        def split(w):
+            y = n @ w
+            return y.reshape(y.shape[:-1] + (h, d))
+        return split(self.p['wq']), split(self.p['wk']), split(self.p['wv'])
+
+    def _head(self, y):
+        return y @ self.p['emb'].T
+
+    def init_cache(self):
+        return create_cache(1, self.max_batch, self.max_seq,
+                            self.num_heads, self.head_dim)
+
+    def prefill(self, cache, tokens, length, slot):
+        lp = tokens.shape[0]
+        x = self.p['emb'][tokens] + self.p['pos'][:lp]      # [Lp, E]
+        q, k, v = self._qkv(x)                              # [Lp, H, D]
+        out = attend_prompt(q, k, v)
+        y = x + out.reshape(lp, -1) @ self.p['wo']
+        cache = write_prompt(cache, 0, slot, k, v)
+        logits = self._head(y)                              # [Lp, V]
+        return cache, logits[length - 1]
+
+    def decode(self, cache, tokens, positions):
+        x = self.p['emb'][tokens] + self.p['pos'][positions]  # [B, E]
+        q, k, v = self._qkv(x)                                # [B, H, D]
+        cache = write_token(cache, 0, k, v, positions)
+        out = attend(cache, 0, q, lengths=positions + 1)
+        y = x + out.reshape(x.shape[0], -1) @ self.p['wo']
+        return cache, self._head(y)
+
+    def reference_decode(self, prompt, max_new_tokens):
+        """Greedy decode with NO cache (full forward each step): the
+        independent oracle the KV-cache path is verified against."""
+        toks = list(np.asarray(prompt, np.int32))
+        for _ in range(int(max_new_tokens)):
+            x = self.p['emb'][jnp.asarray(toks)] + self.p['pos'][:len(toks)]
+            q, k, v = self._qkv(x)
+            out = attend_prompt(q, k, v)
+            y = x + out.reshape(len(toks), -1) @ self.p['wo']
+            nxt = int(np.asarray(jnp.argmax(self._head(y)[-1])))
+            toks.append(nxt)
+            if self.eos_id is not None and nxt == self.eos_id:
+                break
+        return toks[len(np.asarray(prompt)):]

@@ -1,0 +1,409 @@
+"""Model runners: how one scheduler step turns queued requests into math.
+
+Two execution shapes cover the inference surface:
+
+- ``BatchRunner`` — one-shot predict models (classify/embed/score). Each
+  engine step re-packs the queue into the smallest bucket that fits
+  (dynamic batching): requests that arrived while the previous batch ran
+  join the very next one. The batch callable is either ``jax.jit``-wrapped
+  here (Layer / function models) or an ``Executor.run`` closure, in which
+  case the Executor **program cache** is the warm-program store and its
+  hit/miss counters are the cache telemetry.
+- ``GenerativeRunner`` — iteration-level continuous batching for decode
+  (Orca-style): every step admits waiting requests into free KV-cache
+  slots (bucketed prefill), then runs ONE fixed-shape decode step for all
+  active slots; finished sequences leave their slot immediately, so a
+  short request never waits for a long one to finish. Greedy decode; the
+  jitted step set is closed (one prefill per prompt bucket + one decode),
+  so steady-state traffic compiles nothing.
+
+Runners never block: ``step()`` does at most one batch / one decode
+iteration and returns whether it did work; the engine's worker loop (or a
+test's manual pump) drives it.
+"""
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import observability as _obs
+from .bucketing import (BucketSpec, pad_to_bucket, select_bucket,
+                        stack_examples)
+from .scheduler import STATUS_OK, STATUS_DEADLINE, STATUS_ERROR
+
+__all__ = ['BatchRunner', 'GenerativeRunner', 'finish_request']
+
+
+def _count(name, n=1):
+    if _obs.enabled():
+        _obs.counter(name).inc(n)
+
+
+def _observe(name, v):
+    if _obs.enabled():
+        _obs.histogram(name).observe(v)
+
+
+def finish_request(req, status, outputs=None, error=None):
+    """Complete a request and mirror the outcome onto the telemetry spine
+    (latency/queue-wait histograms + a per-request event)."""
+    req.complete(status, outputs, error=error)
+    resp = req.response
+    _count('serving.completed')
+    _count(f'serving.status.{status}')
+    if _obs.enabled():
+        _obs.histogram('serving.latency_ms').observe(resp.latency_ms)
+        _obs.histogram('serving.queue_wait_ms').observe(resp.queue_ms)
+        _obs.event('serving.request', model=req.model, status=status,
+                   latency_ms=round(resp.latency_ms, 3),
+                   queue_ms=round(resp.queue_ms, 3))
+
+
+def _slice_outputs(outs, i):
+    """Per-request view of batched outputs: slice leading axis ``i`` through
+    dict/tuple/list structure."""
+    if isinstance(outs, dict):
+        return {k: _slice_outputs(v, i) for k, v in outs.items()}
+    if isinstance(outs, (list, tuple)):
+        return type(outs)(_slice_outputs(v, i) for v in outs)
+    return np.asarray(outs)[i]
+
+
+class _Stats:
+    """Plain always-on tallies (telemetry mirrors them when enabled)."""
+
+    def __init__(self):
+        self.completed = 0
+        self.expired = 0
+        self.errors = 0
+        self.batches = 0
+        self.joins = 0
+        self.leaves = 0
+        self.decode_tokens = 0
+        self.prefill_tokens = 0
+        self._occ_sum = 0.0
+        self._occ_n = 0
+
+    def occupancy(self, frac):
+        self._occ_sum += frac
+        self._occ_n += 1
+        _observe('serving.batch_occupancy', frac)
+
+    def as_dict(self):
+        return {
+            'completed': self.completed, 'expired': self.expired,
+            'errors': self.errors, 'batches': self.batches,
+            'joins': self.joins, 'leaves': self.leaves,
+            'decode_tokens': self.decode_tokens,
+            'prefill_tokens': self.prefill_tokens,
+            'mean_batch_occupancy': (
+                round(self._occ_sum / self._occ_n, 4) if self._occ_n else 0.0),
+        }
+
+
+class BatchRunner:
+    """Dynamic batching over a one-shot batched callable.
+
+    ``batch_fn(feeds)`` takes ``{name: array [B, ...]}`` and returns an
+    array / tuple / dict with leading batch axis. ``example`` (one request's
+    inputs, no batch axis) pins the shape/dtype spec: submits that disagree
+    are rejected at admission, warmup knows what zeros to fabricate, and
+    the compiled shape set stays closed. ``jit_compile=False`` is for
+    callables that already manage compilation (Executor programs,
+    Predictor exports).
+    """
+
+    kind = 'batch'
+
+    def __init__(self, name, queue, batch_fn, example, bucket_spec=None,
+                 jit_compile=True):
+        self.name = name
+        self.queue = queue
+        self.spec = bucket_spec or BucketSpec()
+        self.example = {k: np.asarray(v) for k, v in example.items()}
+        self._fn = jax.jit(batch_fn) if jit_compile else batch_fn
+        self.stats = _Stats()
+
+    def validate(self, req):
+        missing = sorted(set(self.example) - set(req.inputs))
+        if missing:
+            raise ValueError(
+                f"serving[{self.name}]: request missing inputs {missing}")
+        for k, ex in self.example.items():
+            a = np.asarray(req.inputs[k])
+            if a.shape != ex.shape or a.dtype != ex.dtype:
+                raise ValueError(
+                    f"serving[{self.name}]: input {k!r} has shape/dtype "
+                    f"{a.shape}/{a.dtype}, registered example is "
+                    f"{ex.shape}/{ex.dtype} — serving shapes are a closed "
+                    "set (see serving.bucketing); pad client-side or "
+                    "register a matching model")
+
+    def has_work(self):
+        return len(self.queue) > 0
+
+    def evict_in_flight(self):
+        """-> [(request, partial_outputs)] for requests resident in the
+        runner but no longer in the queue (engine shutdown). One-shot
+        batches are synchronous inside step(), so nothing is resident."""
+        return []
+
+    def warmup(self):
+        """Compile every bucket once with zero feeds (the only compiles a
+        well-bucketed model ever pays)."""
+        for b in self.spec.batch_buckets:
+            feeds = {k: jnp.asarray(np.zeros((b,) + ex.shape, ex.dtype))
+                     for k, ex in self.example.items()}
+            jax.tree_util.tree_map(
+                lambda x: np.asarray(x), self._fn(feeds))
+        return len(self.spec.batch_buckets)
+
+    def step(self):
+        ready, expired = self.queue.pop_ready(self.spec.max_batch)
+        for r in expired:
+            self.stats.expired += 1
+            _count('serving.deadline_expired')
+            finish_request(r, STATUS_DEADLINE)
+        if not ready:
+            return bool(expired)
+        bucket = self.spec.batch_bucket(len(ready))
+        feeds = {k: jnp.asarray(
+                     stack_examples([r.inputs[k] for r in ready], bucket))
+                 for k in self.example}
+        self.stats.batches += 1
+        _count('serving.batches')
+        self.stats.occupancy(len(ready) / bucket)
+        try:
+            with _obs.timer('serving.batch', model=self.name,
+                            batch=len(ready), bucket=bucket):
+                outs = self._fn(feeds)
+            outs = jax.tree_util.tree_map(np.asarray, outs)
+            # slice before completing anything: a malformed output (e.g. no
+            # leading batch axis) must fail the whole batch, not the engine
+            per_req = [_slice_outputs(outs, i) for i in range(len(ready))]
+        except Exception as e:                       # model bug: fail the
+            for r in ready:                          # batch, not the engine
+                self.stats.errors += 1
+                finish_request(r, STATUS_ERROR, error=e)
+            return True
+        for r, out in zip(ready, per_req):
+            self.stats.completed += 1
+            status = STATUS_DEADLINE if r.expired() else STATUS_OK
+            if status == STATUS_DEADLINE:
+                self.stats.expired += 1
+                _count('serving.deadline_expired')
+            finish_request(r, status, out)
+        return True
+
+
+class GenerativeRunner:
+    """Continuous batching: per-iteration join/leave over KV-cache slots.
+
+    ``spec`` is a ``kv_cache.GenerativeSpec``. The runner owns the cache
+    pytree and the slot table; requests are greedy-decoded. The compiled
+    set is exactly ``len(spec.prompt_buckets)`` prefill programs plus one
+    decode program — all fixed shapes, compiled at warmup.
+    """
+
+    kind = 'generative'
+
+    def __init__(self, name, queue, spec, default_max_new_tokens=32):
+        self.name = name
+        self.queue = queue
+        self.spec = spec
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.cache = spec.init_cache()
+        self.slots = [None] * spec.max_batch
+        self.stats = _Stats()
+        self.step_no = 0
+        # join/leave journal for tests/debugging: (event, request_id, step)
+        self.journal = collections.deque(maxlen=1024)
+
+        def _prefill(cache, toks, length, slot):
+            cache, logits = spec.prefill(cache, toks, length, slot)
+            return cache, jnp.argmax(logits)
+
+        def _decode(cache, toks, pos):
+            cache, logits = spec.decode(cache, toks, pos)
+            return cache, jnp.argmax(logits, axis=-1)
+
+        self._prefill = jax.jit(_prefill)
+        self._decode = jax.jit(_decode)
+
+    def validate(self, req):
+        toks = np.asarray(req.inputs.get('tokens', ()))
+        if toks.size == 0:
+            raise ValueError(
+                f"serving[{self.name}]: generative request needs a "
+                "non-empty 'tokens' input")
+        if toks.ravel().shape[0] > self.spec.prompt_buckets[-1]:
+            raise ValueError(
+                f"serving[{self.name}]: prompt of {toks.ravel().shape[0]} "
+                f"tokens exceeds the largest prompt bucket "
+                f"{self.spec.prompt_buckets[-1]}")
+
+    def has_work(self):
+        return len(self.queue) > 0 or any(s is not None for s in self.slots)
+
+    def evict_in_flight(self):
+        """Vacate every occupied KV slot (engine shutdown): returns
+        ``[(request, partial_outputs)]`` so the engine can complete them
+        with their tokens-so-far instead of stranding the clients."""
+        out = []
+        for slot, s in enumerate(self.slots):
+            if s is None:
+                continue
+            self.slots[slot] = None
+            self.stats.leaves += 1
+            _count('serving.leaves')
+            self.journal.append(('leave', s['req'].id, self.step_no))
+            out.append((s['req'],
+                        {'tokens': np.asarray(s['tokens'], np.int32)}))
+        return out
+
+    def warmup(self):
+        """Compile every prefill bucket + the decode step. Uses slot 0 with
+        dummy tokens; a real join later overwrites the slot's cache."""
+        n = 0
+        for lb in self.spec.prompt_buckets:
+            toks = jnp.zeros((lb,), jnp.int32)
+            # length/slot must be int32 ARRAYS exactly like the real calls:
+            # a python int here traces a weak-typed variant and the first
+            # real request would recompile the bucket
+            self.cache, _ = self._prefill(self.cache, toks,
+                                          jnp.asarray(1, jnp.int32),
+                                          jnp.asarray(0, jnp.int32))
+            n += 1
+        b = self.spec.max_batch
+        self.cache, _ = self._decode(self.cache,
+                                     jnp.zeros((b,), jnp.int32),
+                                     jnp.zeros((b,), jnp.int32))
+        return n + 1
+
+    # -- one scheduler iteration ---------------------------------------
+    def step(self):
+        self.step_no += 1
+        did = self._admit()
+        did = self._decode_step() or did
+        return did
+
+    def _admit(self):
+        free = [i for i, s in enumerate(self.slots) if s is None]
+        if not free:
+            # still reap already-dead requests so they don't rot in queue
+            expired = self.queue.reap_expired()
+            for r in expired:
+                self._expire(r)
+            return bool(expired)
+        ready, expired = self.queue.pop_ready(len(free))
+        for r in expired:
+            self._expire(r)
+        did = bool(expired)
+        for r in ready:
+            did = True
+            slot = free.pop(0)
+            prompt = np.asarray(r.inputs['tokens'], np.int32).ravel()
+            lb = select_bucket(len(prompt), self.spec.prompt_buckets)
+            padded = pad_to_bucket(prompt, lb)
+            try:
+                with _obs.timer('serving.prefill', model=self.name,
+                                bucket=lb):
+                    self.cache, nxt = self._prefill(
+                        self.cache, jnp.asarray(padded),
+                        jnp.asarray(len(prompt), jnp.int32),
+                        jnp.asarray(slot, jnp.int32))
+            except Exception as e:                   # model bug: fail the
+                self.stats.errors += 1               # request, not the
+                free.insert(0, slot)                 # engine worker
+                finish_request(r, STATUS_ERROR, error=e)
+                continue
+            first = int(np.asarray(nxt))
+            self.stats.joins += 1
+            self.stats.prefill_tokens += len(prompt)
+            _count('serving.joins')
+            _count('serving.prefill_tokens', len(prompt))
+            self.journal.append(('join', r.id, self.step_no))
+            if _obs.enabled():
+                _obs.event('serving.join', model=self.name, request=r.id,
+                           slot=slot, prompt_len=len(prompt))
+            max_new = int(self.default_max_new_tokens
+                          if r.max_new_tokens is None else r.max_new_tokens)
+            state = {'req': r, 'tokens': [first], 'last': first,
+                     'pos': len(prompt), 'max_new': max_new}
+            self.slots[slot] = state
+            self._maybe_finish(slot)
+        return did
+
+    def _decode_step(self):
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return False
+        b = self.spec.max_batch
+        toks = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        for i in active:
+            toks[i] = self.slots[i]['last']
+            pos[i] = self.slots[i]['pos']
+        self.stats.batches += 1
+        _count('serving.decode_steps')
+        self.stats.occupancy(len(active) / b)
+        try:
+            with _obs.timer('serving.decode', model=self.name,
+                            active=len(active)):
+                self.cache, nxt = self._decode(self.cache, jnp.asarray(toks),
+                                               jnp.asarray(pos))
+        except Exception as e:                       # model bug: fail the
+            for i in active:                         # co-batched requests,
+                s = self.slots[i]                    # not the engine worker
+                self.slots[i] = None
+                self.stats.errors += 1
+                self.stats.leaves += 1
+                _count('serving.leaves')
+                self.journal.append(('leave', s['req'].id, self.step_no))
+                finish_request(s['req'], STATUS_ERROR,
+                               {'tokens': np.asarray(s['tokens'], np.int32)},
+                               error=e)
+            return True
+        nxt = np.asarray(nxt)
+        for i in active:
+            s = self.slots[i]
+            s['pos'] += 1
+            tok = int(nxt[i])
+            s['tokens'].append(tok)
+            s['last'] = tok
+            self.stats.decode_tokens += 1
+            _count('serving.decode_tokens')
+            self._maybe_finish(i)
+        return True
+
+    # -- slot lifecycle -------------------------------------------------
+    def _maybe_finish(self, slot):
+        s = self.slots[slot]
+        r = s['req']
+        eos = self.spec.eos_id
+        done = (len(s['tokens']) >= s['max_new'] or
+                s['pos'] + 1 >= self.spec.max_seq or
+                (eos is not None and s['last'] == eos))
+        status = STATUS_OK
+        if r.expired():
+            done, status = True, STATUS_DEADLINE
+            self.stats.expired += 1
+            _count('serving.deadline_expired')
+        if not done:
+            return
+        self.slots[slot] = None
+        self.stats.leaves += 1
+        self.stats.completed += 1
+        _count('serving.leaves')
+        self.journal.append(('leave', r.id, self.step_no))
+        if _obs.enabled():
+            _obs.event('serving.leave', model=self.name, request=r.id,
+                       slot=slot, tokens=len(s['tokens']), status=status)
+        finish_request(r, status,
+                       {'tokens': np.asarray(s['tokens'], np.int32)})
+
+    def _expire(self, req):
+        self.stats.expired += 1
+        _count('serving.deadline_expired')
+        finish_request(req, STATUS_DEADLINE)

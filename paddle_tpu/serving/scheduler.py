@@ -1,0 +1,236 @@
+"""Admission control + request lifecycle for the serving engine.
+
+Production edges live here, not in the model runners:
+
+- **bounded admission queue**: ``capacity`` requests per model; a full
+  queue rejects at submit time (``QueueFullError`` — the HTTP-429 analogue)
+  instead of growing an unbounded backlog whose tail can never meet its
+  deadline anyway (load shedding).
+- **per-request deadlines**: every request carries a budget measured from
+  submit (``observability.Stopwatch``, the GL011-sanctioned clock). A
+  request that expires while still queued is completed with status
+  ``'deadline'`` *without* running — burning a batch slot on a response
+  nobody is waiting for steals capacity from requests that can still win.
+  Generative requests that expire mid-decode finish early with their
+  partial output and the same status.
+- **completion handoff**: the worker thread completes a request; the
+  client blocks on ``PendingRequest.result()`` with a bounded, tick-based
+  wait (``resilience.watchdog`` discipline — a dead engine raises instead
+  of hanging the caller forever).
+"""
+import collections
+import itertools
+import threading
+
+from ..observability.timing import Stopwatch
+from ..resilience.watchdog import WatchdogTimeout
+
+__all__ = ['QueueFullError', 'Request', 'Response', 'PendingRequest',
+           'AdmissionQueue', 'STATUS_OK', 'STATUS_DEADLINE', 'STATUS_ERROR']
+
+STATUS_OK = 'ok'
+STATUS_DEADLINE = 'deadline'
+STATUS_ERROR = 'error'
+
+_WAIT_TICK = 0.05
+_ids = itertools.count(1)
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity: the request was shed (429-style).
+
+    Raised at submit time so the client can back off / retry elsewhere;
+    nothing was enqueued.
+    """
+
+    def __init__(self, model, capacity):
+        super().__init__(
+            f"serving: model {model!r} admission queue is full "
+            f"(capacity {capacity}) — request shed; retry with backoff")
+        self.model = model
+        self.capacity = capacity
+
+
+class Response:
+    """What a completed request resolves to.
+
+    ``status`` is ``'ok'``, ``'deadline'`` (expired; ``outputs`` holds any
+    partial generative output, else None) or ``'error'`` (``error`` holds
+    the exception). ``latency_ms`` is submit→complete, ``queue_ms`` the
+    part spent waiting for a batch slot.
+    """
+
+    __slots__ = ('status', 'outputs', 'model', 'request_id', 'latency_ms',
+                 'queue_ms', 'error')
+
+    def __init__(self, status, outputs, model, request_id, latency_ms,
+                 queue_ms, error=None):
+        self.status = status
+        self.outputs = outputs
+        self.model = model
+        self.request_id = request_id
+        self.latency_ms = latency_ms
+        self.queue_ms = queue_ms
+        self.error = error
+
+    @property
+    def ok(self):
+        return self.status == STATUS_OK
+
+    def __repr__(self):
+        return (f"Response(status={self.status!r}, model={self.model!r}, "
+                f"id={self.request_id}, latency_ms={self.latency_ms:.1f})")
+
+
+class Request:
+    """One inference request moving through the engine.
+
+    ``inputs`` is a dict name -> per-example array (no batch axis) for
+    one-shot models, or ``{'tokens': int array [L]}`` (+ ``max_new_tokens``)
+    for generative ones. The engine owns all mutation after submit; clients
+    only see the ``PendingRequest`` view.
+    """
+
+    __slots__ = ('id', 'model', 'inputs', 'deadline_ms', 'max_new_tokens',
+                 'sw', 'queue_ms', '_event', 'response')
+
+    def __init__(self, model, inputs, deadline_ms=None, max_new_tokens=None):
+        self.id = next(_ids)
+        self.model = model
+        self.inputs = inputs
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        self.max_new_tokens = max_new_tokens
+        self.sw = Stopwatch()          # lifetime clock, started at submit
+        self.queue_ms = 0.0
+        self._event = threading.Event()
+        self.response = None
+
+    def expired(self):
+        return (self.deadline_ms is not None and
+                self.sw.elapsed_ms() > self.deadline_ms)
+
+    def remaining_ms(self):
+        if self.deadline_ms is None:
+            return None
+        return self.deadline_ms - self.sw.elapsed_ms()
+
+    def complete(self, status, outputs=None, error=None):
+        if self._event.is_set():
+            return                     # first completion wins
+        self.response = Response(status, outputs, self.model, self.id,
+                                 self.sw.elapsed_ms(), self.queue_ms,
+                                 error=error)
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+
+class PendingRequest:
+    """Client-side handle: a future over one Request."""
+
+    __slots__ = ('_req', '_alive')
+
+    def __init__(self, req, alive):
+        self._req = req
+        self._alive = alive            # () -> bool: is the engine running?
+
+    @property
+    def request_id(self):
+        return self._req.id
+
+    def done(self):
+        return self._req.done()
+
+    def result(self, timeout=None):
+        """Block (tick-based, watchdog discipline) for the Response.
+
+        Raises ``WatchdogTimeout`` when ``timeout`` seconds pass, or when
+        the engine stops while the request is still in flight — a dead
+        worker must never strand its clients in an unbounded wait.
+        """
+        sw = Stopwatch()
+        while not self._req._event.wait(_WAIT_TICK):
+            if timeout is not None and sw.elapsed() >= timeout:
+                raise WatchdogTimeout(
+                    f"serving: no response for request {self._req.id} "
+                    f"within {timeout:.1f}s", what='serving response',
+                    waited=sw.elapsed())
+            if not self._alive():
+                # one grace tick: stop() completes queued/in-flight
+                # requests as shaped errors just after the worker dies —
+                # prefer that answer to a raw timeout
+                if self._req._event.wait(_WAIT_TICK):
+                    break
+                raise WatchdogTimeout(
+                    f"serving: engine stopped with request {self._req.id} "
+                    "still in flight", what='serving response',
+                    waited=sw.elapsed())
+        resp = self._req.response
+        if resp.status == STATUS_ERROR and resp.error is not None:
+            raise resp.error
+        return resp
+
+
+class AdmissionQueue:
+    """Bounded FIFO per model, with deadline-aware pops.
+
+    ``push`` raises ``QueueFullError`` at capacity (shed). ``pop_ready``
+    returns up to ``max_n`` live requests and separately the queued
+    requests whose deadline already expired (the caller completes those
+    with status ``'deadline'`` and never runs them).
+    """
+
+    def __init__(self, model, capacity=256):
+        self.model = model
+        self.capacity = int(capacity)
+        self._dq = collections.deque()
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        return len(self._dq)
+
+    def push(self, req):
+        with self._lock:
+            if len(self._dq) >= self.capacity:
+                raise QueueFullError(self.model, self.capacity)
+            self._dq.append(req)
+
+    def pop_ready(self, max_n):
+        """-> (ready, expired): up to ``max_n`` live requests in FIFO
+        order, plus every expired request encountered on the way."""
+        ready, expired = [], []
+        with self._lock:
+            while self._dq and len(ready) < max_n:
+                req = self._dq.popleft()
+                if req.expired():
+                    expired.append(req)
+                else:
+                    ready.append(req)
+        # expired requests spent their WHOLE life queued — stamp them too,
+        # or the queue-wait histogram under-reports exactly the longest
+        # waiters
+        for r in ready + expired:
+            r.queue_ms = r.sw.elapsed_ms()
+        return ready, expired
+
+    def reap_expired(self):
+        """Remove and return every expired request anywhere in the queue
+        (used when no batch slot is free: a dead request must not wait for
+        one just to be told it's dead)."""
+        expired, live = [], []
+        with self._lock:
+            for r in self._dq:
+                (expired if r.expired() else live).append(r)
+            self._dq.clear()
+            self._dq.extend(live)
+        for r in expired:
+            r.queue_ms = r.sw.elapsed_ms()
+        return expired
+
+    def drain(self):
+        """Remove and return every queued request (engine shutdown)."""
+        with self._lock:
+            out = list(self._dq)
+            self._dq.clear()
+        return out

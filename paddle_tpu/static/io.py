@@ -106,6 +106,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 def _export_portable(program, feed_names, fetch_vars):
     """jax.export the fetch subgraph: returns {blob, param_names}."""
     import jax
+    import jax.export  # noqa: F401 — lazy submodule; bare `import jax`
+    # does not bind it and the whole export degrades to export_error
     import numpy as np
     from .executor import program_infer_fn
     from ..core.dtypes import convert_dtype

@@ -31,6 +31,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "obs: observability/telemetry tests (metrics registry, "
         "spans, step events, interposed counters)")
+    config.addinivalue_line(
+        "markers", "serving: serving-runtime tests (bucketing, continuous "
+        "batching, KV-cache decode, deadlines/load shedding, retrace "
+        "flatness)")
 
 
 @pytest.fixture(autouse=True)

@@ -83,6 +83,15 @@ AST_FIXTURES = {
               "def consume():\n"
               "    q = queue.Queue()\n"
               "    return q.get()\n", "q.get()"),
+    'GL013': ("import jax\n"
+              "import numpy as np\n"
+              "def model(x):\n"
+              "    return x * 2\n"
+              "predict = jax.jit(model)\n"
+              "def serve(batch):\n"
+              "    n = len(batch)\n"
+              "    arr = np.zeros((n, 8), np.float32)\n"
+              "    return predict(arr)\n", "predict(arr)"),
 }
 
 
@@ -307,6 +316,71 @@ def test_gl012_exempts_tests_tools_and_watchdog(tmp_path):
     p.write_text(_WAIT_SRC)
     findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
     assert [f for f in findings if f.rule == 'GL012'] != []
+
+
+_DYNSHAPE_SRC = (
+    "import jax\n"
+    "import numpy as np\n"
+    "def model(x):\n"
+    "    return x * 2\n"
+    "predict = jax.jit(model)\n"
+    "def serve_ctor(batch):\n"
+    "    n = len(batch)\n"
+    "    arr = np.zeros((n, 8), np.float32)\n"
+    "    return predict(arr)\n"                       # flagged (dyn ctor)
+    "def serve_slice(batch, buf):\n"
+    "    return predict(buf[:len(batch)])\n"          # flagged (dyn slice)
+    "def serve_scalar(batch, arr):\n"
+    "    return predict(arr, len(batch))\n")          # scalar len(): fine
+
+
+def test_gl013_flags_dynamic_shapes_not_scalars(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'serve.py').write_text(_DYNSHAPE_SRC)
+    findings, _ = lint_paths([str(lib / 'serve.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL013')
+    lines = _DYNSHAPE_SRC.splitlines()
+    assert len(hits) == 2, [(f.rule, f.line) for f in findings]
+    assert 'predict(arr)' in lines[hits[0] - 1]
+    assert 'predict(buf[:len(batch)])' in lines[hits[1] - 1]
+    msg = [f for f in findings if f.rule == 'GL013'][0].message
+    # fix-it points at the serving bucketing helpers
+    assert 'serving.bucketing' in msg and 'pad_to_bucket' in msg
+
+
+def test_gl013_bucketed_code_is_sanctioned(tmp_path):
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from paddle_tpu.serving.bucketing import (pad_to_bucket,\n"
+        "    select_bucket, stack_examples)\n"
+        "def model(x):\n"
+        "    return x * 2\n"
+        "predict = jax.jit(model)\n"
+        "def serve(batch):\n"
+        "    b = select_bucket(len(batch), (1, 2, 4))\n"
+        "    arr = stack_examples(batch, b)\n"
+        "    return predict(arr)\n"
+        "def serve2(batch):\n"
+        "    padded = pad_to_bucket(np.stack(batch), 4)\n"
+        "    return predict(padded)\n")
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'bucketed.py').write_text(src)
+    findings, _ = lint_paths([str(lib / 'bucketed.py')],
+                             scan_root=str(tmp_path))
+    assert [f for f in findings if f.rule == 'GL013'] == []
+
+
+def test_gl013_exempts_tests_and_tools(tmp_path):
+    for rel in ('tests/mod.py', 'tools/mod.py', 'bench_load.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_DYNSHAPE_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL013'] == [], rel
 
 
 def test_unresolvable_fetch_does_not_flood_gv006():

@@ -66,6 +66,66 @@ def to_chrome_trace(events):
     return out
 
 
+def serving_summary(events):
+    """Aggregate ``serving.*`` events into one operator-facing dict: request
+    count, status mix, latency/queue-wait percentiles, shed count, and
+    join/leave tallies for the continuous-batching path."""
+    reqs = [e for e in events if e.get('ev') == 'serving.request']
+    sheds = [e for e in events if e.get('ev') == 'serving.shed']
+    joins = [e for e in events if e.get('ev') == 'serving.join']
+    leaves = [e for e in events if e.get('ev') == 'serving.leave']
+    by_status, by_model = {}, {}
+    lats, queues = [], []
+    for e in reqs:
+        by_status[e.get('status', '?')] = \
+            by_status.get(e.get('status', '?'), 0) + 1
+        by_model[e.get('model', '?')] = \
+            by_model.get(e.get('model', '?'), 0) + 1
+        if isinstance(e.get('latency_ms'), (int, float)):
+            lats.append(float(e['latency_ms']))
+        if isinstance(e.get('queue_ms'), (int, float)):
+            queues.append(float(e['queue_ms']))
+
+    def pct(vals, p):
+        if not vals:
+            return 0.0
+        vals = sorted(vals)
+        k = min(len(vals) - 1,
+                max(0, int(round(p / 100.0 * (len(vals) - 1)))))
+        return round(vals[k], 3)
+
+    return {
+        'requests': len(reqs),
+        'by_status': by_status,
+        'by_model': by_model,
+        'shed': len(sheds),
+        'joins': len(joins),
+        'leaves': len(leaves),
+        'p50_latency_ms': pct(lats, 50),
+        'p99_latency_ms': pct(lats, 99),
+        'p50_queue_ms': pct(queues, 50),
+        'p99_queue_ms': pct(queues, 99),
+    }
+
+
+def render_serving(summary):
+    lines = [f"serving: {summary['requests']} request(s), "
+             f"{summary['shed']} shed"]
+    if summary['by_model']:
+        lines.append("  by model: " + ', '.join(
+            f"{k}: {v}" for k, v in sorted(summary['by_model'].items())))
+    if summary['by_status']:
+        lines.append("  by status: " + ', '.join(
+            f"{k}: {v}" for k, v in sorted(summary['by_status'].items())))
+    lines.append(f"  latency p50/p99: {summary['p50_latency_ms']}/"
+                 f"{summary['p99_latency_ms']} ms, queue p50/p99: "
+                 f"{summary['p50_queue_ms']}/{summary['p99_queue_ms']} ms")
+    if summary['joins'] or summary['leaves']:
+        lines.append(f"  continuous batching: {summary['joins']} join(s), "
+                     f"{summary['leaves']} leave(s)")
+    return '\n'.join(lines)
+
+
 def render_table(events, limit=None):
     """Aligned human listing: relative time, kind, then the fields."""
     if not events:
@@ -104,6 +164,10 @@ def main(argv=None):
                    help='only events of this kind (e.g. step, retry.attempt)')
     p.add_argument('--tail', type=int, default=None,
                    help='show only the last N events')
+    p.add_argument('--serving', action='store_true',
+                   help='summarize serving.* events (request counts by '
+                        'status/model, latency + queue percentiles, shed '
+                        'and join/leave tallies) instead of the table')
     args = p.parse_args(argv)
 
     try:
@@ -117,6 +181,10 @@ def main(argv=None):
               file=sys.stderr)
     if args.ev:
         events = [e for e in events if e.get('ev') == args.ev]
+
+    if args.serving:
+        print(render_serving(serving_summary(events)))
+        return 0
 
     if args.chrome:
         trace = to_chrome_trace(events)
