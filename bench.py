@@ -359,6 +359,14 @@ def bench_serving(duration_s=3.0, rate_mult=3.0, seed=0):
         misses = snap_counter('executor.program_cache.misses') - miss0
         stats = eng_c.stats()['models']['mlp']
         cont_qps = len(lat) / wall if wall > 0 else 0.0
+        # anomaly doctor over the traffic run's own event stream: a clean
+        # run reports [], an overloaded one names serving_overload — the
+        # diagnosis trail lands in BENCH extras either way
+        try:
+            doctor_causes = [d['cause'] for d in obs.diagnose(
+                events=obs.event_log(), snapshot=obs.snapshot())]
+        except Exception as e:
+            doctor_causes = [f'doctor error: {e!r}']
         return {
             'serial_qps': round(serial_qps, 2),
             'continuous_qps': round(cont_qps, 2),
@@ -377,10 +385,66 @@ def bench_serving(duration_s=3.0, rate_mult=3.0, seed=0):
             'program_cache_hit_rate': round(hits / (hits + misses), 4)
             if (hits + misses) else 0.0,
             'compiles_after_warmup': compiles_delta,
+            'doctor': doctor_causes,
         }
     finally:
         if not was_static:
             paddle.disable_static()
+
+
+def _cluster_rank_worker():
+    """One rank of the mission-control telemetry smoke: a few timed steps,
+    rank 3 dragged by faultinject.slow_rank, telemetry flushed to the
+    run dir (picklable top-level function — spawn re-imports it)."""
+    import time as _time
+    from paddle_tpu import observability as obs
+    from paddle_tpu.resilience import faultinject as fi
+    obs.enable()
+    step = fi.slow_rank(lambda: _time.sleep(0.002), rank=3, delay_s=0.02)
+    for i in range(8):
+        with obs.timer('hapi.step', step=i) as t:
+            step()
+        obs.event('step', step=i, step_ms=round(t.elapsed_ms, 3))
+    return int(os.environ.get('PADDLE_TRAINER_ID', '0'))
+
+
+def bench_cluster_telemetry(nprocs=4):
+    """MULTICHIP telemetry smoke for BENCH extras: a ``nprocs``-rank spawn
+    under ``faultinject.slow_rank`` produces per-rank telemetry files, the
+    supervisor's merged cluster snapshot, and the anomaly doctor's ranked
+    diagnoses — straggler/retrace evidence that is provable on CPU, so the
+    BENCH trajectory carries it even when no TPU is reachable."""
+    import shutil
+    import tempfile
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import observability as obs
+
+    run_dir = tempfile.mkdtemp(prefix='paddle_tpu_mc_bench_')
+    override = {'PADDLE_TPU_TELEMETRY': '1',
+                'PADDLE_TPU_TELEMETRY_RUN_DIR': run_dir}
+    saved = {k: os.environ.get(k) for k in override}
+    os.environ.update(override)
+    try:
+        dist.spawn(_cluster_rank_worker, nprocs=nprocs, backend='cpu')
+        snap = obs.aggregate.cluster_snapshot(run_dir)
+        diagnoses = obs.diagnose(
+            events=obs.aggregate.merged_events(run_dir), cluster=snap)
+        return {
+            'n_ranks': snap['n_ranks'],
+            'step_ms_skew': snap['step_ms_skew'],
+            'per_rank_mean_step_ms': {
+                r: round(row['step_ms']['mean'], 3)
+                for r, row in sorted(snap['per_rank'].items())},
+            'diagnoses': [{'cause': d['cause'], 'severity': d['severity'],
+                           'detail': d['detail']} for d in diagnoses],
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(run_dir, ignore_errors=True)
 
 
 def _env_batch(var, default):
@@ -869,12 +933,19 @@ def _child_main(mode, model):
             serving_extras = bench_serving()
         except Exception as e:       # serving bench must never sink smoke
             serving_extras = {'error': repr(e)}
+        telemetry = _telemetry_counters()
+        try:
+            # MULTICHIP mission-control smoke: aggregated per-rank step
+            # times + doctor diagnoses (straggler evidence on CPU)
+            telemetry['cluster'] = bench_cluster_telemetry()
+        except Exception as e:       # never sink smoke on telemetry
+            telemetry['cluster'] = {'error': repr(e)}
         print(json.dumps({
             "metric": "bert_smoke_cpu_samples_per_sec",
             "value": round(sps, 2),
             "unit": "samples/sec",
             "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
-            "extras": {"telemetry": _telemetry_counters(),
+            "extras": {"telemetry": telemetry,
                        "serving": serving_extras},
             "complete": True,
         }))

@@ -25,9 +25,16 @@ but destroys performance or correctness on real hardware:
   distinct request size, i.e. a retrace storm exactly when serving load
   peaks; pad to a fixed bucket with ``paddle_tpu.serving.bucketing``.
 
+- GL014: metrics-shaped ``print()``/``logging`` in library code — a
+  float-formatted measurement on stdout is invisible to the metrics
+  registry, the step-event log, and every scrape; route the number through
+  ``observability.event()``/``counter()``/``histogram()`` (tests/tools/
+  bench harnesses exempt).
+
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
 import ast
+import re
 
 from .rules import Rule, register
 
@@ -689,6 +696,88 @@ def _is_dynamic_shape_expr(node, dyn_scalar, dyn_array):
                         _mentions_dynlen(bound, dyn_scalar):
                     return True
     return False
+
+
+# -- GL014: metrics-shaped print()/logging in library code -------------------
+
+# code whose JOB is console output: test suites, dev harnesses, the
+# telemetry spine itself (its exporters format numbers for humans)
+_EMIT_EXEMPT_PREFIXES = ('tests/', 'tools/', 'paddle_tpu/observability/',
+                        'observability/')
+# a float format spec is the signature of a measurement being rendered:
+# '%.3f ms' / f"{v:.4f}" / '{:.2e}'. Plain str() of a number ("epoch 3")
+# is narrative, not metrics-shaped — it does not fire.
+_FLOAT_SPEC_RE = re.compile(
+    r'%[-+ #0-9.]*[feEgG]'           # percent-style: %.3f, %8.2e
+    r'|\{[^{}]*:[^{}]*\.\d+[feEgG]')  # format-style: {v:.4f}, {:>8.2e}
+_LOG_LEVELS = {'debug', 'info', 'warning', 'warn', 'error', 'critical',
+               'exception', 'log'}
+_LOGGER_NAMES = {'logging', 'logger', 'log', '_logger', '_log'}
+
+
+def _is_emit_call(call):
+    """True for ``print(...)`` and ``logging.info(...)``-shaped calls
+    (any attribute chain ending in a level whose chain mentions a logger
+    name: ``logger.info``, ``self._log.warning``, ``logging.error``)."""
+    if isinstance(call.func, ast.Name) and call.func.id == 'print':
+        return True
+    dotted = _dotted(call.func)
+    if not dotted:
+        return False
+    parts = dotted.split('.')
+    return (len(parts) >= 2 and parts[-1] in _LOG_LEVELS
+            and any(p in _LOGGER_NAMES for p in parts[:-1]))
+
+
+def _metrics_shaped(node):
+    """Does any subtree render a float-formatted value (f-string spec,
+    %-format or .format template)?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            if _FLOAT_SPEC_RE.search(n.value):
+                return True
+        elif isinstance(n, ast.FormattedValue) and n.format_spec is not None:
+            spec = ''.join(
+                v.value for v in ast.walk(n.format_spec)
+                if isinstance(v, ast.Constant) and isinstance(v.value, str))
+            if re.search(r'\.\d+[feEgG]', spec):
+                return True
+    return False
+
+
+@register
+class MetricsShapedPrintRule(Rule):
+    """GL014: a float-formatted measurement emitted via bare ``print()``
+    or ``logging`` in library code — the number dies on stdout: no
+    registry, no step-event log, no ``/metrics`` scrape, no doctor. Emit
+    it with ``observability.event(kind, value=...)`` or bump a
+    ``counter``/``histogram`` (console rendering belongs to tools/ and
+    callbacks the user opted into)."""
+    id = 'GL014'
+    title = 'metrics-shaped print/logging in library code'
+
+    def in_scope(self, rel):
+        if any(rel.startswith(p) for p in _EMIT_EXEMPT_PREFIXES):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _is_emit_call(node)):
+                continue
+            payload = list(node.args) + [kw.value for kw in node.keywords]
+            if any(_metrics_shaped(a) for a in payload):
+                yield self.finding(
+                    ctx, node,
+                    "float-formatted measurement emitted via "
+                    f"{_dotted(node.func) or 'print'}() — the value never "
+                    "reaches the metrics registry, the event log, or a "
+                    "/metrics scrape; record it with paddle_tpu."
+                    "observability.event()/counter()/histogram() (and keep "
+                    "console output in tools/ or an opt-in callback)")
 
 
 @register

@@ -25,7 +25,19 @@ any collective could have run) are optionally restarted up to
 
 Multi-host pods use init_distributed() (jax.distributed) with one process
 per host.
+
+MISSION CONTROL (docs/OBSERVABILITY.md): with telemetry enabled
+(``PADDLE_TPU_TELEMETRY=1``) every supervised rank also streams its
+spans/metrics/events to per-rank files in the run dir, and the supervisor
+merges them at join into ``cluster_snapshot.json`` / ``merged_events.jsonl``
+/ ``merged_trace.json`` (one Perfetto lane per rank) plus a ranked
+``diagnoses.json`` from the anomaly doctor — so a straggling rank is a
+skewed lane and a named ``diagnosis`` event, not a mystery hang. Set
+``PADDLE_TPU_TELEMETRY_RUN_DIR`` to keep the artifacts (spawn's default
+run dir is a temp dir removed at join); ``PADDLE_TPU_TELEMETRY_HTTP=<port>``
+additionally serves the supervisor's live ``/metrics`` + ``/healthz``.
 """
+import json
 import os
 import pickle
 import signal
@@ -143,6 +155,11 @@ def _worker(rank, nprocs, func, args, result_dir):
                    interval=_HB_INTERVAL).start()
     with open(os.path.join(result_dir, f'started_{rank}'), 'w'):
         pass   # atomic-ok: zero-byte phase marker, existence is the datum
+    # mission control: stream this rank's telemetry into the run dir so the
+    # supervisor can aggregate it (no-op unless PADDLE_TPU_TELEMETRY=1)
+    from .. import observability as _obs
+    if _obs.enabled():
+        _obs.start_rank_flusher(rank=rank)
     # results travel via files (atomic commit), not an mp.Queue — queue FDs
     # are unreliable under sandboxed/spawn-restricted environments; the
     # parent trusts these bytes, so they go through atomic_io (graftlint
@@ -156,6 +173,10 @@ def _worker(rank, nprocs, func, args, result_dir):
         raise
     finally:
         hb.stop()
+        if _obs.enabled():
+            # final flush: the aggregator must see the whole run, and a
+            # crashed rank's last periodic flush is its black box
+            _obs.stop_rank_flusher()
     atomic_pickle_dump(payload, path)
 
 
@@ -334,10 +355,59 @@ class _Supervisor:
                        restarts_used=self.restarts_used)
         return True
 
+    def telemetry_dir(self):
+        """Where this run's per-rank telemetry files live (the explicit
+        override, else the run dir the ranks heartbeat into)."""
+        return (os.environ.get('PADDLE_TPU_TELEMETRY_RUN_DIR')
+                or self.run_dir)
+
+    def finish_telemetry(self):
+        """Mission control at join: merge the per-rank telemetry files into
+        cluster_snapshot.json / merged_events.jsonl / merged_trace.json
+        (one Perfetto lane per rank), run the anomaly doctor over the
+        merged stream, land each finding as a ``diagnosis`` event in the
+        supervisor's event log, and write the ranked ``diagnoses.json``.
+        Best-effort by contract: telemetry must never fail a run."""
+        from .. import observability as _obs
+        if not _obs.enabled():
+            return None
+        tdir = self.telemetry_dir()
+        try:
+            paths = _obs.aggregate.write_merged(tdir)
+            if paths is None:
+                return None
+            snap = _obs.aggregate.cluster_snapshot(tdir)
+            diagnoses = _obs.run_doctor(
+                events=_obs.aggregate.merged_events(tdir),
+                cluster=snap, emit=True)
+            report = os.path.join(tdir, 'diagnoses.json')
+            tmp = f"{report}.tmp.{os.getpid()}"
+            with open(tmp, 'w', encoding='utf-8') as f:
+                json.dump(diagnoses, f, sort_keys=True, indent=1,
+                          default=repr)
+            os.replace(tmp, report)
+            paths['diagnoses'] = report
+            return paths
+        except Exception:
+            return None
+
     def wait(self, timeout=None):
         """Supervise until every rank exits 0 (returns), one fails
         (``RankFailedError``), or ``timeout`` expires (stragglers are
-        terminated and a RuntimeError reports per-rank exit codes)."""
+        terminated and a RuntimeError reports per-rank exit codes). With
+        telemetry on, per-rank files are merged + diagnosed at exit (every
+        path: the post-mortem matters most when a rank just died), and a
+        live /metrics endpoint is exported while ranks run when
+        ``PADDLE_TPU_TELEMETRY_HTTP`` is set."""
+        from .. import observability as _obs
+        if _obs.enabled():
+            _obs.endpoint.maybe_start_from_env(run_dir=self.telemetry_dir())
+        try:
+            self._wait(timeout)
+        finally:
+            self.finish_telemetry()
+
+    def _wait(self, timeout=None):
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             running = False

@@ -401,7 +401,17 @@ class Model:
             vals = vals if isinstance(vals, (list, tuple)) else [vals]
             for nm, v in zip(names, vals):
                 logs[nm] = v
+        from .. import observability as _obs
+        if _obs.enabled():
+            # the eval numbers reach the event log whether or not the
+            # console rendering below is on (GL014: a metric that only
+            # exists on stdout is invisible to every scrape)
+            _obs.event('eval_result', **{
+                k: float(v) for k, v in logs.items()
+                if isinstance(v, (int, float))})
         if verbose:
+            # graftlint: disable=GL014 — user-requested verbose console
+            # output; the same values land on the event log above
             print(' - '.join(f"{k}: {v:.4f}" for k, v in logs.items()))
         return logs
 

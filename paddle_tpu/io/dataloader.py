@@ -379,6 +379,11 @@ class DataLoader:
             pass
         return self._threaded_batches()
 
+    # a single batch wait above this lands a streamed `input_stall` event
+    # (the anomaly doctor's input-bound corroboration; the histogram alone
+    # only shows up at snapshot time)
+    _STALL_EVENT_MS = 1000.0
+
     def _timed(self, source):
         """Telemetry wrapper: how long the consumer waits for each host
         batch (assembly + collate stall the device would see)."""
@@ -390,9 +395,12 @@ class DataLoader:
             except StopIteration:
                 return
             if _obs.enabled():
-                _obs.histogram('dataloader.next_wait_ms').observe(
-                    sw.elapsed_ms())
+                wait_ms = sw.elapsed_ms()
+                _obs.histogram('dataloader.next_wait_ms').observe(wait_ms)
                 _obs.counter('dataloader.batches').inc()
+                if wait_ms >= self._STALL_EVENT_MS:
+                    _obs.counter('dataloader.stalls').inc()
+                    _obs.event('input_stall', wait_ms=round(wait_ms, 1))
             yield b
 
     def __iter__(self):

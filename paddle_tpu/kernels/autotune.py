@@ -218,7 +218,15 @@ def autotune_attention(batch, heads, seq, head_dim, dtype='bfloat16',
         try:
             t = _time_step(builder(), (q, k, v))
             results.append((t, decision))
+            from .. import observability as _obs
+            if _obs.enabled():
+                # candidate timings belong on the telemetry spine, not
+                # only the verbose console (GL014)
+                _obs.event('autotune.candidate', sig=sig, label=label,
+                           ms=round(t * 1e3, 3))
             if verbose:
+                # graftlint: disable=GL014 — opt-in tuning console output;
+                # the measurement also lands on the event log above
                 print('  autotune %s %s: %.3f ms' % (sig, label, t * 1e3))
         except Exception as e:
             if verbose:

@@ -16,6 +16,15 @@ cache, verify/compile time), ``hapi.Model.fit`` (``TelemetryCallback``),
 ``optimizer.step``, the resilience layer (NaN skips, retries, checkpoint
 durations), and ``distributed.collective``.
 
+MISSION CONTROL layers cluster-wide operation on the same spine
+(docs/OBSERVABILITY.md, "Mission control"): per-rank telemetry flushed
+live into the supervisor's run dir (``flush``), merged into one cluster
+snapshot + a one-lane-per-rank Perfetto trace (``aggregate``), served over
+a localhost HTTP endpoint — ``/metrics`` Prometheus exposition,
+``/healthz``, ``/events``, ``/diagnosis`` (``endpoint``) — and diagnosed
+by streaming anomaly detectors that name stragglers, retrace storms,
+input-bound runs, and serving overload with fix-it hints (``doctor``).
+
 Everything is off (near-zero overhead: one flag check per site) until
 ``PADDLE_TPU_TELEMETRY=1`` or an explicit ``observability.enable()``.
 
@@ -25,6 +34,7 @@ import other ``paddle_tpu`` modules at the top level.
 """
 from . import events as _events
 from . import interpose, registry, spans, state, timing  # noqa: F401
+from . import aggregate, doctor, endpoint, flush  # noqa: F401  mission ctl
 from .state import enable, disable, enabled, log_dir, sync_every
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry, counter, gauge, histogram, snapshot,
@@ -35,6 +45,9 @@ from .timing import Stopwatch, timer
 from .interpose import (install_jax_hooks, record_host_transfer,
                         record_collective)
 from .interpose import summary as counters_summary
+from .flush import start_rank_flusher, stop_rank_flusher
+from .endpoint import MetricsServer
+from .doctor import diagnose, run_doctor
 
 # event-log surface (module name 'events' is kept for the submodule; the
 # buffered-event accessor is exported as event_log to avoid shadowing it)
@@ -55,6 +68,10 @@ __all__ = [
     'Stopwatch', 'timer',
     'install_jax_hooks', 'record_host_transfer', 'record_collective',
     'counters_summary', 'TelemetryCallback',
+    # mission control (docs/OBSERVABILITY.md, "Mission control")
+    'aggregate', 'doctor', 'endpoint', 'flush',
+    'start_rank_flusher', 'stop_rank_flusher', 'MetricsServer',
+    'diagnose', 'run_doctor',
 ]
 
 

@@ -14,7 +14,8 @@ code changes; pass your own instance to control ``log_dir``.
 import os
 
 from ..hapi.callbacks import Callback
-from . import events, interpose, registry, spans, state, timing
+from . import (doctor, endpoint, events, flush, interpose, registry, spans,
+               state, timing)
 
 __all__ = ['TelemetryCallback']
 
@@ -33,6 +34,7 @@ class TelemetryCallback(Callback):
         self._epoch_timer = None
         self._train_sw = None
         self._steps_per_sec = None
+        self._own_flusher = False
 
     def _dir(self):
         return self.log_dir or state.log_dir()
@@ -46,6 +48,13 @@ class TelemetryCallback(Callback):
             d = self._dir()
             os.makedirs(d, exist_ok=True)
             events.set_sink(os.path.join(d, 'events.jsonl'))
+        # mission control: inside a supervised cluster run, stream this
+        # rank's telemetry to the run dir; with PADDLE_TPU_TELEMETRY_HTTP
+        # set, export the live /metrics + /healthz endpoint for this fit
+        had = flush.active_flusher() is not None
+        self._own_flusher = (flush.start_rank_flusher() is not None
+                             and not had)
+        endpoint.maybe_start_from_env()
         events.emit('train_begin', epochs=self.params.get('epochs'),
                     steps=self.params.get('steps'))
 
@@ -128,6 +137,24 @@ class TelemetryCallback(Callback):
                     total_s=round(self._train_sw.elapsed(), 3)
                     if self._train_sw else None,
                     counters=interpose.summary())
+        # anomaly doctor over this run's own stream (retrace storms,
+        # input-boundness): the findings land as `diagnosis` events so the
+        # JSONL export below carries them
+        try:
+            doctor.run_doctor(events=events.events(),
+                              snapshot=registry.snapshot(), emit=True)
+        except Exception:
+            pass   # diagnosis must never fail a training run
+        # final per-rank flush so the aggregator sees the whole fit; the
+        # flusher is only torn down when this fit started it (a spawn
+        # worker's flusher outlives the fit — launch._worker owns it)
+        fl = flush.active_flusher()
+        if fl is not None:
+            if self._own_flusher:
+                flush.stop_rank_flusher()
+                self._own_flusher = False
+            else:
+                fl.flush_now()
         if self.live_events:
             events.close_sink()
         d = self._dir()

@@ -1,0 +1,302 @@
+"""Anomaly doctor: streaming detectors over the telemetry spine.
+
+Turns the raw counters/events mission control collects into a NAMED cause
+and a fix-it hint. Each detector inspects the merged event stream and/or a
+metrics snapshot (single-process or the aggregator's cluster snapshot) and
+yields ``Diagnosis`` dicts::
+
+    {'cause': 'straggler', 'severity': 'critical',
+     'detail': 'rank 3 mean step 48.1ms vs cluster median 9.7ms (5.0x)',
+     'fix': '...', 'evidence': {...}}
+
+Detector catalog (docs/OBSERVABILITY.md has the operator version):
+
+- ``straggler``       per-rank step-time skew in the cluster snapshot —
+                      one rank's mean step time >= ``skew_threshold`` x
+                      the cluster median (the ``faultinject.slow_rank``
+                      failure mode; on hardware: a thermally throttled or
+                      mis-scheduled chip).
+- ``retrace_storm``   ``jax.compiles`` still growing after the warmup
+                      steps (the dynamic-shape / unhashable-capture traps
+                      graftlint GL005/GL006 + GL013 lint for statically).
+- ``input_bound``     dataloader wait dominates step time — the
+                      accelerator starves on host feed.
+- ``serving_overload`` shed + deadline-expired requests trending up on the
+                      serving event stream / counters — offered load
+                      exceeds engine capacity.
+- ``rank_flatline``   a rank's heartbeat is stale while siblings beat on
+                      (wedged collective / dead process).
+
+Ranked output: ``critical`` > ``warning`` > ``info``. Standalone on
+purpose — stdlib-only, importable by path — so ``tools/doctor.py`` works
+with no jax installed. When imported as part of the package,
+``run_doctor(..., emit=True)`` also lands each diagnosis as a structured
+``diagnosis`` event on the step-event log.
+"""
+
+__all__ = ['diagnose', 'run_doctor', 'render_report', 'DETECTORS',
+           'SEVERITY_ORDER']
+
+SEVERITY_ORDER = {'critical': 0, 'warning': 1, 'info': 2}
+
+# tunables (detectors take overrides via **cfg)
+SKEW_THRESHOLD = 1.75          # rank mean step vs cluster median
+WARMUP_STEPS = 5               # compiles inside warmup are expected
+RETRACE_GRACE = 3              # compiles beyond warmup that are tolerated
+INPUT_BOUND_RATIO = 0.5        # dataloader wait / step time
+OVERLOAD_RATIO = 0.05          # (shed + expired) / offered
+STALE_HEARTBEAT_S = 10.0
+
+
+def _diag(cause, severity, detail, fix, **evidence):
+    return {'cause': cause, 'severity': severity, 'detail': detail,
+            'fix': fix, 'evidence': evidence}
+
+
+def _hist(snapshot, name):
+    return (snapshot or {}).get('histograms', {}).get(name) or {}
+
+
+def _ctr(snapshot, name):
+    return (snapshot or {}).get('counters', {}).get(name, 0)
+
+
+# -- detectors --------------------------------------------------------------
+
+def detect_straggler(events=None, snapshot=None, cluster=None,
+                     skew_threshold=SKEW_THRESHOLD, **_):
+    """Per-rank step-time skew from the cluster snapshot (>= 2 ranks with
+    steps). Falls back to rank-stamped ``step`` events when no snapshot
+    carries step histograms."""
+    per_rank = {}
+    if cluster:
+        for rank, row in (cluster.get('per_rank') or {}).items():
+            st = row.get('step_ms') or {}
+            if st.get('count'):
+                per_rank[int(rank)] = (float(st.get('mean', 0.0)),
+                                       int(st['count']))
+    if not per_rank and events:
+        sums = {}
+        for e in events:
+            if e.get('ev') == 'step' and isinstance(
+                    e.get('step_ms'), (int, float)) and 'rank' in e:
+                s, n = sums.get(int(e['rank']), (0.0, 0))
+                sums[int(e['rank'])] = (s + float(e['step_ms']), n + 1)
+        per_rank = {r: (s / n, n) for r, (s, n) in sums.items() if n}
+    if len(per_rank) < 2:
+        return
+    means = sorted(m for m, _n in per_rank.values())
+    # lower median: with an even rank count the upper middle can BE the
+    # straggler, hiding the skew
+    median = means[(len(means) - 1) // 2]
+    if median <= 0:
+        return
+    worst_rank, (worst_mean, worst_n) = max(
+        per_rank.items(), key=lambda kv: kv[1][0])
+    skew = worst_mean / median
+    if skew < skew_threshold:
+        return
+    yield _diag(
+        'straggler', 'critical',
+        f"rank {worst_rank} mean step {worst_mean:.1f}ms vs cluster median "
+        f"{median:.1f}ms ({skew:.1f}x) over {worst_n} step(s)",
+        "inspect that rank's lane in merged_trace.json: a slow host "
+        "(input pipeline, checkpoint I/O) shows host-side spans stretching; "
+        "a slow chip shows uniform step stretch — reschedule the rank or "
+        "drop it via elastic restart",
+        rank=worst_rank, mean_step_ms=round(worst_mean, 3),
+        median_step_ms=round(median, 3), skew=round(skew, 3),
+        per_rank_mean_step_ms={r: round(m, 3)
+                               for r, (m, _n) in sorted(per_rank.items())})
+
+
+def detect_retrace_storm(events=None, snapshot=None, cluster=None,
+                         warmup_steps=WARMUP_STEPS,
+                         retrace_grace=RETRACE_GRACE, **_):
+    """Compile count growth after warmup: in steady state every step reuses
+    the cached program, so compiles beyond the warmed-up set mean the shape
+    or hash key keeps changing (GL005/GL006/GL013 territory)."""
+    rows = []
+    if cluster:
+        for rank, row in (cluster.get('per_rank') or {}).items():
+            rows.append((f"rank {rank}", int(row.get('steps') or 0),
+                         int(row.get('jax_compiles') or 0)))
+    elif snapshot is not None:
+        steps = int(_ctr(snapshot, 'hapi.steps')
+                    or _hist(snapshot, 'hapi.step_ms').get('count', 0))
+        rows.append(('process', steps, int(_ctr(snapshot, 'jax.compiles'))))
+    for who, steps, compiles in rows:
+        if steps <= warmup_steps:
+            continue
+        excess = compiles - warmup_steps - retrace_grace
+        if excess <= 0 or compiles < 0.5 * steps:
+            continue
+        yield _diag(
+            'retrace_storm', 'critical',
+            f"{who}: {compiles} XLA compile(s) over {steps} step(s) — "
+            "steady state should compile ~once; something retraces every "
+            "step",
+            "a traced argument's shape/dtype/hash changes per call: run "
+            "`python -m paddle_tpu.analysis` (GL005/GL006 retrace traps, "
+            "GL013 unbucketed shapes) and pad dynamic batches with "
+            "serving.bucketing",
+            who=who, steps=steps, compiles=compiles)
+
+
+def detect_input_bound(events=None, snapshot=None, cluster=None,
+                       input_bound_ratio=INPUT_BOUND_RATIO, **_):
+    """Dataloader wait dominating step time: the device idles on host
+    feed. Uses histogram sums (wait vs step) per process/cluster, plus the
+    streamed ``input_stall`` events as corroborating evidence."""
+    rows = []
+    if cluster:
+        for rank, row in (cluster.get('per_rank') or {}).items():
+            st = row.get('step_ms') or {}
+            step_sum = float(st.get('mean', 0.0)) * int(st.get('count') or 0)
+            rows.append((f"rank {rank}",
+                         float(row.get('dataloader_wait_ms_sum') or 0.0),
+                         step_sum))
+    elif snapshot is not None:
+        rows.append(('process',
+                     float(_hist(snapshot,
+                                 'dataloader.next_wait_ms').get('sum', 0.0)),
+                     float(_hist(snapshot, 'hapi.step_ms').get('sum', 0.0))))
+    stalls = sum(1 for e in (events or []) if e.get('ev') == 'input_stall')
+    for who, wait_ms, step_ms in rows:
+        if step_ms <= 0 or wait_ms <= 0:
+            continue
+        ratio = wait_ms / step_ms
+        if ratio < input_bound_ratio:
+            continue
+        yield _diag(
+            'input_bound', 'warning',
+            f"{who}: dataloader wait {wait_ms:.0f}ms is "
+            f"{100 * ratio:.0f}% of step time {step_ms:.0f}ms — the step "
+            "starves on host feed",
+            "raise DataLoader num_workers / prefetch depth, move decode or "
+            "augmentation off the step path, or shard the input files "
+            "wider; dataloader.queue_depth should sit near its capacity",
+            who=who, wait_ms=round(wait_ms, 1), step_ms=round(step_ms, 1),
+            ratio=round(ratio, 3), input_stall_events=stalls)
+
+
+def detect_serving_overload(events=None, snapshot=None, cluster=None,
+                            overload_ratio=OVERLOAD_RATIO, **_):
+    """Load shedding / deadline expiry trending up on the serving stream:
+    offered load exceeds what the engine drains."""
+    counters = (cluster or {}).get('counters_total') if cluster else None
+    if counters is None and snapshot is not None:
+        counters = {
+            'serving_requests': _ctr(snapshot, 'serving.requests'),
+            'serving_shed': _ctr(snapshot, 'serving.shed'),
+            'serving_deadline_expired': _ctr(snapshot,
+                                             'serving.deadline_expired'),
+        }
+    # serving.requests counts every submission (sheds included), so it IS
+    # the offered load; the event stream reconstructs the same totals when
+    # no counter snapshot is available
+    offered = shed = expired = 0
+    if counters:
+        offered = int(counters.get('serving_requests') or 0)
+        shed = int(counters.get('serving_shed') or 0)
+        expired = int(counters.get('serving_deadline_expired') or 0)
+    if events:
+        ev_shed = sum(1 for e in events if e.get('ev') == 'serving.shed')
+        ev_exp = sum(1 for e in events if e.get('ev') == 'serving.request'
+                     and e.get('status') == 'deadline')
+        ev_req = sum(1 for e in events if e.get('ev') == 'serving.request')
+        shed = max(shed, ev_shed)
+        expired = max(expired, ev_exp)
+        offered = max(offered, ev_req + ev_shed)
+    bad = shed + expired
+    if not offered or not bad:
+        return
+    ratio = bad / offered
+    if ratio < overload_ratio:
+        return
+    yield _diag(
+        'serving_overload', 'warning' if ratio < 0.25 else 'critical',
+        f"{bad} of {offered} request(s) shed or deadline-expired "
+        f"({100 * ratio:.0f}%) — offered load exceeds engine capacity",
+        "add engine replicas or raise queue_capacity only with more "
+        "compute behind it; widen the bucket set so batches fill, or "
+        "lower client deadlines so doomed work is shed at admission "
+        "instead of after queueing",
+        offered=offered, shed=shed, deadline_expired=expired,
+        ratio=round(ratio, 3))
+
+
+def detect_rank_flatline(events=None, snapshot=None, cluster=None,
+                         stale_heartbeat_s=STALE_HEARTBEAT_S, **_):
+    """A rank whose heartbeat went stale while siblings stay fresh: a
+    wedged collective or a dead process the deadline layer hasn't named
+    yet."""
+    ages = (cluster or {}).get('heartbeat_age_s') or {}
+    fresh = [r for r, a in ages.items()
+             if a is not None and a < stale_heartbeat_s]
+    for rank, age in sorted(ages.items()):
+        if age is None or age < stale_heartbeat_s or not fresh:
+            continue
+        yield _diag(
+            'rank_flatline', 'critical',
+            f"rank {rank} heartbeat is {age:.1f}s stale while "
+            f"{len(fresh)} sibling(s) beat on — wedged or dead rank",
+            "the supervisor's fail-fast should fire shortly; if not, check "
+            "distributed.set_timeout (collective deadline) and the rank's "
+            "stderr log in the run dir",
+            rank=rank, heartbeat_age_s=age, fresh_ranks=sorted(fresh))
+
+
+DETECTORS = {
+    'straggler': detect_straggler,
+    'retrace_storm': detect_retrace_storm,
+    'input_bound': detect_input_bound,
+    'serving_overload': detect_serving_overload,
+    'rank_flatline': detect_rank_flatline,
+}
+
+
+def diagnose(events=None, snapshot=None, cluster=None, **cfg):
+    """Run every detector; return diagnoses ranked most-severe first."""
+    out = []
+    for name, det in DETECTORS.items():
+        try:
+            out.extend(det(events=events, snapshot=snapshot,
+                           cluster=cluster, **cfg) or [])
+        except Exception as e:   # one broken detector must not mute the rest
+            out.append(_diag('doctor_error', 'info',
+                             f"detector {name} failed: {e!r}",
+                             'report this as a paddle_tpu bug',
+                             detector=name))
+    out.sort(key=lambda d: (SEVERITY_ORDER.get(d['severity'], 9),
+                            d['cause']))
+    return out
+
+
+def run_doctor(events=None, snapshot=None, cluster=None, emit=False, **cfg):
+    """``diagnose`` + (optionally) land each diagnosis as a structured
+    ``diagnosis`` event on the step-event log (requires the package;
+    ``emit=True`` from a path-loaded standalone module is a no-op)."""
+    diagnoses = diagnose(events=events, snapshot=snapshot, cluster=cluster,
+                         **cfg)
+    if emit and diagnoses and __package__:
+        from . import events as _events
+        for d in diagnoses:
+            _events.emit('diagnosis', cause=d['cause'],
+                         severity=d['severity'], detail=d['detail'],
+                         fix=d['fix'], **{
+                             k: v for k, v in d['evidence'].items()
+                             if isinstance(v, (int, float, str))})
+    return diagnoses
+
+
+def render_report(diagnoses):
+    """Operator-facing ranked text report."""
+    if not diagnoses:
+        return 'doctor: no anomalies detected'
+    lines = [f"doctor: {len(diagnoses)} finding(s), most severe first"]
+    for i, d in enumerate(diagnoses, 1):
+        lines.append(f"{i}. [{d['severity'].upper():8s}] {d['cause']}: "
+                     f"{d['detail']}")
+        lines.append(f"   fix: {d['fix']}")
+    return '\n'.join(lines)

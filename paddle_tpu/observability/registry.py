@@ -6,6 +6,13 @@ text exposition (``to_prometheus()``). All instruments are created lazily by
 name — ``counter('executor.program_cache.misses').inc()`` is the whole API
 at a call site — so instrumentation never needs registration boilerplate.
 
+Instruments may carry **labels** (``counter('cluster.step_ms', labels=
+{'rank': '3'})``): one metric family, many label sets — the shape the
+cross-rank aggregator and the Prometheus exposition need for per-rank
+series. A family's label *keys* are pinned by its first use; re-creating
+the same name with a different key set raises (two meanings under one
+exposition name would silently merge in a scrape).
+
 Updates are metric-local locks (an ``inc()`` never contends with an
 unrelated ``observe()``); creation takes the registry lock once per name.
 """
@@ -16,7 +23,29 @@ import threading
 
 __all__ = ['Counter', 'Gauge', 'Histogram', 'MetricsRegistry',
            'get_registry', 'counter', 'gauge', 'histogram',
-           'reset', 'snapshot', 'to_prometheus']
+           'reset', 'snapshot', 'to_prometheus', 'escape_label_value']
+
+
+def _norm_labels(labels):
+    """Validated ``{str: str}`` copy of a labels mapping (or None)."""
+    if not labels:
+        return None
+    out = {}
+    for k, v in labels.items():
+        k = str(k)
+        if not re.match(r'^[a-zA-Z_][a-zA-Z0-9_]*$', k):
+            raise ValueError(f"invalid metric label name {k!r}")
+        out[k] = str(v)
+    return out
+
+
+def _labels_key(labels):
+    """Canonical instrument-key suffix for a label set ('' when unlabeled).
+    json keeps values with commas/quotes unambiguous."""
+    if not labels:
+        return ''
+    import json
+    return json.dumps(labels, sort_keys=True, separators=(',', ':'))
 
 
 class Counter:
@@ -24,8 +53,9 @@ class Counter:
 
     kind = 'counter'
 
-    def __init__(self, name):
+    def __init__(self, name, labels=None):
         self.name = name
+        self.labels = _norm_labels(labels)
         self._value = 0
         self._lock = threading.Lock()
 
@@ -46,8 +76,9 @@ class Gauge:
 
     kind = 'gauge'
 
-    def __init__(self, name):
+    def __init__(self, name, labels=None):
         self.name = name
+        self.labels = _norm_labels(labels)
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -78,8 +109,9 @@ class Histogram:
 
     kind = 'histogram'
 
-    def __init__(self, name, reservoir_size=512):
+    def __init__(self, name, reservoir_size=512, labels=None):
         self.name = name
+        self.labels = _norm_labels(labels)
         self.reservoir_size = int(reservoir_size)
         self._lock = threading.Lock()
         self._rng = random.Random(hash(name) & 0xffffffff)
@@ -129,73 +161,136 @@ class Histogram:
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics = {}
+        self._metrics = {}        # (name, labels_key) -> instrument
+        self._label_keys = {}     # name -> frozenset of label key names
+        self._kinds = {}          # name -> instrument class (one per family)
 
-    def _get(self, cls, name, **kwargs):
+    def _get(self, cls, name, labels=None, **kwargs):
+        labels = _norm_labels(labels)
+        key = (name, _labels_key(labels))
+        keyset = frozenset(labels) if labels else frozenset()
         with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = cls(name, **kwargs)
-                self._metrics[name] = m
-            elif not isinstance(m, cls):
+            # kind is pinned per FAMILY, not per (name, labels) — a
+            # counter('x', m=a) followed by gauge('x', m=b) would otherwise
+            # be created fine and then poison every to_prometheus() call
+            pinned_cls = self._kinds.get(name)
+            if pinned_cls is not None and pinned_cls is not cls:
                 raise TypeError(
-                    f"metric {name!r} already registered as {m.kind}, "
-                    f"requested as {cls.kind}")
+                    f"metric {name!r} already registered as "
+                    f"{pinned_cls.kind}, requested as {cls.kind}")
+            m = self._metrics.get(key)
+            if m is None:
+                pinned = self._label_keys.get(name)
+                if pinned is not None and pinned != keyset:
+                    raise ValueError(
+                        f"metric {name!r} already registered with label set "
+                        f"{sorted(pinned) or '(none)'}, requested with "
+                        f"{sorted(keyset) or '(none)'} — one family, one "
+                        "label key set (a scrape would merge two meanings "
+                        "under one exposition name)")
+                m = cls(name, labels=labels, **kwargs)
+                self._metrics[key] = m
+                self._label_keys.setdefault(name, keyset)
+                self._kinds.setdefault(name, cls)
             return m
 
-    def counter(self, name):
-        return self._get(Counter, name)
+    def counter(self, name, labels=None):
+        return self._get(Counter, name, labels=labels)
 
-    def gauge(self, name):
-        return self._get(Gauge, name)
+    def gauge(self, name, labels=None):
+        return self._get(Gauge, name, labels=labels)
 
-    def histogram(self, name, reservoir_size=512):
-        return self._get(Histogram, name, reservoir_size=reservoir_size)
+    def histogram(self, name, reservoir_size=512, labels=None):
+        return self._get(Histogram, name, labels=labels,
+                         reservoir_size=reservoir_size)
 
     def reset(self):
         with self._lock:
             self._metrics.clear()
+            self._label_keys.clear()
+            self._kinds.clear()
+
+    def _sorted_instruments(self):
+        with self._lock:
+            items = list(self._metrics.items())
+        return [m for _, m in sorted(items, key=lambda kv: kv[0])]
 
     def snapshot(self):
         """Consistent point-in-time dict: counters/gauges as scalars,
-        histograms as their stats dicts."""
-        with self._lock:
-            items = list(self._metrics.items())
+        histograms as their stats dicts. Labeled instruments appear under
+        ``name{k=v,...}`` keys (sorted label order)."""
         out = {'counters': {}, 'gauges': {}, 'histograms': {}}
-        for name, m in sorted(items):
+        for m in self._sorted_instruments():
+            key = m.name if not m.labels else m.name + '{' + ','.join(
+                f"{k}={v}" for k, v in sorted(m.labels.items())) + '}'
             if m.kind == 'counter':
-                out['counters'][name] = m.value
+                out['counters'][key] = m.value
             elif m.kind == 'gauge':
-                out['gauges'][name] = m.value
+                out['gauges'][key] = m.value
             else:
-                out['histograms'][name] = m.stats()
+                out['histograms'][key] = m.stats()
         return out
 
     def to_prometheus(self, prefix='paddle_tpu'):
-        """Prometheus-style text exposition (metric names sanitized to
-        ``[a-z0-9_]``; histograms exposed summary-style)."""
+        """Prometheus-style text exposition.
+
+        Metric names are sanitized to ``[a-z0-9_]``; label values are
+        escaped per the exposition format (backslash, double-quote, and
+        newline); histograms are exposed summary-style. Two distinct
+        metric families that sanitize to the SAME exposition name (e.g. a
+        serving counter and a dataloader counter differing only in
+        punctuation) raise instead of silently merging their series."""
+        by_name = {}    # exposition name -> (raw name, kind, [instruments])
+        for m in self._sorted_instruments():
+            n = _sanitize(prefix, m.name)
+            entry = by_name.get(n)
+            if entry is None:
+                by_name[n] = (m.name, m.kind, [m])
+            elif entry[0] != m.name or entry[1] != m.kind:
+                raise ValueError(
+                    f"metric-name collision in Prometheus exposition: "
+                    f"{entry[0]!r} ({entry[1]}) and {m.name!r} ({m.kind}) "
+                    f"both sanitize to {n!r} — rename one family")
+            else:
+                entry[2].append(m)
         lines = []
-        snap = self.snapshot()
-        for name, v in snap['counters'].items():
-            n = _sanitize(prefix, name)
-            lines.append(f"# TYPE {n} counter")
-            lines.append(f"{n} {_fmt(v)}")
-        for name, v in snap['gauges'].items():
-            n = _sanitize(prefix, name)
-            lines.append(f"# TYPE {n} gauge")
-            lines.append(f"{n} {_fmt(v)}")
-        for name, st in snap['histograms'].items():
-            n = _sanitize(prefix, name)
-            lines.append(f"# TYPE {n} summary")
-            lines.append(f"{n}_count {st['count']}")
-            lines.append(f"{n}_sum {_fmt(st['sum'])}")
-            for q, key in (('0.5', 'p50'), ('0.99', 'p99')):
-                lines.append(f'{n}{{quantile="{q}"}} {_fmt(st[key])}')
+        for n, (_raw, kind, instruments) in by_name.items():
+            if kind == 'histogram':
+                lines.append(f"# TYPE {n} summary")
+                for m in instruments:
+                    st = m.stats()
+                    lbl = _render_labels(m.labels)
+                    lines.append(f"{n}_count{lbl} {st['count']}")
+                    lines.append(f"{n}_sum{lbl} {_fmt(st['sum'])}")
+                    for q, key in (('0.5', 'p50'), ('0.99', 'p99')):
+                        qlbl = _render_labels(dict(m.labels or {},
+                                                   quantile=q))
+                        lines.append(f"{n}{qlbl} {_fmt(st[key])}")
+            else:
+                lines.append(f"# TYPE {n} {kind}")
+                for m in instruments:
+                    lines.append(
+                        f"{n}{_render_labels(m.labels)} {_fmt(m.value)}")
         return '\n'.join(lines) + ('\n' if lines else '')
 
 
 def _sanitize(prefix, name):
     return re.sub(r'[^a-zA-Z0-9_]', '_', f"{prefix}_{name}").lower()
+
+
+def escape_label_value(v):
+    """Escape one label value per the Prometheus text exposition format:
+    backslash, double-quote, and line feed."""
+    return (str(v).replace('\\', '\\\\').replace('"', '\\"')
+            .replace('\n', '\\n'))
+
+
+def _render_labels(labels):
+    if not labels:
+        return ''
+    inner = ','.join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return '{' + inner + '}'
 
 
 def _fmt(v):
@@ -211,16 +306,17 @@ def get_registry():
     return _REGISTRY
 
 
-def counter(name):
-    return _REGISTRY.counter(name)
+def counter(name, labels=None):
+    return _REGISTRY.counter(name, labels=labels)
 
 
-def gauge(name):
-    return _REGISTRY.gauge(name)
+def gauge(name, labels=None):
+    return _REGISTRY.gauge(name, labels=labels)
 
 
-def histogram(name, reservoir_size=512):
-    return _REGISTRY.histogram(name, reservoir_size=reservoir_size)
+def histogram(name, reservoir_size=512, labels=None):
+    return _REGISTRY.histogram(name, reservoir_size=reservoir_size,
+                               labels=labels)
 
 
 def reset():

@@ -15,6 +15,22 @@ Env vars (read once at import; ``enable()``/``disable()`` override):
                                    spans carrying device values: sample the
                                    1st and every Nth occurrence of a span
                                    name (default 16; 0 disables syncing)
+
+Mission-control knobs (docs/OBSERVABILITY.md, "Mission control"):
+
+- ``PADDLE_TPU_TELEMETRY_HTTP``    port for the live ``/metrics`` +
+                                   ``/healthz`` endpoint (0 = pick a free
+                                   port; unset/empty = no endpoint)
+- ``PADDLE_TPU_TELEMETRY_HTTP_HOST``
+                                   bind address (default 127.0.0.1 — the
+                                   endpoint is diagnostics, not a public
+                                   service; bind wider explicitly)
+- ``PADDLE_TPU_TELEMETRY_FLUSH_EVERY``
+                                   per-rank flush cadence in seconds for
+                                   the cross-rank files (default 1.0)
+- ``PADDLE_TPU_TELEMETRY_RUN_DIR`` cluster run dir for per-rank telemetry
+                                   files (default: the supervisor's run
+                                   dir, passed via heartbeat env)
 """
 import os
 import threading
@@ -25,6 +41,13 @@ _DEFAULT_DIR = '/tmp/paddle_tpu_telemetry'
 def _env_int(name, default):
     try:
         return int(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, '') or default)
     except ValueError:
         return default
 
@@ -69,3 +92,34 @@ def log_dir():
 
 def sync_every():
     return _STATE.sync_every
+
+
+# -- mission-control knobs (read live: the supervisor sets the run-dir env
+# for its children after this module was first imported) -------------------
+
+def http_port():
+    """Requested endpoint port, or None when no endpoint was asked for.
+    0 means "pick a free port" (the server reports the bound one)."""
+    raw = os.environ.get('PADDLE_TPU_TELEMETRY_HTTP', '')
+    if raw == '':
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def http_host():
+    return os.environ.get('PADDLE_TPU_TELEMETRY_HTTP_HOST', '127.0.0.1')
+
+
+def flush_every():
+    return _env_float('PADDLE_TPU_TELEMETRY_FLUSH_EVERY', 1.0)
+
+
+def run_dir():
+    """Cluster run dir for per-rank telemetry files: the explicit override,
+    else the supervisor's heartbeat dir (set for every supervised rank),
+    else None (not part of a cluster run)."""
+    return (os.environ.get('PADDLE_TPU_TELEMETRY_RUN_DIR')
+            or os.environ.get('PADDLE_TPU_HEARTBEAT_DIR') or None)

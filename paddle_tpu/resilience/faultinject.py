@@ -20,6 +20,11 @@ against, on CPU, deterministically:
   (straggler model for collective deadlines);
 - ``slow_model`` — wrap a serving batch callable to sleep before every
   batch (overloaded-backend model for deadline expiry / load shedding);
+- ``slow_loader`` — Dataset wrapper sleeping before EVERY sample (the
+  input-bound model the anomaly doctor's dataloader-wait detector names);
+- ``retrace_bait`` — run n jitted calls with n distinct static shapes,
+  deterministically inflating the ``jax.compiles`` counter (retrace-storm
+  model for the anomaly doctor / GL005-GL006-adjacent telemetry);
 - ``slow_collective`` — context manager delaying named eager collectives in
   this process (DistributedTimeoutError model);
 - ``boot_fail`` — context manager arming rank bootstrap crashes (exit 43
@@ -38,8 +43,8 @@ from . import atomic_io
 __all__ = ['FaultInjector', 'flaky', 'poison_loss', 'corrupt_file',
            'truncate_file', 'PreemptAtStep', 'InjectedWriteError',
            'poison_sample', 'kill_worker', 'hang_worker', 'slow_rank',
-           'slow_model', 'slow_collective', 'boot_fail',
-           'PoisonedSampleError']
+           'slow_model', 'slow_loader', 'slow_collective', 'retrace_bait',
+           'boot_fail', 'PoisonedSampleError']
 
 
 class InjectedWriteError(OSError):
@@ -265,6 +270,41 @@ def slow_model(fn, delay_s):
         time.sleep(delay_s)
         return fn(*args, **kwargs)
     return slowed
+
+
+class _SlowDataset(_DatasetWrapper):
+    def __init__(self, dataset, delay_s):
+        super().__init__(dataset, range(len(dataset)))
+        self._delay_s = float(delay_s)
+
+    def _inject(self, i):
+        time.sleep(self._delay_s)
+
+
+def slow_loader(dataset, delay_s):
+    """Dataset wrapper sleeping ``delay_s`` seconds before EVERY sample —
+    the input-bound model: the dataloader wait histogram dominates step
+    time and the anomaly doctor names the run ``input_bound``."""
+    return _SlowDataset(dataset, delay_s)
+
+
+def retrace_bait(n=8, base=4):
+    """Deterministically trigger ``n`` fresh XLA compiles by jitting one
+    trivial function over ``n`` DISTINCT static shapes — the retrace-storm
+    signature (a shape or hash key changing every call) without needing a
+    buggy model. Returns the number of baited calls. Telemetry's
+    ``jax.compiles`` counter absorbs them when enabled."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _poke(x):
+        return x + 1
+
+    for i in range(int(n)):
+        jax.block_until_ready(_poke(jnp.zeros((int(base) + i,),
+                                              jnp.float32)))
+    return int(n)
 
 
 @contextlib.contextmanager

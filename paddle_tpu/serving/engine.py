@@ -79,6 +79,7 @@ class ServingEngine:
         self._stop = threading.Event()
         self._shed = 0
         self._submitted = 0
+        self._endpoint = None          # MetricsServer this engine owns
 
     # -- registration ---------------------------------------------------
     def register(self, name, predict_fn=None, layer=None, program=None,
@@ -321,7 +322,12 @@ class ServingEngine:
     def start(self):
         """Start the background worker thread (idempotent). A worker that
         died from an escaped exception (counted as serving.worker_crash)
-        is replaced, not silently left dead."""
+        is replaced, not silently left dead. With telemetry enabled and
+        ``PADDLE_TPU_TELEMETRY_HTTP`` set, the live ``/metrics`` +
+        ``/healthz`` endpoint comes up alongside (mission control)."""
+        if _obs.enabled():
+            from ..observability import endpoint as _endpoint
+            _endpoint.maybe_start_from_env(extra_health=self._health)
         with self._cond:
             if self._thread is not None and self._thread.is_alive():
                 return self
@@ -330,6 +336,29 @@ class ServingEngine:
                 target=self._worker, name='paddle-tpu-serving', daemon=True)
             self._thread.start()
         return self
+
+    def start_endpoint(self, port=0, host=None):
+        """Explicitly export this engine's live ``/metrics`` + ``/healthz``
+        (``port=0`` picks a free port; binds 127.0.0.1 unless ``host`` or
+        ``PADDLE_TPU_TELEMETRY_HTTP_HOST`` widens it). Returns the
+        ``observability.MetricsServer``; ``stop()`` tears it down."""
+        from ..observability.endpoint import MetricsServer
+        if self._endpoint is None:
+            self._endpoint = MetricsServer(
+                host=host, port=port, extra_health=self._health).start()
+        return self._endpoint
+
+    def _health(self):
+        """The serving slice of ``/healthz``."""
+        with self._lock:
+            queues = {n: len(q) for n, q in self._queues.items()}
+        return {'serving': {
+            'worker_alive': self.alive(),
+            'models': sorted(queues),
+            'queue_depth': queues,
+            'submitted': self._submitted,
+            'shed': self._shed,
+        }}
 
     def alive(self):
         return self._thread is not None and self._thread.is_alive()
@@ -374,6 +403,11 @@ class ServingEngine:
                     error=RuntimeError(
                         f"serving: engine stopped before request "
                         f"{req.id} ran"))
+        if self._endpoint is not None:
+            self._endpoint.stop()
+            self._endpoint = None
+        from ..observability import endpoint as _endpoint
+        _endpoint.detach_health(self._health)
 
     def _worker(self):
         try:

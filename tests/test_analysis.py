@@ -92,6 +92,9 @@ AST_FIXTURES = {
               "    n = len(batch)\n"
               "    arr = np.zeros((n, 8), np.float32)\n"
               "    return predict(arr)\n", "predict(arr)"),
+    'GL014': ("def train_step(loss, step_ms):\n"
+              "    print(f'step loss {loss:.4f} in {step_ms:.1f} ms')\n",
+              "print(f'step loss"),
 }
 
 
@@ -381,6 +384,50 @@ def test_gl013_exempts_tests_and_tools(tmp_path):
         p.write_text(_DYNSHAPE_SRC)
         findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
         assert [f for f in findings if f.rule == 'GL013'] == [], rel
+
+
+_EMIT_SRC = (
+    "import logging\n"
+    "logger = logging.getLogger(__name__)\n"
+    "def report(loss, qps, epoch):\n"
+    "    print(f'loss {loss:.4f}')\n"                   # flagged (f-string)
+    "    logger.info('qps %.2f', qps)\n"                # flagged (%-format)
+    "    print('epoch', epoch)\n"                       # narrative: fine
+    "    print('done: {} items'.format(epoch))\n")      # no float spec: fine
+
+
+def test_gl014_flags_metrics_shaped_emission_only(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'emit.py').write_text(_EMIT_SRC)
+    findings, _ = lint_paths([str(lib / 'emit.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL014')
+    assert hits == [4, 5], [(f.rule, f.line) for f in findings]
+    msg = [f for f in findings if f.rule == 'GL014'][0].message
+    # fix-it points at the telemetry spine
+    assert 'observability.event' in msg
+
+
+def test_gl014_exempts_tests_tools_bench_and_waiver(tmp_path):
+    for rel in ('tests/mod.py', 'tools/mod.py', 'bench_load.py',
+                'paddle_tpu/observability/exporter.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_EMIT_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL014'] == [], rel
+    # inline waiver honored
+    lib = tmp_path / 'paddle_tpu'
+    (lib / 'waived.py').write_text(
+        "def report(loss):\n"
+        "    # graftlint: disable=GL014 — user-facing verbose output\n"
+        "    print(f'loss {loss:.4f}')\n")
+    findings, _ = lint_paths([str(lib / 'waived.py')],
+                             scan_root=str(tmp_path))
+    live = [f for f in findings
+            if f.rule == 'GL014' and not getattr(f, 'waived', False)]
+    assert live == []
 
 
 def test_unresolvable_fetch_does_not_flood_gv006():

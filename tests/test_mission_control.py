@@ -1,0 +1,595 @@
+"""Mission-control acceptance tests (marker ``obs``, tier-1).
+
+Covers the cluster-wide telemetry layer (docs/OBSERVABILITY.md, "Mission
+control"): labeled metrics + the ``to_prometheus()`` escaping/collision
+fixes, per-rank flushing and supervisor-side aggregation through a REAL
+4-rank spawn under ``faultinject.slow_rank`` (merged Perfetto trace with
+one lane per rank, ``diagnosis: straggler`` naming the slow rank,
+``tools/doctor.py`` + ``tools/telemetry_dump.py --merge`` over the same
+run dir), the live ``/metrics`` / ``/healthz`` / ``/events`` /
+``/diagnosis`` endpoint scraped over localhost during a live run, each
+anomaly-doctor detector triggered deterministically via ``faultinject``
+(``slow_rank``, ``slow_model``, ``slow_loader``, ``retrace_bait``), and
+the telemetry-off ≤5% overhead contract for the new integration sites.
+"""
+import importlib.util
+import json
+import os
+import re
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import observability as obs
+from paddle_tpu.resilience import faultinject as fi
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SLOW_RANK = 3
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    """Every test starts disabled with empty buffers and leaves no state
+    (including the mission-control singletons)."""
+    obs.disable()
+    obs.reset()
+    yield
+    obs.endpoint.stop_active_server()
+    obs.stop_rank_flusher(final_flush=False)
+    obs.disable()
+    obs.close_sink()
+    obs.reset()
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, 'tools', f'{name}.py')
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _scrape(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode('utf-8')
+    except urllib.error.HTTPError as e:   # 4xx/5xx still carry a body
+        return e.code, e.read().decode('utf-8')
+
+
+# ---------------------------------------------------------------------------
+# labeled metrics + to_prometheus() escaping / collision regressions
+# ---------------------------------------------------------------------------
+
+def test_prometheus_labels_and_escaping():
+    obs.counter('cluster.steps', labels={'rank': '0'}).inc(3)
+    obs.counter('cluster.steps', labels={'rank': '1'}).inc(5)
+    nasty = 'a"b\\c\nd'
+    obs.gauge('cluster.hb', labels={'host': nasty}).set(1.5)
+    text = obs.to_prometheus()
+    assert 'paddle_tpu_cluster_steps{rank="0"} 3' in text
+    assert 'paddle_tpu_cluster_steps{rank="1"} 5' in text
+    # backslash, quote, and newline are escaped per the exposition format
+    assert 'host="a\\"b\\\\c\\nd"' in text
+    assert '\na"b' not in text   # no raw newline leaked into the body
+    # one # TYPE line per family, not per label set
+    assert text.count('# TYPE paddle_tpu_cluster_steps counter') == 1
+    # snapshot keys carry the labels
+    snap = obs.snapshot()
+    assert snap['counters']['cluster.steps{rank=0}'] == 3
+
+
+def test_label_set_collision_rejected():
+    """Regression (satellite): the same metric name re-registered with a
+    DIFFERENT label key set must be rejected, not silently merged — the
+    serving vs dataloader counter trap."""
+    obs.counter('pipeline.queue_depth', labels={'model': 'bert'}).inc()
+    with pytest.raises(ValueError, match='label set'):
+        obs.counter('pipeline.queue_depth', labels={'worker': '0'})
+    with pytest.raises(ValueError, match='label set'):
+        obs.counter('pipeline.queue_depth')   # unlabeled vs labeled
+    # same keys, different values: same family, second series — fine
+    obs.counter('pipeline.queue_depth', labels={'model': 'gpt'}).inc()
+
+
+def test_kind_collision_rejected_across_label_sets():
+    """Regression: instrument KIND is pinned per family, not per
+    (name, labels) — counter('x', m=a) then gauge('x', m=b) must raise at
+    the second creation, not succeed and then 500 every /metrics scrape."""
+    obs.counter('pipeline.depth', labels={'model': 'a'}).inc()
+    with pytest.raises(TypeError, match='already registered as counter'):
+        obs.gauge('pipeline.depth', labels={'model': 'b'})
+    obs.to_prometheus()   # the family stayed scrapeable
+
+
+def test_sanitized_name_collision_rejected():
+    """Two distinct families whose names sanitize to the same exposition
+    name (serving 'queue-depth' vs dataloader 'queue.depth') must raise in
+    to_prometheus, not interleave their series."""
+    obs.counter('serving.queue-depth').inc()
+    obs.counter('serving.queue.depth').inc()
+    with pytest.raises(ValueError, match='collision'):
+        obs.to_prometheus()
+
+
+def test_histogram_labels_in_summary_exposition():
+    h = obs.histogram('step_ms', labels={'rank': '2'})
+    for v in (1.0, 3.0):
+        h.observe(v)
+    text = obs.to_prometheus()
+    assert 'paddle_tpu_step_ms_count{rank="2"} 2' in text
+    assert 'paddle_tpu_step_ms{quantile="0.99",rank="2"}' in text
+
+
+# ---------------------------------------------------------------------------
+# per-rank flush -> aggregation (single process)
+# ---------------------------------------------------------------------------
+
+def test_rank_flusher_files_and_cluster_snapshot(tmp_path):
+    obs.enable()
+    h = obs.histogram('hapi.step_ms')
+    for i in range(4):
+        h.observe(5.0)
+        obs.event('step', step=i, step_ms=5.0)
+    fl = obs.flush.RankFlusher(str(tmp_path), rank=7)
+    assert fl.flush_now()
+    assert (tmp_path / 'telemetry_rank7.json').exists()
+    assert (tmp_path / 'events_rank7.jsonl').exists()
+    assert (tmp_path / 'trace_rank7.json').exists()
+    head = json.loads((tmp_path / 'telemetry_rank7.json').read_text())
+    assert head['rank'] == 7 and head['pid'] == os.getpid()
+    assert head['host'] and 'metrics' in head and 'counters' in head
+    snap = obs.aggregate.cluster_snapshot(str(tmp_path))
+    assert snap['n_ranks'] == 1
+    assert snap['per_rank'][7]['steps'] == 4
+    evs = obs.aggregate.merged_events(str(tmp_path))
+    assert len(evs) == 4 and all(e['rank'] == 7 for e in evs)
+
+
+def test_flusher_daemon_writes_periodically(tmp_path):
+    obs.enable()
+    fl = obs.flush.RankFlusher(str(tmp_path), rank=0, interval=0.05)
+    fl.start()
+    try:
+        obs.counter('x').inc()
+        sw = obs.Stopwatch()
+        while fl.flushes < 3 and sw.elapsed() < 10.0:
+            pass
+        assert fl.flushes >= 3
+    finally:
+        fl.stop()
+    head = json.loads((tmp_path / 'telemetry_rank0.json').read_text())
+    assert head['metrics']['counters']['x'] == 1
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: 4-rank spawn under slow_rank -> lanes + straggler diagnosis
+# ---------------------------------------------------------------------------
+
+def _mc_rank_worker():
+    """Per-rank body: a few timed steps, the slow rank dragged per-step by
+    faultinject.slow_rank (telemetry enabled via the inherited env)."""
+    import time
+    step_body = fi.slow_rank(lambda: time.sleep(0.002), rank=_SLOW_RANK,
+                             delay_s=0.03)
+    for i in range(6):
+        with obs.timer('hapi.step', step=i) as t:
+            step_body()
+        obs.event('step', step=i, step_ms=round(t.elapsed_ms, 3))
+    return obs.flush.rank_id()
+
+
+@pytest.mark.skipif(sys.platform == 'win32', reason='posix only')
+def test_four_rank_spawn_merged_trace_and_straggler(tmp_path, monkeypatch,
+                                                    capsys):
+    """Acceptance criterion: a 4-rank spawn with faultinject.slow_rank
+    produces a merged Perfetto trace with 4 rank lanes and a
+    `diagnosis: straggler` event naming the slow rank; tools/doctor.py and
+    telemetry_dump --merge on the same run dir report it."""
+    import paddle_tpu.distributed as dist
+    run_dir = tmp_path / 'run'
+    run_dir.mkdir()
+    monkeypatch.setenv('PADDLE_TPU_TELEMETRY', '1')
+    monkeypatch.setenv('PADDLE_TPU_TELEMETRY_RUN_DIR', str(run_dir))
+    obs.enable()
+
+    res = dist.spawn(_mc_rank_worker, nprocs=4, backend='cpu').join()
+    assert res == [0, 1, 2, 3]
+
+    # per-rank files from every rank
+    files = obs.aggregate.rank_files(str(run_dir))
+    assert sorted(files) == [0, 1, 2, 3]
+    for rank, kinds in files.items():
+        assert sorted(kinds) == ['events', 'telemetry', 'trace']
+
+    # the supervisor merged them at join: one Perfetto lane per rank
+    trace = json.loads((run_dir / 'merged_trace.json').read_text())
+    assert sorted({e['pid'] for e in trace}) == [0, 1, 2, 3]
+    names = {e['args']['name'] for e in trace
+             if e.get('ph') == 'M' and e['name'] == 'process_name'}
+    assert any(n.startswith(f'rank {_SLOW_RANK}') for n in names)
+    # the slow rank's step spans really are the stretched ones
+    by_rank_dur = {}
+    for e in trace:
+        if e.get('name') == 'hapi.step':
+            by_rank_dur.setdefault(e['pid'], []).append(e['dur'])
+    slow_mean = np.mean(by_rank_dur[_SLOW_RANK])
+    fast_mean = np.mean(by_rank_dur[0])
+    assert slow_mean > 3 * fast_mean
+
+    # cluster snapshot: skewed step time, all ranks present
+    snap = json.loads((run_dir / 'cluster_snapshot.json').read_text())
+    assert snap['n_ranks'] == 4 and snap['step_ms_skew'] > 3
+
+    # the doctor named the straggler — as a diagnosis event in the
+    # supervisor's own event log AND in the committed diagnoses.json
+    diag_events = [e for e in obs.event_log() if e['ev'] == 'diagnosis']
+    assert any(d['cause'] == 'straggler' and d.get('rank') == _SLOW_RANK
+               for d in diag_events)
+    report = json.loads((run_dir / 'diagnoses.json').read_text())
+    straggler = [d for d in report if d['cause'] == 'straggler']
+    assert straggler and straggler[0]['evidence']['rank'] == _SLOW_RANK
+    assert f'rank {_SLOW_RANK}' in straggler[0]['detail']
+
+    # tools/doctor.py over the same run dir reports it
+    doctor_cli = _load_tool('doctor')
+    assert doctor_cli.main([str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert 'straggler' in out and f'rank {_SLOW_RANK}' in out
+    assert doctor_cli.main([str(run_dir), '--fail-on', 'critical']) == 1
+
+    # telemetry_dump --merge shares the aggregator code path
+    dump_cli = _load_tool('telemetry_dump')
+    out_dir = tmp_path / 'merged'
+    assert dump_cli.main(['--merge', str(run_dir),
+                          '--out', str(out_dir)]) == 0
+    assert 'merged 4 rank(s)' in capsys.readouterr().out
+    merged = json.loads((out_dir / 'merged_trace.json').read_text())
+    assert sorted({e['pid'] for e in merged}) == [0, 1, 2, 3]
+    combined = (out_dir / 'merged_events.jsonl').read_text().splitlines()
+    assert {json.loads(l)['rank'] for l in combined} == {0, 1, 2, 3}
+
+
+# ---------------------------------------------------------------------------
+# live endpoint: /metrics + /healthz + /events + /diagnosis over localhost
+# ---------------------------------------------------------------------------
+
+_EXPOSITION_LINE = re.compile(
+    r'^[a-z_][a-z0-9_]*(\{[^{}]*\})? -?[0-9][0-9.e+-]*$')
+
+
+def test_endpoint_metrics_and_healthz_scrape(tmp_path):
+    obs.enable()
+    # a couple of process metrics + a fake 2-rank run dir with heartbeats
+    obs.counter('exec.steps').inc(3)
+    obs.histogram('hapi.step_ms').observe(4.0)
+    for rank, ms in ((0, 4.0), (1, 40.0)):
+        obs.flush.RankFlusher(str(tmp_path), rank=rank).flush_now()
+        (tmp_path / f'hb_{rank}').touch()
+    srv = obs.MetricsServer(port=0, run_dir=str(tmp_path)).start()
+    try:
+        assert srv.host == '127.0.0.1'   # diagnostics bind, not public
+        code, body = _scrape(f'{srv.url}/metrics')
+        assert code == 200
+        # every sample line is valid Prometheus exposition
+        for line in body.strip().splitlines():
+            if line.startswith('#'):
+                continue
+            assert _EXPOSITION_LINE.match(line), line
+        # per-rank step-time and heartbeat-age series are present
+        assert 'paddle_tpu_cluster_step_ms_count{rank="0"' in body
+        assert re.search(
+            r'paddle_tpu_cluster_heartbeat_age_s\{rank="1"\} [0-9.]+',
+            body)
+        assert 'paddle_tpu_exec_steps 3' in body
+        # regression: families are contiguous (strict exposition parsers
+        # reject e.g. jax_compiles interleaved into the step_ms summary),
+        # and the per-rank compiles family carries its own TYPE line
+        assert '# TYPE paddle_tpu_cluster_jax_compiles counter' in body
+        fams = []
+        for line in body.strip().splitlines():
+            if line.startswith('#'):
+                continue
+            fam = line.split('{')[0].split(' ')[0]
+            for suffix in ('_count', '_sum'):
+                if fam.endswith(suffix):
+                    fam = fam[:-len(suffix)]
+            if not fams or fams[-1] != fam:
+                fams.append(fam)
+        assert len(fams) == len(set(fams)), f'interleaved families: {fams}'
+
+        code, hz = _scrape(f'{srv.url}/healthz')
+        payload = json.loads(hz)
+        assert code == 200 and payload['status'] == 'ok'
+        assert payload['telemetry_enabled'] is True
+        assert set(map(int, payload['heartbeat_age_s'])) == {0, 1}
+
+        obs.event('step', step=0, step_ms=4.0)
+        obs.event('nan_guard.skip', step=1)
+        code, evs = _scrape(f'{srv.url}/events?n=1&ev=nan_guard.skip')
+        evs = json.loads(evs)
+        assert code == 200 and len(evs) == 1
+        assert evs[0]['ev'] == 'nan_guard.skip'
+        # regression: n=0 means none, not all (evs[-0:] is the whole list)
+        code, evs0 = _scrape(f'{srv.url}/events?n=0')
+        assert code == 200 and json.loads(evs0) == []
+
+        code, dg = _scrape(f'{srv.url}/diagnosis')
+        assert code == 200 and isinstance(json.loads(dg), list)
+
+        code, missing = _scrape(f'{srv.url}/nope')
+        assert code == 404 and '/metrics' in missing
+    finally:
+        srv.stop()
+
+
+def test_endpoint_healthz_503_on_stale_heartbeat(tmp_path):
+    obs.enable()
+    obs.flush.RankFlusher(str(tmp_path), rank=0).flush_now()
+    hb = tmp_path / 'hb_0'
+    hb.touch()
+    (tmp_path / 'hb_1').touch()
+    # age rank 0's heartbeat far past the threshold
+    old = os.path.getmtime(hb) - 1000
+    os.utime(hb, (old, old))
+    srv = obs.MetricsServer(port=0, run_dir=str(tmp_path),
+                            stale_after_s=5.0).start()
+    try:
+        code, body = _scrape(f'{srv.url}/healthz')
+        payload = json.loads(body)
+        assert code == 503 and payload['status'] == 'stale'
+        assert payload['stale_ranks'] == [0]
+    finally:
+        srv.stop()
+
+
+def test_endpoint_env_autostart_and_scrape_during_fit(tmp_path,
+                                                      monkeypatch):
+    """PADDLE_TPU_TELEMETRY_HTTP wires the endpoint into Model.fit with no
+    code changes; a mid-train scrape sees live per-step series."""
+    from paddle_tpu.hapi.callbacks import Callback
+
+    monkeypatch.setenv('PADDLE_TPU_TELEMETRY_HTTP', '0')   # free port
+    obs.enable(log_dir=str(tmp_path))
+
+    seen = {}
+
+    class MidTrainScraper(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            if seen:
+                return
+            srv = obs.endpoint.active_server()
+            assert srv is not None, 'endpoint did not auto-start'
+            seen['metrics'] = _scrape(f'{srv.url}/metrics')[1]
+            seen['healthz'] = json.loads(_scrape(f'{srv.url}/healthz')[1])
+
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    x = np.random.rand(8, 4).astype('float32')
+    y = np.random.rand(8, 1).astype('float32')
+    model.fit(list(zip(x, y)), batch_size=4, epochs=1, verbose=0,
+              callbacks=[MidTrainScraper()])
+
+    assert seen['healthz']['status'] == 'ok'
+    # the live scrape saw this very fit's step series
+    assert 'paddle_tpu_hapi_step_ms_count' in seen['metrics']
+    assert 'paddle_tpu_hapi_steps' in seen['metrics']
+
+
+def test_serving_engine_endpoint_health(tmp_path):
+    from paddle_tpu import serving
+    obs.enable()
+    eng = serving.ServingEngine(queue_capacity=8)
+    ep = eng.register('echo', predict_fn=lambda feeds: feeds['x'] * 2,
+                      example={'x': np.zeros((4,), np.float32)},
+                      bucket_spec=serving.BucketSpec((1, 2)))
+    eng.start()
+    srv = eng.start_endpoint(port=0)
+    try:
+        r = ep.predict({'x': np.ones((4,), np.float32)}, timeout=30)
+        assert r.ok
+        code, hz = _scrape(f'{srv.url}/healthz')
+        payload = json.loads(hz)
+        assert code == 200 and payload['serving']['worker_alive']
+        assert payload['serving']['models'] == ['echo']
+        _, body = _scrape(f'{srv.url}/metrics')
+        assert 'paddle_tpu_serving_requests 1' in body
+    finally:
+        eng.stop()
+    assert eng._endpoint is None   # stop() tears the endpoint down
+
+
+def test_stopped_engine_detaches_health_from_env_endpoint(monkeypatch):
+    """Regression: an env-started endpoint must not report the FIRST
+    engine's health forever — stop() detaches it so the next engine's
+    start() can attach its own ``serving`` slice."""
+    from paddle_tpu import serving
+    monkeypatch.setenv('PADDLE_TPU_TELEMETRY_HTTP', '0')
+    obs.enable()
+    eng_a = serving.ServingEngine(queue_capacity=8)
+    eng_a.start()
+    srv = obs.endpoint.active_server()
+    assert srv is not None and srv.extra_health == eng_a._health
+    eng_a.stop()
+    assert srv.extra_health is None   # A's dead worker no longer reported
+    eng_b = serving.ServingEngine(queue_capacity=8)
+    eng_b.register('fresh', predict_fn=lambda feeds: feeds['x'],
+                   example={'x': np.zeros((2,), np.float32)},
+                   bucket_spec=serving.BucketSpec((1,)))
+    eng_b.start()
+    try:
+        assert srv.extra_health == eng_b._health
+        _, payload = srv.health()
+        assert payload['serving']['worker_alive']
+        assert payload['serving']['models'] == ['fresh']
+    finally:
+        eng_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# doctor detectors, each triggered deterministically via faultinject
+# ---------------------------------------------------------------------------
+
+def test_doctor_retrace_storm_via_retrace_bait():
+    obs.enable()   # installs the jax.monitoring compile hooks
+    baited = fi.retrace_bait(n=10)
+    assert baited == 10
+    # a "run" of 20 steps that somehow compiled 10+ programs
+    obs.counter('hapi.steps').inc(20)
+    diagnoses = obs.diagnose(snapshot=obs.snapshot())
+    storm = [d for d in diagnoses if d['cause'] == 'retrace_storm']
+    assert storm, diagnoses
+    assert storm[0]['evidence']['compiles'] >= 10
+    assert 'GL005' in storm[0]['fix'] or 'analysis' in storm[0]['fix']
+
+
+def test_doctor_input_bound_via_slow_loader():
+    from paddle_tpu.io import DataLoader
+    obs.enable()
+    data = [(np.ones((3,), np.float32), np.float32(1.0)) for _ in range(6)]
+    loader = DataLoader(fi.slow_loader(data, 0.02), batch_size=2,
+                        shuffle=False)
+    for _batch in loader:
+        with obs.timer('hapi.step'):
+            pass   # the "compute" is instant; the loader wait dominates
+    diagnoses = obs.diagnose(snapshot=obs.snapshot())
+    bound = [d for d in diagnoses if d['cause'] == 'input_bound']
+    assert bound, diagnoses
+    assert bound[0]['evidence']['ratio'] > 1.0
+
+
+def test_doctor_serving_overload_via_slow_model():
+    from paddle_tpu import serving
+    obs.enable()
+    eng = serving.ServingEngine(queue_capacity=2)
+    slow = fi.slow_model(lambda feeds: feeds['x'], delay_s=0.05)
+    ep = eng.register('slow', predict_fn=slow, jit_compile=False,
+                      example={'x': np.zeros((2,), np.float32)},
+                      bucket_spec=serving.BucketSpec((1, 2)))
+    pending, shed = [], 0
+    for _ in range(8):
+        try:
+            pending.append(ep.submit({'x': np.ones((2,), np.float32)},
+                                     deadline_ms=1))
+        except serving.QueueFullError:
+            shed += 1
+    eng.run_until_idle()
+    statuses = [p.result(timeout=30).status for p in pending]
+    assert shed > 0 and 'deadline' in statuses
+    diagnoses = obs.diagnose(events=obs.event_log(),
+                             snapshot=obs.snapshot())
+    overload = [d for d in diagnoses if d['cause'] == 'serving_overload']
+    assert overload, diagnoses
+    assert overload[0]['evidence']['shed'] == shed
+
+
+def test_doctor_rank_flatline_and_render():
+    cluster = {
+        'per_rank': {},
+        'counters_total': {},
+        'heartbeat_age_s': {0: 0.2, 1: 0.3, 2: 99.0},
+        'n_ranks': 3, 'step_ms_skew': 1.0,
+    }
+    diagnoses = obs.diagnose(cluster=cluster)
+    flat = [d for d in diagnoses if d['cause'] == 'rank_flatline']
+    assert flat and flat[0]['evidence']['rank'] == 2
+    report = obs.doctor.render_report(diagnoses)
+    assert 'rank_flatline' in report and 'fix:' in report
+    assert obs.doctor.render_report([]) == 'doctor: no anomalies detected'
+
+
+def test_doctor_ranking_and_broken_detector_contained(monkeypatch):
+    """critical sorts first; one raising detector degrades to an info
+    finding instead of muting the rest."""
+    def boom(**_kw):
+        raise RuntimeError('kaput')
+    monkeypatch.setitem(obs.doctor.DETECTORS, 'broken', boom)
+    cluster = {
+        'per_rank': {0: {'step_ms': {'count': 5, 'mean': 1.0}},
+                     1: {'step_ms': {'count': 5, 'mean': 50.0}}},
+        'counters_total': {}, 'heartbeat_age_s': {}, 'n_ranks': 2,
+        'step_ms_skew': 50.0,
+    }
+    diagnoses = obs.diagnose(cluster=cluster)
+    causes = [d['cause'] for d in diagnoses]
+    assert causes[0] == 'straggler'             # critical outranks info
+    assert 'doctor_error' in causes             # contained, not fatal
+
+
+def test_single_process_fit_emits_diagnosis_events(tmp_path):
+    """TelemetryCallback runs the doctor at train end: a fit that baits
+    retraces ends with diagnosis events in its exported events.jsonl."""
+    obs.enable(log_dir=str(tmp_path))
+    fi.retrace_bait(n=12)
+    from paddle_tpu.observability.callback import TelemetryCallback
+
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    n = 10 * 4   # enough steps to clear the doctor's warmup threshold
+    x = np.random.rand(n, 4).astype('float32')
+    y = np.random.rand(n, 1).astype('float32')
+    model.fit(list(zip(x, y)), batch_size=4, epochs=1, verbose=0,
+              callbacks=[TelemetryCallback(log_dir=str(tmp_path))])
+    recs = [json.loads(l) for l in
+            (tmp_path / 'events.jsonl').read_text().splitlines()]
+    diag = [r for r in recs if r['ev'] == 'diagnosis']
+    assert any(d['cause'] == 'retrace_storm' for d in diag), \
+        [r['ev'] for r in recs][-5:]
+
+
+# ---------------------------------------------------------------------------
+# overhead: the mission-control integration sites stay free when off
+# ---------------------------------------------------------------------------
+
+def test_overhead_disabled_smoke():
+    """With telemetry OFF, the new mission-control hooks (flusher/endpoint
+    checks in fit-adjacent paths, the stall check in the dataloader, the
+    engine's endpoint guard) must cost ≤5% vs the same loop before: both
+    sides run the instrumented code with telemetry disabled, one with the
+    mission-control env knobs set (the off-path must not even read
+    them per-iteration)."""
+    from paddle_tpu.io import DataLoader
+
+    data = [(np.ones((3,), np.float32), np.float32(1.0))
+            for _ in range(64)]
+
+    def run_loop():
+        sw = obs.Stopwatch()
+        loader = DataLoader(data, batch_size=8, shuffle=False)
+        for _batch in loader:
+            obs.event('step', step_ms=1.0)   # disabled: must be a no-op
+        return sw.elapsed()
+
+    run_loop()   # warm
+    t_plain, t_knobs = [], []
+    env_keys = {'PADDLE_TPU_TELEMETRY_HTTP': '0',
+                'PADDLE_TPU_TELEMETRY_RUN_DIR': '/tmp/never-used'}
+    for _ in range(5):
+        for k in env_keys:
+            os.environ.pop(k, None)
+        t_plain.append(run_loop())
+        os.environ.update(env_keys)
+        t_knobs.append(run_loop())
+    for k in env_keys:
+        os.environ.pop(k, None)
+    best_plain, best_knobs = min(t_plain), min(t_knobs)
+    assert best_knobs <= best_plain * 1.05 + 0.010, \
+        f"mission-control off-path overhead: knobs={best_knobs:.4f}s " \
+        f"plain={best_plain:.4f}s ({best_knobs / best_plain:.3f}x)"
+    # and nothing was started or written
+    assert obs.endpoint.active_server() is None
+    assert obs.flush.active_flusher() is None

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""doctor: ranked anomaly diagnosis for a paddle_tpu run dir or live
+endpoint (docs/OBSERVABILITY.md, "Mission control").
+
+Usage::
+
+    python tools/doctor.py <run_dir>            # supervisor run dir (per-
+                                                # rank telemetry files) or a
+                                                # TelemetryCallback log dir
+    python tools/doctor.py <events.jsonl>       # a bare event log
+    python tools/doctor.py --url http://127.0.0.1:9100   # live endpoint
+    python tools/doctor.py <run_dir> --json     # machine-readable
+    python tools/doctor.py <run_dir> --fail-on critical  # CI gate: exit 1
+
+Reads whatever evidence the path holds — per-rank ``telemetry_rank<R>``
+files (merged into a cluster snapshot), heartbeat files, merged or
+single-process ``events.jsonl`` — runs every anomaly detector (straggler,
+retrace storm, input-bound, serving overload, rank flatline), and prints
+the ranked report with a fix-it per finding. Stdlib-only: loads the
+observability modules BY PATH, so it works on a machine with no jax
+installed.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_OBS_DIR = os.path.join(os.path.dirname(_HERE), 'paddle_tpu',
+                        'observability')
+
+
+def load_obs_module(name):
+    """Load paddle_tpu/observability/<name>.py standalone (no package, no
+    jax): aggregate.py and doctor.py are written to be importable this
+    way."""
+    path = os.path.join(_OBS_DIR, f'{name}.py')
+    spec = importlib.util.spec_from_file_location(f'_mc_{name}', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_jsonl(path):
+    events = []
+    with open(path, 'r', encoding='utf-8') as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                events.append(rec)
+    return events
+
+
+def gather(path, aggregate):
+    """(events, cluster, describe-string) for a run dir / log dir / jsonl
+    file."""
+    if os.path.isfile(path):
+        return load_jsonl(path), None, f"event log {path}"
+    cluster = None
+    events = []
+    parts = []
+    if aggregate.rank_files(path):
+        cluster = aggregate.cluster_snapshot(path)
+        events = aggregate.merged_events(path)
+        parts.append(f"{cluster['n_ranks']} rank(s), "
+                     f"step skew {cluster['step_ms_skew']}x")
+    else:
+        ages = aggregate.heartbeat_ages(path)
+        if ages:
+            cluster = {'per_rank': {}, 'heartbeat_age_s': ages,
+                       'n_ranks': 0, 'counters_total': {},
+                       'step_ms_skew': 0.0}
+            parts.append(f"{len(ages)} heartbeat file(s)")
+    for name in ('merged_events.jsonl', 'events.jsonl'):
+        if not events and os.path.exists(os.path.join(path, name)):
+            events = load_jsonl(os.path.join(path, name))
+            parts.append(name)
+    if events and not any('event' in p for p in parts):
+        parts.append(f"{len(events)} event(s)")
+    return events, cluster, f"run dir {path} ({', '.join(parts) or 'empty'})"
+
+
+def from_url(url):
+    """Ask a live endpoint for its own diagnosis (+ health context)."""
+    from urllib.request import urlopen
+    from urllib.error import URLError
+    url = url.rstrip('/')
+    try:
+        diagnoses = json.load(urlopen(f"{url}/diagnosis", timeout=10))
+    except (URLError, OSError, ValueError) as e:
+        print(f"doctor: cannot reach {url}/diagnosis: {e}", file=sys.stderr)
+        return None, None
+    try:
+        health = json.load(urlopen(f"{url}/healthz", timeout=10))
+    except Exception:
+        health = None
+    return diagnoses, health
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='doctor',
+        description='ranked anomaly diagnosis over paddle_tpu telemetry '
+                    '(docs/OBSERVABILITY.md, "Mission control")')
+    p.add_argument('path', nargs='?',
+                   help='run dir with per-rank telemetry files, a '
+                        'TelemetryCallback log dir, or an events.jsonl')
+    p.add_argument('--url', default=None,
+                   help='live /metrics endpoint base URL instead of a path '
+                        '(e.g. http://127.0.0.1:9100)')
+    p.add_argument('--json', action='store_true', dest='as_json',
+                   help='print the diagnoses as JSON')
+    p.add_argument('--fail-on', choices=('critical', 'warning', 'info'),
+                   default=None,
+                   help='exit 1 when any finding at (or above) this '
+                        'severity exists — CI gate mode')
+    args = p.parse_args(argv)
+    if bool(args.path) == bool(args.url):
+        p.error('give exactly one of <path> or --url')
+
+    doctor = load_obs_module('doctor')
+    if args.url:
+        diagnoses, health = from_url(args.url)
+        if diagnoses is None:
+            return 2
+        describe = f"live endpoint {args.url}"
+        if health:
+            describe += (f" (status {health.get('status')}, "
+                         f"{health.get('n_ranks', 0)} rank(s))")
+    else:
+        if not os.path.exists(args.path):
+            print(f"doctor: no such path: {args.path}", file=sys.stderr)
+            return 2
+        aggregate = load_obs_module('aggregate')
+        events, cluster, describe = gather(args.path, aggregate)
+        diagnoses = doctor.diagnose(events=events, cluster=cluster)
+
+    if args.as_json:
+        print(json.dumps(diagnoses, sort_keys=True, indent=1, default=repr))
+    else:
+        print(f"doctor: examining {describe}")
+        print(doctor.render_report(diagnoses))
+
+    if args.fail_on:
+        order = doctor.SEVERITY_ORDER
+        worst = order[args.fail_on]
+        if any(order.get(d['severity'], 9) <= worst for d in diagnoses):
+            return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -8,15 +8,27 @@ Usage::
     python tools/telemetry_dump.py <events.jsonl> --tail 50     # last 50
     python tools/telemetry_dump.py <events.jsonl> --ev step     # filter kind
     python tools/telemetry_dump.py <events.jsonl> --chrome out.json
+    python tools/telemetry_dump.py --merge <run_dir>            # cluster
 
 The input is what ``observability.dump_jsonl`` / ``TelemetryCallback`` write
 (one JSON object per line with ``ev`` and ``ts`` keys). Conversion maps
 events carrying a ``duration_ms``/``step_ms`` field to complete ("X") trace
 events and everything else to instant ("i") events, timestamped relative to
-the first event. Stdlib-only: usable on a machine with no jax installed.
+the first event.
+
+``--merge`` treats the positional argument as a SUPERVISOR RUN DIR holding
+per-rank telemetry files (``telemetry_rank<R>.json`` / ``events_rank<R>.
+jsonl`` / ``trace_rank<R>.json``, written by the mission-control flusher)
+and — through the same aggregator the launch supervisor uses — commits the
+merged Chrome trace (one Perfetto lane per rank), the combined rank-stamped
+JSONL, and the cluster snapshot back into the run dir (or ``--out DIR``).
+
+Stdlib-only: usable on a machine with no jax installed.
 """
 import argparse
+import importlib.util
 import json
+import os
 import sys
 
 
@@ -126,6 +138,31 @@ def render_serving(summary):
     return '\n'.join(lines)
 
 
+def _load_aggregate():
+    """Load the mission-control aggregator BY PATH (the module is written
+    to be standalone) so this tool keeps its no-jax contract."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, 'paddle_tpu', 'observability',
+                        'aggregate.py')
+    spec = importlib.util.spec_from_file_location('_mc_aggregate', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def merge_run_dir(run_dir, out_dir=None):
+    """Merge a run dir's per-rank telemetry (the shared aggregator code
+    path). Returns (paths, cluster_snapshot) or (None, None)."""
+    aggregate = _load_aggregate()
+    paths = aggregate.write_merged(run_dir, out_dir=out_dir)
+    if paths is None:
+        return None, None
+    # the snapshot was just committed — read it back rather than re-listing
+    # and re-parsing every per-rank file a second time
+    with open(paths['snapshot'], encoding='utf-8') as f:
+        return paths, json.load(f)
+
+
 def render_table(events, limit=None):
     """Aligned human listing: relative time, kind, then the fields."""
     if not events:
@@ -156,7 +193,16 @@ def main(argv=None):
         description='pretty-print / convert a paddle_tpu telemetry JSONL '
                     'event log (docs/OBSERVABILITY.md)')
     p.add_argument('log', help='events.jsonl written by TelemetryCallback / '
-                               'observability.dump_jsonl')
+                               'observability.dump_jsonl (with --merge: a '
+                               'supervisor run dir of per-rank files)')
+    p.add_argument('--merge', action='store_true',
+                   help='treat the positional argument as a run dir of '
+                        'per-rank telemetry files; write the merged Chrome '
+                        'trace (one lane per rank), combined JSONL, and '
+                        'cluster snapshot')
+    p.add_argument('--out', metavar='DIR', default=None,
+                   help='with --merge: where the merged artifacts land '
+                        '(default: the run dir itself)')
     p.add_argument('--chrome', metavar='OUT',
                    help='write Chrome trace-event JSON to OUT instead of '
                         'printing a table')
@@ -169,6 +215,23 @@ def main(argv=None):
                         'status/model, latency + queue percentiles, shed '
                         'and join/leave tallies) instead of the table')
     args = p.parse_args(argv)
+
+    if args.merge:
+        if not os.path.isdir(args.log):
+            print(f"telemetry_dump: --merge expects a run dir, not "
+                  f"{args.log!r}", file=sys.stderr)
+            return 2
+        paths, snap = merge_run_dir(args.log, out_dir=args.out)
+        if paths is None:
+            print(f"telemetry_dump: no per-rank telemetry files "
+                  f"(telemetry_rank<R>.json) in {args.log}",
+                  file=sys.stderr)
+            return 2
+        print(f"merged {paths.pop('n_ranks')} rank(s) "
+              f"(step skew {snap['step_ms_skew']}x):")
+        for kind in ('trace', 'events', 'snapshot'):
+            print(f"  {kind:8s} -> {paths[kind]}")
+        return 0
 
     try:
         events, bad = load_events(args.log)
