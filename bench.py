@@ -392,6 +392,100 @@ def bench_serving(duration_s=3.0, rate_mult=3.0, seed=0):
             paddle.disable_static()
 
 
+def bench_engine(steps=24, warmup=4, microbatch=4, seed=0):
+    """The unified train-step compiler on CPU: the ISSUE-9 acceptance
+    numbers, measured (``extras.engine``).
+
+    - steps/sec through ``engine.build_train_step`` at k=1 and with
+      ``lax.scan`` microbatching (k=``microbatch``) — the dispatch
+      amortization win;
+    - compiles after warmup (0 == one program, no retraces);
+    - host-transfer bytes per steady-state step (0 == the loss stayed
+      on-device; fetches happen at log cadence only);
+    - consumer-side dataloader wait p50 with the device-feed prefetcher
+      off vs on, under ``faultinject.slow_loader``.
+    """
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import engine, nn
+    from paddle_tpu import observability as obs
+    from paddle_tpu.core import rng as prng
+    from paddle_tpu.nn.layer_base import buffer_values, param_values
+
+    rng = np.random.RandomState(seed)
+    data = [(rng.rand(32, 16).astype(np.float32),
+             rng.rand(32, 1).astype(np.float32)) for _ in range(steps)]
+
+    def counters(name):
+        return obs.snapshot()['counters'].get(name, 0)
+
+    def run(k):
+        paddle.seed(1234 + k)
+        net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 1))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        step = engine.build_train_step(net=net, loss=nn.MSELoss(),
+                                       optimizer=opt, microbatch=k)
+        pv = param_values(net)
+        state = step.init_state(pv, buffer_values(net))
+
+        def batches():
+            if k == 1:
+                for x, y in data:
+                    yield ((x,), (y,)), prng.next_key()
+            else:
+                for i in range(0, len(data) - k + 1, k):
+                    grp = data[i:i + k]
+                    import jax.numpy as jnp
+                    yield ((np.stack([g[0] for g in grp]),),
+                           (np.stack([g[1] for g in grp]),)), \
+                        jnp.stack([prng.next_key() for _ in grp])
+
+        todo = list(batches())
+        for batch, key in todo[:max(warmup // k, 1)]:
+            state, out = step(state, batch, key)
+        float(out.loss)
+        compiles0 = counters('jax.compiles')
+        bytes0 = counters('host_transfer.bytes')
+        t0 = time.perf_counter()
+        n = 0
+        for batch, key in todo[max(warmup // k, 1):]:
+            state, out = step(state, batch, key)
+            n += k
+        float(out.loss)   # fence: one log-cadence fetch ends the window
+        dt = time.perf_counter() - t0
+        return {
+            'steps_per_sec': round(n / dt, 2) if dt > 0 else 0.0,
+            'compiles_after_warmup': counters('jax.compiles') - compiles0,
+            'host_transfer_bytes_per_step': round(
+                (counters('host_transfer.bytes') - bytes0) / max(n, 1), 2),
+            'donated': step.donates,
+        }
+
+    out = {'k1': run(1), f'k{microbatch}': run(microbatch)}
+
+    # prefetch overlap: consumer-side wait with the device-feed prefetcher
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.resilience import faultinject
+    samples = [(np.ones((8,), np.float32), np.float32(1.0))
+               for _ in range(16)]
+    slow = faultinject.slow_loader(samples, 0.005)
+
+    def wait_pcts(depth):
+        obs.reset()
+        loader = DataLoader(slow, batch_size=2, shuffle=False,
+                            prefetch_to_device=depth)
+        for _ in loader:
+            time.sleep(0.015)    # stands in for the device step
+        h = obs.snapshot()['histograms'].get('dataloader.next_wait_ms', {})
+        return {'p50': round(h.get('p50', 0.0), 3),
+                'p99': round(h.get('p99', 0.0), 3)}
+
+    out['dataloader_wait_ms'] = {'prefetch_off': wait_pcts(0),
+                                 'prefetch_on': wait_pcts(2)}
+    return out
+
+
 def _cluster_rank_worker():
     """One rank of the mission-control telemetry smoke: a few timed steps,
     rank 3 dragged by faultinject.slow_rank, telemetry flushed to the
@@ -935,6 +1029,14 @@ def _child_main(mode, model):
             serving_extras = {'error': repr(e)}
         telemetry = _telemetry_counters()
         try:
+            # unified train-step compiler numbers (ISSUE 9): steps/sec,
+            # compiles after warmup, host bytes/step, prefetch wait p50.
+            # Runs AFTER the counter capture above — its prefetch section
+            # resets the registry between measurements.
+            engine_extras = bench_engine()
+        except Exception as e:       # engine bench must never sink smoke
+            engine_extras = {'error': repr(e)}
+        try:
             # MULTICHIP mission-control smoke: aggregated per-rank step
             # times + doctor diagnoses (straggler evidence on CPU)
             telemetry['cluster'] = bench_cluster_telemetry()
@@ -946,7 +1048,8 @@ def _child_main(mode, model):
             "unit": "samples/sec",
             "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
             "extras": {"telemetry": telemetry,
-                       "serving": serving_extras},
+                       "serving": serving_extras,
+                       "engine": engine_extras},
             "complete": True,
         }))
 

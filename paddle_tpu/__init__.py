@@ -34,6 +34,7 @@ from . import amp
 from . import incubate
 from . import observability
 from . import resilience
+from . import engine
 from . import utils
 from . import dataset
 from . import device

@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL013).
+"""The graftlint AST rule catalog (GL001–GL015).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -30,6 +30,15 @@ but destroys performance or correctness on real hardware:
   registry, the step-event log, and every scrape; route the number through
   ``observability.event()``/``counter()``/``histogram()`` (tests/tools/
   bench harnesses exempt).
+
+- GL015: a train-step-shaped ``jax.jit`` (the wrapped callable takes a
+  params/opt-state pytree) with no ``donate_argnums`` — on TPU every such
+  step COPIES the parameters instead of updating them in place, doubling
+  HBM for the update and serializing the copy; route the step through
+  ``paddle_tpu.engine.build_train_step`` (donation, scan microbatching,
+  in-graph NaN guard come for free) or donate explicitly. Eval/predict
+  steps (by name) are exempt — their params are read-only and must NOT
+  be donated.
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -778,6 +787,131 @@ class MetricsShapedPrintRule(Rule):
                     "/metrics scrape; record it with paddle_tpu."
                     "observability.event()/counter()/histogram() (and keep "
                     "console output in tools/ or an opt-in callback)")
+
+
+# -- GL015: undonated params/opt-state pytrees into jax.jit -------------------
+
+# the engine package IS the sanctioned donating step builder (its donation
+# is computed at runtime behind the backend gate, invisible to the AST);
+# tests/tools/bench harnesses measure, they don't ship
+_DONATE_EXEMPT_PREFIXES = ('tests/', 'tools/', 'paddle_tpu/engine/',
+                           'engine/')
+# parameter names that mark a train-step signature: the optimizer-state
+# pytree is the tell — eval/apply functions take params but never opt
+# state. Bare 'opt' is deliberately absent: it too often names an
+# options/optimizer *object*, not a state pytree (precision over recall)
+_OPT_STATE_NAMES = {'opt_state', 'optimizer_state', 'opt_vals',
+                    'train_state'}
+# functions whose name says the params are read-only: donation would
+# invalidate buffers the caller still owns — these are exempt BY DESIGN.
+# Deliberately narrow: 'apply'/'forward'/'loss' are NOT here — an
+# apply_gradients-style updater is exactly the undonated train step the
+# rule targets
+_READONLY_NAME_HINTS = ('eval', 'predict', 'infer')
+
+
+def _jit_donates(call):
+    """Does a ``jax.jit(...)`` / ``partial(jax.jit, ...)`` Call carry a
+    donation kwarg?"""
+    kws = {kw.arg for kw in call.keywords}
+    if {'donate_argnums', 'donate_argnames'} & kws:
+        return True
+    if _tail_name(call.func) == 'partial' and call.args and \
+            isinstance(call.args[0], ast.Call):
+        return _jit_donates(call.args[0])
+    return False
+
+
+def _fn_defs_for(arg, index):
+    """FunctionDef nodes a callee argument references (by local name /
+    attribute tail), or [] when unresolvable in this module."""
+    name = _tail_name(arg) if isinstance(arg, (ast.Name, ast.Attribute)) \
+        else None
+    if name is None:
+        return []
+    return [fn for fn in index._by_name.get(name, ())
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _is_partial_jit(call):
+    """``functools.partial(jax.jit, ...)``-shaped Call."""
+    return (isinstance(call, ast.Call) and
+            _tail_name(call.func) == 'partial' and call.args and
+            _tail_name(call.args[0]) == 'jit')
+
+
+@register
+class UndonatedTrainStateRule(Rule):
+    """GL015: ``jax.jit`` over a callable that takes a params/opt-state
+    pytree, with no ``donate_argnums``/``donate_argnames`` — the XLA
+    program copies the whole training state every step instead of
+    updating it in place (double HBM + copy latency on TPU). Route the
+    step through ``paddle_tpu.engine.build_train_step``, which donates
+    behind a backend-capability gate, or donate explicitly. Functions
+    named like eval/predict/infer are exempt: their params are
+    read-only and donating them would be a use-after-free."""
+    id = 'GL015'
+    title = 'undonated params/opt-state pytree into jax.jit'
+
+    def in_scope(self, rel):
+        if any(rel.startswith(p) for p in _DONATE_EXEMPT_PREFIXES):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def _train_shaped(self, fn):
+        names = _param_names(fn)
+        return bool(names & _OPT_STATE_NAMES)
+
+    def _exempt_name(self, fn):
+        name = (getattr(fn, 'name', '') or '').lower()
+        return any(h in name for h in _READONLY_NAME_HINTS)
+
+    def _candidates(self, ctx):
+        """(jit_call_or_decorator_node, wrapped FunctionDef, donates)."""
+        # wrapper forms: step = jax.jit(fn, ...) and
+        # step = functools.partial(jax.jit, ...)(fn)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _tail_name(node.func) == 'jit':
+                for fn in _fn_defs_for(node.args[0], ctx.index):
+                    yield node, fn, _jit_donates(node)
+            elif _is_partial_jit(node.func):
+                # the donation kwargs live on the inner partial(...) call
+                for fn in _fn_defs_for(node.args[0], ctx.index):
+                    yield node, fn, _jit_donates(node.func)
+        # decorator form: @jax.jit / @functools.partial(jax.jit, ...)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in fn.decorator_list:
+                if _tail_name(dec) == 'jit':
+                    yield dec, fn, False
+                elif isinstance(dec, ast.Call):
+                    if _tail_name(dec.func) == 'jit' or \
+                            _is_partial_jit(dec):
+                        yield dec, fn, _jit_donates(dec)
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        seen = set()
+        for node, fn, donates in self._candidates(ctx):
+            if donates or id(fn) in seen:
+                continue
+            if not self._train_shaped(fn) or self._exempt_name(fn):
+                continue
+            seen.add(id(fn))
+            yield self.finding(
+                ctx, node,
+                f"jax.jit over '{fn.name}' takes an optimizer-state pytree "
+                "but donates nothing — every step copies params/opt-state "
+                "instead of updating in place on TPU; build the step with "
+                "paddle_tpu.engine.build_train_step (backend-gated "
+                "donation, scan microbatching, in-graph NaN guard) or "
+                "pass donate_argnums/donate_argnames (eval/predict steps "
+                "are exempt by name)")
 
 
 @register

@@ -1,0 +1,44 @@
+"""paddle_tpu.engine: ONE train-step compiler for every frontend.
+
+Before this package, three frontends each assembled their own train step —
+hapi ``Model.fit(jit=True)``, the eager convenience loop, and the static
+``Executor`` train path — so buffer donation, remat policy, AMP loss
+scaling, and the NaN guard were applied (or silently missed) three
+different ways, and the hapi jit path paid a device→host sync on every
+step just to log the loss.
+
+``build_train_step`` is the single waist (docs/PERF.md):
+
+- **buffer donation** for the params/opt-state pytree (``donate_argnums``),
+  feature-gated off on backends that ignore donation (CPU) and overridable
+  with ``PADDLE_TPU_DONATE=0/1``;
+- **scan microbatching**: ``microbatch=k`` compiles a ``lax.scan`` over k
+  microbatches per dispatch, amortizing per-step Python/dispatch overhead
+  and keeping every loss on-device;
+- **log-cadence host sync**: the step returns a :class:`DeviceLoss` that
+  stays on-device until someone calls ``float()`` on it — steady-state
+  steps transfer 0 bytes (the fetch is counted by the PR 3 host-transfer
+  interposer when it does happen);
+- **in-graph NaN guard**: finiteness check + ``lax.cond`` state-select
+  inside the compiled step. The old host-side ``prev_state`` rollback
+  snapshot is fundamentally incompatible with donation (the snapshot holds
+  the very buffers donation invalidates); the in-graph skip needs no
+  snapshot at all;
+- **AMP folded in**: ``GradScaler`` scale/unscale/found-inf-skip and the
+  dynamic-scale update run inside the step as pure state;
+- **remat + matmul knobs**: ``remat='full'|'dots'|policy`` and
+  ``matmul_precision`` (bf16 by default on TPU).
+
+``fit`` is the eager convenience loop over the same builder, fed by the
+``io.DataLoader`` device prefetcher so the accelerator never waits on host
+batch assembly.
+"""
+from .builder import (DeviceLoss, StepResult, TrainStep, build_train_step,
+                      donation_supported, matmul_preference)
+from .loop import fit, write_back_state
+
+__all__ = [
+    'build_train_step', 'TrainStep', 'StepResult', 'DeviceLoss',
+    'donation_supported', 'matmul_preference',
+    'fit', 'write_back_state',
+]

@@ -1,0 +1,215 @@
+"""The eager convenience loop over the unified step builder.
+
+Users with an eager ``nn.Layer`` + loss + Optimizer and a batch iterable
+get the whole zero-stall fast path in one call::
+
+    report = engine.fit(net, loss_fn, opt, loader, epochs=2, microbatch=4)
+
+Under the hood this is exactly the same compiled step hapi
+``Model.fit(jit=True)`` and the static ``Executor`` train path run —
+``build_train_step`` with buffer donation, the in-graph NaN guard, AMP
+folded in, and ``lax.scan`` microbatching — fed through the DataLoader
+device prefetcher so batch assembly overlaps compute. Losses stay
+on-device and are fetched at ``log_every`` cadence only.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .. import observability as _obs
+from .builder import build_train_step
+
+__all__ = ['fit', 'write_back_state', 'adopt_optimizer_state']
+
+
+def adopt_optimizer_state(network, optimizer, param_values):
+    """Functional opt-state seeded from the optimizer's eager accumulators
+    (``set_state_dict`` on resume) instead of fresh zeros — a compiled
+    resume must continue Adam/Momentum moments exactly like eager does."""
+    opt_state = optimizer.init_state_values(param_values)
+    acc = optimizer._accumulators
+    name_of = {k: (p.name or str(id(p)))
+               for k, p in network.named_parameters()}
+    for key in opt_state:
+        nm = name_of.get(key)
+        if nm in acc and acc[nm]:
+            opt_state[key] = dict(acc[nm])
+    return opt_state
+
+
+def write_back_state(network, optimizer, state):
+    """Mirror the functional state back into the eager world: params and
+    buffers into the network, optimizer slots into the eager accumulators
+    (so ``state_dict()``/checkpointing sees the live moments)."""
+    from ..nn.layer_base import load_state_values
+    load_state_values(network, state['params'])
+    load_state_values(network, state['buffers'])
+    if optimizer is not None and state.get('opt'):
+        name_of = {k: (p.name or str(id(p)))
+                   for k, p in network.named_parameters()}
+        for key, slots in state['opt'].items():
+            nm = name_of.get(key)
+            if nm is not None and slots:
+                optimizer._accumulators[nm] = dict(slots)
+
+
+def _value_tuple(part):
+    """A batch part (array / Tensor / list of either) as raw value tuple."""
+    from ..core.tensor import Tensor
+    items = part if isinstance(part, (list, tuple)) else [part]
+    out = []
+    for it in items:
+        if isinstance(it, Tensor):
+            out.append(it._value)
+        else:
+            out.append(np.asarray(it))
+    return tuple(out)
+
+
+def _split(batch):
+    if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+        return _value_tuple(batch[0]), _value_tuple(batch[1])
+    if isinstance(batch, (list, tuple)) and len(batch) == 1:
+        return _value_tuple(batch[0]), ()
+    return _value_tuple(batch), ()
+
+
+def _grouped(data, k):
+    """Yield host batches as ((bx, by), n_micro) groups: k==1 passes
+    through; k>1 stacks k consecutive batches along a new leading axis
+    (the lax.scan axis). An incomplete trailing group is dropped, like
+    ``drop_last`` — a second compiled shape per epoch tail would defeat
+    the one-program discipline."""
+    if k == 1:
+        for batch in data:
+            yield _split(batch)
+        return
+    def shape_sig(parts):
+        # np.shape reads .shape without materializing device arrays
+        return tuple(np.shape(p) for p in parts)
+
+    group = []
+    dropped = 0
+    canon = None
+    for batch in data:
+        bx, by = _split(batch)
+        sig = (shape_sig(bx), shape_sig(by))
+        if canon is None:
+            canon = sig        # the ONE compiled shape (first batch wins)
+        if sig != canon:
+            # ragged member (e.g. a drop_last=False tail batch): stacking
+            # would raise and a second compiled shape would retrace — drop
+            # the odd batch, keep the group accumulating
+            dropped += 1
+            continue
+        group.append((bx, by))
+        if len(group) == k:
+            # jnp.stack keeps device-resident members on device (a
+            # DataLoader source yields uploaded batches — np.stack would
+            # silently round-trip every one through the host)
+            yield (tuple(jnp.stack([g[0][i] for g in group])
+                         for i in range(len(group[0][0]))),
+                   tuple(jnp.stack([g[1][i] for g in group])
+                         for i in range(len(group[0][1]))))
+            group = []
+    dropped += len(group)
+    if dropped:
+        if _obs.enabled():
+            _obs.counter('engine.dropped_batches').inc(dropped)
+        import warnings
+        warnings.warn(
+            "engine.fit(microbatch=%d): dropped %d batch(es) whose shape "
+            "differed from the first batch (one compiled shape per run) — "
+            "pad/bucket your batches or use microbatch=1 if this is most "
+            "of your data" % (k, dropped), RuntimeWarning, stacklevel=2)
+
+
+def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
+        log_every=10, nan_guard=None, scaler=None, prefetch=2,
+        remat=None, donate='auto', matmul_precision='auto'):
+    """Train ``network`` over ``data`` through the unified compiled step.
+
+    ``data``: a DataLoader or any iterable of ``(inputs, labels)`` batches
+    (numpy arrays / Tensors, single or lists). ``prefetch``: depth of the
+    background device-feed prefetcher (0/None disables). ``nan_guard``: a
+    ``resilience.NanGuard`` (or True for a default one). Losses are
+    fetched to host every ``log_every`` dispatches; guard/scaler host
+    state reconciles on the same cadence (bounded by the guard's
+    consecutive-skip limit).
+
+    Returns a report dict: floated losses at log cadence, step counts,
+    steps/sec, and the final functional state (already written back into
+    ``network``/``optimizer``).
+    """
+    from ..core import rng as _rng
+    from ..nn.layer_base import buffer_values, param_values
+    if nan_guard is True:
+        from ..resilience import NanGuard
+        nan_guard = NanGuard()
+    if nan_guard is not None and scaler is not None:
+        nan_guard.attach_scaler(scaler)
+    step = build_train_step(net=network, loss=loss, optimizer=optimizer,
+                            scaler=scaler, nan_guard=nan_guard is not None,
+                            microbatch=microbatch, donate=donate,
+                            remat=remat, matmul_precision=matmul_precision)
+    network.train()
+    pv = param_values(network)
+    state = step.init_state(
+        pv, buffer_values(network),
+        opt_state=adopt_optimizer_state(network, optimizer, pv),
+        nan_guard=nan_guard, scaler=scaler)
+    k = step.k
+    # cadence is in DISPATCHES and each dispatch advances the streak by up
+    # to k steps: reconcile every ceil(limit/k) dispatches so a diverging
+    # run cannot overshoot the guard's consecutive-skip limit by ~k×
+    guard_cap = (-(-nan_guard.max_consecutive_skips // k)
+                 if nan_guard is not None else log_every)
+    sync_every = max(1, min(log_every, guard_cap))
+    needs_sync = nan_guard is not None or step.scaler is not None
+    report = {'loss': [], 'steps': 0, 'dispatches': 0,
+              'microbatch': k, 'donated': step.donates}
+    sw = _obs.Stopwatch()
+    try:
+        for _ in range(int(epochs)):
+            source = _grouped(data, k)
+            if prefetch:
+                from ..io.dataloader import DevicePrefetcher
+                source = DevicePrefetcher(source, depth=int(prefetch),
+                                          convert=_batch_to_device)
+            for bx, by in source:
+                if k == 1:
+                    key = _rng.next_key()
+                else:
+                    key = jnp.stack([_rng.next_key() for _ in range(k)])
+                state, out = step(state, (bx, by), key)
+                report['dispatches'] += 1
+                report['steps'] += k
+                if needs_sync and report['dispatches'] % sync_every == 0:
+                    step.sync(state, nan_guard=nan_guard, scaler=scaler)
+                if report['dispatches'] % max(int(log_every), 1) == 0 or \
+                        report['dispatches'] == 1:
+                    report['loss'].append(float(out.loss))
+    finally:
+        write_back_state(network, optimizer, state)
+        if needs_sync:
+            # final reconcile; never raise from the cleanup path — the
+            # in-flight NanStepError (if any) already propagated above
+            try:
+                step.sync(state, nan_guard=nan_guard, scaler=scaler,
+                          raise_on_limit=False)
+            except Exception:
+                pass
+    elapsed = sw.elapsed()
+    if elapsed > 0:
+        report['steps_per_sec'] = round(report['steps'] / elapsed, 3)
+    report['state'] = state
+    report['compiled_signatures'] = step.cache_size()
+    return report
+
+
+def _batch_to_device(batch):
+    """Upload one (bx, by) host group as raw jax arrays (the prefetcher's
+    default converter wraps Tensors — the compiled step wants bare
+    arrays)."""
+    bx, by = batch
+    return (tuple(jnp.asarray(v) for v in bx),
+            tuple(jnp.asarray(v) for v in by))

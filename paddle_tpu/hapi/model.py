@@ -31,6 +31,8 @@ class Model:
         self._scaler = None
         self._nan_guard = None
         self._epoch_start_rng = None
+        self._fit_log_freq = 10
+        self._steps_since_engine_sync = 0
 
     # -- setup --------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, jit=False,
@@ -63,37 +65,18 @@ class Model:
         return self
 
     def _build_jit_step(self):
-        """Fully-jitted train step: forward+backward+update in ONE XLA program."""
-        import jax
-        import jax.numpy as jnp
-        from ..nn.layer_base import functional_call, state_values
-        from ..core import rng as _rng
-
-        net, loss_fn, opt = self.network, self._loss, self._optimizer
-        params_meta = {k: p for k, p in net.named_parameters() if p.trainable}
-
-        def step(state, batch_x, batch_y, key):
-            params = {k: state['params'][k] for k in state['params']}
-            buffers = state['buffers']
-
-            def loss_of(p):
-                from ..core.rng import key_scope
-                with key_scope(key):
-                    out, new_buf = functional_call(net, {**p, **buffers},
-                                                   *[Tensor(v) for v in batch_x])
-                    outs = out if isinstance(out, (list, tuple)) else [out]
-                    loss = loss_fn(*outs, *[Tensor(v) for v in batch_y])
-                return loss._value, (tuple(o._value for o in outs), new_buf)
-
-            (loss_val, (out_vals, new_buf)), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
-            new_params, new_opt = opt.functional_update(
-                params, grads, state['opt'], params_meta=params_meta)
-            return ({'params': new_params, 'buffers': new_buf,
-                     'opt': new_opt}, loss_val, out_vals)
-
-        self._jit_step_fn = jax.jit(step)
+        """Fully-jitted train step via the unified engine builder: ONE XLA
+        program with buffer donation (where the backend honors it), the
+        in-graph NaN guard, and AMP loss scaling folded in
+        (docs/PERF.md)."""
+        from ..engine import build_train_step
+        scaler = self._scaler if (self._scaler is not None and
+                                  self._scaler.is_enable()) else None
+        self._jit_step_fn = build_train_step(
+            net=self.network, loss=self._loss, optimizer=self._optimizer,
+            scaler=scaler, nan_guard=self._nan_guard is not None)
         self._jit_state = None
+        self._steps_since_engine_sync = 0
 
     # -- steps --------------------------------------------------------------
     def train_batch(self, inputs, labels=None):
@@ -126,69 +109,84 @@ class Model:
         metrics = self._update_metrics(outs, labels)
         return [float(l.numpy()) for l in losses_list], metrics
 
-    def _jit_train_batch(self, inputs, labels):
-        from ..nn.layer_base import param_values, buffer_values, \
-            load_state_values
+    def _jit_train_batch(self, inputs, labels, lazy=False):
+        from ..engine.loop import adopt_optimizer_state
+        from ..nn.layer_base import param_values, buffer_values
         from ..core import rng as _rng
         if self._jit_state is None:
             pv = param_values(self.network)
-            opt_state = self._optimizer.init_state_values(pv)
             # adopt restored eager accumulators (optimizer.set_state_dict on
             # resume) instead of fresh zeros: jit resume must continue
             # Adam/Momentum moments exactly like the eager path does
-            acc = self._optimizer._accumulators
-            name_of = self._param_unique_names()
-            for k in opt_state:
-                nm = name_of.get(k)
-                if nm in acc and acc[nm]:
-                    opt_state[k] = dict(acc[nm])
-            self._jit_state = {
-                'params': pv,
-                'buffers': buffer_values(self.network),
-                'opt': opt_state}
+            self._jit_state = self._jit_step_fn.init_state(
+                pv, buffer_values(self.network),
+                opt_state=adopt_optimizer_state(self.network,
+                                                self._optimizer, pv),
+                nan_guard=self._nan_guard, scaler=self._scaler)
+            self._steps_since_engine_sync = 0
         bx = tuple(self._tensor(i)._value for i in inputs)
         by = tuple(self._tensor(l)._value for l in labels)
         key = _rng.next_key()
-        prev_state = self._jit_state
-        self._jit_state, loss_val, out_vals = self._jit_step_fn(
-            self._jit_state, bx, by, key)
-        if self._nan_guard is not None:
-            # the fused step already applied the poisoned update — roll the
-            # functional state back to the pre-step snapshot. Rollback must
-            # also cover check() RAISING (NanStepError at the consecutive
-            # limit), or fit()'s finally-block _sync_jit_state would write
-            # the NaN params into the network
-            try:
-                poisoned = self._nan_guard.check(np.asarray(loss_val))
-            except BaseException:
-                self._jit_state = prev_state
-                raise
-            if poisoned:
-                self._jit_state = prev_state
-        outs = [Tensor(v) for v in out_vals]
+        # a poisoned step is skipped IN-GRAPH (lax.cond selects the pre-step
+        # state), so no host-side rollback snapshot exists to clash with
+        # buffer donation; host-side guard/scaler bookkeeping reconciles at
+        # the log cadence (or immediately for direct train_batch calls)
+        self._jit_state, out = self._jit_step_fn(self._jit_state, (bx, by),
+                                                 key)
+        if self._jit_step_fn.guard_enabled or \
+                self._jit_step_fn.scaler is not None:
+            self._steps_since_engine_sync += 1
+            if not lazy or self._steps_since_engine_sync >= \
+                    self._engine_sync_every():
+                self._engine_sync()
+        outs = [Tensor(v) for v in out.outputs]
         metrics = self._update_metrics(outs, labels)
-        return [float(np.asarray(loss_val))], metrics
+        loss = out.loss if lazy else float(out.loss)
+        return [loss], metrics
 
-    def _param_unique_names(self):
-        """structured param name (named_parameters key) -> unique name (the
-        optimizer._accumulators key)."""
-        return {k: (p.name or str(id(p)))
-                for k, p in self.network.named_parameters()}
+    def _engine_sync_every(self):
+        """Guard/scaler host-reconcile cadence inside fit(): the log
+        cadence, tightened so a diverging run can never overshoot the
+        NaN guard's consecutive-skip limit by more than one cadence."""
+        every = self._fit_log_freq
+        if self._nan_guard is not None:
+            every = min(every, self._nan_guard.max_consecutive_skips)
+        return max(int(every), 1)
+
+    def _engine_sync(self, raise_on_limit=True):
+        """Reconcile in-graph guard/scaler counters with the host objects
+        (may raise NanStepError at the consecutive-skip limit)."""
+        self._steps_since_engine_sync = 0
+        if self._jit_state is None:
+            return
+        self._jit_step_fn.sync(self._jit_state, nan_guard=self._nan_guard,
+                               scaler=self._scaler,
+                               raise_on_limit=raise_on_limit)
+
+    def _fit_train_batch(self, inputs, labels):
+        """train_batch with the fit-loop contract: on the jit path the
+        returned loss is an engine ``DeviceLoss`` (materialized by the
+        loop at log cadence only) and guard/scaler host bookkeeping
+        reconciles on the same cadence instead of every step."""
+        if not self._use_jit:
+            return self.train_batch(inputs, labels)
+        self.network.train()
+        return self._jit_train_batch(self._to_list(inputs),
+                                     self._to_list(labels), lazy=True)
 
     def _sync_jit_state(self):
         if self._jit_state is not None:
-            from ..nn.layer_base import load_state_values
-            load_state_values(self.network, self._jit_state['params'])
-            load_state_values(self.network, self._jit_state['buffers'])
-            # mirror the functional optimizer state back into the eager
-            # accumulators: optimizer.state_dict() (checkpointing) must see
-            # the live moments, not the stale pre-jit zeros
-            if self._optimizer is not None and self._jit_state.get('opt'):
-                name_of = self._param_unique_names()
-                for k, st in self._jit_state['opt'].items():
-                    nm = name_of.get(k)
-                    if nm is not None and st:
-                        self._optimizer._accumulators[nm] = dict(st)
+            # mirror the functional state (params, buffers, optimizer
+            # moments) back into the eager world so state_dict()/
+            # checkpointing sees the live values, and reconcile the
+            # in-graph guard/scaler counters (never raising from here —
+            # this also runs in fit()'s finally block)
+            from ..engine.loop import write_back_state
+            write_back_state(self.network, self._optimizer, self._jit_state)
+            step = getattr(self, '_jit_step_fn', None)
+            if step is not None and getattr(step, 'sync', None) is not None:
+                step.sync(self._jit_state, nan_guard=self._nan_guard,
+                          scaler=self._scaler, raise_on_limit=False)
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -245,6 +243,10 @@ class Model:
             user_cbks.insert(0, _obs.TelemetryCallback())
         cbks = CallbackList([ProgBarLogger(log_freq, verbose)] + user_cbks)
         cbks.set_model(self)
+        # jit path: the loss stays on-device between log points; this is
+        # the materialization (and guard/scaler reconcile) cadence
+        self._fit_log_freq = max(int(log_freq), 1)
+        self._steps_since_engine_sync = 0
         steps = None
         try:
             steps = len(train_loader)
@@ -312,8 +314,14 @@ class Model:
                     mid_restore_pending = False
                 cbks.on_train_batch_begin(step)
                 ins, lbs = self._split_batch(batch)
-                losses, metrics = self.train_batch(ins, lbs)
-                logs = {'loss': losses[0]}
+                losses, metrics = self._fit_train_batch(ins, lbs)
+                loss0 = losses[0]
+                if step % self._fit_log_freq == 0 and \
+                        not isinstance(loss0, float):
+                    # log-cadence host sync: the only point a steady-state
+                    # jit step's loss crosses to the host
+                    loss0 = float(loss0)
+                logs = {'loss': loss0}
                 for m, res in zip(self._metrics, metrics):
                     names = m.name() if isinstance(m.name(), list) else \
                         [m.name()]
@@ -333,6 +341,8 @@ class Model:
                 # this position; skip epoch-end bookkeeping that would
                 # otherwise record the partial epoch as complete
                 break
+            if 'loss' in logs and not isinstance(logs['loss'], float):
+                logs['loss'] = float(logs['loss'])   # epoch-boundary sync
             cbks.on_epoch_end(epoch, logs)
             for m in self._metrics:
                 m.reset()

@@ -4,7 +4,7 @@ from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
 from .sampler import (Sampler, SequenceSampler, RandomSampler,
                       WeightedRandomSampler, BatchSampler,
                       DistributedBatchSampler)
-from .dataloader import (DataLoader, DataLoaderWorkerError,
+from .dataloader import (DataLoader, DataLoaderWorkerError, DevicePrefetcher,
                          default_collate_fn, default_convert_fn)
 # fluid.io reader-decorator compat (reference fluid/io.py does
 # `from paddle.reader import *`)
@@ -16,8 +16,8 @@ __all__ = ['Dataset', 'IterableDataset', 'TensorDataset', 'ComposeDataset',
            'ChainDataset', 'ConcatDataset', 'Subset', 'random_split',
            'Sampler', 'SequenceSampler', 'RandomSampler',
            'WeightedRandomSampler', 'BatchSampler', 'DistributedBatchSampler',
-           'DataLoader', 'DataLoaderWorkerError', 'default_collate_fn',
-           'default_convert_fn',
+           'DataLoader', 'DataLoaderWorkerError', 'DevicePrefetcher',
+           'default_collate_fn', 'default_convert_fn',
            'map_readers', 'shuffle', 'chain', 'buffered', 'compose',
            'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
            'ComposeNotAligned']

@@ -29,8 +29,8 @@ from .sampler import BatchSampler, SequenceSampler, RandomSampler
 from .. import observability as _obs
 from ..resilience import watchdog as _watchdog
 
-__all__ = ['DataLoader', 'default_collate_fn', 'default_convert_fn',
-           'DataLoaderWorkerError']
+__all__ = ['DataLoader', 'DevicePrefetcher', 'default_collate_fn',
+           'default_convert_fn', 'DataLoaderWorkerError']
 
 # consumer-side stall budget when DataLoader(timeout=0): generous enough
 # for any real batch assembly, small enough that a wedged pipeline fails
@@ -102,6 +102,94 @@ def _to_device(batch, to_tensor=True):
     return batch
 
 
+class DevicePrefetcher:
+    """Double-buffered device-feed prefetch (docs/PERF.md).
+
+    A background thread pulls host batches from ``source``, uploads them
+    (``jax.device_put`` dispatches async) and keeps up to ``depth``
+    device-resident batches ready, so the consumer's ``next()`` — i.e. the
+    accelerator's feed — never waits on host batch assembly + transfer.
+    The inline double-buffer in ``DataLoader.__iter__`` only overlaps the
+    upload dispatch; this moves the whole host side (sample fetch,
+    collate, conversion) off the consumer thread.
+
+    Failure contract matches the self-healing DataLoader: a raising source
+    ships its exception to the consumer (``DataLoaderWorkerError``), the
+    done sentinel posts from a ``finally``, and every consumer wait is
+    watchdog-bounded. Abandoning the iterator (break / GC) stops the
+    thread promptly via the bounded hand-off.
+    """
+
+    def __init__(self, source, depth=2, timeout=None, convert=None):
+        self.source = source
+        self.depth = max(int(depth), 1)
+        if timeout is None:
+            timeout = float(os.environ.get('PADDLE_TPU_DATA_TIMEOUT', '')
+                            or _DEFAULT_WATCHDOG_S)
+        self.timeout = timeout
+        self._convert = convert if convert is not None else _to_device
+
+    def __iter__(self):
+        out_q = queue.Queue(maxsize=self.depth)
+        done = object()
+        stop = threading.Event()
+
+        def worker():
+            try:
+                for batch in self.source:
+                    item = self._convert(batch)
+                    while not stop.is_set():
+                        try:
+                            out_q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:
+                _post(_WorkerFailure(e, 'device prefetch'))
+            finally:
+                _post(done)
+
+        def _post(item):
+            while not stop.is_set():
+                try:
+                    out_q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name='paddle-tpu-device-prefetch')
+        t.start()
+        try:
+            while True:
+                batch = _watchdog.bounded_get(
+                    out_q, timeout=self.timeout, alive=t.is_alive,
+                    what='device prefetch batch')
+                if batch is done:
+                    return
+                if isinstance(batch, _WorkerFailure):
+                    raise DataLoaderWorkerError(
+                        f"DataLoader device prefetch failed: "
+                        f"{batch.exc!r}\n{batch.tb}")
+                if _obs.enabled():
+                    _obs.gauge('dataloader.prefetch_depth').set(out_q.qsize())
+                yield batch
+        finally:
+            stop.set()
+
+
+def _env_prefetch_depth():
+    """PADDLE_TPU_PREFETCH: '' / '0' off, '1' -> depth 2, N -> depth N."""
+    raw = os.environ.get('PADDLE_TPU_PREFETCH', '')
+    try:
+        n = int(raw or 0)
+    except ValueError:
+        return 0
+    return 2 if n == 1 else max(n, 0)
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False,
@@ -109,7 +197,7 @@ class DataLoader:
                  use_buffer_reader=True, use_shared_memory=True, timeout=0,
                  worker_init_fn=None, prefetch_factor=2,
                  persistent_workers=False, skip_bad_samples=None,
-                 worker_max_restarts=None):
+                 worker_max_restarts=None, prefetch_to_device=None):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -137,6 +225,14 @@ class DataLoader:
             worker_max_restarts = int(
                 os.environ.get('PADDLE_TPU_WORKER_RESTARTS', 2) or 0)
         self.worker_max_restarts = max(int(worker_max_restarts), 0)
+        # device-feed prefetch (docs/PERF.md): None defers to
+        # PADDLE_TPU_PREFETCH; an int is the prefetch depth (0 = off)
+        if prefetch_to_device is None:
+            self.prefetch_to_device = _env_prefetch_depth()
+        elif prefetch_to_device is True:
+            self.prefetch_to_device = 2
+        else:
+            self.prefetch_to_device = max(int(prefetch_to_device or 0), 0)
         self._quarantined = []       # (index, repr(exc)) of skipped samples
         self._q_lock = threading.Lock()
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -406,6 +502,18 @@ class DataLoader:
     def __iter__(self):
         source = self._parallel_batches() if self.num_workers > 0 else \
             self._raw_batches()
+        if self.prefetch_to_device:
+            # background device-feed prefetch: the whole host side (sample
+            # fetch + collate + upload) runs ahead of the consumer; _timed
+            # wraps the OUTSIDE so dataloader.next_wait_ms measures the
+            # wait the accelerator would actually see
+            prefetched = DevicePrefetcher(source,
+                                          depth=self.prefetch_to_device,
+                                          timeout=self.timeout)
+            if _obs.enabled():
+                prefetched = self._timed(prefetched)
+            yield from prefetched
+            return
         if _obs.enabled():
             source = self._timed(source)
         if not self.use_buffer_reader:

@@ -94,8 +94,17 @@ class TelemetryCallback(Callback):
         rec = {'epoch': self._epoch, 'step': step,
                'step_ms': round(t.elapsed_ms, 3)}
         loss = (logs or {}).get('loss')
-        if loss is not None:
+        if isinstance(loss, (int, float)):
             rec['loss'] = float(loss)
+        elif loss is not None:
+            # engine DeviceLoss: record it only when the fit loop already
+            # materialized it (log cadence) — the step event must never add
+            # a host sync the steady-state pipeline would not have had
+            ready = getattr(loss, 'is_ready', None)
+            if ready is not None and ready():
+                rec['loss'] = float(loss)
+            elif ready is None:
+                rec['loss'] = float(loss)
         events.emit('step', **rec)
 
     def on_epoch_end(self, epoch, logs=None):

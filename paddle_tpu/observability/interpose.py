@@ -85,6 +85,9 @@ def summary():
         'jax_compile_ms': round(float(snap.get('jax.compile_ms', 0)), 3),
         'host_transfer_bytes': snap.get('host_transfer.bytes', 0),
         'host_transfer_calls': snap.get('host_transfer.calls', 0),
+        'engine_steps': snap.get('engine.steps', 0),
+        'engine_loss_fetch_bytes': snap.get(
+            'host_transfer.engine.loss_fetch.bytes', 0),
         'worker_restarts': snap.get('dataloader.worker_restarts', 0),
         'quarantined_samples': snap.get('dataloader.quarantined', 0),
         'watchdog_timeouts': snap.get('dataloader.watchdog_timeouts', 0),

@@ -74,6 +74,57 @@ class NanGuard:
                                        self.max_consecutive_skips))
         return True
 
+    def absorb_device_counts(self, total_steps, skipped_steps, consecutive,
+                             mark_scaler=True, raise_on_limit=True,
+                             peak_consecutive=None):
+        """Adopt counters maintained in-graph by the engine's ``lax.cond``
+        NaN guard (engine.build_train_step keeps skip bookkeeping on
+        device so steady-state steps never sync the host; the caller
+        reconciles at its log cadence).
+
+        Emits the same telemetry/warnings as :meth:`check` for the steps
+        skipped since the last reconcile, reports them to an attached
+        ``GradScaler`` unless the engine already folded the scaler update
+        into the graph (``mark_scaler=False``), and enforces the same
+        ``NanStepError`` consecutive-limit abort — judged on
+        ``peak_consecutive`` (the running MAX of the streak between
+        reconciles) so a limit-length streak that happened to end before
+        this sync still aborts, exactly as the eager guard would have
+        mid-streak. Returns the number of newly observed skips.
+        """
+        new_skips = max(int(skipped_steps) - self.skipped_steps, 0)
+        self.total_steps = int(total_steps)
+        self.skipped_steps = int(skipped_steps)
+        self.consecutive_skips = int(consecutive)
+        if new_skips:
+            if _obs.enabled():
+                _obs.counter('nan_guard.skips').inc(new_skips)
+                _obs.event('nan_guard.skip', step=self.total_steps,
+                           skipped=self.skipped_steps,
+                           consecutive=self.consecutive_skips)
+            if mark_scaler and self._scaler is not None and \
+                    self._scaler.is_enable():
+                for _ in range(new_skips):
+                    self._scaler.mark_found_inf()
+            if self._verbose:
+                import warnings
+                warnings.warn(
+                    "NanGuard: %d non-finite step(s) skipped in-graph by "
+                    "step %d (%d skipped so far, %d consecutive)"
+                    % (new_skips, self.total_steps, self.skipped_steps,
+                       self.consecutive_skips))
+        worst = max(self.consecutive_skips,
+                    int(peak_consecutive
+                        if peak_consecutive is not None else 0))
+        if raise_on_limit and worst >= self.max_consecutive_skips:
+            _obs.event('nan_guard.abort', step=self.total_steps,
+                       consecutive=worst)
+            raise NanStepError(
+                "NanGuard: %d consecutive non-finite steps (limit %d) — "
+                "the run is diverging; lower the learning rate or inspect "
+                "the data pipeline" % (worst, self.max_consecutive_skips))
+        return new_skips
+
     def state_dict(self):
         return {'skipped_steps': self.skipped_steps,
                 'consecutive_skips': self.consecutive_skips,

@@ -152,12 +152,19 @@ class Executor:
         with _obs.timer('executor.run', sync=lambda: outs):
             if train_spec is not None:
                 optimizer = train_spec[1]
+                pv = {v.name: val for v, val in zip(params, param_vals)}
                 if getattr(optimizer, '_static_state', None) is None:
-                    optimizer._static_state = optimizer.init_state_values(
-                        {v.name: val for v, val in zip(params, param_vals)})
-                outs, new_param_vals, new_state = compiled(
-                    feed_vals, param_vals, optimizer._static_state)
-                optimizer._static_state = new_state
+                    optimizer._static_state = \
+                        optimizer.init_state_values(pv)
+                # the engine step owns the whole functional state (and
+                # donates it where the backend honors donation); params
+                # stay authoritative in the Variables' concrete payloads
+                state = {'params': pv, 'buffers': {},
+                         'opt': optimizer._static_state}
+                state, result = compiled(state, feed_vals)
+                optimizer._static_state = state['opt']
+                outs = result.outputs
+                new_param_vals = [state['params'][v.name] for v in params]
             else:
                 outs, new_param_vals = compiled(feed_vals, param_vals)
         if new_param_vals is not None:
@@ -296,6 +303,7 @@ class Executor:
         # shard over a 1-D 'data' mesh, params/opt-state replicate; XLA
         # derives the grad all-reduce from the shardings — numerics match
         # the single-device run on the concatenated batch exactly
+        dp_shardings = None
         jit_kwargs = {}
         if dp:
             from jax.sharding import (Mesh, NamedSharding,
@@ -306,12 +314,11 @@ class Executor:
             repl = NamedSharding(mesh, P())
             n_feed = len(feed_vars)
             n_param = len(params)
-            if train_spec is None:
-                jit_kwargs['in_shardings'] = ([feed_sh] * n_feed,
-                                              [repl] * n_param)
-            else:
-                jit_kwargs['in_shardings'] = ([feed_sh] * n_feed,
-                                              [repl] * n_param, repl)
+            jit_kwargs['in_shardings'] = ([feed_sh] * n_feed,
+                                          [repl] * n_param)
+            # engine step signature (state, batch): replicate the whole
+            # state pytree (sharding-as-prefix), shard the feeds
+            dp_shardings = (repl, [feed_sh] * n_feed)
 
         if train_spec is None:
             @functools.partial(jax.jit, **jit_kwargs)
@@ -325,35 +332,34 @@ class Executor:
                 return _fetch_outs(fetch_vars, env), None
             return run
 
+        # train path: ONE compiled step through the unified engine builder
+        # (buffer donation where supported, shared update/clip/decay rule)
+        # — the same step hapi Model.fit(jit=True) and engine.fit run
+        from ..engine import build_train_step
         loss_var, optimizer = train_spec
+        trainable = {v.name for v in params if not v.stop_gradient}
+        meta = {v.name: v.concrete for v in params}
 
-        @functools.partial(jax.jit, **jit_kwargs)
-        def train_run(feed_vals, param_vals, opt_state):
-            def loss_fn(pvals):
-                env = {}
-                for v, val in zip(feed_vars, feed_vals):
-                    env[id(v)] = val
-                for v, val in zip(params, pvals):
-                    env[id(v)] = val
-                env = interpret(env)
-                loss = env[id(loss_var)]
-                return jnp.sum(loss), env
-
-            grads, env = jax.grad(loss_fn, has_aux=True)(list(param_vals))
-            pv = {v.name: val for v, val in zip(params, param_vals)}
-            gv = {v.name: g for v, g in zip(params, grads)
-                  if not v.stop_gradient}
-            meta = {v.name: v.concrete for v in params}
-            new_pv, new_state = optimizer.functional_update(pv, gv, opt_state,
-                                                            params_meta=meta)
+        def program_loss_fn(pvals, buffers, feed_vals, key):
+            env = {}
+            for v, val in zip(feed_vars, feed_vals):
+                env[id(v)] = val
+            for v in params:
+                env[id(v)] = pvals[v.name]
+            env = interpret(env)
+            loss = jnp.sum(env[id(loss_var)])
             outs = []
             for fv in fetch_vars:
                 if id(fv) in env:
                     outs.append(env[id(fv)])
                 else:
                     outs.append(fv.concrete._value)
-            return outs, [new_pv[v.name] for v in params], new_state
-        return train_run
+            return loss, tuple(outs), buffers
+
+        return build_train_step(loss_fn=program_loss_fn,
+                                optimizer=optimizer, params_meta=meta,
+                                trainable=trainable, with_key=False,
+                                in_shardings=dp_shardings)
 
 
 def program_infer_fn(program, feed_names, fetch_vars):

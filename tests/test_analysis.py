@@ -95,6 +95,10 @@ AST_FIXTURES = {
     'GL014': ("def train_step(loss, step_ms):\n"
               "    print(f'step loss {loss:.4f} in {step_ms:.1f} ms')\n",
               "print(f'step loss"),
+    'GL015': ("import jax\n"
+              "def train_step(params, opt_state, batch):\n"
+              "    return params, opt_state\n"
+              "step = jax.jit(train_step)\n", "jax.jit(train_step)"),
 }
 
 
@@ -519,6 +523,66 @@ def test_well_formed_program_verifies_clean():
     prog, final = well_formed_program(seed=5)
     assert verify_program(prog, fetch_list=[final]) == []
     assert prog.verify(fetch_list=[final]) == []
+
+
+_UNDONATED_SRC = (
+    "import jax\n"
+    "import functools\n"
+    "def train_step(params, opt_state, batch):\n"
+    "    return params, opt_state\n"
+    "step = jax.jit(train_step)\n"                            # flagged
+    "donated = jax.jit(train_step, donate_argnums=(0, 1))\n"  # donated: fine
+    "@jax.jit\n"
+    "def update_step(params, opt_state):\n"                   # flagged
+    "    return params, opt_state\n"
+    "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+    "def third_step(params, opt_state):\n"                    # donated: fine
+    "    return params, opt_state\n"
+    "def eval_step(params, opt_state):\n"
+    "    return params\n"
+    "ev = jax.jit(eval_step)\n"                               # name-exempt
+    "def forward(params, batch):\n"
+    "    return params\n"
+    "fw = jax.jit(forward)\n"                 # no opt-state pytree: fine
+    "def scan_step(params, opt_state):\n"
+    "    return params, opt_state\n"
+    "ps = functools.partial(jax.jit, static_argnums=())(scan_step)\n")
+    # ^ flagged: the partial(jax.jit, ...) wrapper spelling
+
+
+def test_gl015_flags_undonated_train_steps(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'steps.py').write_text(_UNDONATED_SRC)
+    findings, _ = lint_paths([str(lib / 'steps.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL015')
+    lines = _UNDONATED_SRC.splitlines()
+    assert len(hits) == 3, [(f.rule, f.line) for f in findings]
+    assert 'jax.jit(train_step)' in lines[hits[0] - 1]
+    assert '@jax.jit' in lines[hits[1] - 1]
+    assert 'functools.partial(jax.jit' in lines[hits[2] - 1]
+    msg = [f for f in findings if f.rule == 'GL015'][0].message
+    # the fix-it points at the unified step builder
+    assert 'engine.build_train_step' in msg and 'donate_argnums' in msg
+
+
+def test_gl015_exempts_engine_tests_tools(tmp_path):
+    # the engine package is the sanctioned builder (donation decided at
+    # runtime behind the backend gate); harnesses measure, they don't ship
+    for rel in ('paddle_tpu/engine/builder.py', 'tests/mod.py',
+                'tools/mod.py', 'bench.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_UNDONATED_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL015'] == [], rel
+    # ...but sibling library packages may not roll their own
+    p = tmp_path / 'paddle_tpu/kernels/steps.py'
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(_UNDONATED_SRC)
+    findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+    assert [f for f in findings if f.rule == 'GL015'] != []
 
 
 def test_ten_distinct_rule_ids_on_seeded_fixtures(tmp_path):

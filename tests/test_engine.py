@@ -1,0 +1,746 @@
+"""engine.build_train_step: the unified zero-stall train-step compiler.
+
+Acceptance anchors (ISSUE 9 / docs/PERF.md):
+
+- hapi ``Model.fit(jit=True)``, the eager convenience loop (``engine.fit``)
+  and the static ``Executor`` train path all route through ONE builder:
+  the two compiled frontends are bitwise-identical and ``jax.compiles``
+  stops growing after warmup on all three (the tier-1 retrace gate);
+- the jit fit loop fetches the loss at log cadence only: steady-state
+  steps transfer 0 host bytes (proven via the PR 3 interposed counter);
+- the NaN guard skips poisoned steps IN-GRAPH (lax.cond state select) —
+  no host-side rollback snapshot, donation-compatible — while keeping the
+  NanStepError consecutive-limit and GradScaler cooperation semantics;
+- the device-feed prefetcher drops the consumer-side dataloader wait
+  (``dataloader.next_wait_ms`` p50) under ``faultinject.slow_loader``.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import engine, nn, static
+from paddle_tpu import observability as obs
+from paddle_tpu.nn.functional import mse_loss
+from paddle_tpu.resilience import NanGuard, NanStepError
+from paddle_tpu.resilience.nanguard import _obs as _nan_obs  # noqa: F401
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _enable():
+    obs.reset()
+    obs.enable()
+
+
+def _data(n=5, batch=8, feat=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.rand(batch, feat).astype('float32'),
+             rng.rand(batch, 1).astype('float32')) for _ in range(n)]
+
+
+def _eager_net():
+    paddle.seed(42)
+    net = nn.Linear(3, 1)
+    init = [np.asarray(p.numpy()).copy() for p in net.parameters()]
+    return net, init
+
+
+def _compiles():
+    return obs.snapshot()['counters'].get('jax.compiles', 0)
+
+
+# ---------------------------------------------------------------------------
+# one step builder, three frontends
+# ---------------------------------------------------------------------------
+
+def _run_eager(data):
+    net, init = _eager_net()
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.MSELoss())
+    for x, y in data:
+        m.train_batch([x], [y])
+    return init, [np.asarray(p.numpy()) for p in net.parameters()]
+
+
+def _run_hapi_jit(data):
+    net, _ = _eager_net()
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.MSELoss(), jit=True)
+    for x, y in data:
+        m.train_batch([x], [y])
+    m._sync_jit_state()
+    return [np.asarray(p.numpy()) for p in net.parameters()]
+
+
+def _run_engine_fit(data):
+    net, _ = _eager_net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    report = engine.fit(net, nn.MSELoss(), opt,
+                        [([x], [y]) for x, y in data],
+                        epochs=1, log_every=2, prefetch=0)
+    return [np.asarray(p.numpy()) for p in net.parameters()], report
+
+
+def _build_static_program(batch=8, feat=3):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data('x', [batch, feat], 'float32')
+        label = static.data('label', [batch, 1], 'float32')
+        pred = static.nn.fc(x, size=1)
+        loss = mse_loss(pred, label)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    return main, loss
+
+
+def _run_executor(data, init):
+    paddle.enable_static()
+    try:
+        import jax.numpy as jnp
+        main, loss = _build_static_program()
+        exe = static.Executor()
+        pvars = [v for v in main.list_vars()
+                 if v.concrete is not None and
+                 getattr(v.concrete, 'trainable', False)]
+        by_shape = {tuple(np.asarray(i).shape): i for i in init}
+        for v in pvars:
+            v.concrete._inplace_value(
+                jnp.asarray(by_shape[tuple(v.concrete._value.shape)]))
+        for x, y in data:
+            exe.run(main, feed={'x': x, 'label': y}, fetch_list=[loss])
+        got = {tuple(np.asarray(v.concrete._value).shape):
+               np.asarray(v.concrete._value) for v in pvars}
+        return [got[tuple(np.asarray(i).shape)] for i in init]
+    finally:
+        paddle.disable_static()
+
+
+def test_three_frontends_one_step_parity():
+    """The unified-builder guarantee: the hapi jit step, the engine fit
+    loop, and the Executor train path produce BITWISE-identical params
+    (they are literally the same compiled update); the eager tape path
+    stays within float32 ulp noise of them (XLA fuses the compiled graph
+    differently than per-op dispatch)."""
+    data = _data()
+    init, eager = _run_eager(data)
+    jit = _run_hapi_jit(data)
+    loop, report = _run_engine_fit(data)
+    execp = _run_executor(data, init)
+    for a, b in zip(jit, loop):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jit, execp):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jit, eager):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    assert report['steps'] == len(data)
+    assert report['compiled_signatures'] == 1
+
+
+# ---------------------------------------------------------------------------
+# tier-1 perf gate: compiles stop growing after warmup, all three frontends
+# ---------------------------------------------------------------------------
+
+@pytest.mark.obs
+def test_compiles_flat_after_warmup_hapi_jit():
+    _enable()
+    net, _ = _eager_net()
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.MSELoss(), jit=True)
+    data = _data(n=13)
+    for x, y in data[:3]:
+        m.train_batch([x], [y])
+    warm = _compiles()
+    assert warm > 0    # the step really compiled in this process
+    for x, y in data[3:]:
+        m.train_batch([x], [y])
+    assert _compiles() == warm, "hapi jit frontend retraced after warmup"
+
+
+@pytest.mark.obs
+def test_compiles_flat_after_warmup_engine_loop():
+    _enable()
+    net, _ = _eager_net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = engine.build_train_step(net=net, loss=nn.MSELoss(),
+                                   optimizer=opt)
+    from paddle_tpu.core import rng as _rng
+    from paddle_tpu.nn.layer_base import buffer_values, param_values
+    pv = param_values(net)
+    state = step.init_state(pv, buffer_values(net))
+    data = _data(n=13)
+    for x, y in data[:3]:
+        state, _ = step(state, ((x,), (y,)), _rng.next_key())
+    warm = _compiles()
+    assert warm > 0
+    for x, y in data[3:]:
+        state, _ = step(state, ((x,), (y,)), _rng.next_key())
+    assert _compiles() == warm, "engine frontend retraced after warmup"
+    assert step.cache_size() == 1
+
+
+@pytest.mark.obs
+def test_compiles_flat_after_warmup_executor():
+    _enable()
+    paddle.enable_static()
+    try:
+        main, loss = _build_static_program()
+        exe = static.Executor()
+        data = _data(n=13)
+        for x, y in data[:3]:
+            exe.run(main, feed={'x': x, 'label': y}, fetch_list=[loss])
+        warm = _compiles()
+        assert warm > 0
+        for x, y in data[3:]:
+            exe.run(main, feed={'x': x, 'label': y}, fetch_list=[loss])
+        assert _compiles() == warm, "Executor frontend retraced after warmup"
+    finally:
+        paddle.disable_static()
+
+
+# ---------------------------------------------------------------------------
+# log-cadence host sync: steady-state steps transfer 0 bytes
+# ---------------------------------------------------------------------------
+
+class _TransferProbe(paddle.callbacks.Callback):
+    """Per-step host-transfer byte deltas, measured across each batch."""
+
+    def __init__(self):
+        super().__init__()
+        self.deltas = []
+        self._before = 0
+
+    def _bytes(self):
+        return obs.snapshot()['counters'].get('host_transfer.bytes', 0)
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._before = self._bytes()
+
+    def on_train_batch_end(self, step, logs=None):
+        self.deltas.append(self._bytes() - self._before)
+
+
+@pytest.mark.obs
+def test_jit_fit_loss_fetch_moves_to_log_cadence():
+    """The old _jit_train_batch paid float(np.asarray(loss)) on EVERY
+    step. Now the loss rides the engine's DeviceLoss: with telemetry on,
+    a 10-step fit with log_freq=5 transfers bytes only on the logging
+    steps (0, 5) — every other step moves 0 bytes to the host."""
+    _enable()
+    net, _ = _eager_net()
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.MSELoss(), jit=True)
+    probe = _TransferProbe()
+    data = _data(n=10)
+    m.fit(data, batch_size=None, epochs=1, log_freq=5, verbose=0,
+          shuffle=False, callbacks=[probe])
+    assert len(probe.deltas) == 10
+    for step, delta in enumerate(probe.deltas):
+        if step % 5 == 0:
+            assert delta > 0, f"logging step {step} fetched nothing"
+        else:
+            assert delta == 0, \
+                f"non-logging step {step} transferred {delta} bytes"
+    # the fetches are attributed to the engine's loss-fetch waist
+    snap = obs.snapshot()['counters']
+    assert snap.get('host_transfer.engine.loss_fetch.bytes', 0) > 0
+    # the step events carry the loss exactly on the materialized steps
+    losses = [r for r in obs.event_log() if r.get('ev') == 'step']
+    with_loss = [r['step'] for r in losses if 'loss' in r]
+    assert 0 in with_loss and all(s % 5 == 0 for s in with_loss[:-1])
+
+
+def test_device_loss_is_lazy_and_counted():
+    _enable()
+    import jax.numpy as jnp
+    dl = engine.DeviceLoss(jnp.float32(1.5))
+    assert not dl.is_ready()
+    before = obs.snapshot()['counters'].get('host_transfer.bytes', 0)
+    assert float(dl) == 1.5
+    after = obs.snapshot()['counters'].get('host_transfer.bytes', 0)
+    assert after - before == 4
+    assert dl.is_ready()
+    assert float(dl) == 1.5     # cached: no second transfer
+    assert obs.snapshot()['counters']['host_transfer.bytes'] == after
+
+
+# ---------------------------------------------------------------------------
+# in-graph NaN guard: donation-safe skip, preserved host semantics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fault
+def test_in_graph_guard_skips_without_rollback_snapshot():
+    """A poisoned step selects the pre-step state via lax.cond inside the
+    compiled step — params stay clean with NO host-side prev_state
+    snapshot (the donation hazard the old rollback had)."""
+    net, _ = _eager_net()
+    guard = NanGuard(max_consecutive_skips=5, verbose=False)
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.MSELoss(), jit=True, nan_guard=guard)
+    (x, y), = _data(n=1)
+    m.train_batch([x], [y])
+    m._sync_jit_state()
+    before = [np.asarray(p.numpy()).copy() for p in net.parameters()]
+    bad = np.full_like(x, np.nan)
+    losses, _ = m.train_batch([bad], [y])
+    assert np.isnan(losses[0])
+    m._sync_jit_state()
+    for a, b in zip(before, [np.asarray(p.numpy())
+                             for p in net.parameters()]):
+        np.testing.assert_array_equal(a, b)
+    assert guard.skipped_steps == 1 and guard.consecutive_skips == 1
+    # a clean step resets the consecutive count (same as the eager guard)
+    m.train_batch([x], [y])
+    assert guard.consecutive_skips == 0 and guard.skipped_steps == 1
+
+
+@pytest.mark.fault
+def test_in_graph_guard_consecutive_limit_still_raises():
+    net, _ = _eager_net()
+    guard = NanGuard(max_consecutive_skips=2, verbose=False)
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.MSELoss(), jit=True, nan_guard=guard)
+    (x, y), = _data(n=1)
+    bad = np.full_like(x, np.nan)
+    m.train_batch([bad], [y])
+    with pytest.raises(NanStepError):
+        m.train_batch([bad], [y])
+    # after the abort the functional state still holds finite params
+    m._sync_jit_state()
+    for p in net.parameters():
+        assert np.isfinite(np.asarray(p.numpy())).all()
+
+
+@pytest.mark.fault
+def test_guard_scaler_cooperation_scale_decays_in_graph():
+    """jit + AMP: the GradScaler is folded INTO the step — a poisoned step
+    takes the found-inf decrement path on device, and the host scaler
+    object sees the decayed scale after the cadence sync (the
+    mark_found_inf cooperation contract, now graph-side)."""
+    from paddle_tpu.amp import GradScaler
+    net, _ = _eager_net()
+    scaler = GradScaler(init_loss_scaling=256.0,
+                        decr_every_n_nan_or_inf=1, incr_every_n_steps=1000)
+    guard = NanGuard(max_consecutive_skips=10, verbose=False)
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.MSELoss(), jit=True, amp_configs=scaler,
+              nan_guard=guard)
+    (x, y), = _data(n=1)
+    m.train_batch([x], [y])
+    assert scaler.get_loss_scaling() == 256.0
+    m._sync_jit_state()
+    before = [np.asarray(p.numpy()).copy() for p in net.parameters()]
+    bad = np.full_like(x, np.nan)
+    m.train_batch([bad], [y])
+    assert scaler.get_loss_scaling() == 128.0     # decayed once, not twice
+    assert guard.skipped_steps == 1
+    m._sync_jit_state()
+    for a, b in zip(before, [np.asarray(p.numpy())
+                             for p in net.parameters()]):
+        np.testing.assert_array_equal(a, b)       # poisoned update skipped
+
+
+def test_scaler_dynamic_growth_matches_eager_policy():
+    from paddle_tpu.amp import GradScaler
+    net, _ = _eager_net()
+    scaler = GradScaler(init_loss_scaling=8.0, incr_every_n_steps=2,
+                        incr_ratio=2.0)
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.MSELoss(), jit=True, amp_configs=scaler)
+    data = _data(n=4)
+    for x, y in data:
+        m.train_batch([x], [y])
+    # 4 clean steps at incr_every=2 -> two doublings, like eager update()
+    assert scaler.get_loss_scaling() == 32.0
+
+
+# ---------------------------------------------------------------------------
+# scan microbatching + remat + donation gate
+# ---------------------------------------------------------------------------
+
+def test_microbatch_scan_matches_sequential_steps():
+    import jax.numpy as jnp
+    from paddle_tpu.core import rng as _rng
+    from paddle_tpu.nn.layer_base import buffer_values, param_values
+
+    data = _data(n=4)
+    paddle.seed(9)
+    keys = [_rng.next_key() for _ in range(4)]
+
+    def build(k):
+        net, _ = _eager_net()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        step = engine.build_train_step(net=net, loss=nn.MSELoss(),
+                                       optimizer=opt, microbatch=k)
+        pv = param_values(net)
+        return net, step, step.init_state(pv, buffer_values(net))
+
+    net1, one, st1 = build(1)
+    for (x, y), key in zip(data, keys):
+        st1, _ = one(st1, ((x,), (y,)), key)
+
+    net4, four, st4 = build(4)
+    bx = (np.stack([x for x, _ in data]),)
+    by = (np.stack([y for _, y in data]),)
+    st4, out = four(st4, (bx, by), jnp.stack(keys))
+    assert out.losses.shape == (4,)
+    assert out.outputs is None    # k>1 keeps only the losses on device
+    for a, b in zip(sorted(st1['params']), sorted(st4['params'])):
+        np.testing.assert_allclose(np.asarray(st1['params'][a]),
+                                   np.asarray(st4['params'][b]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_remat_policy_is_numerically_transparent():
+    from paddle_tpu.core import rng as _rng
+    from paddle_tpu.nn.layer_base import buffer_values, param_values
+
+    data = _data(n=3)
+    keys = [_rng.next_key() for _ in range(3)]
+
+    def run(remat):
+        net, _ = _eager_net()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        step = engine.build_train_step(net=net, loss=nn.MSELoss(),
+                                       optimizer=opt, remat=remat)
+        pv = param_values(net)
+        st = step.init_state(pv, buffer_values(net))
+        for (x, y), key in zip(data, keys):
+            st, _ = step(st, ((x,), (y,)), key)
+        return st['params']
+
+    base = run(None)
+    for policy in ('full', 'dots'):
+        got = run(policy)
+        for k in base:
+            np.testing.assert_allclose(np.asarray(base[k]),
+                                       np.asarray(got[k]),
+                                       rtol=1e-6, atol=1e-7)
+    with pytest.raises(ValueError):
+        run('bogus-policy')
+
+
+def test_donation_gate_follows_backend_and_env(monkeypatch):
+    monkeypatch.delenv('PADDLE_TPU_DONATE', raising=False)
+    assert engine.donation_supported('tpu') is True
+    assert engine.donation_supported('gpu') is True
+    assert engine.donation_supported('cpu') is False
+    monkeypatch.setenv('PADDLE_TPU_DONATE', '0')
+    assert engine.donation_supported('tpu') is False
+    monkeypatch.setenv('PADDLE_TPU_DONATE', '1')
+    assert engine.donation_supported('cpu') is True
+
+
+def test_donation_smoke_guarded_by_backend_capability():
+    """On a donating backend the pre-step param buffer must be invalidated
+    (proof the update is in-place); on CPU the gate keeps donation off and
+    the state survives."""
+    import jax
+    from paddle_tpu.core import rng as _rng
+    from paddle_tpu.nn.layer_base import buffer_values, param_values
+    net, _ = _eager_net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = engine.build_train_step(net=net, loss=nn.MSELoss(),
+                                   optimizer=opt)
+    pv = param_values(net)
+    state = step.init_state(pv, buffer_values(net))
+    donated_inputs = list(state['params'].values())
+    (x, y), = _data(n=1)
+    state, _ = step(state, ((x,), (y,)), _rng.next_key())
+    if engine.donation_supported():
+        assert step.donates
+        assert all(buf.is_deleted() for buf in donated_inputs)
+    else:
+        assert not step.donates
+        assert all(not buf.is_deleted() for buf in donated_inputs)
+        # a second dispatch over the same state must stay valid
+        state, _ = step(state, ((x,), (y,)), _rng.next_key())
+
+
+def test_matmul_preference_env_and_backend(monkeypatch):
+    monkeypatch.delenv('PADDLE_TPU_MATMUL_PRECISION', raising=False)
+    assert engine.matmul_preference('tpu') == 'bfloat16'
+    assert engine.matmul_preference('cpu') is None
+    monkeypatch.setenv('PADDLE_TPU_MATMUL_PRECISION', 'float32')
+    assert engine.matmul_preference('tpu') == 'float32'
+    monkeypatch.setenv('PADDLE_TPU_MATMUL_PRECISION', '')
+    assert engine.matmul_preference('tpu') is None
+
+
+# ---------------------------------------------------------------------------
+# device-feed prefetch: the accelerator never waits on host assembly
+# ---------------------------------------------------------------------------
+
+def _consume_with_work(loader, work_s):
+    n = 0
+    for _ in loader:
+        time.sleep(work_s)    # stands in for the device step
+        n += 1
+    return n
+
+
+@pytest.mark.obs
+@pytest.mark.fault
+def test_prefetch_overlap_drops_dataloader_wait():
+    """faultinject.slow_loader makes every sample cost 20 ms of host time.
+    Without prefetch the consumer eats that wait on every next(); with the
+    background device-feed prefetcher the assembly overlaps the consumer's
+    compute and the dataloader.next_wait_ms p50 collapses."""
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.resilience import faultinject
+
+    samples = [(np.ones((4,), np.float32), np.float32(1.0))
+               for _ in range(8)]
+    slow = faultinject.slow_loader(samples, 0.01)
+
+    def p50(prefetch):
+        _enable()
+        loader = DataLoader(slow, batch_size=2, shuffle=False,
+                            prefetch_to_device=prefetch)
+        assert _consume_with_work(loader, 0.03) == 4
+        return obs.snapshot()['histograms']['dataloader.next_wait_ms']['p50']
+
+    plain = p50(0)
+    overlapped = p50(2)
+    # 2 samples x 10ms per batch: the plain consumer waits ~20ms; the
+    # prefetched consumer's wait hides inside its 30ms of "compute"
+    assert plain >= 15.0, plain
+    assert overlapped < plain * 0.5, (plain, overlapped)
+
+
+def test_prefetcher_propagates_source_failures():
+    from paddle_tpu.io.dataloader import (DataLoaderWorkerError,
+                                          DevicePrefetcher)
+
+    def bad_source():
+        yield np.ones((2,), np.float32)
+        raise RuntimeError("poisoned batch assembly")
+
+    pf = DevicePrefetcher(bad_source(), depth=2, timeout=10.0)
+    with pytest.raises(DataLoaderWorkerError, match='poisoned batch'):
+        list(pf)
+
+
+def test_prefetcher_stops_thread_on_abandoned_iteration():
+    import threading
+    from paddle_tpu.io.dataloader import DevicePrefetcher
+
+    def source():
+        for i in range(1000):
+            yield np.full((2,), i, np.float32)
+
+    pf = DevicePrefetcher(source(), depth=2, timeout=10.0)
+    it = iter(pf)
+    next(it)
+    it.close()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if not any(t.name == 'paddle-tpu-device-prefetch' and t.is_alive()
+                   for t in threading.enumerate()):
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("prefetch thread leaked after consumer abandoned it")
+
+
+def test_dataloader_prefetch_env_knob(monkeypatch):
+    from paddle_tpu.io import DataLoader
+    data = [(np.ones((2,), np.float32), np.float32(0.0)) for _ in range(4)]
+    monkeypatch.setenv('PADDLE_TPU_PREFETCH', '1')
+    assert DataLoader(data, batch_size=2).prefetch_to_device == 2
+    monkeypatch.setenv('PADDLE_TPU_PREFETCH', '3')
+    assert DataLoader(data, batch_size=2).prefetch_to_device == 3
+    monkeypatch.setenv('PADDLE_TPU_PREFETCH', '')
+    assert DataLoader(data, batch_size=2).prefetch_to_device == 0
+    loader = DataLoader(data, batch_size=2, prefetch_to_device=2)
+    batches = list(loader)
+    assert len(batches) == 2 and len(list(loader)) == 2  # re-iterable
+
+
+# ---------------------------------------------------------------------------
+# the eager convenience loop end-to-end
+# ---------------------------------------------------------------------------
+
+def test_engine_fit_converges_with_prefetch_and_microbatch():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(3, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    rng = np.random.RandomState(1)
+    w = np.array([[1.5], [-2.0], [0.5]], np.float32)
+    batches = []
+    for _ in range(24):
+        x = rng.rand(16, 3).astype('float32')
+        batches.append(([x], [x @ w]))
+    report = engine.fit(net, nn.MSELoss(), opt, batches, epochs=3,
+                        microbatch=4, log_every=2, prefetch=2)
+    assert report['microbatch'] == 4
+    assert report['steps'] == 72          # 24 batches x 3 epochs
+    assert report['dispatches'] == 18
+    assert report['compiled_signatures'] == 1
+    assert report['loss'][-1] < report['loss'][0] * 0.5
+    # the functional result was written back into the eager world
+    assert report['state']['params']
+    assert opt._accumulators            # Adam moments mirrored for ckpts
+
+
+@pytest.mark.fault
+def test_guard_peak_streak_aborts_even_if_it_ended_before_sync():
+    """A limit-length NaN streak that ends between two host reconciles
+    must still abort: the guard state carries the running MAX of the
+    streak, not just the instantaneous value (the eager guard would have
+    aborted mid-streak)."""
+    from paddle_tpu.core import rng as _rng
+    from paddle_tpu.nn.layer_base import buffer_values, param_values
+    net, _ = _eager_net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = engine.build_train_step(net=net, loss=nn.MSELoss(),
+                                   optimizer=opt, nan_guard=True)
+    guard = NanGuard(max_consecutive_skips=2, verbose=False)
+    pv = param_values(net)
+    state = step.init_state(pv, buffer_values(net), nan_guard=guard)
+    (x, y), = _data(n=1)
+    bad = np.full_like(x, np.nan)
+    for bx in (bad, bad, x):         # streak of 2 (== limit), then clean
+        state, _ = step(state, ((bx,), (y,)), _rng.next_key())
+    with pytest.raises(NanStepError):
+        step.sync(state, nan_guard=guard)
+
+
+@pytest.mark.fault
+def test_guard_abort_is_recoverable_after_catch():
+    """Catching NanStepError and continuing (lower LR, fixed data) must
+    behave like eager: the next clean step resets the streak and later
+    syncs do NOT re-raise from the stale pre-abort history."""
+    from paddle_tpu.core import rng as _rng
+    from paddle_tpu.nn.layer_base import buffer_values, param_values
+    net, _ = _eager_net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = engine.build_train_step(net=net, loss=nn.MSELoss(),
+                                   optimizer=opt, nan_guard=True)
+    guard = NanGuard(max_consecutive_skips=2, verbose=False)
+    pv = param_values(net)
+    state = step.init_state(pv, buffer_values(net), nan_guard=guard)
+    (x, y), = _data(n=1)
+    bad = np.full_like(x, np.nan)
+    for bx in (bad, bad):
+        state, _ = step(state, ((bx,), (y,)), _rng.next_key())
+    with pytest.raises(NanStepError):
+        step.sync(state, nan_guard=guard)
+    state, _ = step(state, ((x,), (y,)), _rng.next_key())   # clean step
+    step.sync(state, nan_guard=guard)                       # recovered
+    assert guard.consecutive_skips == 0 and guard.skipped_steps == 2
+
+
+def test_empty_trainable_set_updates_nothing():
+    """trainable=set() (every param frozen) is a real filter, not 'no
+    filter': the step must pass every param through unchanged."""
+    import jax.numpy as jnp
+
+    def loss_fn(params, buffers, batch, key):
+        return jnp.sum((params['w'] - batch[0]) ** 2), (), buffers
+
+    opt = paddle.optimizer.SGD(learning_rate=0.5)
+    step = engine.build_train_step(loss_fn=loss_fn, optimizer=opt,
+                                   trainable=set(), with_key=False)
+    state = step.init_state({'w': jnp.ones((3,), jnp.float32)})
+    state, out = step(state, (jnp.zeros((3,), jnp.float32),))
+    np.testing.assert_array_equal(np.asarray(state['params']['w']),
+                                  np.ones((3,), np.float32))
+    assert float(out.loss) == 3.0
+
+
+@pytest.mark.fault
+def test_microbatch_guard_cadence_scales_with_k():
+    """With microbatch=k each dispatch advances the streak by up to k
+    steps — the fit loop must reconcile every ceil(limit/k) dispatches so
+    the abort cannot overshoot by ~k x (here: limit 4, k 4 -> the FIRST
+    poisoned dispatch must already abort, even with a huge log_every)."""
+    net, _ = _eager_net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    bad = np.full((8, 3), np.nan, np.float32)
+    y = np.ones((8, 1), np.float32)
+    with pytest.raises(NanStepError):
+        engine.fit(net, nn.MSELoss(), opt, [([bad], [y])] * 8, epochs=1,
+                   microbatch=4, log_every=100, prefetch=0,
+                   nan_guard=NanGuard(max_consecutive_skips=4,
+                                      verbose=False))
+
+
+def test_engine_fit_drops_ragged_batches_instead_of_crashing():
+    """microbatch>1 over a drop_last=False loader: the tail batch has a
+    different shape — it must be dropped (one compiled shape), not
+    np.stack-crashed mid-epoch."""
+    net, _ = _eager_net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    batches = [([rng.rand(8, 3).astype('float32')],
+                [rng.rand(8, 1).astype('float32')]) for _ in range(4)]
+    batches.append(([rng.rand(3, 3).astype('float32')],   # ragged tail
+                    [rng.rand(3, 1).astype('float32')]))
+    with pytest.warns(RuntimeWarning, match='dropped 1 batch'):
+        report = engine.fit(net, nn.MSELoss(), opt, batches, epochs=1,
+                            microbatch=2, log_every=1, prefetch=0)
+    assert report['steps'] == 4 and report['dispatches'] == 2
+    assert report['compiled_signatures'] == 1
+
+
+def test_device_loss_supports_numeric_callbacks():
+    import jax.numpy as jnp
+    dl = engine.DeviceLoss(jnp.float32(2.0))
+    assert dl < 3.0 and dl > 1.0 and dl <= 2.0 and dl >= 2.0
+    assert dl == 2.0 and dl + 1.0 == 3.0 and 1.0 + dl == 3.0
+    assert dl * 2 == 4.0 and dl / 2 == 1.0 and 4.0 / dl == 2.0
+    assert -dl == -2.0 and +dl == 2.0 and abs(dl) == 2.0
+    assert round(dl) == 2 and round(dl, 1) == 2.0
+    assert f"{dl:.2f}" == "2.00"
+    assert dl.is_ready()       # any numeric use materialized it (once)
+
+
+@pytest.mark.fault
+def test_engine_fit_nan_guard_limit_aborts():
+    net, _ = _eager_net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    bad = np.full((8, 3), np.nan, np.float32)
+    y = np.ones((8, 1), np.float32)
+    with pytest.raises(NanStepError):
+        engine.fit(net, nn.MSELoss(), opt, [([bad], [y])] * 8, epochs=1,
+                   log_every=1, prefetch=0,
+                   nan_guard=NanGuard(max_consecutive_skips=3,
+                                      verbose=False))
+    # the skipped updates never reached the network
+    for p in net.parameters():
+        assert np.isfinite(np.asarray(p.numpy())).all()
