@@ -486,6 +486,83 @@ def bench_engine(steps=24, warmup=4, microbatch=4, seed=0):
     return out
 
 
+def bench_sharding(steps=10, warmup=2, seed=0):
+    """FSDP-style sharded training on the host-device mesh (``extras.
+    sharding``): the ISSUE-10 acceptance numbers, measured.
+
+    For mesh sizes 1/2/4/8 over the 'data' axis: per-device param bytes
+    (expect ~1/k scaling — params + Adam moments sharded at rest),
+    steps/sec vs the replicated data-parallel step, compiles after warmup
+    (0 == one program), and the analytic per-step collective-traffic
+    estimate of the gather/reshard recipe.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    import paddle_tpu as paddle
+    from paddle_tpu import engine, nn
+    from paddle_tpu import observability as obs
+    from paddle_tpu.core import rng as prng
+    from paddle_tpu.distributed.strategy import ShardingConfig
+    from paddle_tpu.nn.layer_base import buffer_values, param_values
+
+    rng = np.random.RandomState(seed)
+    data = [(rng.rand(16, 256).astype(np.float32),
+             rng.rand(16, 256).astype(np.float32)) for _ in range(steps)]
+
+    def counters(name):
+        return obs.snapshot()['counters'].get(name, 0)
+
+    def run(mesh_k, fsdp):
+        mesh = Mesh(np.asarray(jax.devices()[:mesh_k]), ('data',))
+        cfg = ShardingConfig(mesh=mesh, fsdp=fsdp, min_size=1024)
+        paddle.seed(1000 + mesh_k)
+        net = nn.Sequential(nn.Linear(256, 512), nn.Tanh(),
+                            nn.Linear(512, 256))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        step = engine.build_train_step(net=net, loss=nn.MSELoss(),
+                                       optimizer=opt, sharding=cfg)
+        state = step.init_state(param_values(net), buffer_values(net))
+        for x, y in data[:warmup]:
+            state, out = step(state, ((x,), (y,)), prng.next_key())
+        float(out.loss)
+        compiles0 = counters('jax.compiles')
+        t0 = time.perf_counter()
+        for x, y in data[warmup:]:
+            state, out = step(state, ((x,), (y,)), prng.next_key())
+        float(out.loss)   # fence
+        dt = time.perf_counter() - t0
+        info = step.sharding_info(state)
+        return {
+            'steps_per_sec': round((steps - warmup) / dt, 2) if dt else 0.0,
+            'param_bytes_per_device': info['param_bytes_per_device'],
+            'state_bytes_per_device': info['state_bytes_per_device'],
+            'collective_bytes_per_step_est':
+                info['collective_bytes_per_step_est'],
+            'compiles_after_warmup': counters('jax.compiles') - compiles0,
+        }
+
+    n_dev = len(jax.devices())
+    out = {'mesh': {}}
+    for k in (1, 2, 4, 8):
+        if k > n_dev:
+            break
+        out['mesh'][str(k)] = run(k, fsdp=True)
+    dp = run(n_dev, fsdp=False)
+    out['dp_baseline'] = dp
+    biggest = out['mesh'][max(out['mesh'], key=int)]
+    if dp['param_bytes_per_device']:
+        out['param_bytes_ratio_vs_dp'] = round(
+            biggest['param_bytes_per_device'] /
+            dp['param_bytes_per_device'], 4)
+        out['steps_per_sec_vs_dp'] = round(
+            biggest['steps_per_sec'] / dp['steps_per_sec'], 3) \
+            if dp['steps_per_sec'] else 0.0
+    return out
+
+
 def _cluster_rank_worker():
     """One rank of the mission-control telemetry smoke: a few timed steps,
     rank 3 dragged by faultinject.slow_rank, telemetry flushed to the
@@ -1042,6 +1119,12 @@ def _child_main(mode, model):
             telemetry['cluster'] = bench_cluster_telemetry()
         except Exception as e:       # never sink smoke on telemetry
             telemetry['cluster'] = {'error': repr(e)}
+        try:
+            # FSDP sharded-training numbers (ISSUE 10): per-device param
+            # bytes at mesh 1/2/4/8 (~1/k), steps/sec vs DP, flat compiles
+            sharding_extras = bench_sharding()
+        except Exception as e:       # sharding bench must never sink smoke
+            sharding_extras = {'error': repr(e)}
         print(json.dumps({
             "metric": "bert_smoke_cpu_samples_per_sec",
             "value": round(sps, 2),
@@ -1049,7 +1132,8 @@ def _child_main(mode, model):
             "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
             "extras": {"telemetry": telemetry,
                        "serving": serving_extras,
-                       "engine": engine_extras},
+                       "engine": engine_extras,
+                       "sharding": sharding_extras},
             "complete": True,
         }))
 

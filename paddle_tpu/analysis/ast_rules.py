@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL015).
+"""The graftlint AST rule catalog (GL001–GL016).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -39,6 +39,13 @@ but destroys performance or correctness on real hardware:
   in-graph NaN guard come for free) or donate explicitly. Eval/predict
   steps (by name) are exempt — their params are read-only and must NOT
   be donated.
+
+- GL016: eager ``jax.device_put`` of a full params/opt-state pytree with
+  no sharding placement — on a >1-device mesh the whole model lands
+  replicated (or pinned to one device), exactly the per-device memory
+  ceiling FSDP removes; place params with ``distributed.sharding.
+  shard_tensor``/``fsdp_pspecs`` or let ``engine.build_train_step(
+  sharding=...)`` derive the ``NamedSharding``s.
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -912,6 +919,85 @@ class UndonatedTrainStateRule(Rule):
                 "donation, scan microbatching, in-graph NaN guard) or "
                 "pass donate_argnums/donate_argnames (eval/predict steps "
                 "are exempt by name)")
+
+
+# -- GL016: eager device_put of full (unsharded) param pytrees ----------------
+
+# names that mark a params/opt-state pytree at a device_put callsite (the
+# same tell GL015 uses for train-step signatures, plus the param-pytree
+# spellings the engine/hapi world uses)
+_PARAM_PYTREE_NAMES = {'params', 'param_values', 'param_vals', 'weights',
+                       'state', 'train_state', 'opt_state', 'opt_vals',
+                       'optimizer_state'}
+# calls whose RESULT is a param pytree: jax.device_put(param_values(net))
+_PARAM_PYTREE_CALLS = {'param_values', 'buffer_values', 'state_dict'}
+_DEVICE_LIST_CALLS = {'devices', 'local_devices'}
+
+
+def _is_param_pytree_arg(node):
+    if isinstance(node, ast.Call):
+        return _tail_name(node.func) in _PARAM_PYTREE_CALLS
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return _tail_name(node) in _PARAM_PYTREE_NAMES
+    return False
+
+
+def _is_single_device_pin(node):
+    """``jax.devices()[0]`` / ``jax.local_devices()[i]``-shaped placement:
+    the whole pytree lands on ONE device — worse than replicated."""
+    return (isinstance(node, ast.Subscript) and
+            isinstance(node.value, ast.Call) and
+            _tail_name(node.value.func) in _DEVICE_LIST_CALLS)
+
+
+@register
+class UnshardedParamDevicePutRule(Rule):
+    """GL016: eager ``jax.device_put`` of a full params/opt-state pytree
+    with no sharding placement. While a >1-device mesh is active this
+    replicates the whole model per device (or pins it to one), exactly
+    the per-device memory ceiling FSDP sharding removes — and the arrays
+    arrive committed, so the later jitted step cannot place them without
+    a reshard. Place params with ``distributed.sharding.shard_tensor``
+    (or derive specs via ``fsdp_pspecs``), or let
+    ``engine.build_train_step(sharding=...)`` device_put the state to
+    its derived ``NamedSharding``s. A ``device_put`` that already passes
+    a sharding/placement object is sanctioned."""
+    id = 'GL016'
+    title = 'eager device_put of full param pytree without sharding'
+
+    def in_scope(self, rel):
+        if rel.startswith(('tests/', 'tools/')):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _tail_name(node.func) != 'device_put':
+                continue
+            if not node.args or not _is_param_pytree_arg(node.args[0]):
+                continue
+            placement = node.args[1] if len(node.args) > 1 else None
+            if placement is None:
+                for kw in node.keywords:
+                    if kw.arg == 'device':
+                        placement = kw.value
+            if placement is not None and not _is_single_device_pin(placement):
+                continue   # NamedSharding/spec-shaped placement: sanctioned
+            what = 'pinned to a single device' if placement is not None \
+                else 'fully replicated (no placement)'
+            yield self.finding(
+                ctx, node,
+                f"eager jax.device_put of a full param pytree, {what} — "
+                "on a >1-device mesh this holds the complete params (and "
+                "later their Adam moments) per device, the memory ceiling "
+                "FSDP removes; shard with paddle_tpu.distributed.sharding."
+                "shard_tensor/fsdp_pspecs or let engine.build_train_step("
+                "sharding=...) place the state to derived NamedShardings")
 
 
 @register

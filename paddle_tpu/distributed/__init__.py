@@ -9,6 +9,8 @@ from .parallel import DataParallel, ParallelStrategy, prepare_context
 from . import fleet
 from . import sharding
 from .sharding import shard_tensor, shard_layer
+from . import strategy
+from .strategy import ShardingConfig, resolve_sharding
 from .ring_attention import ring_attention
 from . import pipeline
 from .pipeline import pipeline_apply

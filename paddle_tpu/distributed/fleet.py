@@ -56,21 +56,53 @@ class Fleet:
         self._strategy = None
         self._role = _RoleMaker()
         self._user_defined_optimizer = None
+        self._sharding_config = None
+
+    def sharding_config(self):
+        """The resolved ShardingConfig (None when sharding is off)."""
+        return self._sharding_config
 
     def init(self, role_maker=None, is_collective=True, strategy=None,
              mesh_shape=None, axis_names=None):
         self._strategy = strategy or DistributedStrategy()
+        wants_sharding = strategy is not None and (strategy.sharding or
+                                                   strategy.tensor_parallel)
         if not env.is_initialized():
-            if strategy is not None and strategy.tensor_parallel:
-                tp = strategy.tensor_parallel_configs.get(
-                    'tensor_parallel_degree', 1)
+            if wants_sharding and mesh_shape is None:
+                # same knob normalization as strategy.resolve_sharding
+                # (0/None mean "off"), so a bad degree fails with the
+                # named error instead of a bare ZeroDivisionError
+                tp = (int(strategy.tensor_parallel_configs.get(
+                    'tensor_parallel_degree', 1) or 1)
+                    if strategy.tensor_parallel else 1)
                 import jax
                 total = jax.device_count()
+                if total % tp:
+                    raise ValueError(
+                        f"tensor_parallel_degree={tp} does not divide the "
+                        f"{total} available devices")
                 env.init_parallel_env((total // tp, tp),
                                       (env.DATA_AXIS, env.MODEL_AXIS))
             else:
+                # an explicit mesh_shape always wins — the resolver adopts
+                # the installed mesh (or raises if its axes cannot carry
+                # the requested plan)
                 env.init_parallel_env(mesh_shape, axis_names)
+        self._install_sharding(strategy if wants_sharding else None)
         return self
+
+    def _install_sharding(self, strategy):
+        """Resolve-or-raise the strategy's sharding knobs into THE config
+        (validating companion knobs — an unsupported combination raises
+        instead of silently training unsharded) and install it process-
+        wide so every frontend (hapi ``strategy=``, ``engine.fit``, the
+        Executor dp path) compiles against the same plan. ``None`` (or
+        knobs off) installs None — a stale global would silently keep
+        sharding after the knob is turned off."""
+        from . import strategy as _strategy
+        self._sharding_config = (_strategy.resolve_sharding(strategy)
+                                 if strategy is not None else None)
+        _strategy.set_current_config(self._sharding_config)
 
     # role predicates -------------------------------------------------------
     def is_first_worker(self):
@@ -114,6 +146,8 @@ class Fleet:
     def distributed_optimizer(self, optimizer, strategy=None):
         self._strategy = strategy or self._strategy or DistributedStrategy()
         st = self._strategy
+        self._install_sharding(st if (st.sharding or st.tensor_parallel)
+                               else None)
         # lamb/lars meta-optimizers: swap the inner update rule, keeping the
         # user's learning rate, parameters and grad clip (the reference's
         # LambOptimizer/LarsOptimizer meta passes do the same rewrite)
@@ -138,7 +172,8 @@ class Fleet:
                                      parameters=optimizer._parameters,
                                      grad_clip=optimizer._grad_clip, **kw)
         self._user_defined_optimizer = optimizer
-        return _DistributedOptimizer(optimizer, st)
+        return _DistributedOptimizer(optimizer, st,
+                                     sharding_config=self._sharding_config)
 
     def distributed_model(self, model):
         from .parallel import DataParallel
@@ -155,11 +190,19 @@ class Fleet:
 
 
 class _DistributedOptimizer:
-    """Wraps an optimizer: allreduce-mean grads over 'data' before stepping."""
+    """Wraps an optimizer: allreduce-mean grads over 'data' before stepping.
 
-    def __init__(self, inner, strategy):
+    Carries the resolved ``sharding_config`` (when the strategy asked for
+    ZeRO/FSDP or tensor parallelism) and forwards the functional-update
+    surface, so ``engine.build_train_step``/hapi ``Model.prepare`` accept
+    the wrapper anywhere a bare Optimizer works — the compiled sharded
+    step and the eager allreduce path stay ONE optimizer object.
+    """
+
+    def __init__(self, inner, strategy, sharding_config=None):
         self.inner = inner
         self.strategy = strategy
+        self.sharding_config = sharding_config
         self._accum = 0
         self._scaled_pending = False
         self._scaler = None
@@ -173,8 +216,19 @@ class _DistributedOptimizer:
     def _parameters(self):
         return self.inner._parameters
 
+    @property
+    def _accumulators(self):
+        return self.inner._accumulators
+
     def get_lr(self):
         return self.inner.get_lr()
+
+    # functional surface (engine.build_train_step consumes these)
+    def init_state_values(self, param_values):
+        return self.inner.init_state_values(param_values)
+
+    def functional_update(self, *args, **kwargs):
+        return self.inner.functional_update(*args, **kwargs)
 
     @no_grad()
     def _sync_grads(self):

@@ -20,7 +20,7 @@ from . import env
 
 __all__ = ['shard_tensor', 'shard_layer', 'ColumnParallelLinear',
            'RowParallelLinear', 'VocabParallelEmbedding', 'param_pspecs',
-           'fsdp_pspecs']
+           'fsdp_pspecs', 'first_divisible_spec']
 
 
 def shard_tensor(x, spec):
@@ -57,22 +57,44 @@ def param_pspecs(layer, rules, default=P()):
     return out
 
 
-def fsdp_pspecs(layer, axis=env.DATA_AXIS, min_size=1024):
-    """ZeRO-3 style: shard every large param's first divisible dim over `axis`."""
-    mesh = env.get_mesh()
-    n = env.get_world_size(axis)
+def fsdp_pspecs(layer, axis=env.DATA_AXIS, min_size=1024, n=None):
+    """ZeRO-3 style: shard every large param's first divisible dim over
+    ``axis``.
+
+    ``layer`` may be an ``nn.Layer`` or a plain ``{name: value}`` dict
+    (raw arrays / Tensors / shape tuples — the engine's functional param
+    pytree). Partitioning is conservative by construction: a param smaller
+    than ``min_size`` elements, or whose dims are all *unevenly* sized for
+    the ``n``-way axis (e.g. an odd-sized vocab embedding), falls back to
+    replicated instead of failing inside pjit with a non-divisible-shard
+    error. ``n`` overrides the mesh-derived axis size (so specs can be
+    derived before the mesh is installed)."""
+    if n is None:
+        n = env.get_world_size(axis)
+    items = (layer.named_parameters() if hasattr(layer, 'named_parameters')
+             else layer.items())
     out = {}
-    for name, p in layer.named_parameters():
-        spec = P()
-        if n > 1 and p.size >= min_size:
-            for d, s in enumerate(p.shape):
-                if s % n == 0:
-                    parts = [None] * len(p.shape)
-                    parts[d] = axis
-                    spec = P(*parts)
-                    break
-        out[name] = spec
+    for name, p in items:
+        shape = tuple(p) if isinstance(p, (tuple, list)) \
+            else tuple(np.shape(p) if not hasattr(p, 'shape') else p.shape)
+        out[name] = first_divisible_spec(shape, n, axis, min_size)
     return out
+
+
+def first_divisible_spec(shape, n, axis_entry, min_size):
+    """THE FSDP partitioning policy, in one place (``fsdp_pspecs`` and
+    ``strategy.ShardingConfig`` both apply it): shard the first dim evenly
+    divisible by ``n`` over ``axis_entry`` (an axis name or tuple of axis
+    names); params under ``min_size`` elements or with no divisible dim
+    stay replicated — a partial shard would pad silently or die in pjit."""
+    size = int(np.prod(shape or (1,)))
+    if n > 1 and size >= min_size:
+        for d, s in enumerate(shape):
+            if s % n == 0:
+                parts = [None] * len(shape)
+                parts[d] = axis_entry
+                return P(*parts)
+    return P()
 
 
 class ColumnParallelLinear(Layer):

@@ -1,0 +1,378 @@
+"""Resolved sharding strategy: the one config every frontend consumes.
+
+The fleet ``DistributedStrategy`` knobs (``sharding``, ``tensor_parallel``)
+and the hapi/engine ``strategy=``/``sharding=`` arguments all resolve to a
+:class:`ShardingConfig` — a 2D ``data`` × ``model`` device mesh plus the
+partitioning rules for the whole train-step state pytree — which
+``engine.build_train_step(sharding=...)`` turns into ``jax.jit``
+in-shardings + in-graph ``with_sharding_constraint``s (docs/PERF.md,
+"Sharded training").
+
+The FSDP recipe follows ZeRO (Rajbhandari et al.): parameters and
+optimizer moments live *sharded at rest* (each device holds ``1/k`` of
+every large tensor), are all-gathered at use time inside the step, and the
+gradient/update math reshards on the way back out. Because the gather
+makes the compute bitwise-identical to the replicated (data-parallel)
+step, sharding is a pure memory/bandwidth trade — asserted bitwise in
+tier-1. Tensor parallelism composes on the ``model`` axis: params matched
+by a tensor-parallel rule keep their Megatron-style layout (see
+``sharding.ColumnParallelLinear``/``RowParallelLinear``) and are *not*
+gathered; GSPMD inserts the collectives their sharding implies.
+"""
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import env
+
+__all__ = ['ShardingConfig', 'resolve_sharding', 'current_config',
+           'set_current_config']
+
+# the resolved config fleet.init()/distributed_optimizer() installed, so
+# frontends that never see the strategy object (the Executor dp path) still
+# find it; None means "no sharding requested"
+_current = [None]
+
+
+def set_current_config(config):
+    _current[0] = config
+
+
+def current_config():
+    return _current[0]
+
+
+def _leaf_shape(v):
+    """Shape of a param leaf: raw array, Tensor, or an explicit shape."""
+    shape = getattr(v, 'shape', None)
+    if shape is None and isinstance(v, (tuple, list)):
+        return tuple(v)
+    return tuple(shape)
+
+
+def _dtype_size(v):
+    try:
+        return np.dtype(v.dtype).itemsize
+    except Exception:
+        return 4
+
+
+class ShardingConfig:
+    """The resolved sharding plan a train step compiles against.
+
+    - ``mesh``: a 2D jax Mesh with axes ``(data_axis, model_axis)``
+      (built from all local devices when not given; ``model`` axis size =
+      ``tensor_parallel_degree``).
+    - ``fsdp``: shard params + optimizer moments over ``fsdp_axes``
+      (default: the data axis) — each param's first evenly-divisible dim
+      is partitioned; params smaller than ``min_size`` elements, or with
+      no divisible dim (the uneven-leading-dim case), stay replicated.
+    - ``param_rules``: ``{name-substring: PartitionSpec}`` tensor-parallel
+      placement rules; matched params keep this layout *through* the step
+      (no use-time gather) so Column/Row-parallel layers compose.
+    - ``gather_params``: constrain FSDP-sharded params to replicated at
+      use time inside the step (the ZeRO gather). On: compute is
+      bitwise-identical to the replicated step. Off: GSPMD propagates the
+      sharded layouts into the matmuls (faster at scale, not bitwise).
+    """
+
+    def __init__(self, mesh=None, data_axis=env.DATA_AXIS,
+                 model_axis=env.MODEL_AXIS, fsdp=True, min_size=1024,
+                 fsdp_axes=None, tensor_parallel_degree=1, param_rules=None,
+                 gather_params=True):
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        self.fsdp = bool(fsdp)
+        self.min_size = int(min_size)
+        self.tensor_parallel_degree = int(tensor_parallel_degree)
+        self.param_rules = dict(param_rules or {})
+        self.gather_params = bool(gather_params)
+        if mesh is None:
+            mesh = self._default_mesh()
+        self.mesh = mesh
+        self.fsdp_axes = tuple(fsdp_axes) if fsdp_axes else (data_axis,)
+        for ax in self.fsdp_axes + ((model_axis,)
+                                    if self.tensor_parallel_degree > 1
+                                    else ()):
+            if ax not in mesh.axis_names:
+                raise ValueError(
+                    f"ShardingConfig: axis {ax!r} not in mesh axes "
+                    f"{mesh.axis_names}")
+
+    def _default_mesh(self):
+        existing = env.get_mesh()
+        tp = self.tensor_parallel_degree
+        if existing is not None:
+            names = existing.axis_names
+            if self.data_axis in names and \
+                    (tp <= 1 or existing.shape.get(self.model_axis, 1) == tp):
+                return existing
+            # building a second, divergent mesh here would silently split
+            # the world: eager collectives/get_world_size on the installed
+            # mesh, the compiled step on ours — fail loudly instead
+            raise ValueError(
+                f"the installed device mesh (axes {dict(existing.shape)}) "
+                f"cannot carry this sharding plan (need axis "
+                f"{self.data_axis!r}"
+                + (f" and {self.model_axis!r} of size {tp}" if tp > 1
+                   else "")
+                + "); re-init the mesh or pass mesh= explicitly")
+        devices = np.asarray(jax.devices())
+        total = len(devices)
+        if tp > 1:
+            if total % tp:
+                raise ValueError(
+                    f"tensor_parallel_degree={tp} does not divide the "
+                    f"{total} available devices")
+            shape, names = (total // tp, tp), (self.data_axis,
+                                               self.model_axis)
+        else:
+            shape, names = (total,), (self.data_axis,)
+        return Mesh(devices.reshape(shape), names)
+
+    # -- sizes ---------------------------------------------------------------
+    @property
+    def data_degree(self):
+        return int(self.mesh.shape.get(self.data_axis, 1))
+
+    @property
+    def fsdp_degree(self):
+        n = 1
+        for ax in self.fsdp_axes:
+            n *= int(self.mesh.shape.get(ax, 1))
+        return n
+
+    @property
+    def num_devices(self):
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    # -- spec derivation -----------------------------------------------------
+    def _tp_spec(self, name):
+        for pat, spec in self.param_rules.items():
+            if pat in name:
+                return spec if isinstance(spec, P) else P(*spec)
+        return None
+
+    def _fsdp_spec(self, shape):
+        """The shared first-evenly-divisible-dim policy (see
+        ``sharding.first_divisible_spec``) over the FSDP axes; uneven or
+        under-``min_size`` params fall back to replicated."""
+        from .sharding import first_divisible_spec
+        axes = self.fsdp_axes[0] if len(self.fsdp_axes) == 1 \
+            else self.fsdp_axes
+        return first_divisible_spec(shape, self.fsdp_degree, axes,
+                                    self.min_size)
+
+    def param_specs(self, params):
+        """``{name: PartitionSpec}`` for a params dict (name → value)."""
+        out = {}
+        for name, v in params.items():
+            spec = self._tp_spec(name)
+            if spec is None:
+                spec = self._fsdp_spec(_leaf_shape(v)) if self.fsdp else P()
+            out[name] = spec
+        return out
+
+    def with_rules_from(self, layer):
+        """A config augmented with tensor-parallel rules read off the
+        layer's *eager* placements: ``ColumnParallelLinear``/
+        ``RowParallelLinear``/``VocabParallelEmbedding`` already
+        ``shard_tensor`` their weights onto the model axis at construction
+        time, and the compiled step must keep that layout rather than
+        FSDP-shard (or gather) it. Params whose eager sharding does not
+        touch the model axis are left to the FSDP rules."""
+        rules = dict(self.param_rules)
+        added = False
+        for name, p in layer.named_parameters():
+            sh = getattr(getattr(p, '_value', None), 'sharding', None)
+            if not isinstance(sh, NamedSharding):
+                continue
+            axes = set()
+            for part in sh.spec:
+                if part is not None:
+                    axes.update(part if isinstance(part, tuple) else (part,))
+            if self.model_axis in axes and name not in rules:
+                rules[name] = sh.spec
+                added = True
+        if not added:
+            return self
+        import copy
+        clone = copy.copy(self)
+        clone.param_rules = rules
+        return clone
+
+    def gather_names(self, params, specs=None):
+        """Params to all-gather at use time: the FSDP-sharded ones.
+        Tensor-parallel (rule-matched) params keep their layout through
+        the compute — gathering them would undo the parallelism."""
+        if not self.gather_params:
+            return frozenset()
+        specs = specs if specs is not None else self.param_specs(params)
+        return frozenset(n for n, spec in specs.items()
+                         if spec != P() and self._tp_spec(n) is None)
+
+    # -- sharding pytrees ----------------------------------------------------
+    def named(self, spec):
+        return NamedSharding(self.mesh, spec if isinstance(spec, P)
+                             else P(*spec))
+
+    def replicated(self):
+        return self.named(P())
+
+    def _slot_sharding(self, param_shape, param_spec, leaf):
+        """An optimizer slot shards like its param when the shapes match
+        (Adam moments); scalar/odd-shaped slots (beta powers, step counts)
+        replicate."""
+        if _leaf_shape(leaf) == tuple(param_shape):
+            return self.named(param_spec)
+        return self.replicated()
+
+    def state_shardings(self, state, specs=None):
+        """NamedSharding pytree matching the engine state dict
+        (``{'params', 'buffers', 'opt', 'guard'?, 'scaler'?}``)."""
+        params = state['params']
+        specs = specs if specs is not None else self.param_specs(params)
+        repl = self.replicated()
+        sh = {'params': {n: self.named(specs.get(n, P()))
+                         for n in params},
+              'buffers': jax.tree_util.tree_map(lambda _: repl,
+                                                state.get('buffers', {}))}
+        opt_sh = {}
+        for n, slots in state.get('opt', {}).items():
+            pshape = _leaf_shape(params[n]) if n in params else None
+            pspec = specs.get(n, P())
+            if pshape is None:
+                opt_sh[n] = jax.tree_util.tree_map(lambda _: repl, slots)
+            else:
+                opt_sh[n] = jax.tree_util.tree_map(
+                    lambda leaf: self._slot_sharding(pshape, pspec, leaf),
+                    slots)
+        sh['opt'] = opt_sh
+        for extra in ('guard', 'scaler'):
+            if extra in state:
+                sh[extra] = jax.tree_util.tree_map(lambda _: repl,
+                                                   state[extra])
+        return sh
+
+    def batch_sharding(self, microbatch=1):
+        """Feeds shard over the data axis on their batch dim (axis 0, or
+        axis 1 under scan microbatching where axis 0 is the scan axis)."""
+        spec = P(self.data_axis) if microbatch <= 1 \
+            else P(None, self.data_axis)
+        return self.named(spec)
+
+    # -- placement + accounting ----------------------------------------------
+    def device_put_state(self, state, shardings=None):
+        if shardings is None:
+            shardings = self.state_shardings(state)
+        return jax.device_put(state, shardings)
+
+    def bytes_per_device(self, tree):
+        """Per-device resident bytes of a (sharded) pytree — reads
+        ``sharding.shard_shape``, so it reports what one device actually
+        holds, not the global logical size."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shape = _leaf_shape(leaf)
+            sharding = getattr(leaf, 'sharding', None)
+            if sharding is not None:
+                try:
+                    shape = sharding.shard_shape(shape)
+                except Exception:
+                    pass
+            total += int(np.prod(shape or (1,))) * _dtype_size(leaf)
+        return total
+
+    def collective_bytes_estimate(self, params, specs=None):
+        """Analytic per-step cross-device traffic of the FSDP recipe, per
+        device: all-gather each sharded param for forward+backward (each
+        device receives the (k-1)/k it does not hold, twice) plus the
+        grad reshard on the way out (sends the (k-1)/k it does not keep).
+        An estimate — compiled collectives never cross the host, so the
+        eager byte counters cannot see them."""
+        specs = specs if specs is not None else self.param_specs(params)
+        k = self.fsdp_degree
+        if k <= 1:
+            return 0
+        total = 0
+        for name, v in params.items():
+            if specs.get(name, P()) == P() or self._tp_spec(name):
+                continue
+            nbytes = int(np.prod(_leaf_shape(v) or (1,))) * _dtype_size(v)
+            total += 3 * nbytes * (k - 1) // k
+        return total
+
+    def describe(self):
+        return {'mesh': dict(self.mesh.shape),
+                'fsdp': self.fsdp, 'fsdp_axes': list(self.fsdp_axes),
+                'min_size': self.min_size,
+                'tensor_parallel_degree': self.tensor_parallel_degree,
+                'gather_params': self.gather_params,
+                'tp_rules': {k: str(v) for k, v in self.param_rules.items()}}
+
+
+# knobs on fleet.DistributedStrategy that have NO sharded-step
+# implementation: accepting them silently would let users believe they
+# sharded/compressed when they did not (the exact bug this module fixes
+# for .sharding itself)
+_UNSUPPORTED_WITH_SHARDING = ('dgc', 'pipeline', 'hierarchical_allreduce')
+_SHARDING_CONFIG_KEYS = {'min_size', 'gather_params', 'fsdp_axes',
+                         'sharding_degree', 'stage'}
+_TP_CONFIG_KEYS = {'tensor_parallel_degree', 'param_rules'}
+
+
+def resolve_sharding(obj, params_rules=None):
+    """Normalize anything a frontend accepts into a ShardingConfig.
+
+    ``None`` → None (unsharded); a ``ShardingConfig`` passes through; a
+    fleet ``DistributedStrategy`` with ``sharding``/``tensor_parallel``
+    set resolves (and *validates* — unsupported companion knobs raise
+    ``NotImplementedError`` instead of silently doing nothing); a plain
+    dict is treated as ShardingConfig kwargs.
+    """
+    if obj is None or isinstance(obj, ShardingConfig):
+        return obj
+    if isinstance(obj, dict):
+        return ShardingConfig(**obj)
+    # fleet.DistributedStrategy duck-typed (import cycle: fleet imports us)
+    if hasattr(obj, 'sharding') and hasattr(obj, 'tensor_parallel'):
+        if not (obj.sharding or obj.tensor_parallel):
+            return None
+        for knob in _UNSUPPORTED_WITH_SHARDING:
+            if getattr(obj, knob, False):
+                raise NotImplementedError(
+                    f"DistributedStrategy.{knob}=True has no sharded-step "
+                    f"implementation — combined with sharding/"
+                    f"tensor_parallel it would be silently ignored; unset "
+                    f"it or drop the sharding flags")
+        scfg = dict(getattr(obj, 'sharding_configs', None) or {})
+        stage = scfg.pop('stage', None)
+        if stage is not None and stage not in (2, 3):
+            raise NotImplementedError(
+                f"sharding_configs['stage']={stage!r}: only the ZeRO "
+                f"stage-2/3 recipe (params + optimizer states sharded at "
+                f"rest, gathered at use) is implemented")
+        scfg.pop('sharding_degree', None)   # degree follows the mesh
+        unknown = set(scfg) - _SHARDING_CONFIG_KEYS
+        if unknown:
+            raise NotImplementedError(
+                f"sharding_configs keys {sorted(unknown)} are not "
+                f"implemented (supported: {sorted(_SHARDING_CONFIG_KEYS)})")
+        tcfg = dict(getattr(obj, 'tensor_parallel_configs', None) or {})
+        tp = int(tcfg.pop('tensor_parallel_degree', 1) or 1)
+        if not obj.tensor_parallel:
+            tp = 1
+        rules = tcfg.pop('param_rules', None)
+        if tcfg:
+            raise NotImplementedError(
+                f"tensor_parallel_configs keys {sorted(tcfg)} are not "
+                f"implemented (supported: {sorted(_TP_CONFIG_KEYS)})")
+        return ShardingConfig(
+            fsdp=bool(obj.sharding),
+            tensor_parallel_degree=tp,
+            param_rules=rules or params_rules,
+            **scfg)
+    raise TypeError(
+        f"cannot resolve a sharding config from {type(obj).__name__!r} "
+        f"(pass a ShardingConfig, a fleet.DistributedStrategy, a kwargs "
+        f"dict, or None)")

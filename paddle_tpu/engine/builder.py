@@ -239,7 +239,7 @@ def build_train_step(loss_fn=None, optimizer=None, *, net=None, loss=None,
                      params_meta=None, trainable=None, scaler=None,
                      nan_guard=False, microbatch=1, donate='auto',
                      remat=None, matmul_precision='auto', with_key=None,
-                     in_shardings=None):
+                     in_shardings=None, sharding=None):
     """Compile ONE train step every frontend can share.
 
     Either pass a pure ``loss_fn(params, buffers, batch, key) ->
@@ -267,6 +267,17 @@ def build_train_step(loss_fn=None, optimizer=None, *, net=None, loss=None,
     - ``in_shardings``: passed straight to ``jax.jit`` for sharded feeds
       (the Executor's data-parallel compile); the pytree must match the
       step signature ``(state, batch[, keys])``.
+    - ``sharding``: a ``distributed.ShardingConfig`` (or a fleet
+      ``DistributedStrategy`` / kwargs dict — resolved via
+      ``distributed.strategy.resolve_sharding``). The whole state pytree
+      gets ``NamedSharding``s derived from the config's FSDP/tensor-
+      parallel rules: params + optimizer moments live sharded at rest
+      (and stay sharded through donation and the scan carry), feeds
+      shard over the data axis, and FSDP params are gathered at use time
+      inside the step so the math is bitwise-identical to the replicated
+      step (docs/PERF.md, "Sharded training"). The jit program is built
+      lazily by :meth:`TrainStep.init_state`, which also places the
+      state and records ``sharding.param_bytes_per_device``.
     """
     if net is not None:
         if loss_fn is not None:
@@ -292,6 +303,17 @@ def build_train_step(loss_fn=None, optimizer=None, *, net=None, loss=None,
                          f"got {microbatch}")
     if scaler is not None and not scaler.is_enable():
         scaler = None
+    if sharding is not None:
+        from ..distributed.strategy import resolve_sharding
+        sharding = resolve_sharding(sharding)
+    if sharding is not None and net is not None:
+        # tensor-parallel layers placed their weights on the model axis
+        # eagerly (shard_tensor at construction) — the compiled step keeps
+        # those layouts instead of FSDP-sharding/gathering them
+        sharding = sharding.with_rules_from(net)
+    if sharding is not None and in_shardings is not None:
+        raise ValueError("build_train_step: sharding= derives the step's "
+                         "in_shardings itself — pass one or the other")
     return TrainStep(loss_fn, optimizer, params_meta=params_meta,
                      # an EMPTY set is a real filter (every param frozen:
                      # update nothing) — only None means "no filter"
@@ -300,7 +322,7 @@ def build_train_step(loss_fn=None, optimizer=None, *, net=None, loss=None,
                      scaler=scaler, nan_guard=bool(nan_guard), microbatch=k,
                      donate=donate, remat=remat,
                      matmul_precision=matmul_precision, with_key=with_key,
-                     in_shardings=in_shardings)
+                     in_shardings=in_shardings, sharding=sharding)
 
 
 class TrainStep:
@@ -308,11 +330,12 @@ class TrainStep:
 
     def __init__(self, loss_fn, optimizer, params_meta, trainable, scaler,
                  nan_guard, microbatch, donate, remat, matmul_precision,
-                 with_key, in_shardings):
+                 with_key, in_shardings, sharding=None):
         self.optimizer = optimizer
         self.k = microbatch
         self.guard_enabled = nan_guard
         self.scaler = scaler
+        self.sharding = sharding
         self._params_meta = params_meta
         self._trainable = trainable
         self._with_key = with_key
@@ -325,6 +348,19 @@ class TrainStep:
         self._matmul_precision = matmul_precision
         self.donates = donation_supported() if donate == 'auto' \
             else bool(donate)
+        # sharded-state wiring (filled by init_state once the real state
+        # pytree exists — shardings must match its exact structure)
+        self._gather = frozenset()
+        self._state_constraints = None
+        self._state_shardings = None
+        self._batch_sharding = None
+        self._collective_bytes_est = 0
+        if sharding is not None:
+            # the jit program needs the state pytree's shardings: built
+            # lazily by init_state (which every frontend goes through)
+            self._jit = None
+            self._batch_sharding = sharding.batch_sharding(self.k)
+            return
         jit_kwargs = {}
         if self.donates:
             jit_kwargs['donate_argnums'] = (0,)
@@ -367,7 +403,81 @@ class TrainStep:
                 'good': jnp.int32(s._good_steps),
                 'bad': jnp.int32(s._bad_steps),
             }
+        if self.sharding is not None:
+            state = self._shard_state(state)
         return state
+
+    def _shard_state(self, state):
+        """Place the state on the mesh per the config and (first time)
+        compile the sharded step against its exact pytree structure.
+        Derivation + telemetry run once; repeat calls (the Executor runs
+        init_state per step to adopt fresh eager params) only pay the
+        device_put — which is a no-op for already-placed leaves."""
+        cfg = self.sharding
+        first = self._jit is None
+        if first:
+            specs = cfg.param_specs(state['params'])
+            shardings = cfg.state_shardings(state, specs)
+            self._gather = cfg.gather_names(state['params'], specs)
+            self._state_shardings = shardings
+            self._state_constraints = {
+                'params': shardings['params'], 'opt': shardings['opt']}
+            self._collective_bytes_est = cfg.collective_bytes_estimate(
+                state['params'], specs)
+            repl = cfg.replicated()
+            jit_kwargs = {
+                'in_shardings': (
+                    (shardings, self._batch_sharding) +
+                    ((repl,) if self._with_key else ())),
+                # pin outputs to the SAME NamedShardings as the inputs:
+                # without this the output state carries GSPMD-inferred
+                # sharding objects that compare unequal to the input
+                # NamedShardings, and every call re-traces (the XLA cache
+                # hides it from jax.compiles, but the jit cache grows)
+                'out_shardings': (shardings, repl, repl),
+            }
+            if self.donates:
+                jit_kwargs['donate_argnums'] = (0,)
+            self._jit = jax.jit(self._make_step(), **jit_kwargs)
+        state = cfg.device_put_state(state, self._state_shardings)
+        if first and _obs.enabled():
+            _obs.gauge('sharding.param_bytes_per_device').set(
+                cfg.bytes_per_device(state['params']))
+            _obs.gauge('sharding.opt_bytes_per_device').set(
+                cfg.bytes_per_device(state['opt']))
+            _obs.gauge('sharding.state_bytes_per_device').set(
+                cfg.bytes_per_device(state))
+            _obs.gauge('sharding.mesh_devices').set(cfg.num_devices)
+            _obs.gauge('sharding.collective_bytes_per_step_est').set(
+                self._collective_bytes_est)
+        return state
+
+    def sharding_info(self, state):
+        """Per-device residency + traffic accounting for a (sharded)
+        state — what bench/tier-1 assert the memory win with."""
+        cfg = self.sharding
+        if cfg is None:
+            nbytes = sum(
+                int(np.prod(np.shape(leaf) or (1,))) *
+                np.dtype(getattr(leaf, 'dtype', np.float32)).itemsize
+                for leaf in jax.tree_util.tree_leaves(state))
+            return {'param_bytes_per_device': sum(
+                        int(np.prod(np.shape(v) or (1,))) *
+                        np.dtype(getattr(v, 'dtype', np.float32)).itemsize
+                        for v in state['params'].values()),
+                    'state_bytes_per_device': nbytes,
+                    'mesh_devices': 1, 'collective_bytes_per_step_est': 0,
+                    'sharded_params': 0}
+        specs = cfg.param_specs(state['params'])
+        from jax.sharding import PartitionSpec as _P
+        return {
+            'param_bytes_per_device': cfg.bytes_per_device(state['params']),
+            'opt_bytes_per_device': cfg.bytes_per_device(state['opt']),
+            'state_bytes_per_device': cfg.bytes_per_device(state),
+            'mesh_devices': cfg.num_devices,
+            'collective_bytes_per_step_est': self._collective_bytes_est,
+            'sharded_params': sum(1 for s in specs.values() if s != _P()),
+        }
 
     # -- the compiled step ---------------------------------------------------
     def _make_step(self):
@@ -375,8 +485,19 @@ class TrainStep:
         k = self.k
         precision = self._matmul_precision
         with_key = self._with_key
+        batch_sharding = self._batch_sharding
+
+        def constrain_batch(batch):
+            # pin activations to the data axis at the step boundary so
+            # GSPMD keeps the batch dim sharded through the network
+            # instead of inferring a replicated layout from the params
+            return jax.tree_util.tree_map(
+                lambda v: jax.lax.with_sharding_constraint(v, batch_sharding),
+                batch)
 
         def run(state, batch, keys):
+            if batch_sharding is not None:
+                batch = constrain_batch(batch)
             if k == 1:
                 key = keys
                 return one(state, batch, key)
@@ -415,6 +536,19 @@ class TrainStep:
         params, buffers = state['params'], state['buffers']
         opt_state = state['opt']
         scale = state['scaler']['scale'] if use_scaler else None
+        if self._gather:
+            # the ZeRO use-time gather: FSDP-sharded params become
+            # replicated for the forward/backward, so every reduction
+            # runs in the same order as the replicated step (bitwise
+            # parity); tensor-parallel params are NOT in the gather set —
+            # their sharding IS the parallelism. The constraint's
+            # transpose keeps the cotangent replicated; the update math
+            # is elementwise, and the carry constraint below reshards
+            # the new state on the way out.
+            repl = self.sharding.replicated()
+            params = {n: (jax.lax.with_sharding_constraint(v, repl)
+                          if n in self._gather else v)
+                      for n, v in params.items()}
 
         def scaled_loss(p):
             loss, outs, new_buf = loss_fn(p, buffers, batch, key)
@@ -467,6 +601,16 @@ class TrainStep:
             }
         if use_scaler:
             new_state['scaler'] = self._advance_scaler(state['scaler'], ok)
+        if self._state_constraints is not None:
+            # reshard the updated params/opt on the way out: the scan
+            # carry (and the donated output buffers) stay sharded across
+            # microbatches instead of riding replicated through the loop
+            wsc = jax.lax.with_sharding_constraint
+            new_state['params'] = {
+                n: wsc(v, self._state_constraints['params'][n])
+                for n, v in new_state['params'].items()}
+            new_state['opt'] = jax.tree_util.tree_map(
+                wsc, new_state['opt'], self._state_constraints['opt'])
         return new_state, loss, outs
 
     def _advance_scaler(self, sc, ok):
@@ -494,6 +638,19 @@ class TrainStep:
         if self._with_key and key is None:
             raise ValueError("this TrainStep was built with_key=True — pass "
                              "key= (k stacked keys for microbatch>1)")
+        if self.sharding is not None:
+            if self._jit is None:
+                raise RuntimeError(
+                    "sharded TrainStep: call init_state() first — it "
+                    "derives the state shardings and compiles the step")
+            # feeds go straight to their mesh placement (device_put on an
+            # already-matching array is a no-op), so a committed host/
+            # single-device batch never fights the jit's in_shardings
+            bsh = self._batch_sharding
+            batch = jax.tree_util.tree_map(
+                lambda v: jax.device_put(v, bsh), batch)
+            if key is not None:
+                key = jax.device_put(key, self.sharding.replicated())
         telemetry = _obs.enabled()
         if telemetry:
             with _obs.timer('engine.step', k=self.k):
@@ -501,6 +658,9 @@ class TrainStep:
                     else self._jit(state, batch)
             _obs.counter('engine.steps').inc(self.k)
             _obs.counter('engine.dispatches').inc()
+            if self._collective_bytes_est:
+                _obs.counter('sharding.collective_bytes_est').inc(
+                    self._collective_bytes_est * self.k)
         else:
             out = self._jit(state, batch, key) if self._with_key \
                 else self._jit(state, batch)

@@ -12,6 +12,8 @@ folded in, and ``lax.scan`` microbatching — fed through the DataLoader
 device prefetcher so batch assembly overlaps compute. Losses stay
 on-device and are fetched at ``log_every`` cadence only.
 """
+import functools
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -125,7 +127,7 @@ def _grouped(data, k):
 
 def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
         log_every=10, nan_guard=None, scaler=None, prefetch=2,
-        remat=None, donate='auto', matmul_precision='auto'):
+        remat=None, donate='auto', matmul_precision='auto', sharding=None):
     """Train ``network`` over ``data`` through the unified compiled step.
 
     ``data``: a DataLoader or any iterable of ``(inputs, labels)`` batches
@@ -134,7 +136,10 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
     ``resilience.NanGuard`` (or True for a default one). Losses are
     fetched to host every ``log_every`` dispatches; guard/scaler host
     state reconciles on the same cadence (bounded by the guard's
-    consecutive-skip limit).
+    consecutive-skip limit). ``sharding``: a ``distributed.ShardingConfig``
+    (or fleet ``DistributedStrategy``) — params/optimizer state shard over
+    the mesh through the compiled step, feeds shard over the data axis
+    (docs/PERF.md, "Sharded training").
 
     Returns a report dict: floated losses at log cadence, step counts,
     steps/sec, and the final functional state (already written back into
@@ -150,7 +155,8 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
     step = build_train_step(net=network, loss=loss, optimizer=optimizer,
                             scaler=scaler, nan_guard=nan_guard is not None,
                             microbatch=microbatch, donate=donate,
-                            remat=remat, matmul_precision=matmul_precision)
+                            remat=remat, matmul_precision=matmul_precision,
+                            sharding=sharding)
     network.train()
     pv = param_values(network)
     state = step.init_state(
@@ -173,8 +179,14 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
             source = _grouped(data, k)
             if prefetch:
                 from ..io.dataloader import DevicePrefetcher
+                convert = _batch_to_device
+                if step.sharding is not None:
+                    # prefetch straight to the mesh placement: uploading to
+                    # the default device first would reshard on every step
+                    convert = functools.partial(_batch_to_mesh,
+                                                step._batch_sharding)
                 source = DevicePrefetcher(source, depth=int(prefetch),
-                                          convert=_batch_to_device)
+                                          convert=convert)
             for bx, by in source:
                 if k == 1:
                     key = _rng.next_key()
@@ -213,3 +225,12 @@ def _batch_to_device(batch):
     bx, by = batch
     return (tuple(jnp.asarray(v) for v in bx),
             tuple(jnp.asarray(v) for v in by))
+
+
+def _batch_to_mesh(batch_sharding, batch):
+    """Sharded-step converter: upload each leaf directly to its mesh
+    placement (batch dim over the data axis)."""
+    import jax
+    bx, by = batch
+    return (tuple(jax.device_put(v, batch_sharding) for v in bx),
+            tuple(jax.device_put(v, batch_sharding) for v in by))

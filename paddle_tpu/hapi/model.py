@@ -28,6 +28,7 @@ class Model:
         self._jit_step = None
         self._jit_state = None
         self._use_jit = False
+        self._sharding_cfg = None
         self._scaler = None
         self._nan_guard = None
         self._epoch_start_rng = None
@@ -36,9 +37,10 @@ class Model:
 
     # -- setup --------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, jit=False,
-                amp_configs=None, nan_guard=None):
+                amp_configs=None, nan_guard=None, strategy=None):
         self._optimizer = optimizer
         self._loss = loss
+        self._set_strategy(strategy)
         if metrics is None:
             self._metrics = []
         elif isinstance(metrics, Metric):
@@ -59,22 +61,35 @@ class Model:
                 else NanGuard()
             if self._scaler is not None:
                 self._nan_guard.attach_scaler(self._scaler)
-        self._use_jit = jit
-        if jit:
+        self._use_jit = jit or self._sharding_cfg is not None
+        if self._use_jit:
             self._build_jit_step()
         return self
+
+    def _set_strategy(self, strategy):
+        """Resolve a sharding strategy (fleet ``DistributedStrategy``,
+        ``distributed.ShardingConfig``, or None). When none is given but
+        the optimizer is a ``fleet.distributed_optimizer`` wrapper that
+        carries a resolved config, adopt that — the fleet knobs and the
+        hapi ``strategy=`` argument must mean the same thing."""
+        from ..distributed.strategy import resolve_sharding
+        cfg = resolve_sharding(strategy)
+        if cfg is None and strategy is None:
+            cfg = getattr(self._optimizer, 'sharding_config', None)
+        self._sharding_cfg = cfg
 
     def _build_jit_step(self):
         """Fully-jitted train step via the unified engine builder: ONE XLA
         program with buffer donation (where the backend honors it), the
-        in-graph NaN guard, and AMP loss scaling folded in
-        (docs/PERF.md)."""
+        in-graph NaN guard, AMP loss scaling, and (with a strategy) the
+        FSDP/tensor-parallel sharding plan folded in (docs/PERF.md)."""
         from ..engine import build_train_step
         scaler = self._scaler if (self._scaler is not None and
                                   self._scaler.is_enable()) else None
         self._jit_step_fn = build_train_step(
             net=self.network, loss=self._loss, optimizer=self._optimizer,
-            scaler=scaler, nan_guard=self._nan_guard is not None)
+            scaler=scaler, nan_guard=self._nan_guard is not None,
+            sharding=self._sharding_cfg)
         self._jit_state = None
         self._steps_since_engine_sync = 0
 
@@ -217,8 +232,14 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            resume_from=None):
+            resume_from=None, strategy=None):
         """Train for ``epochs`` epochs.
+
+        ``strategy``: a ``distributed.ShardingConfig`` or a fleet
+        ``DistributedStrategy`` with ``sharding``/``tensor_parallel`` set —
+        the train step compiles with params/optimizer state sharded over
+        the mesh (sharded training runs through the compiled path, so this
+        implies ``jit=True``; docs/PERF.md, "Sharded training").
 
         ``resume_from``: a directory previously written by a
         :class:`~paddle_tpu.hapi.callbacks.CheckpointSaver` callback (or a
@@ -229,6 +250,28 @@ class Model:
         interrupted. A SIGTERM during training (with a CheckpointSaver
         active) checkpoints at the next batch boundary and stops cleanly.
         """
+        if strategy is not None:
+            prev_cfg = self._sharding_cfg
+            self._set_strategy(strategy)
+            changed = self._sharding_cfg is not prev_cfg
+            if self._sharding_cfg is not None and \
+                    (changed or not self._use_jit):
+                # sharding lives in the compiled step. Write any prior
+                # jitted progress back into the eager net first — the
+                # rebuild drops _jit_state, and the new state re-inits
+                # from the network
+                self._sync_jit_state()
+                self._use_jit = True
+                self._build_jit_step()
+            elif changed and prev_cfg is not None and self._use_jit:
+                # an explicit knobs-off strategy turns sharding OFF: the
+                # old sharded step may not silently keep running under a
+                # config that now claims "unsharded"
+                self._sync_jit_state()
+                self._build_jit_step()
+            # a knobs-off strategy on a never-sharded model (or the same
+            # config again) changes nothing — in particular it must not
+            # flip the model onto the jit path or reset accumulated state
         train_loader = self._to_loader(train_data, batch_size, shuffle,
                                        drop_last, num_workers)
         eval_loader = self._to_loader(eval_data, batch_size, False, False,

@@ -74,6 +74,19 @@ def _fetch_outs(fetch_vars, env):
     return outs
 
 
+def _unshard_committed(tree):
+    """Pull leaves that are still committed to a non-trivial mesh sharding
+    back to host (a sharding-config toggle leaves the previous plan's
+    placements in the param concretes / optimizer slots; a replicated-
+    pinned dp jit rejects them). The next step's output re-places them,
+    so the host round-trip happens once per toggle."""
+    def fix(v):
+        if getattr(getattr(v, 'sharding', None), 'spec', None):
+            return np.asarray(v)
+        return v
+    return jax.tree_util.tree_map(fix, tree)
+
+
 class Executor:
     def __init__(self, place=None):
         self.place = place
@@ -131,9 +144,15 @@ class Executor:
         param_vals = [v.concrete._value for v in params]
 
         dp = bool(getattr(program, '_dp', False))
+        # the live sharding config is part of the compiled program's
+        # identity: toggling fleet sharding between runs must recompile,
+        # not silently reuse the other plan's cached step
+        from ..distributed.strategy import current_config
+        sharding_cfg = current_config() if dp else None
         key = (program._fingerprint, tuple(feed_names),
                tuple((tuple(v.shape), str(v.dtype)) for v in feed_vals),
-               tuple(v.name for v in fetch_vars), train_spec is not None, dp)
+               tuple(v.name for v in fetch_vars), train_spec is not None,
+               sharding_cfg, dp)
         telemetry = _obs.enabled()
         if key not in self._cache:
             if telemetry:
@@ -159,13 +178,28 @@ class Executor:
                 # the engine step owns the whole functional state (and
                 # donates it where the backend honors donation); params
                 # stay authoritative in the Variables' concrete payloads
-                state = {'params': pv, 'buffers': {},
-                         'opt': optimizer._static_state}
+                if getattr(compiled, 'sharding', None) is not None:
+                    # fleet sharding config live: init_state compiles the
+                    # sharded program (first run) and places params +
+                    # opt-state on the mesh per the FSDP/TP plan
+                    state = compiled.init_state(
+                        pv, {}, opt_state=optimizer._static_state)
+                else:
+                    state = {'params': pv, 'buffers': {},
+                             'opt': optimizer._static_state}
+                    if dp:
+                        # a previous sharded run leaves committed sharded
+                        # params/slots in the concretes; the replicated-
+                        # pinned dp jit rejects those — pull the
+                        # stragglers once (the step output re-places them)
+                        state = _unshard_committed(state)
                 state, result = compiled(state, feed_vals)
                 optimizer._static_state = state['opt']
                 outs = result.outputs
                 new_param_vals = [state['params'][v.name] for v in params]
             else:
+                if dp and sharding_cfg is None:
+                    param_vals = _unshard_committed(param_vals)
                 outs, new_param_vals = compiled(feed_vals, param_vals)
         if new_param_vals is not None:
             for v, nv in zip(params, new_param_vals):
@@ -302,27 +336,39 @@ class Executor:
         # data-parallel compile (CompiledProgram.with_data_parallel): feeds
         # shard over a 1-D 'data' mesh, params/opt-state replicate; XLA
         # derives the grad all-reduce from the shardings — numerics match
-        # the single-device run on the concatenated batch exactly
+        # the single-device run on the concatenated batch exactly. When a
+        # fleet sharding config is live (DistributedStrategy.sharding/
+        # tensor_parallel resolved by fleet.init), the train path upgrades
+        # to the full FSDP/TP plan through the same engine builder.
+        from ..distributed.strategy import current_config
+        sharding_cfg = current_config() if dp else None
         dp_shardings = None
         jit_kwargs = {}
+        sharded_feed = None
         if dp:
             from jax.sharding import (Mesh, NamedSharding,
                                       PartitionSpec as P)
-            devs = jax.devices()
-            mesh = Mesh(np.asarray(devs), ('data',))
-            feed_sh = NamedSharding(mesh, P('data'))
-            repl = NamedSharding(mesh, P())
-            n_feed = len(feed_vars)
-            n_param = len(params)
-            jit_kwargs['in_shardings'] = ([feed_sh] * n_feed,
-                                          [repl] * n_param)
-            # engine step signature (state, batch): replicate the whole
-            # state pytree (sharding-as-prefix), shard the feeds
-            dp_shardings = (repl, [feed_sh] * n_feed)
+            if sharding_cfg is not None:
+                # feeds go to the config mesh; params keep whatever
+                # placement they carry (FSDP/TP training left them
+                # committed sharded — pinning them to replicated
+                # in_shardings would raise on the first call)
+                sharded_feed = sharding_cfg.batch_sharding()
+            else:
+                mesh = Mesh(np.asarray(jax.devices()), ('data',))
+                feed_sh = NamedSharding(mesh, P('data'))
+                repl = NamedSharding(mesh, P())
+                n_feed = len(feed_vars)
+                n_param = len(params)
+                jit_kwargs['in_shardings'] = ([feed_sh] * n_feed,
+                                              [repl] * n_param)
+                # engine step signature (state, batch): replicate the whole
+                # state pytree (sharding-as-prefix), shard the feeds
+                dp_shardings = (repl, [feed_sh] * n_feed)
 
         if train_spec is None:
             @functools.partial(jax.jit, **jit_kwargs)
-            def run(feed_vals, param_vals):
+            def run_jit(feed_vals, param_vals):
                 env = {}
                 for v, val in zip(feed_vars, feed_vals):
                     env[id(v)] = val
@@ -330,6 +376,13 @@ class Executor:
                     env[id(v)] = val
                 env = interpret(env)
                 return _fetch_outs(fetch_vars, env), None
+            if sharded_feed is None:
+                return run_jit
+
+            def run(feed_vals, param_vals):
+                feed_vals = [jax.device_put(v, sharded_feed)
+                             for v in feed_vals]
+                return run_jit(feed_vals, param_vals)
             return run
 
         # train path: ONE compiled step through the unified engine builder
@@ -359,7 +412,8 @@ class Executor:
         return build_train_step(loss_fn=program_loss_fn,
                                 optimizer=optimizer, params_meta=meta,
                                 trainable=trainable, with_key=False,
-                                in_shardings=dp_shardings)
+                                in_shardings=dp_shardings,
+                                sharding=sharding_cfg)
 
 
 def program_infer_fn(program, feed_names, fetch_vars):

@@ -35,6 +35,10 @@ def pytest_configure(config):
         "markers", "serving: serving-runtime tests (bucketing, continuous "
         "batching, KV-cache decode, deadlines/load shedding, retrace "
         "flatness)")
+    config.addinivalue_line(
+        "markers", "sharding: FSDP/tensor-parallel sharded-training tests "
+        "(2D-mesh parameter/optimizer-state sharding through the unified "
+        "train step)")
 
 
 @pytest.fixture(autouse=True)

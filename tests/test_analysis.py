@@ -99,6 +99,10 @@ AST_FIXTURES = {
               "def train_step(params, opt_state, batch):\n"
               "    return params, opt_state\n"
               "step = jax.jit(train_step)\n", "jax.jit(train_step)"),
+    'GL016': ("import jax\n"
+              "def place(params):\n"
+              "    return jax.device_put(params)\n",
+              "jax.device_put(params)"),
 }
 
 
@@ -583,6 +587,46 @@ def test_gl015_exempts_engine_tests_tools(tmp_path):
     p.write_text(_UNDONATED_SRC)
     findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
     assert [f for f in findings if f.rule == 'GL015'] != []
+
+
+_DEVICE_PUT_SRC = (
+    "import jax\n"
+    "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+    "def replicate_all(params):\n"
+    "    return jax.device_put(params)\n"                  # flagged
+    "def pin_one(opt_state):\n"
+    "    return jax.device_put(opt_state, jax.devices()[0])\n"  # flagged
+    "def upload(state, mesh):\n"
+    "    sh = NamedSharding(mesh, P('data'))\n"
+    "    return jax.device_put(state, sh)\n"               # sanctioned
+    "def upload_batch(x):\n"
+    "    return jax.device_put(x)\n")                      # not a pytree
+
+
+def test_gl016_flags_unsharded_param_device_put(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'place.py').write_text(_DEVICE_PUT_SRC)
+    findings, _ = lint_paths([str(lib / 'place.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL016')
+    lines = _DEVICE_PUT_SRC.splitlines()
+    assert len(hits) == 2, [(f.rule, f.line) for f in findings]
+    assert 'jax.device_put(params)' in lines[hits[0] - 1]
+    assert 'jax.devices()[0]' in lines[hits[1] - 1]
+    msg = [f for f in findings if f.rule == 'GL016'][0].message
+    # fix-it points at the sharding surface
+    assert 'shard_tensor' in msg and 'fsdp_pspecs' in msg
+    assert 'build_train_step' in msg
+
+
+def test_gl016_exempts_harnesses(tmp_path):
+    for rel in ('tests/mod.py', 'tools/mod.py', 'bench.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_DEVICE_PUT_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL016'] == [], rel
 
 
 def test_ten_distinct_rule_ids_on_seeded_fixtures(tmp_path):
