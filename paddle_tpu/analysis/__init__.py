@@ -2,10 +2,12 @@
 
 Two engines over one ``Finding`` type and one reporter pair:
 
-- **AST lint** (``graftlint``): rules GL001–GL013 catch host syncs in traced
-  code, retrace triggers, nondeterminism, leftover debug artifacts,
-  non-atomic checkpoint writes and ad-hoc wall-clock timing *before* they
-  reach hardware. CLI:
+- **AST lint** (``graftlint``): rules GL001–GL017 catch host syncs in traced
+  code, retrace triggers (incl. unbucketed dynamic shapes and
+  shape-polymorphic boolean-mask indexing), nondeterminism, leftover debug
+  artifacts, non-atomic checkpoint writes, ad-hoc wall-clock timing,
+  unbounded waits, undonated train steps, and unsharded param placement
+  *before* they reach hardware. CLI:
   ``python tools/graftlint.py`` or ``python -m paddle_tpu.analysis``.
 - **IR verifier**: checks GV001–GV008 validate a captured static-graph
   Program (dangling inputs, duplicate names, dtype/shape drift, dead ops,

@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL016).
+"""The graftlint AST rule catalog (GL001–GL017).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -46,6 +46,15 @@ but destroys performance or correctness on real hardware:
   ceiling FSDP removes; place params with ``distributed.sharding.
   shard_tensor``/``fsdp_pspecs`` or let ``engine.build_train_step(
   sharding=...)`` derive the ``NamedSharding``s.
+
+- GL017: data-dependent boolean-mask indexing (``x[x > 0]``) or
+  ``nonzero()``/``argwhere``/one-arg ``where()`` inside traced code — the
+  result shape depends on runtime VALUES, so under jit it either raises
+  (ConcretizationTypeError) or, evaluated eagerly per request, forces a
+  fresh compile for every distinct count: a retrace storm exactly when
+  serving load peaks. Use a fixed-shape gather over an index table (the
+  ``serving.paged_kv`` block-table pattern), 3-arg ``jnp.where(cond, a,
+  b)``, or the ``size=`` kwarg that pins the output shape.
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -1081,3 +1090,126 @@ class UnbucketedDynamicShapeRule(Rule):
                         "with paddle_tpu.serving.bucketing "
                         "(select_bucket + pad_to_bucket/stack_examples)")
                     break
+
+
+# -- GL017: data-dependent boolean-mask indexing / nonzero in traced code ----
+
+# calls whose output shape is the COUNT of true/nonzero elements — a
+# runtime value, not a static shape
+_DYN_SHAPE_CALLS = {'nonzero', 'argwhere', 'flatnonzero'}
+
+
+def _is_shape_safe_call(node):
+    """Calls whose RESULT has a data-independent shape even though a
+    comparison feeds them: 3-arg ``where(cond, a, b)`` (in-place select)
+    and anything carrying a ``size=`` kwarg. A comparison nested inside
+    one must not taint the surrounding index expression — an integer
+    gather like ``x[jnp.where(c, i, j)]`` is the sanctioned pattern."""
+    if not isinstance(node, ast.Call):
+        return False
+    if any(kw.arg == 'size' for kw in node.keywords):
+        return True
+    return _tail_name(node.func) == 'where' and len(node.args) == 3
+
+
+def _compare_on_traced(node, tainted):
+    """Does ``node`` contain a comparison whose operands read a traced
+    name (`x > 0`, `(a < b) & (c != 0)`) OUTSIDE shape-safe calls? The
+    mask's own shape is static, but INDEXING with it makes the result
+    shape data-dependent."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if _is_shape_safe_call(n):
+            continue
+        if isinstance(n, ast.Compare):
+            for side in [n.left] + list(n.comparators):
+                for leaf in ast.walk(side):
+                    if isinstance(leaf, ast.Name) and leaf.id in tainted:
+                        return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+@register
+class DataDependentMaskIndexRule(Rule):
+    """GL017: boolean-mask indexing (``x[mask]``) or ``nonzero()``/
+    ``argwhere``/one-arg ``where()`` inside traced code. The result's
+    SHAPE is the number of true elements — a runtime value — so under
+    ``jit`` this either raises a concretization error or, run eagerly on
+    the serving path, compiles a fresh program per distinct count (shape-
+    polymorphic retrace storm, GL013's dynamic twin). Keep the shape
+    closed: a fixed-shape **gather over an index table** (the
+    ``serving.paged_kv`` block-table/page-index pattern), 3-arg
+    ``jnp.where(cond, a, b)`` to select values in place, or the ``size=``
+    kwarg that pins the output length."""
+    id = 'GL017'
+    title = 'data-dependent boolean-mask indexing in traced code'
+
+    def in_scope(self, rel):
+        if rel.startswith(('tests/', 'tools/')):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def _mask_names(self, fn, index, tainted):
+        """Names assigned from comparisons over traced values — the
+        `mask = x > 0` spelling of the same trap."""
+        masks = set()
+        for n in index.walk_body(fn):
+            if not isinstance(n, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = n.value
+            if value is None or not _compare_on_traced(value, tainted):
+                continue
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    masks.add(t.id)
+        return masks
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        taint = {}
+        masks = {}
+        for fn, node in ctx.traced_nodes():
+            if isinstance(node, ast.Call):
+                tail = _tail_name(node.func)
+                sized = any(kw.arg == 'size' for kw in node.keywords)
+                if tail in _DYN_SHAPE_CALLS and not sized:
+                    yield self.finding(
+                        ctx, node,
+                        f"{tail}() in traced code returns a data-dependent "
+                        "shape (the count of nonzero elements) — a "
+                        "concretization error under jit, a compile per "
+                        "distinct count when run eagerly; gather through a "
+                        "fixed-shape index table (serving.paged_kv block-"
+                        "table pattern) or pass size= to pin the shape")
+                elif tail == 'where' and len(node.args) == 1 and not sized:
+                    yield self.finding(
+                        ctx, node,
+                        "one-arg where(cond) is nonzero() in disguise — "
+                        "its shape is the true-count; use 3-arg "
+                        "jnp.where(cond, a, b) to select in place, a "
+                        "fixed-shape gather over an index table, or size=")
+            elif isinstance(node, ast.Subscript):
+                if isinstance(node.slice, (ast.Slice, ast.Constant)):
+                    continue
+                if id(fn) not in taint:
+                    taint[id(fn)] = _traced_values(fn, ctx.index)
+                    masks[id(fn)] = self._mask_names(fn, ctx.index,
+                                                     taint[id(fn)])
+                idx = node.slice
+                bad = _compare_on_traced(idx, taint[id(fn)]) or (
+                    isinstance(idx, ast.Name) and idx.id in masks[id(fn)])
+                if bad:
+                    yield self.finding(
+                        ctx, node,
+                        "boolean-mask indexing on a traced value — the "
+                        "result shape is the mask's true-count (shape-"
+                        "polymorphic): a concretization error under jit, "
+                        "a retrace per distinct count eagerly; select "
+                        "with 3-arg jnp.where(cond, a, b) or gather over "
+                        "a fixed-shape index table (serving.paged_kv "
+                        "block-table pattern)")

@@ -23,7 +23,14 @@ Detector catalog (docs/OBSERVABILITY.md has the operator version):
                       accelerator starves on host feed.
 - ``serving_overload`` shed + deadline-expired requests trending up on the
                       serving event stream / counters — offered load
-                      exceeds engine capacity.
+                      exceeds engine capacity. Page-exhaustion sheds are
+                      EXCLUDED (that is memory pressure, not traffic —
+                      see ``kv_page_exhaustion``).
+- ``kv_page_exhaustion`` the paged KV cache ran out of pages: admission
+                      blocked, decode rows stalled, sequences preempted,
+                      or queue-full sheds attributed to page starvation.
+                      The fix is memory-side (num_pages / page_size /
+                      prefix_cache), never replicas or queue capacity.
 - ``rank_flatline``   a rank's heartbeat is stale while siblings beat on
                       (wedged collective / dead process).
 
@@ -189,25 +196,34 @@ def detect_serving_overload(events=None, snapshot=None, cluster=None,
         counters = {
             'serving_requests': _ctr(snapshot, 'serving.requests'),
             'serving_shed': _ctr(snapshot, 'serving.shed'),
+            'serving_shed_page_exhaustion': _ctr(
+                snapshot, 'serving.shed.page_exhaustion'),
             'serving_deadline_expired': _ctr(snapshot,
                                              'serving.deadline_expired'),
         }
     # serving.requests counts every submission (sheds included), so it IS
     # the offered load; the event stream reconstructs the same totals when
-    # no counter snapshot is available
-    offered = shed = expired = 0
+    # no counter snapshot is available. Page-exhaustion sheds are memory
+    # pressure wearing a queue-full mask — kv_page_exhaustion owns those,
+    # and counting them here would prescribe replicas for an OOM.
+    offered = shed = expired = page_shed = 0
     if counters:
         offered = int(counters.get('serving_requests') or 0)
         shed = int(counters.get('serving_shed') or 0)
+        page_shed = int(counters.get('serving_shed_page_exhaustion') or 0)
         expired = int(counters.get('serving_deadline_expired') or 0)
     if events:
         ev_shed = sum(1 for e in events if e.get('ev') == 'serving.shed')
+        ev_pshed = sum(1 for e in events if e.get('ev') == 'serving.shed'
+                       and e.get('reason') == 'page_exhaustion')
         ev_exp = sum(1 for e in events if e.get('ev') == 'serving.request'
                      and e.get('status') == 'deadline')
         ev_req = sum(1 for e in events if e.get('ev') == 'serving.request')
         shed = max(shed, ev_shed)
+        page_shed = max(page_shed, ev_pshed)
         expired = max(expired, ev_exp)
         offered = max(offered, ev_req + ev_shed)
+    shed = max(0, shed - page_shed)
     bad = shed + expired
     if not offered or not bad:
         return
@@ -224,6 +240,59 @@ def detect_serving_overload(events=None, snapshot=None, cluster=None,
         "instead of after queueing",
         offered=offered, shed=shed, deadline_expired=expired,
         ratio=round(ratio, 3))
+
+
+def detect_kv_page_exhaustion(events=None, snapshot=None, cluster=None, **_):
+    """The paged KV cache ran out of pages: admission blocked behind page
+    starvation (sheds attributed ``page_exhaustion``), decode rows
+    stalled, or sequences were preempted to free memory. Distinct from
+    ``serving_overload`` on purpose — the fix is pages, not replicas."""
+    counters = (cluster or {}).get('counters_total') if cluster else None
+    if counters is None and snapshot is not None:
+        counters = {
+            'serving_shed_page_exhaustion': _ctr(
+                snapshot, 'serving.shed.page_exhaustion'),
+            'serving_kv_decode_stalls': _ctr(snapshot,
+                                             'serving.kv.decode_stalls'),
+            'serving_kv_prefill_stalls': _ctr(snapshot,
+                                              'serving.kv.prefill_stalls'),
+            'serving_preemptions': _ctr(snapshot, 'serving.preemptions'),
+        }
+    page_shed = stalls = preempts = 0
+    if counters:
+        page_shed = int(counters.get('serving_shed_page_exhaustion') or 0)
+        stalls = (int(counters.get('serving_kv_decode_stalls') or 0) +
+                  int(counters.get('serving_kv_prefill_stalls') or 0))
+        preempts = int(counters.get('serving_preemptions') or 0)
+    if events:
+        page_shed = max(page_shed, sum(
+            1 for e in events if e.get('ev') == 'serving.shed'
+            and e.get('reason') == 'page_exhaustion'))
+        stalls = max(stalls, sum(
+            1 for e in events if e.get('ev') == 'serving.page_exhausted'))
+        preempts = max(preempts, sum(
+            1 for e in events if e.get('ev') == 'serving.preempt'))
+    if not (page_shed or stalls or preempts):
+        return
+    util = None
+    if snapshot is not None:
+        util = (snapshot.get('gauges') or {}).get(
+            'serving.kv.page_utilization')
+    severity = 'critical' if (page_shed or preempts) else 'warning'
+    yield _diag(
+        'kv_page_exhaustion', severity,
+        f"paged KV cache out of pages: {page_shed} shed(s) attributed to "
+        f"page exhaustion, {stalls} stall(s), {preempts} preemption(s)"
+        + (f" at {100 * util:.0f}% page utilization"
+           if isinstance(util, (int, float)) else ""),
+        "grow num_pages (or shrink page_size to cut tail waste), enable "
+        "prefix_cache= for shared system prompts, or lower "
+        "max_new_tokens/deadlines; raising queue_capacity or adding "
+        "replicas will NOT help — memory, not traffic, is the limit",
+        page_exhaustion_sheds=page_shed, stalls=stalls,
+        preemptions=preempts,
+        **({'page_utilization': round(util, 4)}
+           if isinstance(util, (int, float)) else {}))
 
 
 def detect_rank_flatline(events=None, snapshot=None, cluster=None,
@@ -252,6 +321,7 @@ DETECTORS = {
     'retrace_storm': detect_retrace_storm,
     'input_bound': detect_input_bound,
     'serving_overload': detect_serving_overload,
+    'kv_page_exhaustion': detect_kv_page_exhaustion,
     'rank_flatline': detect_rank_flatline,
 }
 

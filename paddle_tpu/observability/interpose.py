@@ -96,5 +96,16 @@ def summary():
         'rank_restarts': snap.get('distributed.rank_restarts', 0),
         'serving_requests': snap.get('serving.requests', 0),
         'serving_shed': snap.get('serving.shed', 0),
+        'serving_shed_queue_full': snap.get('serving.shed.queue_full', 0),
+        'serving_shed_page_exhaustion': snap.get(
+            'serving.shed.page_exhaustion', 0),
         'serving_deadline_expired': snap.get('serving.deadline_expired', 0),
+        'serving_kv_decode_stalls': snap.get('serving.kv.decode_stalls', 0),
+        'serving_kv_prefill_stalls': snap.get(
+            'serving.kv.prefill_stalls', 0),
+        'serving_preemptions': snap.get('serving.preemptions', 0),
+        'serving_prefix_hit_pages': snap.get(
+            'serving.kv.prefix_hit_pages', 0),
+        'serving_spec_proposed': snap.get('serving.spec.proposed', 0),
+        'serving_spec_accepted': snap.get('serving.spec.accepted', 0),
     }

@@ -8,8 +8,12 @@ compiled-program caches and served under load with
   so steady-state traffic never traces or compiles (``jax.compiles`` flat
   after ``warmup()``; graftlint GL013 lints for violations statically);
 - **iteration-level continuous batching** (``runners``) — one-shot models
-  re-pack the queue every batch; generative models join/leave fixed
-  KV-cache slots per decode step (``kv_cache``);
+  re-pack the queue every batch; generative models join/leave the KV
+  cache per decode step: by default a **paged** cache (``paged_kv`` /
+  ``paged_runner``: block tables over a refcounted page pool, prefix
+  sharing of identical prompt prefixes, chunked prefill for long
+  prompts, speculative decoding via a draft spec), with the fixed-slot
+  cache (``kv_cache``) retained as the memory baseline;
 - **production edges** (``scheduler``) — bounded admission queues with
   429-style shedding, per-request deadlines (expired work is dropped, not
   run), watchdog-bounded client waits;
@@ -30,18 +34,23 @@ from .bucketing import (DEFAULT_BATCH_BUCKETS, BucketSpec, pad_to_bucket,
                         select_bucket, stack_examples)
 from .engine import Endpoint, ServingEngine
 from .kv_cache import GenerativeSpec, TinyCausalLM
+from .paged_kv import (PageAllocator, PagesExhaustedError, PrefixCache,
+                       chain_hashes)
+from .paged_runner import PagedGenerativeRunner
 from .runners import BatchRunner, GenerativeRunner
 from .scheduler import (AdmissionQueue, PendingRequest, QueueFullError,
                         Request, Response, STATUS_DEADLINE, STATUS_ERROR,
                         STATUS_OK)
-from . import bucketing, engine, kv_cache, runners, scheduler  # noqa: F401
+from . import (bucketing, engine, kv_cache, paged_kv,  # noqa: F401
+               paged_runner, runners, scheduler)
 
 __all__ = [
     'ServingEngine', 'Endpoint',
     'BucketSpec', 'DEFAULT_BATCH_BUCKETS', 'select_bucket', 'pad_to_bucket',
     'stack_examples',
     'GenerativeSpec', 'TinyCausalLM',
-    'BatchRunner', 'GenerativeRunner',
+    'BatchRunner', 'GenerativeRunner', 'PagedGenerativeRunner',
+    'PageAllocator', 'PagesExhaustedError', 'PrefixCache', 'chain_hashes',
     'AdmissionQueue', 'PendingRequest', 'QueueFullError', 'Request',
     'Response', 'STATUS_OK', 'STATUS_DEADLINE', 'STATUS_ERROR',
 ]

@@ -19,7 +19,9 @@ Registration adapters (all funnel into the two runner shapes):
   store (hits/misses already counted on the telemetry spine);
 - ``predictor=`` — an ``inference.Predictor`` (portable export);
 - ``generative=`` — a ``kv_cache.GenerativeSpec`` for continuous-batching
-  decode.
+  decode over the **paged KV cache** (block tables + free-list allocator,
+  prefix sharing, chunked prefill, speculative decoding via ``draft=``;
+  ``kv_cache='slot'`` retains the PR-6 fixed-slot baseline).
 
 Drive it either with ``start()`` (background worker thread; clients block
 on ``Endpoint.predict``) or synchronously with ``pump()`` /
@@ -31,6 +33,7 @@ import numpy as np
 
 from .. import observability as _obs
 from ..resilience.watchdog import join_thread
+from .paged_runner import PagedGenerativeRunner
 from .runners import BatchRunner, GenerativeRunner, _count
 from .scheduler import (AdmissionQueue, PendingRequest, QueueFullError,
                         Request)
@@ -78,7 +81,9 @@ class ServingEngine:
         self._thread = None
         self._stop = threading.Event()
         self._shed = 0
-        self._submitted = 0
+        self._shed_queue_full = 0      # real overload: offered > drained
+        self._shed_page_exhaustion = 0  # memory pressure wearing a queue-
+        self._submitted = 0             # full mask (doctor tells them apart)
         self._endpoint = None          # MetricsServer this engine owns
 
     # -- registration ---------------------------------------------------
@@ -86,11 +91,25 @@ class ServingEngine:
                  executor=None, predictor=None, generative=None,
                  example=None, bucket_spec=None, quantize=None,
                  calib_data=None, default_max_new_tokens=32,
-                 queue_capacity=None, jit_compile=True):
+                 queue_capacity=None, jit_compile=True,
+                 kv_cache='paged', page_size=16, num_pages=None,
+                 max_concurrency=None, draft=None, draft_k=4,
+                 prefix_cache=True):
         """Register one model under ``name``. Exactly one of
         ``predict_fn``/``layer``/``program``/``predictor``/``generative``
         must be given; one-shot kinds also need ``example`` (one request's
-        inputs, no batch axis) to pin the closed shape set."""
+        inputs, no batch axis) to pin the closed shape set.
+
+        Generative models decode over a **paged KV cache** by default
+        (``kv_cache='paged'``; docs/SERVING.md "Paged KV cache"):
+        ``page_size`` tokens per page, ``num_pages`` total (default:
+        worst case — size it below that to realize the memory win),
+        ``max_concurrency`` block-table rows (default
+        ``spec.max_batch``), ``prefix_cache=`` hash-consed shared-prompt
+        pages, and ``draft=``/``draft_k=`` speculative decoding (a small
+        ``GenerativeSpec`` proposing ``draft_k`` tokens per verify
+        step). ``kv_cache='slot'`` keeps the PR-6 fixed-slot cache (the
+        memory baseline)."""
         given = [k for k, v in (('predict_fn', predict_fn), ('layer', layer),
                                 ('program', program),
                                 ('predictor', predictor),
@@ -115,6 +134,28 @@ class ServingEngine:
                     f"register({name!r}): {bad} do not apply to "
                     "generative= models — prompt buckets and batch size "
                     "come from the GenerativeSpec itself")
+            if kv_cache not in ('paged', 'slot'):
+                raise ValueError(
+                    f"register({name!r}): kv_cache must be 'paged' or "
+                    f"'slot', got {kv_cache!r}")
+            if kv_cache == 'slot':
+                paged_only = [k for k, v in (
+                    ('num_pages', num_pages), ('draft', draft),
+                    ('max_concurrency', max_concurrency)) if v is not None]
+                if paged_only:
+                    raise ValueError(
+                        f"register({name!r}): {paged_only} need the paged "
+                        "KV cache — drop kv_cache='slot' (paged is the "
+                        "default) to use pages, prefix sharing, and "
+                        "speculative decoding")
+        else:
+            paged_given = [k for k, v in (
+                ('num_pages', num_pages), ('draft', draft),
+                ('max_concurrency', max_concurrency)) if v is not None]
+            if paged_given:
+                raise ValueError(
+                    f"register({name!r}): {paged_given} apply only to "
+                    "generative= models (the paged KV cache)")
         if queue_capacity is not None and int(queue_capacity) < 1:
             raise ValueError(
                 f"register({name!r}): queue_capacity must be >= 1, got "
@@ -123,9 +164,16 @@ class ServingEngine:
                                self.queue_capacity if queue_capacity is None
                                else queue_capacity)
         if generative is not None:
-            runner = GenerativeRunner(
-                name, queue, generative,
-                default_max_new_tokens=default_max_new_tokens)
+            if kv_cache == 'paged':
+                runner = PagedGenerativeRunner(
+                    name, queue, generative, page_size=page_size,
+                    num_pages=num_pages, max_concurrency=max_concurrency,
+                    draft=draft, draft_k=draft_k, prefix_cache=prefix_cache,
+                    default_max_new_tokens=default_max_new_tokens)
+            else:
+                runner = GenerativeRunner(
+                    name, queue, generative,
+                    default_max_new_tokens=default_max_new_tokens)
         else:
             if example is None:
                 raise ValueError(
@@ -258,11 +306,23 @@ class ServingEngine:
         _count('serving.requests')
         try:
             self._queues[model].push(req)
-        except QueueFullError:
+        except QueueFullError as e:
+            # attribute the shed: a queue that backed up behind a page-
+            # starved runner is memory pressure, not traffic overload —
+            # the doctor must not prescribe replicas for an OOM
+            starved = getattr(runner, 'page_starved', lambda: False)()
+            e.reason = 'page_exhaustion' if starved else 'queue_full'
             self._shed += 1
             _count('serving.shed')
+            if e.reason == 'page_exhaustion':
+                self._shed_page_exhaustion += 1
+                _count('serving.shed.page_exhaustion')
+            else:
+                self._shed_queue_full += 1
+                _count('serving.shed.queue_full')
             if _obs.enabled():
-                _obs.event('serving.shed', model=model, request=req.id)
+                _obs.event('serving.shed', model=model, request=req.id,
+                           reason=e.reason)
             raise
         self._submitted += 1
         with self._cond:
@@ -293,7 +353,7 @@ class ServingEngine:
                 sum(len(q) for q in queues))
             _obs.gauge('serving.active_slots').set(sum(
                 sum(1 for s in r.slots if s is not None)
-                for r in runners if isinstance(r, GenerativeRunner)))
+                for r in runners if r.kind == 'generative'))
         return did
 
     def run_until_idle(self, max_steps=100000):
@@ -435,6 +495,8 @@ class ServingEngine:
         return {
             'submitted': self._submitted,
             'shed': self._shed,
+            'shed_queue_full': self._shed_queue_full,
+            'shed_page_exhaustion': self._shed_page_exhaustion,
             'queue_depth': {n: len(q) for n, q in self._queues.items()},
             'models': {n: r.stats.as_dict()
                        for n, r in self._models.items()},

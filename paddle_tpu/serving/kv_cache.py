@@ -7,8 +7,11 @@ max_seq]`` slots so one decode step is O(S) — and, crucially for the
 serving engine, the cache shapes are **static**: requests join by writing
 their prefill K/V into a free slot and leave by freeing it, while the
 jitted decode step always runs at ``[max_batch]``. No shape ever changes,
-so nothing ever recompiles (the Orca/vLLM iteration-level scheduling idea,
-restricted to fixed slots — the right size for this runtime).
+so nothing ever recompiles (the Orca/vLLM iteration-level scheduling
+idea, restricted to fixed slots). The fixed-slot layout is the MEMORY
+BASELINE: every sequence pays ``max_seq`` rows; ``paged_kv.py`` replaces
+the slots with block-table pages (the serving default) and this module's
+``GenerativeSpec`` carries both contracts.
 
 Everything here is pure ``jnp`` — safe inside ``jax.jit``; the cache is a
 plain dict pytree threaded through the jitted prefill/decode calls.
@@ -100,6 +103,25 @@ class GenerativeSpec:
       scalars; ``Lp`` is one of ``prompt_buckets`` (static).
     - ``decode(cache, tokens[B], positions[B]) -> (cache, logits[B, V])``
       — one token step for every slot at once, ``B == max_batch`` fixed.
+
+    **Paged contract** (the default serving path — ``paged_kv.py`` has the
+    primitives, ``paged_runner.py`` the scheduler): four more pure
+    functions over a paged cache + block tables instead of slots. The
+    slot contract above is retained as the memory-baseline comparison
+    (``register(..., kv_cache='slot')``).
+
+    - ``init_paged_cache(num_pages, page_size) -> pytree`` of
+      ``[.., P, page_size, ..]`` arrays
+    - ``prefill_chunk(cache, block_row[MP], tokens[Cb], start, length)
+      -> (cache, logits[Cb, V])`` — one chunk of one sequence's prompt
+      at absolute offset ``start`` (chunked prefill / prefix-cache
+      resume); rows at or beyond ``length`` are bucket padding.
+    - ``decode_paged(cache, block_tables[B, MP], tokens[B],
+      positions[B]) -> (cache, logits[B, V])`` — one token per row.
+    - ``verify_tokens(cache, block_tables[B, MP], tokens[B, K],
+      positions[B, K]) -> (cache, logits[B, K, V])`` — process ``K``
+      tokens per row in ONE step (the speculative-decoding verify;
+      ``decode_paged`` is its ``K=1`` special case).
     """
 
     max_batch = 1
@@ -114,6 +136,21 @@ class GenerativeSpec:
         raise NotImplementedError
 
     def decode(self, cache, tokens, positions):
+        raise NotImplementedError
+
+    # -- paged contract (kv_cache='paged', the default) -----------------
+    def init_paged_cache(self, num_pages, page_size):
+        raise NotImplementedError
+
+    def prefill_chunk(self, cache, block_row, tokens, start, length):
+        raise NotImplementedError
+
+    def decode_paged(self, cache, block_tables, tokens, positions):
+        cache, logits = self.verify_tokens(
+            cache, block_tables, tokens[:, None], positions[:, None])
+        return cache, logits[:, 0]
+
+    def verify_tokens(self, cache, block_tables, tokens, positions):
         raise NotImplementedError
 
 
@@ -192,6 +229,35 @@ class TinyCausalLM(GenerativeSpec):
         out = attend(cache, 0, q, lengths=positions + 1)
         y = x + out.reshape(x.shape[0], -1) @ self.p['wo']
         return cache, self._head(y)
+
+    # -- paged contract (see paged_kv.py) -------------------------------
+    def init_paged_cache(self, num_pages, page_size):
+        from . import paged_kv
+        return paged_kv.create_paged_cache(
+            1, num_pages, page_size, self.num_heads, self.head_dim)
+
+    def prefill_chunk(self, cache, block_row, tokens, start, length):
+        from . import paged_kv
+        cb = tokens.shape[0]
+        pos = jnp.minimum(start + jnp.arange(cb), self.max_seq - 1)
+        x = self.p['emb'][tokens] + self.p['pos'][pos]        # [Cb, E]
+        q, k, v = self._qkv(x)                                # [Cb, H, D]
+        cache = paged_kv.write_chunk(cache, 0, block_row, k, v, start,
+                                     length)
+        out = paged_kv.attend_chunk(cache, 0, q, block_row, start)
+        y = x + out.reshape(cb, -1) @ self.p['wo']
+        return cache, self._head(y)                           # [Cb, V]
+
+    def verify_tokens(self, cache, block_tables, tokens, positions):
+        from . import paged_kv
+        pos = jnp.minimum(positions, self.max_seq - 1)
+        x = self.p['emb'][tokens] + self.p['pos'][pos]        # [B, K, E]
+        q, k, v = self._qkv(x)                                # [B, K, H, D]
+        cache = paged_kv.write_tokens(cache, 0, block_tables, k, v,
+                                      positions)
+        out = paged_kv.attend_tokens(cache, 0, q, block_tables, positions)
+        y = x + out.reshape(out.shape[0], out.shape[1], -1) @ self.p['wo']
+        return cache, self._head(y)                           # [B, K, V]
 
     def reference_decode(self, prompt, max_new_tokens):
         """Greedy decode with NO cache (full forward each step): the

@@ -15,7 +15,12 @@ Two execution shapes cover the inference surface:
   active slots; finished sequences leave their slot immediately, so a
   short request never waits for a long one to finish. Greedy decode; the
   jitted step set is closed (one prefill per prompt bucket + one decode),
-  so steady-state traffic compiles nothing.
+  so steady-state traffic compiles nothing. This is the FIXED-SLOT
+  baseline (``register(..., kv_cache='slot')``): every sequence reserves
+  ``max_seq`` rows. The default generative path is
+  ``paged_runner.PagedGenerativeRunner`` — same scheduling contract over
+  a paged cache (several times the concurrency at equal memory, prefix
+  sharing, chunked prefill, speculative decoding).
 
 Runners never block: ``step()`` does at most one batch / one decode
 iteration and returns whether it did work; the engine's worker loop (or a

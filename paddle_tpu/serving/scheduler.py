@@ -40,15 +40,23 @@ class QueueFullError(RuntimeError):
     """Admission queue at capacity: the request was shed (429-style).
 
     Raised at submit time so the client can back off / retry elsewhere;
-    nothing was enqueued.
+    nothing was enqueued. ``reason`` distinguishes the two ways a backlog
+    builds — ``'queue_full'`` (offered load exceeds drain rate: real
+    overload) vs ``'page_exhaustion'`` (the paged KV cache is out of
+    memory, so admission stalled and the queue backed up behind it). The
+    engine stamps it from the runner's ``page_starved()`` signal so the
+    doctor's ``serving_overload``/``kv_page_exhaustion`` detectors can
+    tell traffic from memory pressure.
     """
 
-    def __init__(self, model, capacity):
+    def __init__(self, model, capacity, reason='queue_full'):
         super().__init__(
             f"serving: model {model!r} admission queue is full "
-            f"(capacity {capacity}) — request shed; retry with backoff")
+            f"(capacity {capacity}) — request shed ({reason}); retry "
+            "with backoff")
         self.model = model
         self.capacity = capacity
+        self.reason = reason
 
 
 class Response:
@@ -196,17 +204,36 @@ class AdmissionQueue:
                 raise QueueFullError(self.model, self.capacity)
             self._dq.append(req)
 
+    def push_front(self, req):
+        """Re-admit ``req`` at the head of the queue, bypassing the
+        capacity check: the request was already admitted once (a paged
+        runner stalling on KV pages, or a preemption) and must not be
+        shed on its way back in."""
+        with self._lock:
+            self._dq.appendleft(req)
+
     def pop_ready(self, max_n):
         """-> (ready, expired): up to ``max_n`` live requests in FIFO
         order, plus every expired request encountered on the way."""
+        return self.pop_ready_while(None, max_n)
+
+    def pop_ready_while(self, admit, max_n):
+        """Admission-gated pop: like ``pop_ready`` but stops at the first
+        live request ``admit(req)`` declines (strict FIFO — no head-of-
+        line jumping). The paged runner's predicate gates on **free KV
+        pages**, not free slots: a prompt whose pages cannot be allocated
+        right now stays queued, and everything behind it waits its turn.
+        ``admit=None`` admits everything."""
         ready, expired = [], []
         with self._lock:
             while self._dq and len(ready) < max_n:
-                req = self._dq.popleft()
+                req = self._dq[0]
                 if req.expired():
-                    expired.append(req)
-                else:
-                    ready.append(req)
+                    expired.append(self._dq.popleft())
+                    continue
+                if admit is not None and not admit(req):
+                    break
+                ready.append(self._dq.popleft())
         # expired requests spent their WHOLE life queued — stamp them too,
         # or the queue-wait histogram under-reports exactly the longest
         # waiters

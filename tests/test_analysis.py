@@ -103,6 +103,11 @@ AST_FIXTURES = {
               "def place(params):\n"
               "    return jax.device_put(params)\n",
               "jax.device_put(params)"),
+    'GL017': ("import jax\n"
+              "@jax.jit\n"
+              "def f(x):\n"
+              "    mask = x > 0\n"
+              "    return x[mask].sum()\n", "x[mask]"),
 }
 
 
@@ -627,6 +632,93 @@ def test_gl016_exempts_harnesses(tmp_path):
         p.write_text(_DEVICE_PUT_SRC)
         findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
         assert [f for f in findings if f.rule == 'GL016'] == [], rel
+
+
+_MASK_INDEX_SRC = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "@jax.jit\n"
+    "def inline_mask(x):\n"
+    "    return x[x > 0]\n"                                 # flagged
+    "@jax.jit\n"
+    "def named_mask(x, lo):\n"
+    "    keep = x > lo\n"
+    "    return x[keep]\n"                                  # flagged
+    "@jax.jit\n"
+    "def dyn_nonzero(x):\n"
+    "    return jnp.nonzero(x)\n"                           # flagged
+    "@jax.jit\n"
+    "def one_arg_where(x):\n"
+    "    return jnp.where(x > 0)\n"                         # flagged
+    "@jax.jit\n"
+    "def sized_nonzero(x):\n"
+    "    return jnp.nonzero(x, size=8)\n"                   # size= pins shape
+    "@jax.jit\n"
+    "def three_arg_where(x):\n"
+    "    return jnp.where(x > 0, x, 0.0)\n"                 # in-place select
+    "@jax.jit\n"
+    "def page_gather(cache, block_tables):\n"
+    "    return cache[block_tables]\n"                      # fixed-shape gather
+    "@jax.jit\n"
+    "def where_gather(x, i, j):\n"
+    "    return x[jnp.where(x > 0, i, j)]\n"   # the fix-it's OWN pattern
+    "@jax.jit\n"
+    "def where_gather_named(x, i, j):\n"
+    "    idx = jnp.where(x > 0, i, j)\n"
+    "    return x[idx]\n"                      # same, via a name
+    "def host_filter(x):\n"
+    "    return x[x > 0]\n")                                # not traced
+
+
+def test_gl017_flags_mask_indexing_and_nonzero_in_traced_code(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'masks.py').write_text(_MASK_INDEX_SRC)
+    findings, _ = lint_paths([str(lib / 'masks.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL017')
+    lines = _MASK_INDEX_SRC.splitlines()
+    assert len(hits) == 4, [(f.rule, f.line) for f in findings]
+    assert 'x[x > 0]' in lines[hits[0] - 1]
+    assert 'x[keep]' in lines[hits[1] - 1]
+    assert 'jnp.nonzero(x)' in lines[hits[2] - 1]
+    assert 'jnp.where(x > 0)' in lines[hits[3] - 1]
+    msg = [f for f in findings if f.rule == 'GL017'][0].message
+    # fix-it points at the fixed-shape gather / page-index pattern
+    assert 'paged_kv' in msg and 'jnp.where' in msg
+
+
+def test_gl017_exempts_harnesses_and_host_code(tmp_path):
+    for rel in ('tests/mod.py', 'tools/mod.py', 'bench.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_MASK_INDEX_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL017'] == [], rel
+    # the same mask indexing outside any traced function never fires
+    host_only = ("import numpy as np\n"
+                 "def pick(x):\n"
+                 "    mask = x > 0\n"
+                 "    return x[mask]\n")
+    p = tmp_path / 'lib.py'
+    p.write_text(host_only)
+    findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+    assert [f for f in findings if f.rule == 'GL017'] == []
+
+
+def test_gl017_inline_waiver(tmp_path):
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    # graftlint: disable=GL017 — eager-only debug helper\n"
+           "    return x[x > 0]\n")
+    p = tmp_path / 'lib.py'
+    p.write_text(src)
+    findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+    hits = [f for f in findings if f.rule == 'GL017']
+    assert len(hits) == 1 and hits[0].waived
+    from paddle_tpu.analysis.finding import active
+    assert active(hits) == []
 
 
 def test_ten_distinct_rule_ids_on_seeded_fixtures(tmp_path):

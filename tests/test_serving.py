@@ -316,8 +316,19 @@ class TestContinuousBatching:
         ep = eng.register('lm', generative=self._lm())
         with pytest.raises(ValueError, match='non-empty'):
             ep.submit({'tokens': np.array([], np.int32)})
+        # chunked prefill lifts the per-bucket cap: 9 > largest bucket (8)
+        # is admissible now; the sequence BUDGET (max_seq) still binds
+        f = ep.submit({'tokens': np.arange(1, 10, dtype=np.int32)},
+                      max_new_tokens=2)
+        eng.run_until_idle()
+        assert f.result(10).ok
+        with pytest.raises(ValueError, match='max_seq'):
+            ep.submit({'tokens': np.arange(32, dtype=np.int32)})
+        # the slot-cache baseline keeps the PR-6 bucket cap
+        ep_slot = eng.register('lm_slot', generative=self._lm(),
+                               kv_cache='slot')
         with pytest.raises(ValueError, match='largest prompt bucket'):
-            ep.submit({'tokens': np.arange(9, dtype=np.int32)})
+            ep_slot.submit({'tokens': np.arange(9, dtype=np.int32)})
 
 
 # ---------------------------------------------------------------------------

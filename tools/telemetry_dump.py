@@ -80,12 +80,17 @@ def to_chrome_trace(events):
 
 def serving_summary(events):
     """Aggregate ``serving.*`` events into one operator-facing dict: request
-    count, status mix, latency/queue-wait percentiles, shed count, and
-    join/leave tallies for the continuous-batching path."""
+    count, status mix, latency/queue-wait percentiles, shed count (split by
+    reason), join/leave tallies for the continuous-batching path, and the
+    paged-KV columns — page utilization, prefix-hit rate, draft acceptance
+    (from the ``serving.kv_stats`` records the paged runner emits)."""
     reqs = [e for e in events if e.get('ev') == 'serving.request']
     sheds = [e for e in events if e.get('ev') == 'serving.shed']
     joins = [e for e in events if e.get('ev') == 'serving.join']
     leaves = [e for e in events if e.get('ev') == 'serving.leave']
+    kv = [e for e in events if e.get('ev') == 'serving.kv_stats']
+    preempts = [e for e in events if e.get('ev') == 'serving.preempt']
+    exhausted = [e for e in events if e.get('ev') == 'serving.page_exhausted']
     by_status, by_model = {}, {}
     lats, queues = [], []
     for e in reqs:
@@ -106,23 +111,40 @@ def serving_summary(events):
                 max(0, int(round(p / 100.0 * (len(vals) - 1)))))
         return round(vals[k], 3)
 
+    def kv_last(key):
+        # the kv_stats records carry cumulative figures: the last one wins
+        for e in reversed(kv):
+            if isinstance(e.get(key), (int, float)):
+                return round(float(e[key]), 4)
+        return None
+
     return {
         'requests': len(reqs),
         'by_status': by_status,
         'by_model': by_model,
         'shed': len(sheds),
+        'shed_page_exhaustion': sum(
+            1 for e in sheds if e.get('reason') == 'page_exhaustion'),
         'joins': len(joins),
         'leaves': len(leaves),
         'p50_latency_ms': pct(lats, 50),
         'p99_latency_ms': pct(lats, 99),
         'p50_queue_ms': pct(queues, 50),
         'p99_queue_ms': pct(queues, 99),
+        'page_utilization': kv_last('page_utilization'),
+        'prefix_hit_rate': kv_last('prefix_hit_rate'),
+        'draft_acceptance': kv_last('draft_acceptance'),
+        'preemptions': len(preempts),
+        'page_exhausted_events': len(exhausted),
     }
 
 
 def render_serving(summary):
-    lines = [f"serving: {summary['requests']} request(s), "
-             f"{summary['shed']} shed"]
+    shed_note = f"{summary['shed']} shed"
+    if summary.get('shed_page_exhaustion'):
+        shed_note += (f" ({summary['shed_page_exhaustion']} from page "
+                      "exhaustion)")
+    lines = [f"serving: {summary['requests']} request(s), {shed_note}"]
     if summary['by_model']:
         lines.append("  by model: " + ', '.join(
             f"{k}: {v}" for k, v in sorted(summary['by_model'].items())))
@@ -135,6 +157,20 @@ def render_serving(summary):
     if summary['joins'] or summary['leaves']:
         lines.append(f"  continuous batching: {summary['joins']} join(s), "
                      f"{summary['leaves']} leave(s)")
+    kv_bits = []
+    if summary.get('page_utilization') is not None:
+        kv_bits.append(f"page util {summary['page_utilization']}")
+    if summary.get('prefix_hit_rate') is not None:
+        kv_bits.append(f"prefix hit rate {summary['prefix_hit_rate']}")
+    if summary.get('draft_acceptance') is not None:
+        kv_bits.append(f"draft acceptance {summary['draft_acceptance']}")
+    if summary.get('preemptions'):
+        kv_bits.append(f"{summary['preemptions']} preemption(s)")
+    if summary.get('page_exhausted_events'):
+        kv_bits.append(
+            f"{summary['page_exhausted_events']} page-exhausted stall(s)")
+    if kv_bits:
+        lines.append("  paged kv: " + ', '.join(kv_bits))
     return '\n'.join(lines)
 
 
