@@ -347,10 +347,14 @@ def bench_serving(duration_s=3.0, rate_mult=3.0, seed=0):
             except serving.QueueFullError:
                 shed += 1
         lat = []
+        phases = {'queue_ms': []}
         for f in futs:
             r = f.result(timeout=60)
             if r.ok:
                 lat.append(r.latency_ms)
+                phases['queue_ms'].append(r.queue_ms)
+                for k, v in r.breakdown.items():
+                    phases.setdefault(f'{k}_ms', []).append(v)
         wall = time.perf_counter() - t0
         eng_c.stop()
         offered = len(futs) + shed
@@ -386,6 +390,13 @@ def bench_serving(duration_s=3.0, rate_mult=3.0, seed=0):
             if (hits + misses) else 0.0,
             'compiles_after_warmup': compiles_delta,
             'doctor': doctor_causes,
+            # where a request's life goes: queue wait vs model run, p50/p99
+            # over the completed set (responses carry the runner-attributed
+            # phase breakdown)
+            'request_breakdown': {
+                k: {'p50': round(float(np.percentile(vals, 50)), 3),
+                    'p99': round(float(np.percentile(vals, 99)), 3)}
+                for k, vals in sorted(phases.items()) if vals},
         }
     finally:
         if not was_static:
@@ -448,6 +459,20 @@ def bench_serving_generative(seed=0):
     assert peak_concurrency >= 4 * slot_baseline, out['concurrency']
 
     # -- tokens/sec, speculation off vs on --------------------------------
+    def breakdown_of(reqs):
+        """queue/prefill/decode p50/p99 over completed responses."""
+        phases = {'queue_ms': []}
+        for f in reqs:
+            r = f.result(timeout=30)
+            if not r.ok:
+                continue
+            phases['queue_ms'].append(r.queue_ms)
+            for k, v in r.breakdown.items():
+                phases.setdefault(f'{k}_ms', []).append(v)
+        return {k: {'p50': round(float(np.percentile(vals, 50)), 3),
+                    'p99': round(float(np.percentile(vals, 99)), 3)}
+                for k, vals in sorted(phases.items()) if vals}
+
     def drive(draft, draft_k, n_req=24, max_new=12):
         lm2 = serving.TinyCausalLM.random(
             vocab=64, embed=32, num_heads=4, max_batch=8, max_seq=64,
@@ -474,11 +499,12 @@ def bench_serving_generative(seed=0):
                    for f in reqs)
         st = eng2.stats()['models']['lm']
         return (toks / wall if wall > 0 else 0.0, st,
-                snap('jax.compiles') - c0)
+                snap('jax.compiles') - c0, reqs)
 
-    tps_plain, _, d1 = drive(None, 1)
-    tps_spec, st_spec, d2 = drive('small', 4)
-    tps_oracle, st_oracle, d5 = drive('same', 4)
+    tps_plain, _, d1, plain_reqs = drive(None, 1)
+    out['request_breakdown'] = breakdown_of(plain_reqs)
+    tps_spec, st_spec, d2, _r = drive('small', 4)
+    tps_oracle, st_oracle, d5, _r = drive('same', 4)
     compile_delta += d1 + d2 + d5
     out['speculation'] = {
         'tokens_per_sec_plain': round(tps_plain, 1),
@@ -1113,6 +1139,24 @@ def _telemetry_counters():
         return {'error': repr(e)}
 
 
+def _cost_ledger():
+    """Cost-explorer extras: the ledger summary + per-program rows (FLOPs,
+    bytes accessed, peak memory, roofline bound) every compiled program in
+    the bench run registered. Never fatal."""
+    try:
+        from paddle_tpu import observability as obs
+        out = obs.costs.summary()
+        out['programs_detail'] = [
+            {k: e[k] for k in ('program', 'kind', 'flops', 'bytes_accessed',
+                               'peak_bytes', 'hits')}
+            | {'bound': e['roofline']['bound'],
+               'est_ms': e['roofline']['est_ms']}
+            for e in obs.costs.ledger()[:40]]
+        return out
+    except Exception as e:
+        return {'error': repr(e)}
+
+
 def _enable_telemetry():
     try:
         from paddle_tpu import observability as obs
@@ -1236,6 +1280,7 @@ def _child_main(mode, model):
         result["complete"] = True   # all sections measured: the timeout/
         # crash paths in _run_child must not annotate this line as partial
         result["extras"]["telemetry"] = _telemetry_counters()
+        result["extras"]["costs"] = _cost_ledger()
         print(json.dumps(result), flush=True)
         record_onchip(result)
     else:  # local smoke mode: same code path, tiny shapes
@@ -1255,6 +1300,10 @@ def _child_main(mode, model):
         except Exception as e:       # must never sink smoke either
             serving_extras['generative'] = {'error': repr(e)}
         telemetry = _telemetry_counters()
+        # cost ledger BEFORE bench_engine for the same reason as the
+        # counter capture: its prefetch section resets the registry (and
+        # with it the ledger), which would drop the serving programs
+        costs_extras = _cost_ledger()
         try:
             # unified train-step compiler numbers (ISSUE 9): steps/sec,
             # compiles after warmup, host bytes/step, prefetch wait p50.
@@ -1283,7 +1332,10 @@ def _child_main(mode, model):
             "extras": {"telemetry": telemetry,
                        "serving": serving_extras,
                        "engine": engine_extras,
-                       "sharding": sharding_extras},
+                       "sharding": sharding_extras,
+                       # cost explorer (ISSUE 13): every program the run
+                       # compiled, with FLOPs/bytes/peak + roofline bound
+                       "costs": costs_extras},
             "complete": True,
         }))
 

@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL017).
+"""The graftlint AST rule catalog (GL001–GL018).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -55,6 +55,15 @@ but destroys performance or correctness on real hardware:
   serving load peaks. Use a fixed-shape gather over an index table (the
   ``serving.paged_kv`` block-table pattern), 3-arg ``jnp.where(cond, a,
   b)``, or the ``size=`` kwarg that pins the output shape.
+
+- GL018: an unpaired profiler/span start in library code —
+  ``jax.profiler.start_trace`` without ``stop_trace`` in a ``finally``
+  (one exception and the device trace leaks: every later span bridges
+  into a trace nobody will ever stop or collect), ``start_server``
+  outside tools/bench (an unowned background profiler server), or a
+  manual ``span()``/``timer()`` ``.__enter__()`` whose ``.__exit__`` is
+  not exception-safe. Wrap the region in ``with observability.span(...)``
+  (pairs enter/exit on every path) or stop in a ``finally``.
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -1213,3 +1222,155 @@ class DataDependentMaskIndexRule(Rule):
                         "with 3-arg jnp.where(cond, a, b) or gather over "
                         "a fixed-shape index table (serving.paged_kv "
                         "block-table pattern)")
+
+
+# -- GL018: unpaired profiler/span start in library code ---------------------
+
+# the modules whose JOB is profiler lifetime management (the sanctioned
+# wrappers + the telemetry spine), test suites, and dev harnesses
+_PROFILER_EXEMPT_PREFIXES = ('tests/', 'tools/',
+                             'paddle_tpu/observability/', 'observability/',
+                             'paddle_tpu/utils/profiler.py',
+                             'utils/profiler.py')
+
+_SPAN_FACTORIES = ('span', 'timer')
+
+
+@register
+class UnpairedProfilerStartRule(Rule):
+    """GL018: a profiler/span started without an exception-safe stop in
+    library code. ``jax.profiler.start_trace`` whose ``stop_trace`` is not
+    in a ``finally`` leaks the device trace on the first exception — every
+    later span then bridges into a trace nobody will stop or collect, and
+    a second ``start_trace`` raises. ``start_server`` in library code is
+    an unowned background profiler port (run it from tools/bench where
+    something owns its lifetime). A manual ``span()``/``timer()``
+    ``.__enter__()`` with the ``.__exit__`` outside a ``finally`` is the
+    same leak one layer up. Fix-it: wrap the region in ``with
+    paddle_tpu.observability.span(name):`` — it pairs enter/exit on every
+    exit path and lands in both viewers — or move the stop into a
+    ``finally``."""
+    id = 'GL018'
+    title = 'unpaired profiler/span start in library code'
+
+    def in_scope(self, rel):
+        if any(rel == p or rel.startswith(p)
+               for p in _PROFILER_EXEMPT_PREFIXES):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def _scopes(self, tree):
+        """{scope_node_or_None: [nodes]} with every node assigned to its
+        INNERMOST enclosing function (None = module level)."""
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        owner = {}
+        for fn in funcs:            # ast.walk is BFS: outer functions come
+            for n in ast.walk(fn):  # first, inner overwrite -> innermost
+                owner[id(n)] = fn
+        scopes = {None: []}
+        for fn in funcs:
+            scopes[fn] = []
+        for n in ast.walk(tree):
+            scopes[owner.get(id(n))].append(n)
+        return scopes
+
+    def _finally_call_tails(self, nodes, node_set):
+        """Attribute/name tails of calls inside ``finally`` blocks that
+        belong to this scope's nodes."""
+        tails = set()
+        for n in nodes:
+            if not (isinstance(n, ast.Try) and n.finalbody):
+                continue
+            for stmt in n.finalbody:
+                for c in ast.walk(stmt):
+                    if id(c) not in node_set or not isinstance(c, ast.Call):
+                        continue
+                    d = _dotted(c.func)
+                    if d:
+                        tails.add(d.rsplit('.', 1)[-1])
+                    elif isinstance(c.func, ast.Attribute):
+                        tails.add(c.func.attr)
+        return tails
+
+    def _span_names(self, nodes):
+        """Local names assigned from span()/timer() factory calls (the
+        ``s = span(...); s.__enter__()`` spelling)."""
+        names = set()
+        for n in nodes:
+            if not isinstance(n, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = n.value
+            if not (isinstance(value, ast.Call) and
+                    _tail_name(value.func) in _SPAN_FACTORIES):
+                continue
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        return names
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        for scope, nodes in self._scopes(ctx.tree).items():
+            node_set = {id(n) for n in nodes}
+            finally_tails = None   # computed lazily: most scopes are clean
+            span_names = None
+            for n in nodes:
+                if not isinstance(n, ast.Call):
+                    continue
+                tail = _tail_name(n.func)
+                if tail == 'start_server' and \
+                        'profiler' in (_dotted(n.func) or ''):
+                    yield self.finding(
+                        ctx, n,
+                        "jax.profiler.start_server() in library code — an "
+                        "unowned background profiler port that outlives "
+                        "the caller; start the server from a tools/ or "
+                        "bench harness that owns its lifetime, or gate it "
+                        "behind an explicit operator knob")
+                    continue
+                if tail == 'start_trace' and \
+                        'profiler' in (_dotted(n.func) or ''):
+                    if finally_tails is None:
+                        finally_tails = self._finally_call_tails(nodes,
+                                                                 node_set)
+                    if 'stop_trace' not in finally_tails:
+                        yield self.finding(
+                            ctx, n,
+                            "jax.profiler.start_trace() without "
+                            "stop_trace() in a finally — one exception "
+                            "between start and stop leaks the device "
+                            "trace (later spans bridge into a trace "
+                            "nobody collects; a second start raises); "
+                            "wrap the region in `with paddle_tpu."
+                            "observability.span(name):` or stop in a "
+                            "finally")
+                    continue
+                if tail != '__enter__' or not isinstance(n.func,
+                                                         ast.Attribute):
+                    continue
+                recv = n.func.value
+                direct = isinstance(recv, ast.Call) and \
+                    _tail_name(recv.func) in _SPAN_FACTORIES
+                named = False
+                if isinstance(recv, ast.Name):
+                    if span_names is None:
+                        span_names = self._span_names(nodes)
+                    named = recv.id in span_names
+                if not (direct or named):
+                    continue
+                if finally_tails is None:
+                    finally_tails = self._finally_call_tails(nodes,
+                                                             node_set)
+                if '__exit__' not in finally_tails:
+                    yield self.finding(
+                        ctx, n,
+                        "manual span()/timer() __enter__ whose __exit__ "
+                        "is not in a finally — an exception in the timed "
+                        "region leaves the span open (its duration never "
+                        "lands in the registry or the trace); use `with "
+                        "paddle_tpu.observability.span(name):` so the "
+                        "exit runs on every path")

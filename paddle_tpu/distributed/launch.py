@@ -156,8 +156,11 @@ def _worker(rank, nprocs, func, args, result_dir):
     with open(os.path.join(result_dir, f'started_{rank}'), 'w'):
         pass   # atomic-ok: zero-byte phase marker, existence is the datum
     # mission control: stream this rank's telemetry into the run dir so the
-    # supervisor can aggregate it (no-op unless PADDLE_TPU_TELEMETRY=1)
+    # supervisor can aggregate it (no-op unless PADDLE_TPU_TELEMETRY=1).
+    # The flight recorder's crash hooks are ALWAYS on: a SIGTERM'd or
+    # crashing rank leaves flight_rank<R>.json in the run dir either way.
     from .. import observability as _obs
+    _obs.flight.install_crash_hooks()
     if _obs.enabled():
         _obs.start_rank_flusher(rank=rank)
     # results travel via files (atomic commit), not an mp.Queue — queue FDs
@@ -170,6 +173,10 @@ def _worker(rank, nprocs, func, args, result_dir):
         payload = ('ok', result)
     except BaseException as e:  # surface the failure to the parent
         atomic_pickle_dump(('error', repr(e)), path)
+        # black box: dump the ring next to the heartbeat files so the
+        # supervisor-side post-mortem has this rank's last seconds
+        _obs.flight.dump('worker_exception', exc=e,
+                         extra={'rank': rank}, run_dir=result_dir)
         raise
     finally:
         hb.stop()
@@ -428,6 +435,21 @@ class _Supervisor:
                         _obs.counter('distributed.rank_failures').inc()
                         _obs.event('rank_failed', rank=rank, exitcode=code,
                                    signal=err.signal_name)
+                    # supervisor-side black box (always-on): the failed
+                    # rank's own dump lives in the run dir; this one
+                    # records what the supervisor saw — under its OWN
+                    # name (the supervisor has no PADDLE_TRAINER_ID, so
+                    # the default flight_rank0.json would masquerade as,
+                    # and could clobber, rank 0's real dump)
+                    # run_dir explicitly: the run-dir env vars are only
+                    # set for the CHILDREN, so the default would land
+                    # this in the global telemetry dir instead of next
+                    # to the ranks' own dumps
+                    _obs.flight.dump('rank_failed', exc=err,
+                                     extra={'failed_rank': rank,
+                                            'exitcode': code},
+                                     filename='flight_supervisor.json',
+                                     run_dir=self.telemetry_dir())
                     raise err
             if not running:
                 return

@@ -25,6 +25,7 @@ rollback snapshot (which donation would invalidate). Host bookkeeping
 the caller's log cadence through :meth:`TrainStep.sync`.
 """
 import functools
+import itertools
 import os
 
 import numpy as np
@@ -32,6 +33,10 @@ import jax
 import jax.numpy as jnp
 
 from .. import observability as _obs
+
+# distinguishes the default cost-ledger labels of multiple TrainSteps
+# built in one process (frontends that care set .cost_label explicitly)
+_STEP_SEQ = itertools.count()
 
 __all__ = ['build_train_step', 'TrainStep', 'StepResult', 'DeviceLoss',
            'donation_supported', 'matmul_preference']
@@ -336,6 +341,10 @@ class TrainStep:
         self.guard_enabled = nan_guard
         self.scaler = scaler
         self.sharding = sharding
+        # cost explorer: this step's ledger label (Executor overrides it
+        # with the program fingerprint) + the captured-once latch
+        self.cost_label = f'engine.train_step{next(_STEP_SEQ)}'
+        self._cost_captured = False
         self._params_meta = params_meta
         self._trainable = trainable
         self._with_key = with_key
@@ -652,6 +661,15 @@ class TrainStep:
             if key is not None:
                 key = jax.device_put(key, self.sharding.replicated())
         telemetry = _obs.enabled()
+        if telemetry and not self._cost_captured:
+            # cost explorer: AOT-ledger this program's FLOPs/bytes/peak
+            # memory once, while the first dispatch is compiling anyway
+            self._cost_captured = True
+            args = (state, batch, key) if self._with_key else (state, batch)
+            _obs.costs.capture(
+                self.cost_label, self._jit, *args, kind='train_step',
+                meta={'microbatch': self.k, 'donates': self.donates,
+                      'sharded': self.sharding is not None})
         if telemetry:
             with _obs.timer('engine.step', k=self.k):
                 out = self._jit(state, batch, key) if self._with_key \

@@ -35,12 +35,14 @@ import other ``paddle_tpu`` modules at the top level.
 from . import events as _events
 from . import interpose, registry, spans, state, timing  # noqa: F401
 from . import aggregate, doctor, endpoint, flush  # noqa: F401  mission ctl
+from . import costs, flight, slo  # noqa: F401  cost explorer + black box
 from .state import enable, disable, enabled, log_dir, sync_every
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry, counter, gauge, histogram, snapshot,
                        to_prometheus)
 from .registry import reset as reset_metrics
-from .spans import span, Span, dump_chrome_trace, trace_events
+from .spans import (span, Span, dump_chrome_trace, trace_events,
+                    async_begin, async_instant, async_end)
 from .timing import Stopwatch, timer
 from .interpose import (install_jax_hooks, record_host_transfer,
                         record_collective)
@@ -64,6 +66,7 @@ __all__ = [
     'counter', 'gauge', 'histogram', 'snapshot', 'to_prometheus',
     'reset_metrics', 'reset',
     'span', 'Span', 'dump_chrome_trace', 'trace_events',
+    'async_begin', 'async_instant', 'async_end',
     'event', 'event_log', 'dump_jsonl', 'set_sink', 'close_sink', 'wall_ts',
     'Stopwatch', 'timer',
     'install_jax_hooks', 'record_host_transfer', 'record_collective',
@@ -72,14 +75,20 @@ __all__ = [
     'aggregate', 'doctor', 'endpoint', 'flush',
     'start_rank_flusher', 'stop_rank_flusher', 'MetricsServer',
     'diagnose', 'run_doctor',
+    # cost explorer + SLO tracker + flight recorder
+    'costs', 'slo', 'flight',
 ]
 
 
 def reset():
-    """Clear every buffer (metrics, events, spans) — test isolation hook."""
+    """Clear every buffer (metrics, events, spans, cost ledger, SLO
+    tallies, flight ring) — test isolation hook."""
     reset_metrics()
     _events.clear()
     spans.clear()
+    costs.reset()
+    slo.reset()
+    flight.clear()
 
 
 def __getattr__(name):

@@ -30,10 +30,10 @@ import time
 
 __all__ = ['rank_files', 'load_rank_snapshots', 'heartbeat_ages',
            'cluster_snapshot', 'merged_events', 'merged_chrome_trace',
-           'write_merged']
+           'flight_dumps', 'write_merged']
 
 _RANK_FILE_RE = re.compile(
-    r'^(telemetry|events|trace)_rank(\d+)\.(json|jsonl)$')
+    r'^(telemetry|events|trace|flight)_rank(\d+)\.(json|jsonl)$')
 
 
 def rank_files(run_dir):
@@ -110,6 +110,7 @@ def cluster_snapshot(run_dir):
     mean step time — the straggler headline number."""
     heads = load_rank_snapshots(run_dir)
     ages = heartbeat_ages(run_dir, ranks=sorted(heads) or None)
+    flights = flight_dumps(run_dir)
     per_rank, totals = {}, {}
     for rank, head in sorted(heads.items()):
         metrics = head.get('metrics') or {}
@@ -148,7 +149,33 @@ def cluster_snapshot(run_dir):
         'counters_total': totals,
         'heartbeat_age_s': ages,
         'step_ms_skew': skew,
+        # crash post-mortems: {rank: {reason, ts, exception?}} for every
+        # flight_rank<R>.json a dying rank left behind — a rank may have a
+        # dump and NO telemetry head (telemetry off, flight always-on)
+        'flight_dumps': flights,
     }
+
+
+def flight_dumps(run_dir):
+    """``{rank: {'reason', 'ts', 'path', 'exception'?}}`` for every
+    flight-recorder dump in the run dir (``tools/postmortem.py`` renders
+    the full documents; the snapshot carries the headline)."""
+    out = {}
+    for rank, files in rank_files(run_dir).items():
+        path = files.get('flight')
+        if not path:
+            continue
+        doc = _load_json(path)
+        if not isinstance(doc, dict) or 'reason' not in doc:
+            continue
+        row = {'reason': doc.get('reason'), 'ts': doc.get('ts'),
+               'path': path}
+        exc = doc.get('exception')
+        if isinstance(exc, dict):
+            row['exception'] = {'type': exc.get('type'),
+                                'message': exc.get('message')}
+        out[rank] = row
+    return out
 
 
 def merged_events(run_dir):

@@ -14,8 +14,8 @@ code changes; pass your own instance to control ``log_dir``.
 import os
 
 from ..hapi.callbacks import Callback
-from . import (doctor, endpoint, events, flush, interpose, registry, spans,
-               state, timing)
+from . import (doctor, endpoint, events, flight, flush, interpose, registry,
+               spans, state, timing)
 
 __all__ = ['TelemetryCallback']
 
@@ -41,6 +41,9 @@ class TelemetryCallback(Callback):
 
     # -- train lifecycle ----------------------------------------------------
     def on_train_begin(self, logs=None):
+        # the flight recorder's crash hooks ride along regardless of the
+        # telemetry switch: a SIGTERM'd fit leaves its black box behind
+        flight.install_crash_hooks()
         if not state.enabled():
             return
         self._train_sw = timing.Stopwatch()

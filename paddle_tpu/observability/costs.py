@@ -1,0 +1,290 @@
+"""Cost explorer: compiled-program cost attribution for the whole runtime.
+
+Every program the runtime compiles — Executor program-cache entries, the
+unified ``engine.build_train_step`` step, and the serving runners' closed
+program sets — is captured ONCE at build/warmup time into a process-wide
+**cost ledger** keyed by program label:
+
+- ``flops`` / ``bytes_accessed`` from XLA's ``Compiled.cost_analysis()``;
+- ``argument`` / ``output`` / ``temp`` / ``generated_code`` bytes (and
+  their sum, ``peak_bytes``) from ``Compiled.memory_analysis()`` — all
+  available on CPU, so the numbers are provable without a chip;
+- an **analytic roofline** estimate: arithmetic intensity (flops per byte
+  accessed) against configurable device peaks names whether the program is
+  compute- or memory-bound and what its floor step time would be. The
+  peaks are *nominal* (env-overridable), the estimate is a bound, not a
+  measurement — see docs/OBSERVABILITY.md, "Cost explorer" for caveats.
+
+Capture is an AOT ``fn.lower(*args).compile()`` — one extra backend
+compile per program, paid once while the program is being built/warmed
+anyway; repeat requests are ledger hits (``jax.compiles`` flatness gates
+stay flat after warmup). Everything is off until telemetry is enabled.
+
+Surfaces: ``cost.flops{program=}`` / ``cost.peak_bytes{program=}`` gauges,
+one ``cost.program`` event per capture (what ``tools/telemetry_dump.py
+--costs`` tabulates), the ``/costs`` endpoint slice, the per-rank flush
+head, and BENCH ``extras.costs``.
+
+Env knobs:
+
+- ``PADDLE_TPU_DEVICE_PEAK_FLOPS``     roofline peak FLOP/s override
+- ``PADDLE_TPU_DEVICE_PEAK_BPS``       roofline peak memory bytes/s override
+- ``PADDLE_TPU_HBM_BUDGET``            device memory budget in bytes (the
+                                       doctor's ``memory_pressure`` detector
+                                       compares ledger ``peak_bytes`` to it)
+
+Stdlib-only at import (jax is imported lazily inside ``capture``).
+"""
+import os
+import threading
+
+from . import events, registry, state
+
+__all__ = ['capture', 'record_compiled', 'mark_hit', 'ledger', 'entry',
+           'summary', 'reset', 'device_peaks', 'roofline', 'hbm_budget']
+
+_lock = threading.Lock()
+_ledger = {}         # program label -> entry dict
+
+
+# nominal peak (FLOP/s, bytes/s) per backend for the analytic roofline —
+# deliberately round numbers: the roofline is a *bound* used to rank
+# programs and name the binding resource, not a performance prediction
+_DEFAULT_PEAKS = {
+    'tpu': (275e12, 1.2e12),     # ~v4 chip: bf16 MXU peak, HBM2 bw
+    'gpu': (312e12, 2.0e12),     # ~A100 bf16 / HBM2e
+    'cpu': (2e11, 5e10),         # a few AVX cores / dual-channel DRAM
+}
+
+
+def _env_float(name):
+    raw = os.environ.get(name, '')
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def device_peaks(backend=None):
+    """(peak_flops_per_s, peak_bytes_per_s) for the roofline: the env
+    overrides when set, else the nominal table entry for the backend."""
+    if backend is None:
+        try:
+            import jax
+            backend = jax.default_backend()
+        except Exception:
+            backend = 'cpu'
+    flops, bps = _DEFAULT_PEAKS.get(backend, _DEFAULT_PEAKS['cpu'])
+    return (_env_float('PADDLE_TPU_DEVICE_PEAK_FLOPS') or flops,
+            _env_float('PADDLE_TPU_DEVICE_PEAK_BPS') or bps)
+
+
+def hbm_budget():
+    """Device-memory budget in bytes for memory-pressure accounting:
+    ``PADDLE_TPU_HBM_BUDGET`` when set, else the device's reported limit
+    (TPU/GPU ``memory_stats``; CPU reports none), else None."""
+    raw = os.environ.get('PADDLE_TPU_HBM_BUDGET', '')
+    if raw:
+        try:
+            return int(float(raw))
+        except ValueError:
+            pass
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get('bytes_limit')
+        return int(limit) if limit else None
+    except Exception:
+        return None
+
+
+def roofline(flops, bytes_accessed, backend=None):
+    """Analytic roofline for one program: arithmetic intensity vs the
+    device ridge point -> binding resource + floor time estimate."""
+    peak_flops, peak_bps = device_peaks(backend)
+    ai = (flops / bytes_accessed) if bytes_accessed else 0.0
+    ridge = peak_flops / peak_bps
+    est_s = max(flops / peak_flops if peak_flops else 0.0,
+                bytes_accessed / peak_bps if peak_bps else 0.0)
+    return {
+        'arithmetic_intensity': round(ai, 4),
+        'ridge': round(ridge, 4),
+        'bound': 'compute' if ai >= ridge else 'memory',
+        'est_ms': round(est_s * 1e3, 6),
+        'peak_flops': peak_flops,
+        'peak_bytes_per_s': peak_bps,
+    }
+
+
+def _cost_scalars(cost):
+    """flops / bytes accessed from a ``cost_analysis()`` result (a dict in
+    newer jax, a one-element list of dicts in older)."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return 0.0, 0.0
+    return (float(cost.get('flops', 0.0) or 0.0),
+            float(cost.get('bytes accessed', 0.0) or 0.0))
+
+
+def _memory_scalars(mem):
+    """argument/output/temp/generated-code bytes from ``memory_analysis()``
+    (a CompiledMemoryStats-like object; absent fields read 0)."""
+    def grab(attr):
+        try:
+            return int(getattr(mem, attr, 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+    return {
+        'argument_bytes': grab('argument_size_in_bytes'),
+        'output_bytes': grab('output_size_in_bytes'),
+        'temp_bytes': grab('temp_size_in_bytes'),
+        'alias_bytes': grab('alias_size_in_bytes'),
+        'generated_code_bytes': grab('generated_code_size_in_bytes'),
+    }
+
+
+def capture(program, fn, *args, kind='jit', meta=None):
+    """AOT-lower+compile ``fn`` at ``args``' shapes and ledger the result
+    under ``program``. Returns the (possibly pre-existing) entry, or None
+    when telemetry is off or the capture failed — a failed capture must
+    never fail the program it describes. Idempotent per label: a second
+    call is a ledger **hit** (no recompile), so cost numbers are stable
+    across program-cache hits."""
+    if not state.enabled():
+        return None
+    with _lock:
+        ent = _ledger.get(program)
+    if ent is not None:
+        mark_hit(program)
+        return ent
+    try:
+        compiled = fn.lower(*args).compile()
+    except Exception as e:
+        events.emit('cost.capture_error', program=str(program),
+                    error=repr(e))
+        return None
+    return record_compiled(program, compiled, kind=kind, meta=meta)
+
+
+def record_compiled(program, compiled, kind='jit', meta=None):
+    """Ledger an already-compiled ``jax.stages.Compiled`` (the AOT-export
+    path, or a capture that happened elsewhere)."""
+    if not state.enabled():
+        return None
+    try:
+        flops, bytes_accessed = _cost_scalars(compiled.cost_analysis())
+    except Exception:
+        flops = bytes_accessed = 0.0
+    mem = {}
+    try:
+        mem = _memory_scalars(compiled.memory_analysis())
+    except Exception:
+        pass
+    return record_costs(program, flops, bytes_accessed, mem,
+                        kind=kind, meta=meta)
+
+
+def record_costs(program, flops, bytes_accessed, mem=None, kind='jit',
+                 meta=None):
+    """Ledger raw numbers (the seam record_compiled/capture feed; also lets
+    tests and external analyzers inject entries)."""
+    if not state.enabled():
+        return None
+    mem = dict(mem or {})
+    peak = (mem.get('argument_bytes', 0) + mem.get('output_bytes', 0) +
+            mem.get('temp_bytes', 0) + mem.get('generated_code_bytes', 0))
+    entry = {
+        'program': str(program),
+        'kind': str(kind),
+        'flops': float(flops),
+        'bytes_accessed': float(bytes_accessed),
+        'peak_bytes': int(peak),
+        'captured_ts': round(events.wall_ts(), 6),
+        'hits': 0,
+    }
+    entry.update(mem)
+    entry['roofline'] = roofline(entry['flops'], entry['bytes_accessed'])
+    if meta:
+        entry['meta'] = dict(meta)
+    with _lock:
+        fresh = program not in _ledger
+        _ledger[program] = entry
+    lbl = {'program': str(program)}
+    registry.gauge('cost.flops', labels=lbl).set(entry['flops'])
+    registry.gauge('cost.bytes_accessed', labels=lbl).set(
+        entry['bytes_accessed'])
+    registry.gauge('cost.peak_bytes', labels=lbl).set(entry['peak_bytes'])
+    registry.counter('cost.captures').inc()
+    if fresh:
+        registry.counter('cost.programs').inc()
+    events.emit('cost.program', program=entry['program'],
+                program_kind=entry['kind'],
+                flops=entry['flops'], bytes_accessed=entry['bytes_accessed'],
+                peak_bytes=entry['peak_bytes'],
+                argument_bytes=entry.get('argument_bytes', 0),
+                output_bytes=entry.get('output_bytes', 0),
+                temp_bytes=entry.get('temp_bytes', 0),
+                arithmetic_intensity=entry['roofline'][
+                    'arithmetic_intensity'],
+                bound=entry['roofline']['bound'],
+                est_ms=entry['roofline']['est_ms'])
+    return entry
+
+
+def mark_hit(program):
+    """Count one reuse of a ledgered program (a program-cache hit)."""
+    with _lock:
+        ent = _ledger.get(program)
+        if ent is not None:
+            ent['hits'] += 1
+    if state.enabled():
+        registry.counter('cost.hits').inc()
+    return ent
+
+
+def entry(program):
+    with _lock:
+        ent = _ledger.get(program)
+    return dict(ent) if ent is not None else None
+
+
+def ledger():
+    """Snapshot of every entry, sorted by descending flops."""
+    with _lock:
+        entries = [dict(e) for e in _ledger.values()]
+    entries.sort(key=lambda e: (-e['flops'], e['program']))
+    return entries
+
+
+def summary():
+    """Headline ledger aggregates (BENCH ``extras.costs``, flight dumps,
+    the flush head)."""
+    entries = ledger()
+    peak_prog = max(entries, key=lambda e: e['peak_bytes'], default=None)
+    by_kind = {}
+    for e in entries:
+        by_kind[e['kind']] = by_kind.get(e['kind'], 0) + 1
+    out = {
+        'programs': len(entries),
+        'total_flops': round(sum(e['flops'] for e in entries), 1),
+        'total_bytes_accessed': round(
+            sum(e['bytes_accessed'] for e in entries), 1),
+        'max_peak_bytes': peak_prog['peak_bytes'] if peak_prog else 0,
+        'max_peak_program': peak_prog['program'] if peak_prog else None,
+        'hits': sum(e['hits'] for e in entries),
+        'by_kind': by_kind,
+    }
+    budget = hbm_budget()
+    if budget:
+        out['hbm_budget'] = budget
+        out['peak_budget_ratio'] = round(
+            out['max_peak_bytes'] / budget, 4)
+    return out
+
+
+def reset():
+    with _lock:
+        _ledger.clear()

@@ -33,6 +33,15 @@ Detector catalog (docs/OBSERVABILITY.md has the operator version):
                       prefix_cache), never replicas or queue capacity.
 - ``rank_flatline``   a rank's heartbeat is stale while siblings beat on
                       (wedged collective / dead process).
+- ``memory_pressure`` the cost ledger's worst per-program ``peak_bytes``
+                      approaches (>= 80%) or exceeds the device memory
+                      budget (``PADDLE_TPU_HBM_BUDGET`` or the device's
+                      reported limit) — the next bigger batch/sequence
+                      OOMs. The fix is memory-side: microbatch, remat,
+                      FSDP sharding.
+- ``slo_burn``        a served model is burning its latency error budget
+                      faster than its objective allows (the SLO tracker's
+                      ``burn_rate``; warning at 1x, critical at 5x).
 
 Ranked output: ``critical`` > ``warning`` > ``info``. Standalone on
 purpose — stdlib-only, importable by path — so ``tools/doctor.py`` works
@@ -53,6 +62,25 @@ RETRACE_GRACE = 3              # compiles beyond warmup that are tolerated
 INPUT_BOUND_RATIO = 0.5        # dataloader wait / step time
 OVERLOAD_RATIO = 0.05          # (shed + expired) / offered
 STALE_HEARTBEAT_S = 10.0
+MEMORY_PRESSURE_RATIO = 0.8    # worst program peak_bytes / memory budget
+SLO_BURN_WARNING = 1.0         # error-budget burn rate thresholds
+SLO_BURN_CRITICAL = 5.0
+
+
+def _labeled(section, prefix, key='model'):
+    """``{label_value: number}`` from snapshot keys shaped
+    ``prefix{key=value}`` (the registry's labeled-instrument spelling).
+    These families carry exactly ONE label key, so everything between
+    ``key=`` and the closing brace IS the value — no comma split, which
+    would truncate values that legitimately contain commas (the
+    Executor's ``executor.p1[4x8,16x2]`` shape-signature labels)."""
+    out = {}
+    marker = prefix + '{' + key + '='
+    for k, v in (section or {}).items():
+        if k.startswith(marker) and k.endswith('}') and \
+                isinstance(v, (int, float)):
+            out[k[len(marker):-1]] = v
+    return out
 
 
 def _diag(cause, severity, detail, fix, **evidence):
@@ -316,6 +344,107 @@ def detect_rank_flatline(events=None, snapshot=None, cluster=None,
             rank=rank, heartbeat_age_s=age, fresh_ranks=sorted(fresh))
 
 
+def detect_memory_pressure(events=None, snapshot=None, cluster=None,
+                           hbm_budget=None,
+                           memory_pressure_ratio=MEMORY_PRESSURE_RATIO, **_):
+    """Worst per-program peak memory vs. the device budget, from the cost
+    ledger's ``cost.peak_bytes{program=}`` gauges (snapshot) or
+    ``cost.program`` events. Budget: the ``hbm_budget`` override, the
+    ``PADDLE_TPU_HBM_BUDGET`` env (bytes), or — when jax is importable,
+    which it is not from the path-loaded tools — the device's reported
+    ``bytes_limit``."""
+    import os
+    budget = hbm_budget
+    if budget is None:
+        raw = os.environ.get('PADDLE_TPU_HBM_BUDGET', '')
+        if raw:
+            try:
+                budget = int(float(raw))
+            except ValueError:
+                budget = None
+    if budget is None:
+        try:
+            import jax
+            stats = jax.devices()[0].memory_stats() or {}
+            budget = int(stats.get('bytes_limit') or 0) or None
+        except Exception:
+            budget = None
+    if not budget:
+        return
+    peaks = {}
+    if snapshot is not None:
+        peaks.update(_labeled(snapshot.get('gauges'), 'cost.peak_bytes',
+                              key='program'))
+    for e in (events or []):
+        if e.get('ev') == 'cost.program' and isinstance(
+                e.get('peak_bytes'), (int, float)):
+            name = str(e.get('program', '?'))
+            peaks[name] = max(peaks.get(name, 0), float(e['peak_bytes']))
+    if not peaks:
+        return
+    worst_prog, worst = max(peaks.items(), key=lambda kv: kv[1])
+    ratio = worst / budget
+    if ratio < memory_pressure_ratio:
+        return
+    yield _diag(
+        'memory_pressure', 'critical' if ratio >= 1.0 else 'warning',
+        f"program {worst_prog!r} peaks at {worst / 1e6:.1f} MB = "
+        f"{100 * ratio:.0f}% of the {budget / 1e6:.1f} MB device budget"
+        + (" — it does not fit" if ratio >= 1.0 else
+           " — the next bigger batch/sequence will not fit"),
+        "cut live memory: engine.build_train_step(microbatch=k) to shrink "
+        "the per-dispatch batch, remat='dots'/'full' to trade FLOPs for "
+        "activations, sharding= (FSDP) to split params/optimizer state "
+        "across the mesh, or page the serving KV cache down; raise "
+        "PADDLE_TPU_HBM_BUDGET only if the budget was set conservatively",
+        program=worst_prog, peak_bytes=int(worst), budget_bytes=int(budget),
+        ratio=round(ratio, 4))
+
+
+def detect_slo_burn(events=None, snapshot=None, cluster=None,
+                    slo_burn_warning=SLO_BURN_WARNING,
+                    slo_burn_critical=SLO_BURN_CRITICAL, **_):
+    """Error-budget burn per served model, from the SLO tracker's
+    ``slo.burn_rate{model=}`` gauge (snapshot) or the ``slo.violation``
+    event stream. The gauge WINS where both exist: it is updated on every
+    request, while a violation event carries the burn at emission — stale
+    the moment good requests follow — so events only fill models the
+    snapshot does not cover (bare event-log runs, flight dumps). Counts
+    likewise take the max of the two sources, never their sum."""
+    burns = {}
+    counts = {}
+    if snapshot is not None:
+        burns.update(_labeled(snapshot.get('gauges'), 'slo.burn_rate'))
+        counts.update(_labeled(snapshot.get('counters'), 'slo.violations'))
+    ev_burns, ev_counts = {}, {}
+    for e in (events or []):
+        if e.get('ev') == 'slo.violation' and isinstance(
+                e.get('burn_rate'), (int, float)):
+            model = str(e.get('model', '?'))
+            ev_burns[model] = float(e['burn_rate'])  # stream: last wins
+            ev_counts[model] = ev_counts.get(model, 0) + 1
+    for model, b in ev_burns.items():
+        burns.setdefault(model, b)
+    for model, n in ev_counts.items():
+        counts[model] = max(counts.get(model, 0), n)
+    for model, burn in sorted(burns.items()):
+        if burn < slo_burn_warning:
+            continue
+        severity = 'critical' if burn >= slo_burn_critical else 'warning'
+        yield _diag(
+            'slo_burn', severity,
+            f"model {model!r} is burning its latency error budget at "
+            f"{burn:.1f}x the sustainable rate"
+            + (f" ({int(counts[model])} violation(s))"
+               if counts.get(model) else ""),
+            "cut tail latency (widen buckets so batches fill, shrink "
+            "max_new_tokens/deadlines, add prefix caching) or add "
+            "capacity; if the objective is wrong, re-register with a "
+            "realistic slo_ms — burning quietly hides real regressions",
+            model=model, burn_rate=round(burn, 3),
+            violations=int(counts.get(model, 0)))
+
+
 DETECTORS = {
     'straggler': detect_straggler,
     'retrace_storm': detect_retrace_storm,
@@ -323,6 +452,8 @@ DETECTORS = {
     'serving_overload': detect_serving_overload,
     'kv_page_exhaustion': detect_kv_page_exhaustion,
     'rank_flatline': detect_rank_flatline,
+    'memory_pressure': detect_memory_pressure,
+    'slo_burn': detect_slo_burn,
 }
 
 

@@ -16,6 +16,9 @@ instead of post-hoc:
 - ``GET /events``     JSON tail of the step-event log
                       (``?n=100&ev=step`` filters).
 - ``GET /diagnosis``  the anomaly doctor's ranked findings as JSON.
+- ``GET /costs``      the cost explorer's ledger slice: per-program
+                      FLOPs/bytes/peak memory + roofline estimates, the
+                      summary aggregates, and the SLO burn rates.
 
 Security posture: binds 127.0.0.1 unless
 ``PADDLE_TPU_TELEMETRY_HTTP_HOST`` says otherwise — this is a diagnostics
@@ -78,11 +81,14 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == '/diagnosis':
                 self._send(200, json.dumps(self.server.owner.diagnosis(),
                                            sort_keys=True, default=repr))
+            elif route == '/costs':
+                self._send(200, json.dumps(self.server.owner.costs(),
+                                           sort_keys=True, default=repr))
             else:
                 self._send(404, json.dumps(
                     {'error': f'no route {route!r}',
                      'routes': ['/metrics', '/healthz', '/events',
-                                '/diagnosis']}))
+                                '/diagnosis', '/costs']}))
         except BrokenPipeError:
             pass
         except Exception as e:   # a scrape must never kill the server
@@ -187,6 +193,12 @@ class MetricsServer:
         return doctor.diagnose(events=events.events(),
                                snapshot=registry.snapshot(),
                                cluster=self._cluster())
+
+    def costs(self):
+        """The cost-explorer slice: ledger + aggregates + SLO burn."""
+        from . import costs, slo
+        return {'summary': costs.summary(), 'programs': costs.ledger(),
+                'slo_burn': slo.burn_rates()}
 
     # -- lifecycle -------------------------------------------------------
     @property

@@ -7,14 +7,16 @@ are exported as JSONL by ``dump_jsonl()`` (the ``TelemetryCallback`` does
 this at train end; ``tools/telemetry_dump.py`` pretty-prints / converts the
 file). An optional live sink streams each event to disk as it is emitted —
 for long runs where losing the tail on a crash matters more than the extra
-write per event.
+write per event. Every emitted event is also mirrored into the flight
+recorder's always-on ring (``flight.py``) so a crash dump carries the last
+seconds even when no sink or flusher was configured.
 """
 import collections
 import json
 import threading
 import time
 
-from . import state
+from . import flight, state
 
 __all__ = ['emit', 'events', 'clear', 'dump_jsonl', 'set_sink',
            'close_sink', 'wall_ts', 'MAX_EVENTS']
@@ -40,6 +42,9 @@ def emit(kind, **fields):
         return None
     rec = {'ev': str(kind), 'ts': round(wall_ts(), 6)}
     rec.update(fields)
+    # the flight recorder's ring mirrors every event so a crash dump
+    # carries the last seconds even if no flusher ever fired
+    flight.note(rec)
     with _lock:
         if len(_buf) == _buf.maxlen:
             _dropped[0] += 1

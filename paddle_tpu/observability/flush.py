@@ -25,21 +25,14 @@ import os
 import socket
 import threading
 
-from . import events, interpose, registry, spans, state
+from . import costs, events, interpose, registry, spans, state
+from .state import rank_id
 
 __all__ = ['RankFlusher', 'start_rank_flusher', 'stop_rank_flusher',
            'active_flusher', 'rank_id']
 
 _lock = threading.Lock()
 _active = [None]
-
-
-def rank_id():
-    """This process's rank in the cluster (0 in a single-process run)."""
-    try:
-        return int(os.environ.get('PADDLE_TRAINER_ID', '0') or 0)
-    except ValueError:
-        return 0
 
 
 class RankFlusher:
@@ -90,6 +83,7 @@ class RankFlusher:
             'ts': round(events.wall_ts(), 6),
             'metrics': registry.snapshot(),
             'counters': interpose.summary(),
+            'costs': costs.summary(),
         }
         try:
             self._commit(self.metrics_path,

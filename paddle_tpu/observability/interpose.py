@@ -108,4 +108,8 @@ def summary():
             'serving.kv.prefix_hit_pages', 0),
         'serving_spec_proposed': snap.get('serving.spec.proposed', 0),
         'serving_spec_accepted': snap.get('serving.spec.accepted', 0),
+        'cost_programs': snap.get('cost.programs', 0),
+        'cost_captures': snap.get('cost.captures', 0),
+        'slo_requests': snap.get('slo.requests_total', 0),
+        'slo_violations': snap.get('slo.violations_total', 0),
     }

@@ -3,8 +3,18 @@
 ``span(name)`` times a host-side region and records a Chrome trace "complete"
 event (``ph: "X"``, microsecond ``ts``/``dur``) into a bounded in-process
 buffer; ``dump_chrome_trace(path)`` writes the buffer as a JSON array that
-loads directly in Perfetto / chrome://tracing. Two disciplines keep the
-tracer honest on an async accelerator runtime:
+loads directly in Perfetto / chrome://tracing.
+
+Besides synchronous spans, the buffer carries **async (flow) events** —
+``async_begin``/``async_instant``/``async_end`` record nestable Chrome
+async events (``ph: b/n/e``) sharing a ``cat`` + ``id`` pair, which
+Perfetto renders as ONE connected lane spanning threads and time. The
+serving engine threads each request's id through them so a request's
+lifecycle (admitted → prefill chunks → decode iterations → speculative
+verify → completion) reads as a single flow in the merged cluster trace
+(docs/OBSERVABILITY.md, "Per-request traces").
+
+Two disciplines keep the tracer honest on an async accelerator runtime:
 
 - **device-trace bridging**: while a ``jax.profiler`` trace is active
   (``utils.profiler.start_profiler``), every span also enters a
@@ -26,6 +36,7 @@ import time
 from . import state
 
 __all__ = ['span', 'Span', 'dump_chrome_trace', 'trace_events',
+           'async_begin', 'async_instant', 'async_end',
            'clear', 'MAX_TRACE_EVENTS']
 
 MAX_TRACE_EVENTS = 65536
@@ -131,6 +142,36 @@ class Span:
 def span(name, sync=None, **attrs):
     """Context manager timing a named host region (see module docstring)."""
     return Span(name, sync=sync, **attrs)
+
+
+def _record_async(ph, name, aid, cat, args):
+    if not state.enabled():
+        return
+    ev = {'name': name, 'ph': ph, 'cat': cat, 'id': str(aid),
+          'ts': round(_now_us(), 3), 'pid': os.getpid(),
+          'tid': threading.get_ident()}
+    if args:
+        ev['args'] = args
+    with _lock:
+        if len(_events) >= MAX_TRACE_EVENTS:
+            _dropped[0] += 1
+            return
+        _events.append(ev)
+
+
+def async_begin(name, aid, cat='async', **args):
+    """Open one async lane: events sharing ``(cat, id)`` until the matching
+    ``async_end`` render as a single connected flow in Perfetto."""
+    _record_async('b', name, aid, cat, args or None)
+
+
+def async_instant(name, aid, cat='async', **args):
+    """A point milestone on an open async lane (``ph: 'n'``)."""
+    _record_async('n', name, aid, cat, args or None)
+
+
+def async_end(name, aid, cat='async', **args):
+    _record_async('e', name, aid, cat, args or None)
 
 
 def trace_events():

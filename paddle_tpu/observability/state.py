@@ -31,6 +31,20 @@ Mission-control knobs (docs/OBSERVABILITY.md, "Mission control"):
 - ``PADDLE_TPU_TELEMETRY_RUN_DIR`` cluster run dir for per-rank telemetry
                                    files (default: the supervisor's run
                                    dir, passed via heartbeat env)
+
+Cost explorer / SLO / flight-recorder knobs (owned by ``costs.py`` /
+``slo.py`` / ``flight.py``, catalogued here so one file documents the env
+surface):
+
+- ``PADDLE_TPU_DEVICE_PEAK_FLOPS`` / ``PADDLE_TPU_DEVICE_PEAK_BPS``
+                                   roofline device peaks (see costs.py)
+- ``PADDLE_TPU_HBM_BUDGET``        device memory budget in bytes for the
+                                   doctor's memory_pressure detector
+- ``PADDLE_TPU_SLO_MS`` / ``PADDLE_TPU_SLO_OBJECTIVE``
+                                   default per-model latency SLO
+- ``PADDLE_TPU_FLIGHT=0``          disable the always-on flight recorder
+- ``PADDLE_TPU_FLIGHT_EVENTS``     flight ring capacity (default 512)
+- ``PADDLE_TPU_FLIGHT_DIR``        where crash dumps land
 """
 import os
 import threading
@@ -123,3 +137,14 @@ def run_dir():
     else None (not part of a cluster run)."""
     return (os.environ.get('PADDLE_TPU_TELEMETRY_RUN_DIR')
             or os.environ.get('PADDLE_TPU_HEARTBEAT_DIR') or None)
+
+
+def rank_id():
+    """This process's rank in the cluster (0 in a single-process run) —
+    the ONE definition of the per-rank file-naming identity, shared by the
+    flusher (telemetry_rank<R>.json) and the flight recorder
+    (flight_rank<R>.json)."""
+    try:
+        return int(os.environ.get('PADDLE_TRAINER_ID', '0') or 0)
+    except ValueError:
+        return 0

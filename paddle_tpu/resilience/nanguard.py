@@ -67,11 +67,18 @@ class NanGuard:
         if self.consecutive_skips >= self.max_consecutive_skips:
             _obs.event('nan_guard.abort', step=self.total_steps,
                        consecutive=self.consecutive_skips)
-            raise NanStepError(
+            err = NanStepError(
                 "NanGuard: %d consecutive non-finite steps (limit %d) — "
                 "the run is diverging; lower the learning rate or inspect "
                 "the data pipeline" % (self.consecutive_skips,
                                        self.max_consecutive_skips))
+            # black box: the run is about to die — dump the flight ring
+            # (always-on, telemetry or not) so the post-mortem has the
+            # last seconds of skip events and counters
+            _obs.flight.dump('nan_abort', exc=err,
+                             extra={'step': self.total_steps,
+                                    'consecutive': self.consecutive_skips})
+            raise err
         return True
 
     def absorb_device_counts(self, total_steps, skipped_steps, consecutive,
@@ -119,10 +126,14 @@ class NanGuard:
         if raise_on_limit and worst >= self.max_consecutive_skips:
             _obs.event('nan_guard.abort', step=self.total_steps,
                        consecutive=worst)
-            raise NanStepError(
+            err = NanStepError(
                 "NanGuard: %d consecutive non-finite steps (limit %d) — "
                 "the run is diverging; lower the learning rate or inspect "
                 "the data pipeline" % (worst, self.max_consecutive_skips))
+            _obs.flight.dump('nan_abort', exc=err,
+                             extra={'step': self.total_steps,
+                                    'consecutive': worst})
+            raise err
         return new_skips
 
     def state_dict(self):

@@ -35,14 +35,43 @@ __all__ = ['WatchdogTimeout', 'bounded_get', 'join_thread', 'join_proc',
 DEFAULT_TICK = 0.1
 
 
+# rate limiter for the watchdog's flight-recorder dumps: every timeout is
+# RECORDED in the ring, but the disk dump is throttled — a client polling
+# result(timeout=0.1) must not fsync a document per miss
+_FLIGHT_DUMP_EVERY_S = 5.0
+_last_flight_dump = [0.0]
+
+
 class WatchdogTimeout(RuntimeError):
     """A bounded wait expired (or every producer died) before the item
-    arrived. ``.what`` names the wait; ``.waited`` is the elapsed seconds."""
+    arrived. ``.what`` names the wait; ``.waited`` is the elapsed seconds.
+
+    Construction records into the observability flight recorder and dumps
+    its black box (best-effort, always-on, rate-limited): a watchdog
+    firing usually means something is wedged or dead, and the ring's last
+    seconds are the evidence a post-mortem needs. The dump goes to a
+    watchdog-specific file (``flight_rank<R>_watchdog.json``) so a caught,
+    routine client timeout never clobbers the primary black box a real
+    crash (worker exception, NaN abort) wrote. The import is lazy so this
+    module stays safe to import from bare worker processes."""
 
     def __init__(self, message, what='wait', waited=0.0):
         super().__init__(message)
         self.what = what
         self.waited = waited
+        try:
+            from ..observability import flight
+            flight.record('watchdog_timeout', what=what,
+                          waited=round(waited, 3))
+            now = time.monotonic()
+            if now - _last_flight_dump[0] >= _FLIGHT_DUMP_EVERY_S:
+                _last_flight_dump[0] = now
+                flight.dump(
+                    'watchdog_timeout', exc=self,
+                    extra={'what': what, 'waited': round(waited, 3)},
+                    filename=f'flight_rank{flight.rank_id()}_watchdog.json')
+        except Exception:
+            pass   # the black box must never mask the timeout itself
 
 
 def bounded_get(q, timeout=None, alive=None, what='queue item',

@@ -94,7 +94,7 @@ class ServingEngine:
                  queue_capacity=None, jit_compile=True,
                  kv_cache='paged', page_size=16, num_pages=None,
                  max_concurrency=None, draft=None, draft_k=4,
-                 prefix_cache=True):
+                 prefix_cache=True, slo_ms=None, slo_objective=0.99):
         """Register one model under ``name``. Exactly one of
         ``predict_fn``/``layer``/``program``/``predictor``/``generative``
         must be given; one-shot kinds also need ``example`` (one request's
@@ -109,7 +109,13 @@ class ServingEngine:
         pages, and ``draft=``/``draft_k=`` speculative decoding (a small
         ``GenerativeSpec`` proposing ``draft_k`` tokens per verify
         step). ``kv_cache='slot'`` keeps the PR-6 fixed-slot cache (the
-        memory baseline)."""
+        memory baseline).
+
+        ``slo_ms=`` declares this model's latency objective for the SLO
+        tracker: ``slo_objective`` (default 0.99) of requests must
+        complete OK within ``slo_ms`` end-to-end. Violations burn the
+        error budget; the doctor's ``slo_burn`` detector fires when the
+        burn rate crosses 1x (docs/OBSERVABILITY.md, "SLO tracking")."""
         given = [k for k, v in (('predict_fn', predict_fn), ('layer', layer),
                                 ('program', program),
                                 ('predictor', predictor),
@@ -160,6 +166,9 @@ class ServingEngine:
             raise ValueError(
                 f"register({name!r}): queue_capacity must be >= 1, got "
                 f"{queue_capacity!r}")
+        if slo_ms is not None:
+            from ..observability import slo as _slo
+            _slo.set_objective(name, slo_ms, slo_objective)
         queue = AdmissionQueue(name,
                                self.queue_capacity if queue_capacity is None
                                else queue_capacity)
@@ -304,6 +313,16 @@ class ServingEngine:
                       max_new_tokens=max_new_tokens)
         runner.validate(req)
         _count('serving.requests')
+        if _obs.enabled():
+            # open the request's async trace lane BEFORE the queue push:
+            # the worker may pop, run, and emit the closing async_end
+            # before this thread resumes — a begin after that would leave
+            # Perfetto an unmatched lane. Everything the runners stamp
+            # with this id (prefill chunks, decode iterations,
+            # speculative verify) renders as ONE connected flow, closed
+            # by finish_request's async_end (or the shed edge below).
+            _obs.async_begin('request', req.id, cat='serving.request',
+                             model=model, deadline_ms=deadline_ms)
         try:
             self._queues[model].push(req)
         except QueueFullError as e:
@@ -323,6 +342,8 @@ class ServingEngine:
             if _obs.enabled():
                 _obs.event('serving.shed', model=model, request=req.id,
                            reason=e.reason)
+                _obs.async_end('request', req.id, cat='serving.request',
+                               status='shed', reason=e.reason)
             raise
         self._submitted += 1
         with self._cond:
@@ -385,6 +406,10 @@ class ServingEngine:
         is replaced, not silently left dead. With telemetry enabled and
         ``PADDLE_TPU_TELEMETRY_HTTP`` set, the live ``/metrics`` +
         ``/healthz`` endpoint comes up alongside (mission control)."""
+        # flight recorder: a serving worker that dies takes its black box
+        # with it unless the crash hooks are in (always-on, telemetry or
+        # not — threading.excepthook catches an escaped worker exception)
+        _obs.flight.install_crash_hooks()
         if _obs.enabled():
             from ..observability import endpoint as _endpoint
             _endpoint.maybe_start_from_env(extra_health=self._health)
@@ -412,13 +437,18 @@ class ServingEngine:
         """The serving slice of ``/healthz``."""
         with self._lock:
             queues = {n: len(q) for n, q in self._queues.items()}
-        return {'serving': {
+        out = {'serving': {
             'worker_alive': self.alive(),
             'models': sorted(queues),
             'queue_depth': queues,
             'submitted': self._submitted,
             'shed': self._shed,
         }}
+        from ..observability import slo as _slo
+        burns = _slo.burn_rates()
+        if burns:
+            out['serving']['slo_burn'] = burns
+        return out
 
     def alive(self):
         return self._thread is not None and self._thread.is_alive()
@@ -492,6 +522,7 @@ class ServingEngine:
 
     # -- introspection --------------------------------------------------
     def stats(self):
+        from ..observability import slo as _slo
         return {
             'submitted': self._submitted,
             'shed': self._shed,
@@ -500,4 +531,5 @@ class ServingEngine:
             'queue_depth': {n: len(q) for n, q in self._queues.items()},
             'models': {n: r.stats.as_dict()
                        for n, r in self._models.items()},
+            'slo_burn': _slo.burn_rates(),
         }

@@ -355,36 +355,55 @@ class PagedGenerativeRunner:
 
     def warmup(self):
         """Compile the whole closed program set against the null row/page,
-        with int32-array scalars exactly like the real calls."""
+        with int32-array scalars exactly like the real calls. With
+        telemetry on, every program lands in the cost ledger."""
+        from ..observability import costs as _costs
+        ledger = _obs.enabled()
+
+        def cap(label, kind, fn, *args, **meta):
+            if ledger:
+                _costs.capture(f'serving.{self.name}.{label}', fn, *args,
+                               kind=kind, meta=dict(meta, model=self.name))
         n = 0
         z = jnp.asarray(0, jnp.int32)
         one = jnp.asarray(1, jnp.int32)
         trow = jnp.zeros((self.target.max_pages,), jnp.int32)
         for cb in self.buckets:
             toks = jnp.zeros((cb,), jnp.int32)
-            self.target.cache, _ = self._prefill(self.target.cache, trow,
-                                                 toks, z, one)
+            args = (self.target.cache, trow, toks, z, one)
+            self.target.cache, _ = self._prefill(*args)
+            cap(f'prefill{cb}', 'serving.prefill', self._prefill, *args,
+                bucket=cb)
             n += 1
         tblocks = jnp.zeros((self.rows, self.target.max_pages), jnp.int32)
         zb = jnp.zeros((self.rows,), jnp.int32)
-        self.target.cache, _ = self._decode(self.target.cache, tblocks,
-                                            zb, zb)
+        dargs = (self.target.cache, tblocks, zb, zb)
+        self.target.cache, _ = self._decode(*dargs)
+        cap('decode', 'serving.decode', self._decode, *dargs, batch=self.rows)
         n += 1
         if self.draft is not None:
             drow = jnp.zeros((self.draft.max_pages,), jnp.int32)
             for cb in self.buckets:
                 toks = jnp.zeros((cb,), jnp.int32)
-                self.draft.cache, _ = self._draft_prefill(
-                    self.draft.cache, drow, toks, z, one)
+                args = (self.draft.cache, drow, toks, z, one)
+                self.draft.cache, _ = self._draft_prefill(*args)
+                cap(f'draft_prefill{cb}', 'serving.prefill',
+                    self._draft_prefill, *args, bucket=cb)
                 n += 1
             dblocks = jnp.zeros((self.rows, self.draft.max_pages), jnp.int32)
-            self.draft.cache, _ = self._draft_decode(self.draft.cache,
-                                                     dblocks, zb, zb)
-            self.draft.cache, _ = self._propose(self.draft.cache, dblocks,
-                                                zb, zb)
+            ddargs = (self.draft.cache, dblocks, zb, zb)
+            self.draft.cache, _ = self._draft_decode(*ddargs)
+            cap('draft_decode', 'serving.decode', self._draft_decode,
+                *ddargs, batch=self.rows)
+            pargs = (self.draft.cache, dblocks, zb, zb)
+            self.draft.cache, _ = self._propose(*pargs)
+            cap('propose', 'serving.speculate', self._propose, *pargs,
+                k=self.draft_k)
             zk = jnp.zeros((self.rows, self.draft_k + 1), jnp.int32)
-            self.target.cache, _ = self._verify(self.target.cache, tblocks,
-                                                zk, zk)
+            vargs = (self.target.cache, tblocks, zk, zk)
+            self.target.cache, _ = self._verify(*vargs)
+            cap('verify', 'serving.speculate', self._verify, *vargs,
+                k=self.draft_k)
             n += 3
         return n
 
@@ -610,7 +629,8 @@ class PagedGenerativeRunner:
         st32 = jnp.asarray(start, jnp.int32)
         nv32 = jnp.asarray(nvalid, jnp.int32)
         try:
-            with _obs.timer('serving.prefill', model=self.name, bucket=cb):
+            with _obs.timer('serving.prefill', model=self.name,
+                            bucket=cb) as t:
                 self.target.cache, toks = self._prefill(
                     self.target.cache, jnp.asarray(self.target.blocks[row]),
                     padded, st32, nv32)
@@ -619,6 +639,11 @@ class PagedGenerativeRunner:
                         self.draft.cache,
                         jnp.asarray(self.draft.blocks[row]),
                         padded, st32, nv32)
+            s['req'].add_phase_ms('prefill', t.elapsed_ms)
+            if _obs.enabled():
+                _obs.async_instant('prefill_chunk', s['req'].id,
+                                   cat='serving.request', start=start,
+                                   bucket=cb, n=nvalid)
         except Exception as e:               # model bug: fail the request,
             self._fail_row(row, e)           # not the engine worker
             return 'failed'
@@ -709,7 +734,7 @@ class PagedGenerativeRunner:
         self.stats.occupancy(len(run) / b)
         try:
             with _obs.timer('serving.decode', model=self.name,
-                            active=len(run)):
+                            active=len(run)) as t:
                 self.target.cache, nxt = self._decode(
                     self.target.cache, self._masked_blocks(self.target, run),
                     toks, pos)
@@ -718,14 +743,20 @@ class PagedGenerativeRunner:
                 self._fail_row(i, e)
             return True
         nxt = np.asarray(nxt)
+        telemetry = _obs.enabled()
         for i in run:
             s = self.seqs[i]
             s['pos'] += 1
             tok = int(nxt[i])
             s['tokens'].append(tok)
             s['last'] = tok
+            s['req'].add_phase_ms('decode', t.elapsed_ms)
             self.stats.decode_tokens += 1
             _count('serving.decode_tokens')
+            if telemetry:
+                _obs.async_instant('decode', s['req'].id,
+                                   cat='serving.request',
+                                   tokens=len(self._generated(s)))
             self._maybe_finish(i)
         return True
 
@@ -775,7 +806,7 @@ class PagedGenerativeRunner:
                 last[i] = self.seqs[i]['last']
                 pos[i] = self.seqs[i]['pos']
             dblocks = self._masked_blocks(self.draft, run)
-            with _obs.timer('serving.propose', model=self.name, k=k):
+            with _obs.timer('serving.propose', model=self.name, k=k) as tp:
                 self.draft.cache, props = self._propose(
                     self.draft.cache, dblocks, last, pos)
             props = np.asarray(props)                      # [B, k]
@@ -788,7 +819,7 @@ class PagedGenerativeRunner:
                 vtoks[i, 0] = self.seqs[i]['last']
                 vtoks[i, 1:] = props[i]
                 vpos[i] = self.seqs[i]['pos'] + np.arange(k + 1)
-            with _obs.timer('serving.verify', model=self.name, k=k):
+            with _obs.timer('serving.verify', model=self.name, k=k) as tv:
                 self.target.cache, greedy = self._verify(
                     self.target.cache, self._masked_blocks(self.target, run),
                     vtoks, vpos)
@@ -797,12 +828,19 @@ class PagedGenerativeRunner:
                 self._fail_row(i, e)
             return True
         greedy = np.asarray(greedy)                        # [B, k+1]
+        telemetry = _obs.enabled()
         # 4) accept/commit + exact page rollback
         for i in run:
             s = self.seqs[i]
             m = 0
             while m < k and props[i, m] == greedy[i, m]:
                 m += 1
+            s['req'].add_phase_ms('draft', tp.elapsed_ms)
+            s['req'].add_phase_ms('verify', tv.elapsed_ms)
+            if telemetry:
+                _obs.async_instant('verify', s['req'].id,
+                                   cat='serving.request', proposed=k,
+                                   accepted=m)
             self.stats.spec_proposed += k
             self.stats.spec_accepted += m
             _count('serving.spec.proposed', k)
@@ -872,6 +910,9 @@ class PagedGenerativeRunner:
             _obs.event('serving.preempt', model=self.name,
                        request=s['req'].id,
                        tokens_so_far=len(self._generated(s)))
+            _obs.async_instant('preempt', s['req'].id,
+                               cat='serving.request',
+                               tokens=len(self._generated(s)))
         return True
 
     # -- row lifecycle -----------------------------------------------------

@@ -52,17 +52,26 @@ def _observe(name, v):
 
 def finish_request(req, status, outputs=None, error=None):
     """Complete a request and mirror the outcome onto the telemetry spine
-    (latency/queue-wait histograms + a per-request event)."""
+    (latency/queue-wait histograms, a per-request event carrying the
+    queue/prefill/decode breakdown, the SLO tracker's judgment, and the
+    closing edge of the request's async trace lane)."""
     req.complete(status, outputs, error=error)
     resp = req.response
     _count('serving.completed')
     _count(f'serving.status.{status}')
+    from ..observability import slo as _slo
+    _slo.record(req.model, status, resp.latency_ms)
     if _obs.enabled():
         _obs.histogram('serving.latency_ms').observe(resp.latency_ms)
         _obs.histogram('serving.queue_wait_ms').observe(resp.queue_ms)
         _obs.event('serving.request', model=req.model, status=status,
                    latency_ms=round(resp.latency_ms, 3),
-                   queue_ms=round(resp.queue_ms, 3))
+                   queue_ms=round(resp.queue_ms, 3),
+                   **{f'{k}_ms': round(v, 3)
+                      for k, v in resp.breakdown.items()})
+        _obs.async_end('request', req.id, cat='serving.request',
+                       status=status,
+                       latency_ms=round(resp.latency_ms, 3))
 
 
 def _slice_outputs(outs, i):
@@ -127,6 +136,7 @@ class BatchRunner:
         self.queue = queue
         self.spec = bucket_spec or BucketSpec()
         self.example = {k: np.asarray(v) for k, v in example.items()}
+        self._jitted = bool(jit_compile)
         self._fn = jax.jit(batch_fn) if jit_compile else batch_fn
         self.stats = _Stats()
 
@@ -156,12 +166,19 @@ class BatchRunner:
 
     def warmup(self):
         """Compile every bucket once with zero feeds (the only compiles a
-        well-bucketed model ever pays)."""
+        well-bucketed model ever pays). With telemetry on, each bucket's
+        program is cost-ledgered here (Executor-backed models are ledgered
+        by the Executor itself at its cache miss)."""
+        from ..observability import costs as _costs
         for b in self.spec.batch_buckets:
             feeds = {k: jnp.asarray(np.zeros((b,) + ex.shape, ex.dtype))
                      for k, ex in self.example.items()}
             jax.tree_util.tree_map(
                 lambda x: np.asarray(x), self._fn(feeds))
+            if self._jitted and _obs.enabled():
+                _costs.capture(f'serving.{self.name}.b{b}', self._fn, feeds,
+                               kind='serving.batch',
+                               meta={'model': self.name, 'bucket': b})
         return len(self.spec.batch_buckets)
 
     def step(self):
@@ -179,11 +196,18 @@ class BatchRunner:
         self.stats.batches += 1
         _count('serving.batches')
         self.stats.occupancy(len(ready) / bucket)
+        telemetry = _obs.enabled()
+        if telemetry:
+            for r in ready:
+                _obs.async_instant('batch', r.id, cat='serving.request',
+                                   bucket=bucket, n=len(ready))
         try:
             with _obs.timer('serving.batch', model=self.name,
-                            batch=len(ready), bucket=bucket):
+                            batch=len(ready), bucket=bucket) as t:
                 outs = self._fn(feeds)
             outs = jax.tree_util.tree_map(np.asarray, outs)
+            for r in ready:
+                r.add_phase_ms('run', t.elapsed_ms)
             # slice before completing anything: a malformed output (e.g. no
             # leading batch axis) must fail the whole batch, not the engine
             per_req = [_slice_outputs(outs, i) for i in range(len(ready))]
@@ -269,21 +293,33 @@ class GenerativeRunner:
 
     def warmup(self):
         """Compile every prefill bucket + the decode step. Uses slot 0 with
-        dummy tokens; a real join later overwrites the slot's cache."""
+        dummy tokens; a real join later overwrites the slot's cache. With
+        telemetry on, each program lands in the cost ledger."""
+        from ..observability import costs as _costs
+        ledger = _obs.enabled()
         n = 0
         for lb in self.spec.prompt_buckets:
             toks = jnp.zeros((lb,), jnp.int32)
             # length/slot must be int32 ARRAYS exactly like the real calls:
             # a python int here traces a weak-typed variant and the first
             # real request would recompile the bucket
-            self.cache, _ = self._prefill(self.cache, toks,
-                                          jnp.asarray(1, jnp.int32),
-                                          jnp.asarray(0, jnp.int32))
+            args = (self.cache, toks, jnp.asarray(1, jnp.int32),
+                    jnp.asarray(0, jnp.int32))
+            self.cache, _ = self._prefill(*args)
+            if ledger:
+                _costs.capture(f'serving.{self.name}.prefill{lb}',
+                               self._prefill, *args,
+                               kind='serving.prefill',
+                               meta={'model': self.name, 'bucket': lb})
             n += 1
         b = self.spec.max_batch
-        self.cache, _ = self._decode(self.cache,
-                                     jnp.zeros((b,), jnp.int32),
-                                     jnp.zeros((b,), jnp.int32))
+        dargs = (self.cache, jnp.zeros((b,), jnp.int32),
+                 jnp.zeros((b,), jnp.int32))
+        self.cache, _ = self._decode(*dargs)
+        if ledger:
+            _costs.capture(f'serving.{self.name}.decode', self._decode,
+                           *dargs, kind='serving.decode',
+                           meta={'model': self.name, 'batch': b})
         return n + 1
 
     # -- one scheduler iteration ---------------------------------------
@@ -313,11 +349,12 @@ class GenerativeRunner:
             padded = pad_to_bucket(prompt, lb)
             try:
                 with _obs.timer('serving.prefill', model=self.name,
-                                bucket=lb):
+                                bucket=lb) as t:
                     self.cache, nxt = self._prefill(
                         self.cache, jnp.asarray(padded),
                         jnp.asarray(len(prompt), jnp.int32),
                         jnp.asarray(slot, jnp.int32))
+                r.add_phase_ms('prefill', t.elapsed_ms)
             except Exception as e:                   # model bug: fail the
                 self.stats.errors += 1               # request, not the
                 free.insert(0, slot)                 # engine worker
@@ -332,6 +369,9 @@ class GenerativeRunner:
             if _obs.enabled():
                 _obs.event('serving.join', model=self.name, request=r.id,
                            slot=slot, prompt_len=len(prompt))
+                _obs.async_instant('prefill', r.id, cat='serving.request',
+                                   slot=slot, bucket=lb,
+                                   prompt_len=len(prompt))
             max_new = int(self.default_max_new_tokens
                           if r.max_new_tokens is None else r.max_new_tokens)
             state = {'req': r, 'tokens': [first], 'last': first,
@@ -355,7 +395,7 @@ class GenerativeRunner:
         self.stats.occupancy(len(active) / b)
         try:
             with _obs.timer('serving.decode', model=self.name,
-                            active=len(active)):
+                            active=len(active)) as t:
                 self.cache, nxt = self._decode(self.cache, jnp.asarray(toks),
                                                jnp.asarray(pos))
         except Exception as e:                       # model bug: fail the
@@ -371,14 +411,20 @@ class GenerativeRunner:
                                error=e)
             return True
         nxt = np.asarray(nxt)
+        telemetry = _obs.enabled()
         for i in active:
             s = self.slots[i]
             s['pos'] += 1
             tok = int(nxt[i])
             s['tokens'].append(tok)
             s['last'] = tok
+            s['req'].add_phase_ms('decode', t.elapsed_ms)
             self.stats.decode_tokens += 1
             _count('serving.decode_tokens')
+            if telemetry:
+                _obs.async_instant('decode', s['req'].id,
+                                   cat='serving.request',
+                                   tokens=len(s['tokens']))
             self._maybe_finish(i)
         return True
 

@@ -65,14 +65,17 @@ class Response:
     ``status`` is ``'ok'``, ``'deadline'`` (expired; ``outputs`` holds any
     partial generative output, else None) or ``'error'`` (``error`` holds
     the exception). ``latency_ms`` is submit→complete, ``queue_ms`` the
-    part spent waiting for a batch slot.
+    part spent waiting for a batch slot, and ``breakdown`` the per-phase
+    wall-time attribution the runners accumulate (``prefill``/``decode``/
+    ``verify`` for generative models, ``run`` for one-shot batches; decode
+    wall time is shared by every request co-resident in the batch).
     """
 
     __slots__ = ('status', 'outputs', 'model', 'request_id', 'latency_ms',
-                 'queue_ms', 'error')
+                 'queue_ms', 'error', 'breakdown')
 
     def __init__(self, status, outputs, model, request_id, latency_ms,
-                 queue_ms, error=None):
+                 queue_ms, error=None, breakdown=None):
         self.status = status
         self.outputs = outputs
         self.model = model
@@ -80,6 +83,7 @@ class Response:
         self.latency_ms = latency_ms
         self.queue_ms = queue_ms
         self.error = error
+        self.breakdown = breakdown or {}
 
     @property
     def ok(self):
@@ -100,7 +104,7 @@ class Request:
     """
 
     __slots__ = ('id', 'model', 'inputs', 'deadline_ms', 'max_new_tokens',
-                 'sw', 'queue_ms', '_event', 'response')
+                 'sw', 'queue_ms', 'phase_ms', '_event', 'response')
 
     def __init__(self, model, inputs, deadline_ms=None, max_new_tokens=None):
         self.id = next(_ids)
@@ -110,8 +114,16 @@ class Request:
         self.max_new_tokens = max_new_tokens
         self.sw = Stopwatch()          # lifetime clock, started at submit
         self.queue_ms = 0.0
+        self.phase_ms = {}             # runner-attributed wall ms per phase
         self._event = threading.Event()
         self.response = None
+
+    def add_phase_ms(self, phase, ms):
+        """Attribute ``ms`` of wall time to a lifecycle phase (prefill /
+        decode / verify / run). Batched phases charge every participant
+        the full batch wall time — the honest per-request view of time
+        spent in that phase, not an exclusive-device accounting."""
+        self.phase_ms[phase] = self.phase_ms.get(phase, 0.0) + float(ms)
 
     def expired(self):
         return (self.deadline_ms is not None and
@@ -127,7 +139,9 @@ class Request:
             return                     # first completion wins
         self.response = Response(status, outputs, self.model, self.id,
                                  self.sw.elapsed_ms(), self.queue_ms,
-                                 error=error)
+                                 error=error,
+                                 breakdown={k: round(v, 3) for k, v
+                                            in self.phase_ms.items()})
         self._event.set()
 
     def done(self):

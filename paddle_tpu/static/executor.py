@@ -161,8 +161,18 @@ class Executor:
                 self._cache[key] = self._compile(program, feed_names,
                                                  fetch_vars, param_names,
                                                  train_spec, dp=dp)
+            if telemetry:
+                # cost explorer: ledger this program's FLOPs/bytes/peak
+                # memory once, at build time (train steps capture
+                # themselves at first dispatch — see TrainStep)
+                cap = getattr(self._cache[key], 'capture_costs', None)
+                if cap is not None:
+                    cap(feed_vals, param_vals)
         elif telemetry:
             _obs.counter('executor.program_cache.hits').inc()
+            lbl = getattr(self._cache[key], 'cost_label', None)
+            if lbl:
+                _obs.costs.mark_hit(lbl)
         compiled = self._cache[key]
         # sampled sync: the run span blocks on the fetched outputs only on
         # sampled occurrences, so timing the step never adds a host sync the
@@ -376,13 +386,33 @@ class Executor:
                     env[id(v)] = val
                 env = interpret(env)
                 return _fetch_outs(fetch_vars, env), None
-            if sharded_feed is None:
-                return run_jit
 
-            def run(feed_vals, param_vals):
-                feed_vals = [jax.device_put(v, sharded_feed)
-                             for v in feed_vals]
-                return run_jit(feed_vals, param_vals)
+            fp = program._fingerprint
+            if sharded_feed is None:
+                def run(feed_vals, param_vals):
+                    return run_jit(feed_vals, param_vals)
+            else:
+                def run(feed_vals, param_vals):
+                    feed_vals = [jax.device_put(v, sharded_feed)
+                                 for v in feed_vals]
+                    return run_jit(feed_vals, param_vals)
+
+            def capture_costs(feed_vals, param_vals):
+                """AOT cost/memory capture into the observability cost
+                ledger (one extra compile, once per cache entry)."""
+                from ..observability import costs as _costs
+                fv = feed_vals
+                if sharded_feed is not None:
+                    fv = [jax.device_put(v, sharded_feed)
+                          for v in feed_vals]
+                sig = ','.join(
+                    'x'.join(str(d) for d in np.shape(v)) or '()'
+                    for v in fv)
+                run.cost_label = f'executor.p{fp}[{sig}]'
+                _costs.capture(run.cost_label, run_jit, fv, param_vals,
+                               kind='executor.infer',
+                               meta={'fingerprint': fp, 'dp': dp})
+            run.capture_costs = capture_costs
             return run
 
         # train path: ONE compiled step through the unified engine builder
@@ -409,11 +439,13 @@ class Executor:
                     outs.append(fv.concrete._value)
             return loss, tuple(outs), buffers
 
-        return build_train_step(loss_fn=program_loss_fn,
+        step = build_train_step(loss_fn=program_loss_fn,
                                 optimizer=optimizer, params_meta=meta,
                                 trainable=trainable, with_key=False,
                                 in_shardings=dp_shardings,
                                 sharding=sharding_cfg)
+        step.cost_label = f'executor.train.p{program._fingerprint}'
+        return step
 
 
 def program_infer_fn(program, feed_names, fetch_vars):

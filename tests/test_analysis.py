@@ -108,6 +108,11 @@ AST_FIXTURES = {
               "def f(x):\n"
               "    mask = x > 0\n"
               "    return x[mask].sum()\n", "x[mask]"),
+    'GL018': ("import jax\n"
+              "def trace_step(fn):\n"
+              "    jax.profiler.start_trace('/tmp/x')\n"
+              "    fn()\n"
+              "    jax.profiler.stop_trace()\n", "start_trace"),
 }
 
 
@@ -716,6 +721,81 @@ def test_gl017_inline_waiver(tmp_path):
     p.write_text(src)
     findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
     hits = [f for f in findings if f.rule == 'GL017']
+    assert len(hits) == 1 and hits[0].waived
+    from paddle_tpu.analysis.finding import active
+    assert active(hits) == []
+
+
+_PROFILER_SRC = (
+    "import jax\n"
+    "from paddle_tpu import observability\n"
+    "def leaky_trace(fn):\n"
+    "    jax.profiler.start_trace('/tmp/x')\n"            # flagged: stop not
+    "    fn()\n"                                          # in a finally
+    "    jax.profiler.stop_trace()\n"
+    "def owned_trace(fn):\n"
+    "    jax.profiler.start_trace('/tmp/x')\n"            # sanctioned
+    "    try:\n"
+    "        fn()\n"
+    "    finally:\n"
+    "        jax.profiler.stop_trace()\n"
+    "def serve_profiler():\n"
+    "    jax.profiler.start_server(9999)\n"               # flagged always
+    "def leaky_span(fn):\n"
+    "    s = observability.span('step')\n"
+    "    s.__enter__()\n"                                 # flagged: exit not
+    "    fn()\n"                                          # exception-safe
+    "    s.__exit__(None, None, None)\n"
+    "def owned_span(fn):\n"
+    "    s = observability.span('step')\n"
+    "    s.__enter__()\n"                                 # sanctioned
+    "    try:\n"
+    "        fn()\n"
+    "    finally:\n"
+    "        s.__exit__(None, None, None)\n"
+    "def with_span(fn):\n"
+    "    with observability.span('step'):\n"              # the fix-it itself
+    "        fn()\n")
+
+
+def test_gl018_flags_unpaired_profiler_and_span_starts(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'prof.py').write_text(_PROFILER_SRC)
+    findings, _ = lint_paths([str(lib / 'prof.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL018')
+    lines = _PROFILER_SRC.splitlines()
+    assert len(hits) == 3, [(f.rule, f.line) for f in findings]
+    assert 'start_trace' in lines[hits[0] - 1]
+    assert 'start_server' in lines[hits[1] - 1]
+    assert '__enter__' in lines[hits[2] - 1]
+    msg = [f for f in findings if f.rule == 'GL018'][0].message
+    # fix-it points at the with-span spelling
+    assert 'observability.span' in msg and 'finally' in msg
+
+
+def test_gl018_exempts_harnesses_and_profiler_wrappers(tmp_path):
+    for rel in ('tests/mod.py', 'tools/mod.py', 'bench_x.py',
+                'paddle_tpu/observability/mod.py',
+                'paddle_tpu/utils/profiler.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_PROFILER_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL018'] == [], rel
+
+
+def test_gl018_inline_waiver(tmp_path):
+    src = ("import jax\n"
+           "def trace_window(fn):\n"
+           "    # graftlint: disable=GL018 — harness owns the stop\n"
+           "    jax.profiler.start_trace('/tmp/x')\n"
+           "    fn()\n")
+    p = tmp_path / 'lib.py'
+    p.write_text(src)
+    findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+    hits = [f for f in findings if f.rule == 'GL018']
     assert len(hits) == 1 and hits[0].waived
     from paddle_tpu.analysis.finding import active
     assert active(hits) == []

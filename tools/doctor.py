@@ -11,6 +11,8 @@ Usage::
     python tools/doctor.py --url http://127.0.0.1:9100   # live endpoint
     python tools/doctor.py <run_dir> --json     # machine-readable
     python tools/doctor.py <run_dir> --fail-on critical  # CI gate: exit 1
+    python tools/doctor.py <run_dir> --fail-on memory_pressure,slo_burn
+                                                # gate on specific causes
 
 Reads whatever evidence the path holds — per-rank ``telemetry_rank<R>``
 files (merged into a cluster snapshot), heartbeat files, merged or
@@ -117,10 +119,12 @@ def main(argv=None):
                         '(e.g. http://127.0.0.1:9100)')
     p.add_argument('--json', action='store_true', dest='as_json',
                    help='print the diagnoses as JSON')
-    p.add_argument('--fail-on', choices=('critical', 'warning', 'info'),
-                   default=None,
-                   help='exit 1 when any finding at (or above) this '
-                        'severity exists — CI gate mode')
+    p.add_argument('--fail-on', default=None, metavar='SEVERITY|CAUSE[,..]',
+                   help='exit 1 when any finding matches — CI gate mode. '
+                        'Accepts a severity (critical/warning/info: fail '
+                        'at or above it) and/or specific causes '
+                        '(straggler, retrace_storm, memory_pressure, '
+                        'slo_burn, ...), comma-separated')
     args = p.parse_args(argv)
     if bool(args.path) == bool(args.url):
         p.error('give exactly one of <path> or --url')
@@ -150,9 +154,21 @@ def main(argv=None):
 
     if args.fail_on:
         order = doctor.SEVERITY_ORDER
-        worst = order[args.fail_on]
-        if any(order.get(d['severity'], 9) <= worst for d in diagnoses):
-            return 1
+        tokens = [t.strip() for t in args.fail_on.split(',') if t.strip()]
+        severities = [t for t in tokens if t in order]
+        causes = [t for t in tokens if t not in order]
+        unknown = [c for c in causes
+                   if c not in doctor.DETECTORS and c != 'doctor_error']
+        if unknown:
+            p.error(f"--fail-on: unknown severity/cause {unknown} "
+                    f"(severities: {sorted(order)}; causes: "
+                    f"{sorted(doctor.DETECTORS)})")
+        worst = min((order[s] for s in severities), default=None)
+        for d in diagnoses:
+            if worst is not None and order.get(d['severity'], 9) <= worst:
+                return 1
+            if d['cause'] in causes:
+                return 1
     return 0
 
 

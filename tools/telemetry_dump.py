@@ -8,6 +8,7 @@ Usage::
     python tools/telemetry_dump.py <events.jsonl> --tail 50     # last 50
     python tools/telemetry_dump.py <events.jsonl> --ev step     # filter kind
     python tools/telemetry_dump.py <events.jsonl> --chrome out.json
+    python tools/telemetry_dump.py <events.jsonl> --costs       # cost table
     python tools/telemetry_dump.py --merge <run_dir>            # cluster
 
 The input is what ``observability.dump_jsonl`` / ``TelemetryCallback`` write
@@ -174,6 +175,55 @@ def render_serving(summary):
     return '\n'.join(lines)
 
 
+def costs_table(events):
+    """Rows for the cost-explorer table from ``cost.program`` events (one
+    per captured program; the last record per program wins)."""
+    rows = {}
+    for e in events:
+        if e.get('ev') != 'cost.program':
+            continue
+        rows[str(e.get('program', '?'))] = e
+    out = []
+    for name in sorted(rows, key=lambda n: -float(
+            rows[n].get('flops', 0) or 0)):
+        e = rows[name]
+        out.append({
+            'program': name,
+            'kind': e.get('program_kind', '?'),
+            'flops': float(e.get('flops', 0) or 0),
+            'bytes_accessed': float(e.get('bytes_accessed', 0) or 0),
+            'peak_bytes': float(e.get('peak_bytes', 0) or 0),
+            'ai': float(e.get('arithmetic_intensity', 0) or 0),
+            'bound': e.get('bound', '?'),
+            'est_ms': float(e.get('est_ms', 0) or 0),
+        })
+    return out
+
+
+def render_costs(rows):
+    """Aligned cost-explorer table (flops-descending)."""
+    if not rows:
+        return ('(no cost.program events — enable telemetry and run the '
+                'programs once so the cost ledger captures them)')
+    width = max([len('program')] + [len(r['program']) for r in rows])
+    lines = [f"{'program':<{width}}  {'kind':<16} {'MFLOP':>10} "
+             f"{'MB acc':>9} {'MB peak':>9} {'AI':>7} {'bound':>7} "
+             f"{'est ms':>9}"]
+    for r in rows:
+        lines.append(
+            f"{r['program']:<{width}}  {r['kind']:<16} "
+            f"{r['flops'] / 1e6:>10.3f} "
+            f"{r['bytes_accessed'] / 1e6:>9.3f} "
+            f"{r['peak_bytes'] / 1e6:>9.3f} {r['ai']:>7.2f} "
+            f"{r['bound']:>7} {r['est_ms']:>9.4f}")
+    total_flops = sum(r['flops'] for r in rows)
+    peak = max(rows, key=lambda r: r['peak_bytes'])
+    lines.append(f"-- {len(rows)} program(s), {total_flops / 1e6:.2f} "
+                 f"MFLOP total, peak memory {peak['peak_bytes'] / 1e6:.3f} "
+                 f"MB ({peak['program']})")
+    return '\n'.join(lines)
+
+
 def _load_aggregate():
     """Load the mission-control aggregator BY PATH (the module is written
     to be standalone) so this tool keeps its no-jax contract."""
@@ -250,6 +300,10 @@ def main(argv=None):
                    help='summarize serving.* events (request counts by '
                         'status/model, latency + queue percentiles, shed '
                         'and join/leave tallies) instead of the table')
+    p.add_argument('--costs', action='store_true',
+                   help='tabulate cost.program events (the cost explorer: '
+                        'per-program FLOPs, bytes accessed, peak memory, '
+                        'arithmetic intensity, roofline bound + estimate)')
     args = p.parse_args(argv)
 
     if args.merge:
@@ -267,6 +321,13 @@ def main(argv=None):
               f"(step skew {snap['step_ms_skew']}x):")
         for kind in ('trace', 'events', 'snapshot'):
             print(f"  {kind:8s} -> {paths[kind]}")
+        flights = snap.get('flight_dumps') or {}
+        for rank, row in sorted(flights.items()):
+            note = row.get('reason')
+            exc = row.get('exception') or {}
+            if exc.get('type'):
+                note += f" ({exc['type']}: {exc.get('message')})"
+            print(f"  flight   rank {rank}: {note} -> {row.get('path')}")
         return 0
 
     try:
@@ -283,6 +344,10 @@ def main(argv=None):
 
     if args.serving:
         print(render_serving(serving_summary(events)))
+        return 0
+
+    if args.costs:
+        print(render_costs(costs_table(events)))
         return 0
 
     if args.chrome:
