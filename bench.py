@@ -732,6 +732,154 @@ def bench_sharding(steps=10, warmup=2, seed=0):
     return out
 
 
+def _elastic_soak_worker(ckpt_dir, kill_marker, epochs=3):
+    """One rank of the elastic chaos soak (picklable top-level fn): train
+    deterministically through engine.fit with sharded-by-world async
+    checkpoints every epoch; rank 1 SIGKILLs itself mid-generation-0 (one
+    shot via the marker file). The relaunched generation resumes from the
+    latest committed checkpoint on the smaller world. Returns
+    ``(rank, world, generation, crc32-of-final-params)`` — every surviving
+    rank (and the uninterrupted reference run) must agree bitwise."""
+    import zlib
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import engine, nn
+    from paddle_tpu.resilience import faultinject as fi
+
+    rank = int(os.environ.get('PADDLE_TRAINER_ID', '0'))
+    world = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
+    gen = int(os.environ.get('PADDLE_TPU_ELASTIC_GENERATION', '0'))
+    rs = np.random.RandomState(0)
+    data = [(rs.rand(8, 32).astype('f4'), rs.rand(8, 4).astype('f4'))
+            for _ in range(6)]
+    maybe_die = fi.kill_rank_at_step(9, kill_marker, rank=1)
+    seen = [0]
+
+    def chaos_data():
+        for b in data:
+            maybe_die(seen[0])
+            seen[0] += 1
+            yield b
+
+    class ChaosIterable:
+        def __iter__(self):
+            return chaos_data()
+
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(32, 64), nn.Tanh(), nn.Linear(64, 4))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    report = engine.fit(net, nn.MSELoss(), opt, ChaosIterable(),
+                        epochs=epochs, prefetch=0, checkpoint=ckpt_dir,
+                        checkpoint_every=0, async_save=True,
+                        resume_from=ckpt_dir, world=world, rank=rank,
+                        preempt_save=False)
+    crc = 0
+    for k in sorted(report['state']['params']):
+        crc = zlib.crc32(np.ascontiguousarray(
+            np.asarray(report['state']['params'][k])).tobytes(), crc)
+    return (rank, world, gen, crc & 0xFFFFFFFF)
+
+
+def bench_elastic(seed=0):
+    """Elastic-training numbers for BENCH ``extras.elastic`` (ISSUE 14):
+
+    - ``save_stall``: p50 training-thread stall of synchronous vs async
+      checkpoint saves of an ~8 MB state under a ``faultinject.slow_fs``
+      disk (acceptance: async p50 <= 10% of sync p50 — the async thread
+      eats the disk latency, the trainer does not);
+    - ``soak``: a 4-rank spawn with ``elastic=True`` where rank 1 is
+      SIGKILLed mid-run — records that the job COMPLETED (no fail-fast),
+      the downsize count, supervisor recovery-time p50, and that every
+      surviving rank's final params CRC matches an uninterrupted
+      single-process reference bitwise.
+    """
+    import statistics
+    import shutil
+    import tempfile
+    import zlib
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import observability as obs
+    from paddle_tpu.resilience import CheckpointManager
+    from paddle_tpu.resilience import faultinject as fi
+
+    out = {}
+    rs = np.random.RandomState(seed)
+    state = {'params': {('w%d' % i): rs.rand(128, 1024).astype('f4')
+                        for i in range(4)},
+             'buffers': {}, 'opt': {}}
+
+    def stall_p50(async_, n=5, compute_s=0.0):
+        d = tempfile.mkdtemp(prefix='paddle_tpu_ckptbench_')
+        mgr = CheckpointManager(d, max_keep=2)
+        stalls = []
+        try:
+            with fi.FaultInjector().slow_fs(0.02, match='ckpt_'):
+                for i in range(n):
+                    t0 = time.perf_counter()
+                    mgr.save(state, step=i, world=1, async_=async_)
+                    stalls.append((time.perf_counter() - t0) * 1000.0)
+                    # the training compute a checkpoint interval overlaps
+                    # with; in steady state it exceeds the commit latency,
+                    # so the next save's ordering fence finds the previous
+                    # commit already landed (stall ~= the enqueue)
+                    if compute_s:
+                        time.sleep(compute_s)
+                mgr.fence()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        return round(statistics.median(stalls), 3)
+
+    sync_p50 = stall_p50(async_=False)
+    async_p50 = stall_p50(async_=True,
+                          compute_s=max(0.2, 1.3 * sync_p50 / 1000.0))
+    out['save_stall'] = {
+        'sync_p50_ms': sync_p50, 'async_p50_ms': async_p50,
+        'async_vs_sync': round(async_p50 / sync_p50, 4) if sync_p50 else 0.0,
+    }
+
+    # -- chaos soak: rank death under elastic=True ---------------------------
+    run_dir = tempfile.mkdtemp(prefix='paddle_tpu_elastic_bench_')
+    ckpt = os.path.join(run_dir, 'ckpts')
+    marker = os.path.join(run_dir, 'killed')
+    obs.enable()
+    soak = {}
+    try:
+        ctx = dist.spawn(_elastic_soak_worker, (ckpt, marker), nprocs=4,
+                         backend='cpu', join=False, elastic=True,
+                         max_restarts=2)
+        results = ctx.join(timeout=240)
+        sup = ctx._supervisor
+        crcs = sorted({r[3] for r in results if r})
+        # uninterrupted reference: same training, single process, no chaos
+        ref_dir = tempfile.mkdtemp(prefix='paddle_tpu_elastic_ref_')
+        try:
+            ref = _elastic_soak_worker(os.path.join(ref_dir, 'ck'),
+                                       os.path.join(ref_dir, 'killed'))
+        finally:
+            shutil.rmtree(ref_dir, ignore_errors=True)
+        snap = obs.snapshot()['histograms']
+        recovery = snap.get('elastic.recovery_ms', {})
+        soak.update({
+            'completed': True,
+            'world_start': 4,
+            'world_end': len(results),
+            'downsizes': sup.downsizes,
+            'generations': sup.generation + 1,
+            'dead_ranks': [r for (_g, r, _c) in sup.dead_ranks],
+            'recovery_ms_p50': round(recovery.get('p50', 0.0), 1),
+            'final_params_crc_agree': len(crcs) == 1,
+            'bitwise_equal_vs_uninterrupted':
+                len(crcs) == 1 and crcs[0] == ref[3],
+        })
+    except Exception as e:
+        soak = {'completed': False, 'error': repr(e)}
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+    out['soak'] = soak
+    return out
+
+
 def _cluster_rank_worker():
     """One rank of the mission-control telemetry smoke: a few timed steps,
     rank 3 dragged by faultinject.slow_rank, telemetry flushed to the
@@ -1324,6 +1472,13 @@ def _child_main(mode, model):
             sharding_extras = bench_sharding()
         except Exception as e:       # sharding bench must never sink smoke
             sharding_extras = {'error': repr(e)}
+        try:
+            # elastic training (ISSUE 14): async save stall p50 vs sync,
+            # 4-rank chaos soak surviving a SIGKILLed rank via downsize +
+            # sharded-checkpoint resume (bitwise vs uninterrupted)
+            elastic_extras = bench_elastic()
+        except Exception as e:       # elastic bench must never sink smoke
+            elastic_extras = {'error': repr(e)}
         print(json.dumps({
             "metric": "bert_smoke_cpu_samples_per_sec",
             "value": round(sps, 2),
@@ -1333,6 +1488,9 @@ def _child_main(mode, model):
                        "serving": serving_extras,
                        "engine": engine_extras,
                        "sharding": sharding_extras,
+                       # elastic training (ISSUE 14): save-stall p50s +
+                       # rank-death chaos soak with downsize + resume
+                       "elastic": elastic_extras,
                        # cost explorer (ISSUE 13): every program the run
                        # compiled, with FLOPs/bytes/peak + roofline bound
                        "costs": costs_extras},

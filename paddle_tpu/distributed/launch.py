@@ -141,6 +141,9 @@ def _maybe_inject_boot_failure(rank, result_dir):
 
 
 def _worker(rank, nprocs, func, args, result_dir):
+    # an elastic relaunch respawns with a SMALLER world than the payload
+    # recorded: the supervisor's env (set per generation) wins
+    nprocs = int(os.environ.get('PADDLE_TRAINERS_NUM') or nprocs)
     os.environ.update(_rank_env(rank, nprocs))
     os.environ['FLAGS_selected_gpus'] = str(rank)
     os.environ.setdefault('JAX_PLATFORMS', 'cpu')
@@ -302,14 +305,40 @@ class _Supervisor:
     (total across ranks), and on any other non-zero exit kills the
     surviving siblings and raises ``RankFailedError`` with per-rank
     diagnostics. Used by both spawn's ``_Context.join`` and the
-    ``launch()`` CLI."""
+    ``launch()`` CLI.
 
-    def __init__(self, procs, run_dir, respawn=None, max_restarts=0):
+    With ``elastic=True`` (``spawn(elastic=True)`` / ``--elastic`` /
+    ``PADDLE_TPU_ELASTIC=1``; docs/RESILIENCE.md, "Elastic training") a
+    STARTED rank's death no longer fail-fasts the job: the supervisor
+    kills the stragglers (their collectives would wedge on the dead
+    peer), waits ``rejoin_grace_s`` for a replacement to volunteer for
+    the dead slot (a ``rejoin_<rank>`` file in the run dir), re-forms the
+    world with the survivors (same size on rejoin, one smaller on
+    downsize), and relaunches every rank of the new generation — whose
+    training function is expected to resume from the latest committed
+    sharded checkpoint (``engine.fit(resume_from=...)``). Bounded by the
+    same ``max_restarts`` budget (default 3 when elastic); every
+    transition lands as telemetry events + counters + a flight-recorder
+    dump, and death→all-ranks-restarted is recorded on the
+    ``elastic.recovery_ms`` histogram."""
+
+    def __init__(self, procs, run_dir, respawn=None, max_restarts=0,
+                 elastic=False, rejoin_grace_s=None):
         self.procs = list(procs)            # rank -> _Proc-like
         self.run_dir = run_dir
-        self.respawn = respawn              # rank -> new proc, or None
+        self.respawn = respawn              # (rank, world, gen) -> new proc
+        self.elastic = bool(elastic)
+        if rejoin_grace_s is None:
+            rejoin_grace_s = float(os.environ.get(
+                'PADDLE_TPU_ELASTIC_REJOIN_GRACE', '0') or 0)
+        self.rejoin_grace_s = float(rejoin_grace_s)
+        if self.elastic and not max_restarts:
+            max_restarts = 3
         self.max_restarts = int(max_restarts)
         self.restarts_used = 0
+        self.generation = 0
+        self.downsizes = 0
+        self.dead_ranks = []                # (generation, rank, exitcode)
 
     def _rank_started(self, rank):
         return os.path.exists(
@@ -354,12 +383,134 @@ class _Supervisor:
             os.unlink(stale)
         old = self.procs[rank]
         _daemon_procs.discard(old)
-        self.procs[rank] = self.respawn(rank)
+        # respawn into the CURRENT generation's world: after an elastic
+        # downsize the replacement must not come up believing the old
+        # (larger) world size or the dead generation's tag
+        self.procs[rank] = self.respawn(rank, world=len(self.procs),
+                                        generation=self.generation)
         from .. import observability as _obs
         if _obs.enabled():
             _obs.counter('distributed.rank_restarts').inc()
             _obs.event('rank_restart', rank=rank,
                        restarts_used=self.restarts_used)
+        return True
+
+    def _clear_rank_state(self, world):
+        """Remove the dead generation's per-rank run-dir artifacts so the
+        relaunch starts clean: stale results must not satisfy join(),
+        stale started markers must not disable boot-restart, and stale
+        heartbeats must not read as live ranks."""
+        for r in range(world):
+            for name in (f'result_{r}.pkl', f'started_{r}', f'hb_{r}'):
+                try:
+                    os.unlink(os.path.join(self.run_dir, name))
+                except OSError:
+                    pass
+
+    def _wait_rejoin(self, dead_ranks, grace=None):
+        """Grace window for replacements: a ``rejoin_<rank>`` (or
+        ``rejoin_any``) file dropped into the run dir within
+        ``rejoin_grace_s`` seconds re-claims a dead slot, so the new
+        generation keeps the old world size instead of downsizing."""
+        if not dead_ranks:
+            return []
+        if grace is None:
+            grace = self.rejoin_grace_s
+        deadline = time.monotonic() + max(float(grace), 0.0)
+        rejoined = []
+        pending = list(dead_ranks)
+        while True:
+            # at least one scan even with a zero budget: an offer armed
+            # BEFORE the death (a standby replacement) is always honored
+            for r in list(pending):
+                for name in (f'rejoin_{r}', 'rejoin_any'):
+                    p = os.path.join(self.run_dir, name)
+                    if os.path.exists(p):
+                        try:
+                            os.unlink(p)
+                        except OSError:
+                            pass
+                        pending.remove(r)
+                        rejoined.append(r)
+                        break
+            if not pending or time.monotonic() >= deadline:
+                return rejoined
+            time.sleep(_POLL_TICK)
+
+    def _elastic_restart(self, rank, code, deadline=None):
+        """Survive a started rank's death: downsize (or rejoin) + relaunch.
+        True when a new generation is running; False when the budget is
+        exhausted / the world cannot shrink further (caller fail-fasts).
+        ``deadline`` (monotonic, from ``join(timeout=)``) caps both the
+        rejoin grace and the started-marker wait — a bounded join must
+        not sit out a minutes-long recovery."""
+        from .. import observability as _obs
+        if (not self.elastic or self.respawn is None
+                or self.restarts_used >= self.max_restarts):
+            return False
+
+        def budget(want):
+            if deadline is None:
+                return want
+            return max(min(want, deadline - time.monotonic()), 0.0)
+        world = len(self.procs)
+        err = self._diagnose(rank, killed_by_us=[r for r in range(world)
+                                                 if r != rank])
+        sw_recovery = time.monotonic()
+        self.dead_ranks.append((self.generation, rank, code))
+        if _obs.enabled():
+            _obs.counter('distributed.rank_failures').inc()
+            _obs.event('elastic.rank_death', rank=rank, exitcode=code,
+                       signal=err.signal_name, generation=self.generation,
+                       world=world)
+        # stragglers first: their next collective would wedge on the dead
+        # peer, and a half-dead generation must never overlap the next one
+        _kill_tree(self.procs)
+        rejoined = self._wait_rejoin([rank],
+                                     grace=budget(self.rejoin_grace_s))
+        new_world = world if rejoined else world - 1
+        if new_world < 1:
+            return False
+        self.restarts_used += 1
+        self.generation += 1
+        self._clear_rank_state(world)
+        ev = 'elastic.rejoin' if rejoined else 'elastic.downsize'
+        if not rejoined:
+            self.downsizes += 1
+        if _obs.enabled():
+            _obs.counter('distributed.elastic_restarts').inc()
+            if not rejoined:
+                _obs.counter('distributed.elastic_downsizes').inc()
+            _obs.event(ev, dead_rank=rank, old_world=world,
+                       new_world=new_world, generation=self.generation,
+                       exitcode=code, signal=err.signal_name,
+                       restarts_used=self.restarts_used)
+        # always-on black box: what the supervisor saw at the transition
+        _obs.flight.dump(
+            ev.replace('.', '_'), exc=err,
+            extra={'dead_rank': rank, 'old_world': world,
+                   'new_world': new_world, 'generation': self.generation},
+            filename='flight_supervisor.json', run_dir=self.telemetry_dir())
+        self.procs = [self.respawn(r, world=new_world,
+                                   generation=self.generation)
+                      for r in range(new_world)]
+        # recovery ends when every rank of the new generation reaches its
+        # started marker (mesh re-formed, checkpoint restored) — bounded
+        # (and capped by the caller's join deadline): a generation that
+        # cannot even boot shows up as its own failure
+        boot_deadline = time.monotonic() + budget(60.0)
+        while time.monotonic() < boot_deadline:
+            if all(self._rank_started(r) for r in range(new_world)):
+                break
+            if any(p.exitcode not in (None, 0) for p in self.procs):
+                break
+            time.sleep(_POLL_TICK)
+        recovery_ms = (time.monotonic() - sw_recovery) * 1000.0
+        if _obs.enabled():
+            _obs.histogram('elastic.recovery_ms').observe(recovery_ms)
+            _obs.event('elastic.relaunch', generation=self.generation,
+                       world=new_world,
+                       recovery_ms=round(recovery_ms, 3))
         return True
 
     def telemetry_dir(self):
@@ -418,6 +569,7 @@ class _Supervisor:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             running = False
+            restarted = False
             for rank, p in enumerate(self.procs):
                 code = p.exitcode
                 if code is None:
@@ -426,6 +578,13 @@ class _Supervisor:
                     if self._try_restart(rank):
                         running = True
                         continue
+                    if self._elastic_restart(rank, code,
+                                             deadline=deadline):
+                        # a new (possibly smaller) generation is running;
+                        # self.procs changed under us — restart the scan
+                        running = True
+                        restarted = True
+                        break
                     survivors = [r for r, q in enumerate(self.procs)
                                  if q.is_alive()]
                     err = self._diagnose(rank, killed_by_us=survivors)
@@ -451,6 +610,8 @@ class _Supervisor:
                                      filename='flight_supervisor.json',
                                      run_dir=self.telemetry_dir())
                     raise err
+            if restarted:
+                continue
             if not running:
                 return
             if deadline is not None and time.monotonic() >= deadline:
@@ -467,13 +628,14 @@ class _Supervisor:
 
 class _Context:
     def __init__(self, procs, result_dir, result=None, respawn=None,
-                 max_restarts=0):
+                 max_restarts=0, elastic=False, rejoin_grace_s=None):
         self.processes = procs
         self._result_dir = result_dir
         self._result = result
         self._joined = None
         self._supervisor = None if not procs else _Supervisor(
-            procs, result_dir, respawn=respawn, max_restarts=max_restarts)
+            procs, result_dir, respawn=respawn, max_restarts=max_restarts,
+            elastic=elastic, rejoin_grace_s=rejoin_grace_s)
 
     def join(self, timeout=None):
         if not self.processes:
@@ -513,14 +675,23 @@ class _Context:
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
-          max_restarts=0, **options):
+          max_restarts=0, elastic=None, rejoin_grace_s=None, **options):
     """Run func on nprocs workers (spawn.py parity; see module docstring
     for the TPU execution model and the supervisor semantics).
 
     ``max_restarts``: total replacement budget for ranks that die before
     writing their started marker (i.e. before ``func`` — and therefore any
     collective — began). Default 0; ``PADDLE_TPU_MAX_RESTARTS`` overrides
-    the default."""
+    the default.
+
+    ``elastic``: survive a STARTED rank's death by re-forming the world
+    with the survivors and relaunching ``func`` (which is expected to
+    resume from its latest committed sharded checkpoint) instead of
+    fail-fasting; ``PADDLE_TPU_ELASTIC=1`` sets the default, the restart
+    budget rides ``max_restarts`` (default 3 when elastic), and
+    ``rejoin_grace_s`` (``PADDLE_TPU_ELASTIC_REJOIN_GRACE``) bounds the
+    window in which a ``rejoin_<rank>`` marker re-claims the dead slot at
+    full world size (docs/RESILIENCE.md, "Elastic training")."""
     if os.environ.get('PADDLE_TPU_SPAWN_WORKER') == '1':
         # a worker re-executing the parent's entry script reached an
         # unguarded spawn() call (any nprocs — the in-process fast path
@@ -539,6 +710,8 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
     if not max_restarts:
         max_restarts = int(os.environ.get('PADDLE_TPU_MAX_RESTARTS', '0')
                            or 0)
+    if elastic is None:
+        elastic = os.environ.get('PADDLE_TPU_ELASTIC', '') in ('1', 'true')
     result_dir = tempfile.mkdtemp(prefix='paddle_tpu_spawn_')
     # Workers are fresh interpreters started via subprocess (the posix_spawn
     # fast path: no preexec_fn, close_fds=False, no cwd/session changes) —
@@ -577,9 +750,10 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
     from ..resilience.atomic_io import atomic_pickle_dump
     atomic_pickle_dump(payload, payload_path)
 
-    def make_proc(rank):
+    def make_proc(rank, world=None, generation=0):
         child_env = dict(os.environ)
-        child_env.update(_rank_env(rank, n))
+        child_env.update(_rank_env(rank, world if world is not None else n))
+        child_env['PADDLE_TPU_ELASTIC_GENERATION'] = str(generation)
         child_env['FLAGS_selected_gpus'] = str(rank)
         child_env['JAX_PLATFORMS'] = 'cpu'  # the parent owns the chip
         # CPU-pinned workers must not load (or talk to) the device plugin:
@@ -613,7 +787,8 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
 
     procs = [make_proc(rank) for rank in range(n)]
     context = _Context(procs, result_dir, respawn=make_proc,
-                       max_restarts=max_restarts)
+                       max_restarts=max_restarts, elastic=elastic,
+                       rejoin_grace_s=rejoin_grace_s)
     if join:
         context.join()
     return context
@@ -621,17 +796,30 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, backend=None,
 
 def launch():
     """`python -m paddle_tpu.distributed.launch [--nproc_per_node N]
-    [--max_restarts R] [--log_dir D] script.py args...` — run a training
-    script once per rank under the spawn env (launch.py parity), SUPERVISED:
-    the first rank to exit non-zero terminates its siblings and the launcher
-    exits with that rank's diagnostics; boot-phase failures are restarted up
-    to --max_restarts."""
+    [--max_restarts R] [--elastic] [--log_dir D] script.py args...` — run a
+    training script once per rank under the spawn env (launch.py parity),
+    SUPERVISED: the first rank to exit non-zero terminates its siblings and
+    the launcher exits with that rank's diagnostics; boot-phase failures
+    are restarted up to --max_restarts. With --elastic (or
+    PADDLE_TPU_ELASTIC=1) a started rank's death instead re-forms the
+    world with the survivors and relaunches the script, which is expected
+    to resume from its latest committed checkpoint."""
     import argparse
     import runpy
 
     parser = argparse.ArgumentParser('paddle_tpu.distributed.launch')
     parser.add_argument('--nproc_per_node', type=int, default=1)
     parser.add_argument('--max_restarts', type=int, default=0)
+    parser.add_argument('--elastic', action='store_true',
+                        default=os.environ.get('PADDLE_TPU_ELASTIC', '')
+                        in ('1', 'true'),
+                        help='survive rank death: downsize the world and '
+                             'relaunch from the latest checkpoint instead '
+                             'of fail-fasting (docs/RESILIENCE.md)')
+    parser.add_argument('--rejoin_grace', type=float, default=None,
+                        help='seconds to wait for a rejoin_<rank> marker '
+                             'before downsizing (default: '
+                             'PADDLE_TPU_ELASTIC_REJOIN_GRACE or 0)')
     parser.add_argument('--log_dir', default=None,
                         help='per-rank stderr logs (default: a temp run '
                              'dir, quoted in failure diagnostics)')
@@ -647,9 +835,11 @@ def launch():
     run_dir = ns.log_dir or tempfile.mkdtemp(prefix='paddle_tpu_launch_')
     os.makedirs(run_dir, exist_ok=True)
 
-    def make_proc(rank):
+    def make_proc(rank, world=None, generation=0):
         child = dict(os.environ)
-        child.update(_rank_env(rank, ns.nproc_per_node))
+        child.update(_rank_env(rank, world if world is not None
+                               else ns.nproc_per_node))
+        child['PADDLE_TPU_ELASTIC_GENERATION'] = str(generation)
         child.setdefault('JAX_PLATFORMS', 'cpu')
         # scripts that call init_parallel_env() heartbeat + mark started
         # through these (distributed.env); scripts that never do are
@@ -669,7 +859,8 @@ def launch():
 
     procs = [make_proc(rank) for rank in range(ns.nproc_per_node)]
     sup = _Supervisor(procs, run_dir, respawn=make_proc,
-                      max_restarts=ns.max_restarts)
+                      max_restarts=ns.max_restarts, elastic=ns.elastic,
+                      rejoin_grace_s=ns.rejoin_grace)
     try:
         sup.wait()
     except RankFailedError as e:

@@ -461,6 +461,59 @@ class TrainStep:
                 self._collective_bytes_est)
         return state
 
+    def restore_state(self, source, step=None):
+        """Resume this step from a checkpoint — possibly saved on a
+        DIFFERENT mesh shape (resharding restore, docs/RESILIENCE.md,
+        "Elastic training").
+
+        ``source`` is a checkpoint directory or a
+        ``resilience.CheckpointManager``. The newest non-corrupt
+        checkpoint (or ``step``) is reassembled on host and placed per
+        THIS step's sharding config (compiling the sharded program
+        against the restored structure when needed) — bitwise-equal to a
+        same-mesh restore. Guard/scaler slots the checkpoint carries but
+        this step does not use are dropped (warning); missing ones are
+        seeded fresh. Returns ``(state, meta)`` or ``None`` when nothing
+        loadable exists.
+        """
+        from ..resilience import CheckpointManager
+        mgr = source if isinstance(source, CheckpointManager) \
+            else CheckpointManager(source)
+        got = mgr.restore(step=step)
+        if got is None:
+            return None
+        state, meta = got
+        state = self.adopt_state(state)
+        return state, meta
+
+    def adopt_state(self, state):
+        """Align a restored host state with this step's contract: seed or
+        drop guard/scaler slots, then shard/place it for dispatch."""
+        import warnings
+        state = dict(state)
+        if self.guard_enabled and 'guard' not in state:
+            state['guard'] = {'steps': jnp.int32(0), 'skipped': jnp.int32(0),
+                              'consecutive': jnp.int32(0),
+                              'peak': jnp.int32(0)}
+        if self.scaler is not None and 'scaler' not in state:
+            s = self.scaler
+            state['scaler'] = {'scale': jnp.float32(s.get_loss_scaling()),
+                               'good': jnp.int32(s._good_steps),
+                               'bad': jnp.int32(s._bad_steps)}
+        for slot, enabled in (('guard', self.guard_enabled),
+                              ('scaler', self.scaler is not None)):
+            if not enabled and slot in state:
+                warnings.warn(
+                    f"TrainStep.restore_state: checkpoint carries a "
+                    f"{slot!r} slot this step was built without — "
+                    f"dropping it", RuntimeWarning, stacklevel=2)
+                state.pop(slot)
+        if self.sharding is not None:
+            state = self._shard_state(state)
+        else:
+            state = jax.tree_util.tree_map(jnp.asarray, state)
+        return state
+
     def sharding_info(self, state):
         """Per-device residency + traffic accounting for a (sharded)
         state — what bench/tier-1 assert the memory win with."""

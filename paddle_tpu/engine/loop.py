@@ -127,7 +127,10 @@ def _grouped(data, k):
 
 def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
         log_every=10, nan_guard=None, scaler=None, prefetch=2,
-        remat=None, donate='auto', matmul_precision='auto', sharding=None):
+        remat=None, donate='auto', matmul_precision='auto', sharding=None,
+        checkpoint=None, checkpoint_every=0, async_save=True,
+        resume_from=None, preempt_save=True, checkpoint_max_keep=3,
+        world=None, rank=None):
     """Train ``network`` over ``data`` through the unified compiled step.
 
     ``data``: a DataLoader or any iterable of ``(inputs, labels)`` batches
@@ -140,6 +143,28 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
     (or fleet ``DistributedStrategy``) — params/optimizer state shard over
     the mesh through the compiled step, feeds shard over the data axis
     (docs/PERF.md, "Sharded training").
+
+    Checkpointing (docs/RESILIENCE.md, "Elastic training"):
+
+    - ``checkpoint=``: a directory or ``resilience.CheckpointManager`` —
+      the loop saves the whole functional state (params/buffers/opt/guard/
+      scaler + RNG streams) every ``checkpoint_every`` dispatches (0 =
+      epoch boundaries only) in the sharded format, following the step's
+      sharding config when one is set; ``async_save=True`` commits on a
+      background thread so the training thread's save stall is ~0
+      (``checkpoint.save_stall_ms`` proves it).
+    - ``resume_from=`` (defaults to ``checkpoint=``): restore the newest
+      non-corrupt checkpoint — saved on ANY mesh shape — onto this run's
+      mesh (resharding restore), replay the loop position, and continue
+      bitwise-identically to an uninterrupted run (deterministic ``data``
+      iteration assumed).
+    - ``preempt_save=True``: a SIGTERM (fleet preemption) is caught at the
+      next dispatch boundary; any in-flight async save is fenced (finished
+      or cleanly abandoned) FIRST, then a final synchronous checkpoint
+      commits and the loop stops with ``report['preempted'] = True``.
+    - ``world=``/``rank=``: multi-process elastic jobs — each rank writes
+      only its checkpoint shard; rank 0 commits the manifest after the
+      shard barrier.
 
     Returns a report dict: floated losses at log cadence, step counts,
     steps/sec, and the final functional state (already written back into
@@ -164,6 +189,47 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
         opt_state=adopt_optimizer_state(network, optimizer, pv),
         nan_guard=nan_guard, scaler=scaler)
     k = step.k
+
+    mgr = _to_manager(checkpoint, checkpoint_max_keep)
+    resume_mgr = _to_manager(resume_from, checkpoint_max_keep) or mgr
+    start_epoch = skip_dispatches = 0
+    report = {'loss': [], 'steps': 0, 'dispatches': 0,
+              'microbatch': k, 'donated': step.donates,
+              'checkpoints': 0, 'resumed_from': None, 'preempted': False}
+    if resume_mgr is not None:
+        got = resume_mgr.restore(return_extra=True)
+        if got is not None:
+            loaded, meta, extra = got
+            state = step.adopt_state(loaded)
+            start_epoch = int(meta.get('epoch', 0))
+            skip_dispatches = int(meta.get('dispatch_in_epoch', 0))
+            report['dispatches'] = int(meta.get('dispatches', 0))
+            report['steps'] = report['dispatches'] * k
+            report['resumed_from'] = int(meta.get('dispatches', 0))
+            if extra and extra.get('rng') is not None:
+                from ..resilience.checkpoint import restore_rng
+                restore_rng(extra['rng'])
+
+    guard = None
+    if mgr is not None and preempt_save:
+        from ..resilience import PreemptionGuard
+        guard = PreemptionGuard().install()   # inert off the main thread
+
+    def save_now(epoch, dispatch_in_epoch, async_ok=True):
+        from ..resilience.checkpoint import capture_rng
+        meta = {'epoch': int(epoch),
+                'dispatch_in_epoch': int(dispatch_in_epoch),
+                'dispatches': report['dispatches'],
+                'microbatch': k,
+                'world': int(world or 1)}
+        mgr.save(state, step=report['dispatches'], meta=meta,
+                 async_=bool(async_save and async_ok),
+                 sharding=step.sharding,
+                 world=world if step.sharding is None else None,
+                 rank=rank if step.sharding is None else None,
+                 extra={'rng': capture_rng()})
+        report['checkpoints'] += 1
+
     # cadence is in DISPATCHES and each dispatch advances the streak by up
     # to k steps: reconcile every ceil(limit/k) dispatches so a diverging
     # run cannot overshoot the guard's consecutive-skip limit by ~k×
@@ -171,12 +237,17 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
                  if nan_guard is not None else log_every)
     sync_every = max(1, min(log_every, guard_cap))
     needs_sync = nan_guard is not None or step.scaler is not None
-    report = {'loss': [], 'steps': 0, 'dispatches': 0,
-              'microbatch': k, 'donated': step.donates}
     sw = _obs.Stopwatch()
     try:
-        for _ in range(int(epochs)):
+        for epoch in range(int(start_epoch), int(epochs)):
             source = _grouped(data, k)
+            if skip_dispatches:
+                # resumed mid-epoch: these groups were already trained
+                # (keys for them were drawn BEFORE the restored RNG
+                # snapshot, so skipping draws nothing). Sliced BEFORE the
+                # prefetcher so skipped groups are never uploaded.
+                import itertools
+                source = itertools.islice(source, skip_dispatches, None)
             if prefetch:
                 from ..io.dataloader import DevicePrefetcher
                 convert = _batch_to_device
@@ -187,6 +258,7 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
                                                 step._batch_sharding)
                 source = DevicePrefetcher(source, depth=int(prefetch),
                                           convert=convert)
+            dispatch_in_epoch = skip_dispatches
             for bx, by in source:
                 if k == 1:
                     key = _rng.next_key()
@@ -195,21 +267,87 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
                 state, out = step(state, (bx, by), key)
                 report['dispatches'] += 1
                 report['steps'] += k
+                dispatch_in_epoch += 1
                 if needs_sync and report['dispatches'] % sync_every == 0:
                     step.sync(state, nan_guard=nan_guard, scaler=scaler)
                 if report['dispatches'] % max(int(log_every), 1) == 0 or \
                         report['dispatches'] == 1:
                     report['loss'].append(float(out.loss))
-    finally:
-        write_back_state(network, optimizer, state)
-        if needs_sync:
-            # final reconcile; never raise from the cleanup path — the
-            # in-flight NanStepError (if any) already propagated above
+                if guard is not None and guard.preempted:
+                    # the preemption contract: fence the in-flight async
+                    # save (finish or cleanly abandon) BEFORE the final
+                    # synchronous checkpoint commits, then stop cleanly.
+                    # A PRIOR background save's stored failure (or a
+                    # wedged fence) must not abort this last chance to
+                    # persist progress inside the grace window.
+                    try:
+                        mgr.fence(timeout=_PREEMPT_FENCE_S, abandon=True)
+                    except Exception as e:
+                        if _obs.enabled():
+                            _obs.event('checkpoint.preempt_fence_error',
+                                       error=repr(e))
+                    save_now(epoch, dispatch_in_epoch, async_ok=False)
+                    report['preempted'] = True
+                    return _finish(report, sw, step, state, network,
+                                   optimizer, nan_guard, scaler, needs_sync,
+                                   mgr, guard)
+                if mgr is not None and checkpoint_every and \
+                        report['dispatches'] % int(checkpoint_every) == 0:
+                    save_now(epoch, dispatch_in_epoch)
+            skip_dispatches = 0
+            if mgr is not None and not checkpoint_every:
+                save_now(epoch + 1, 0)
+        if mgr is not None and checkpoint_every:
+            save_now(int(epochs), 0)
+        return _finish(report, sw, step, state, network, optimizer,
+                       nan_guard, scaler, needs_sync, mgr, guard)
+    except BaseException:
+        _cleanup(step, state, network, optimizer, nan_guard, scaler,
+                 needs_sync, mgr, guard)
+        raise
+
+
+_PREEMPT_FENCE_S = 5.0
+
+
+def _to_manager(source, max_keep):
+    if source is None:
+        return None
+    from ..resilience import CheckpointManager
+    if isinstance(source, CheckpointManager):
+        return source
+    return CheckpointManager(source, max_keep=max_keep)
+
+
+def _cleanup(step, state, network, optimizer, nan_guard, scaler,
+             needs_sync, mgr, guard, raise_fence=False):
+    write_back_state(network, optimizer, state)
+    if needs_sync:
+        # final reconcile; never raise from the cleanup path — the
+        # in-flight NanStepError (if any) already propagated above
+        try:
+            step.sync(state, nan_guard=nan_guard, scaler=scaler,
+                      raise_on_limit=False)
+        except Exception:
+            pass
+    if guard is not None:
+        guard.uninstall()
+    if mgr is not None:
+        # the final async save must land before we return; on the normal
+        # path its failure IS the caller's business
+        if raise_fence:
+            mgr.fence()
+        else:
             try:
-                step.sync(state, nan_guard=nan_guard, scaler=scaler,
-                          raise_on_limit=False)
+                mgr.fence()
             except Exception:
                 pass
+
+
+def _finish(report, sw, step, state, network, optimizer, nan_guard, scaler,
+            needs_sync, mgr, guard):
+    _cleanup(step, state, network, optimizer, nan_guard, scaler,
+             needs_sync, mgr, guard, raise_fence=True)
     elapsed = sw.elapsed()
     if elapsed > 0:
         report['steps_per_sec'] = round(report['steps'] / elapsed, 3)

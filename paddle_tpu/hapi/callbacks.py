@@ -151,15 +151,25 @@ class CheckpointSaver(Callback):
     continues bitwise-identically to a never-interrupted run (the epoch-start
     RNG snapshot lets a mid-epoch resume replay the epoch's shuffle, skip the
     completed steps, then restore the exact mid-epoch RNG state).
+
+    ``async_save=True`` commits epoch-boundary checkpoints on a background
+    thread (``CheckpointManager.save(async_=True)``): the training thread's
+    stall is the snapshot enqueue only. The PREEMPTION checkpoint is always
+    synchronous — and it first *fences* any in-flight async save (finish,
+    or cleanly abandon after ``preempt_fence_s`` seconds) so the two can
+    never interleave half-written artifacts inside the grace window.
     """
 
     def __init__(self, save_dir, save_freq=1, max_keep=3,
-                 save_on_preempt=True):
+                 save_on_preempt=True, async_save=False,
+                 preempt_fence_s=5.0):
         super().__init__()
         self.save_dir = save_dir
         self.save_freq = save_freq
         self.max_keep = max_keep
         self.save_on_preempt = save_on_preempt
+        self.async_save = bool(async_save)
+        self.preempt_fence_s = float(preempt_fence_s)
         self._mgr = None
         self._guard = None
         self._epoch = 0
@@ -185,8 +195,20 @@ class CheckpointSaver(Callback):
     def on_train_batch_end(self, step, logs=None):
         if self._guard is not None and self._guard.preempted and \
                 not self._preempt_saved:
+            # an async epoch-boundary save may still be committing: fence
+            # it (finish, or abandon its uncommitted artifacts) BEFORE the
+            # preemption checkpoint starts — two concurrent writers inside
+            # the grace window was the race the sync-only path never had.
+            # A prior background save's stored failure must not abort this
+            # final save: it is the last chance to persist progress.
+            try:
+                self.manager().fence(timeout=self.preempt_fence_s,
+                                     abandon=True)
+            except Exception:
+                pass
             # step+1 batches of this epoch are complete; resume skips them
-            self._save(epoch=self._epoch, step_in_epoch=step + 1)
+            self._save(epoch=self._epoch, step_in_epoch=step + 1,
+                       async_ok=False)
             self._preempt_saved = True
             self.model.stop_training = True
 
@@ -200,12 +222,15 @@ class CheckpointSaver(Callback):
         if self._guard is not None:
             self._guard.uninstall()
             self._guard = None
+        if self._mgr is not None:
+            # the final async save must land before the process can exit
+            self._mgr.fence()
 
     @property
     def preempted(self):
         return self._preempt_saved
 
-    def _save(self, epoch, step_in_epoch):
+    def _save(self, epoch, step_in_epoch, async_ok=True):
         from ..resilience import capture_rng
         model = self.model
         model._sync_jit_state()
@@ -223,7 +248,8 @@ class CheckpointSaver(Callback):
         if guard is not None:
             state['nan_guard'] = guard.state_dict()
         self.manager().save(state, meta={'epoch': int(epoch),
-                                         'step_in_epoch': int(step_in_epoch)})
+                                         'step_in_epoch': int(step_in_epoch)},
+                            async_=self.async_save and async_ok)
 
 
 class LRScheduler(Callback):

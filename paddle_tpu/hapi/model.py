@@ -417,6 +417,47 @@ class Model:
                 "starting from scratch" % (mgr.path,))
             return 0, 0, None
         state, meta = loaded
+        if 'model' not in state and 'params' in state:
+            # an engine-layout (sharded, format-2) checkpoint written by
+            # engine.fit / an elastic worker — possibly on a DIFFERENT
+            # mesh shape: the manager already reassembled the global
+            # arrays, so adopting them here IS the resharding restore
+            # (this model's own strategy re-shards at the next jit
+            # init_state). Functional opt slots map back through the
+            # same helper the jit loop uses.
+            from ..engine.loop import write_back_state
+            write_back_state(self.network, self._optimizer, state)
+            if self._use_jit:
+                self._jit_state = None
+            if self._scaler is not None and \
+                    isinstance(state.get('scaler'), dict) and \
+                    'scale' in state['scaler']:
+                self._scaler._scale = float(
+                    np.asarray(state['scaler']['scale']))
+            start = int(meta.get('epoch', 0))
+            # a mid-epoch engine checkpoint records how many dispatches of
+            # the epoch are already trained — skip them instead of double-
+            # stepping the optimizer on consumed data. Engine checkpoints
+            # carry ONE RNG snapshot (the save point): exact for epoch-
+            # boundary resumes and for deterministic (unshuffled) loaders;
+            # a shuffled mid-epoch hapi resume cannot replay the epoch's
+            # shuffle from it (engine.fit never shuffles).
+            # one engine dispatch consumes k (microbatch) hapi-sized
+            # batches — skip batches, not dispatches
+            skip = int(meta.get('dispatch_in_epoch', 0)) * \
+                int(meta.get('microbatch', 1))
+            rng = None
+            extra = mgr.load_extra(
+                step=int(meta['dispatches'])
+                if meta.get('dispatches') is not None else None)
+            if extra is not None and extra.get('rng') is not None:
+                rng = {'save_point': extra['rng'],
+                       'epoch_start': extra['rng']}
+            elif skip:
+                # no RNG payload but a position to honor: skip with the
+                # streams left as-is rather than retrain consumed batches
+                rng = {'save_point': None, 'epoch_start': None}
+            return start, skip, rng
         self.network.set_state_dict(state['model'])
         if self._use_jit:
             self._jit_state = None   # rebuild functional state from network

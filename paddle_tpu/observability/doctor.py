@@ -42,6 +42,14 @@ Detector catalog (docs/OBSERVABILITY.md has the operator version):
 - ``slo_burn``        a served model is burning its latency error budget
                       faster than its objective allows (the SLO tracker's
                       ``burn_rate``; warning at 1x, critical at 5x).
+- ``checkpoint_stall`` synchronous checkpoint saves block the training
+                      thread for >= 25% of the mean step time — the fix-it
+                      is the async save path (``async_=True``), which
+                      moves snapshot+commit off the step path.
+- ``elastic_downsize`` the world size shrank mid-run: a rank died and the
+                      elastic supervisor resumed on the survivors (info —
+                      the run survived, but capacity is reduced; names
+                      the dead rank from the supervisor's heartbeats).
 
 Ranked output: ``critical`` > ``warning`` > ``info``. Standalone on
 purpose — stdlib-only, importable by path — so ``tools/doctor.py`` works
@@ -65,6 +73,7 @@ STALE_HEARTBEAT_S = 10.0
 MEMORY_PRESSURE_RATIO = 0.8    # worst program peak_bytes / memory budget
 SLO_BURN_WARNING = 1.0         # error-budget burn rate thresholds
 SLO_BURN_CRITICAL = 5.0
+CHECKPOINT_STALL_RATIO = 0.25  # mean save stall / mean step time
 
 
 def _labeled(section, prefix, key='model'):
@@ -445,6 +454,96 @@ def detect_slo_burn(events=None, snapshot=None, cluster=None,
             violations=int(counts.get(model, 0)))
 
 
+def detect_checkpoint_stall(events=None, snapshot=None, cluster=None,
+                            checkpoint_stall_ratio=CHECKPOINT_STALL_RATIO,
+                            **_):
+    """Checkpoint saves stalling the training thread: the mean
+    ``checkpoint.save_stall_ms`` (training-thread blocked time — the full
+    commit for synchronous saves, ~0 for async ones) is a large fraction
+    of the mean step time. The fix is the async save path, not a faster
+    disk."""
+    stall_mean = stall_count = step_mean = 0.0
+    if snapshot is not None:
+        h = _hist(snapshot, 'checkpoint.save_stall_ms')
+        stall_mean, stall_count = float(h.get('mean', 0.0)), \
+            int(h.get('count') or 0)
+        for name in ('hapi.step_ms', 'engine.step_ms'):
+            sh = _hist(snapshot, name)
+            if sh.get('count'):
+                step_mean = float(sh.get('mean', 0.0))
+                break
+    if (not stall_count or not step_mean) and events:
+        # event-stream fallback: synchronous saves' commit time IS their
+        # stall; async saves are excluded (their stall is the enqueue)
+        durs = [float(e['duration_ms']) for e in events
+                if e.get('ev') == 'checkpoint.save'
+                and not e.get('async_')
+                and isinstance(e.get('duration_ms'), (int, float))]
+        steps = [float(e['step_ms']) for e in events
+                 if e.get('ev') == 'step'
+                 and isinstance(e.get('step_ms'), (int, float))]
+        if durs and steps:
+            stall_mean = sum(durs) / len(durs)
+            stall_count = len(durs)
+            step_mean = sum(steps) / len(steps)
+    if not stall_count or step_mean <= 0 or stall_mean <= 0:
+        return
+    ratio = stall_mean / step_mean
+    if ratio < checkpoint_stall_ratio:
+        return
+    yield _diag(
+        'checkpoint_stall', 'warning',
+        f"checkpoint saves stall the training thread {stall_mean:.1f}ms "
+        f"on average = {100 * ratio:.0f}% of the {step_mean:.1f}ms mean "
+        f"step, over {stall_count} save(s)",
+        "use the async save path: CheckpointManager.save(async_=True), "
+        "CheckpointSaver(async_save=True), or engine.fit(checkpoint=..., "
+        "async_save=True) — the snapshot+commit move to a background "
+        "thread and checkpoint.save_stall_ms drops to ~0 "
+        "(checkpoint.commit_ms keeps the true disk latency)",
+        stall_ms=round(stall_mean, 3), step_ms=round(step_mean, 3),
+        ratio=round(ratio, 3), saves=stall_count)
+
+
+def detect_elastic_downsize(events=None, snapshot=None, cluster=None, **_):
+    """The world size shrank mid-run: a rank died and the elastic
+    supervisor re-formed the mesh with the survivors instead of
+    fail-fasting. Informational by design — the run SURVIVED — but every
+    downsize means less throughput and one less failure the budget can
+    absorb, so it must never pass silently."""
+    downs = [e for e in (events or [])
+             if e.get('ev') == 'elastic.downsize']
+    count = len(downs)
+    for src in (snapshot, None if cluster is None else
+                {'counters': cluster.get('counters_total') or {}}):
+        if src is not None:
+            count = max(count, int(_ctr(
+                src, 'distributed.elastic_downsizes') or 0))
+    if not count:
+        return
+    recov = _hist(snapshot, 'elastic.recovery_ms') if snapshot else {}
+    for e in downs or [{}]:
+        dead = e.get('dead_rank')
+        detail = (f"world shrank {e.get('old_world', '?')} -> "
+                  f"{e.get('new_world', '?')}"
+                  + (f" after rank {dead} died"
+                     + (f" ({e['signal']})" if e.get('signal') else "")
+                     if dead is not None else "")) if e else \
+            f"{count} elastic downsize(s) this run"
+        yield _diag(
+            'elastic_downsize', 'info', detail,
+            "the job survived on fewer ranks; restore full capacity by "
+            "bringing a replacement up inside the rejoin grace window "
+            "(rejoin_<rank> marker / a rescheduled node), or expect "
+            "proportionally lower throughput until the next full restart",
+            downsizes=count,
+            **({'dead_rank': dead} if e and dead is not None else {}),
+            **({'recovery_ms_p50': round(recov['p50'], 1)}
+               if recov.get('count') else {}))
+        if not e:
+            break
+
+
 DETECTORS = {
     'straggler': detect_straggler,
     'retrace_storm': detect_retrace_storm,
@@ -454,6 +553,8 @@ DETECTORS = {
     'rank_flatline': detect_rank_flatline,
     'memory_pressure': detect_memory_pressure,
     'slo_burn': detect_slo_burn,
+    'checkpoint_stall': detect_checkpoint_stall,
+    'elastic_downsize': detect_elastic_downsize,
 }
 
 

@@ -14,6 +14,11 @@ distributed.{env,fs}):
 - a NaN/Inf step guard (``NanGuard``) that skips poisoned updates and
   reports them to the dynamic GradScaler;
 - bounded ``retry`` with exponential backoff + jitter for transient I/O;
+- async + sharded + resharding checkpoints (``async_checkpoint``,
+  ``CheckpointManager.save(async_=True / sharding= / world=)``): zero-stall
+  background commits with a fence, per-rank shard files under a merged CRC
+  manifest, and restore onto a *different* mesh shape — the mechanism
+  behind the elastic supervisor (docs/RESILIENCE.md, "Elastic training");
 - bounded waits + liveness (``watchdog``): ``bounded_get``/``join_thread``/
   ``wait_proc`` and the supervisor ``Heartbeat`` — the primitives behind
   the self-healing DataLoader, the supervised launcher, and collective
@@ -32,6 +37,7 @@ from .checkpoint import CheckpointManager, capture_rng, restore_rng
 from .watchdog import (WatchdogTimeout, bounded_get, join_thread, join_proc,
                        wait_proc, Heartbeat, heartbeat_age)
 from . import atomic_io
+from . import async_checkpoint
 from . import faultinject
 from . import watchdog
 
@@ -39,6 +45,7 @@ __all__ = ['atomic_open', 'atomic_write', 'atomic_pickle_dump',
            'crc32_file', 'crc32_bytes',
            'AtomicWriteError', 'retry', 'RetryError', 'PreemptionGuard',
            'NanGuard', 'NanStepError', 'CheckpointManager', 'capture_rng',
-           'restore_rng', 'atomic_io', 'faultinject', 'watchdog',
+           'restore_rng', 'atomic_io', 'async_checkpoint', 'faultinject',
+           'watchdog',
            'WatchdogTimeout', 'bounded_get', 'join_thread', 'join_proc',
            'wait_proc', 'Heartbeat', 'heartbeat_age']

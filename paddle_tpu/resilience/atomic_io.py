@@ -27,6 +27,13 @@ __all__ = ['atomic_open', 'atomic_write', 'atomic_pickle_dump', 'crc32_file',
 # crash at that point of the commit protocol. None in production.
 _fault_hook = None
 
+# Stream-level fault seam: called as hook(path, bytes_so_far, chunk_len)
+# BEFORE every staged write() once armed — raising models ENOSPC partway
+# through a payload (faultinject.disk_full), sleeping models a slow
+# filesystem (faultinject.slow_fs). None in production: the wrapper below is
+# only interposed while a hook is armed, so the hot path stays a bare file.
+_stream_hook = None
+
 
 class AtomicWriteError(OSError):
     """A staged write failed before commit; the destination is untouched."""
@@ -65,7 +72,8 @@ def atomic_open(path, fsync=True):
         _invoke_hook('write', path)
         f = open(tmp, 'wb')   # atomic-ok: staged temp, committed below
         try:
-            yield f
+            yield (f if _stream_hook is None
+                   else _HookedStream(f, path, _stream_hook))
             if fsync:
                 f.flush()
                 os.fsync(f.fileno())
@@ -86,6 +94,28 @@ def atomic_open(path, fsync=True):
         raise
     if fsync:
         _fsync_dir(d)
+
+
+class _HookedStream:
+    """File proxy interposed only while a stream fault hook is armed:
+    forwards everything (seek/tell/fileno — zipfile/np.savez need them) but
+    routes ``write`` through the hook with a running byte count, so an
+    injector can fail or delay a commit *partway through* the payload."""
+
+    def __init__(self, f, path, hook):
+        self._f = f
+        self._path = path
+        self._hook = hook
+        self._written = 0
+
+    def write(self, data):
+        self._hook(self._path, self._written, len(data))
+        n = self._f.write(data)
+        self._written += len(data)
+        return n
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
 
 
 def atomic_write(path, data, fsync=True):

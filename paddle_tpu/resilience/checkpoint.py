@@ -1,18 +1,36 @@
 """CRC-stamped, rotating, fallback-capable checkpoint store.
 
-Layout under a checkpoint directory::
+Two on-disk formats under one directory (both may coexist; ``steps()`` /
+``load()`` / ``restore()`` see the union):
+
+Format 1 — single-file::
 
     ckpt-00000012.ckpt            # pickled payload (Tensors -> numpy)
     ckpt-00000012.manifest.json   # {"format":1,"step":12,"size":...,"crc32":...,
                                   #  "meta":{"epoch":3,"step_in_epoch":0,...}}
 
-Commit protocol: payload first, manifest second, both through
-``atomic_io.atomic_write``. A checkpoint EXISTS only once its manifest does;
-a crash between the two writes leaves an orphan payload that loaders ignore
-and the next save of that step overwrites. ``load()`` walks steps newest
-first, verifies size+CRC32 against the manifest, and transparently falls
-back to the newest non-corrupt checkpoint (warning on every skip) — a torn
-or bit-flipped latest file costs one checkpoint interval, not the run.
+Format 2 — sharded (``async_checkpoint``; docs/RESILIENCE.md, "Elastic
+training")::
+
+    ckpt_00000012/shard_rank<R>.npz   # per-rank leaf pieces
+    ckpt_00000012/manifest.json       # merged CRC manifest, committed LAST
+
+Commit protocol (both formats): payload/shards first, manifest second, all
+through ``atomic_io.atomic_write``. A checkpoint EXISTS only once its
+manifest does; a crash between the writes leaves invisible orphans that the
+next save of that step overwrites. ``load()``/``restore()`` walk steps
+newest first, verify size+CRC32 against the manifest, and transparently
+fall back to the newest non-corrupt checkpoint (warning on every skip) — a
+torn or bit-flipped latest file costs one checkpoint interval, not the run.
+
+``save(state, async_=True)`` snapshots and commits on a background thread
+(ONE in flight; the next save — or an explicit :meth:`fence` — waits for
+it), recording ``checkpoint.save_stall_ms`` (training-thread blocked time)
+separately from ``checkpoint.commit_ms`` (total commit latency): in steady
+state the stall is ~0 while the commit runs as long as the disk needs.
+``save(state, sharding=cfg)`` / ``save(state, world=W, rank=R)`` write the
+sharded format; ``restore(sharding=new_cfg)`` reassembles any committed
+checkpoint and re-places it onto a *different* mesh (resharding restore).
 """
 import json
 import os
@@ -29,14 +47,18 @@ _FMT = 1
 _PREFIX = 'ckpt-'
 _PAYLOAD_EXT = '.ckpt'
 _MANIFEST_EXT = '.manifest.json'
+_V2_PREFIX = 'ckpt_'
 
 
 class CheckpointManager:
-    """Keep-last-N rotating checkpoint directory with corruption fallback."""
+    """Keep-last-N rotating checkpoint directory with corruption fallback,
+    async (background-thread) saves, per-rank sharded checkpoints, and
+    resharding restore."""
 
     def __init__(self, path, max_keep=3):
         self.path = os.fspath(path)
         self.max_keep = max_keep
+        self._async = None   # lazy AsyncSaver (one in-flight save)
 
     # -- naming -------------------------------------------------------------
     def _payload(self, step):
@@ -47,60 +69,180 @@ class CheckpointManager:
         return os.path.join(self.path, '%s%08d%s' % (_PREFIX, step,
                                                      _MANIFEST_EXT))
 
+    def _v2_dir(self, step):
+        return os.path.join(self.path, '%s%08d' % (_V2_PREFIX, int(step)))
+
+    def _is_v2(self, step):
+        from . import async_checkpoint as ac
+        return os.path.isfile(os.path.join(self._v2_dir(step),
+                                           ac.MANIFEST_NAME))
+
     def steps(self):
-        """Committed (manifest present) steps, ascending."""
+        """Committed (manifest present) steps, ascending — both formats."""
         if not os.path.isdir(self.path):
             return []
-        out = []
+        out = set()
         for name in os.listdir(self.path):
             if name.startswith(_PREFIX) and name.endswith(_MANIFEST_EXT):
                 digits = name[len(_PREFIX):-len(_MANIFEST_EXT)]
                 if digits.isdigit():
-                    out.append(int(digits))
+                    out.add(int(digits))
+            elif name.startswith(_V2_PREFIX):
+                digits = name[len(_V2_PREFIX):]
+                if digits.isdigit() and os.path.isfile(
+                        os.path.join(self.path, name, 'manifest.json')):
+                    out.add(int(digits))
         return sorted(out)
 
     def latest_step(self):
         s = self.steps()
         return s[-1] if s else None
 
+    # -- async machinery ----------------------------------------------------
+    def _saver(self):
+        if self._async is None:
+            from .async_checkpoint import AsyncSaver
+            self._async = AsyncSaver()
+        return self._async
+
+    def in_flight(self):
+        """True while a background save is still committing."""
+        return self._async is not None and self._async.in_flight()
+
+    def fence(self, timeout=None, abandon=False):
+        """Block until the in-flight async save (if any) finishes; with
+        ``abandon=True`` a save still running after ``timeout`` seconds is
+        cooperatively abandoned (it removes its uncommitted artifacts) —
+        the contract the preemption checkpoint relies on. Re-raises a
+        background save's failure. Returns blocked milliseconds."""
+        if self._async is None:
+            return 0.0
+        return self._async.fence(timeout=timeout, abandon=abandon)
+
     # -- write --------------------------------------------------------------
-    def save(self, state, step=None, meta=None):
-        """Atomically commit ``state`` (arbitrary pytree; Tensors become
-        numpy payloads) as checkpoint ``step`` (default: latest+1)."""
+    def save(self, state, step=None, meta=None, *, async_=False,
+             sharding=None, world=None, rank=None, tag=None, extra=None):
+        """Atomically commit ``state`` as checkpoint ``step``
+        (default: latest+1).
+
+        - ``async_=True``: snapshot (device->host) + serialization + commit
+          run on a background thread; this call returns after fencing any
+          previous in-flight save (ONE save in flight) and records only
+          the training-thread stall. On donating backends the leaves are
+          first secured with cheap device-side copies.
+        - ``sharding=`` (a ``distributed.ShardingConfig``): sharded format —
+          one ``shard_rank<R>.npz`` per mesh position plus a merged CRC
+          manifest (see ``async_checkpoint``).
+        - ``world=``/``rank=``: the multi-process sharded split — each rank
+          writes only its shard; rank 0 commits the manifest after the
+          shard barrier.
+        - ``extra=``: small pickled side payload (RNG streams, loop
+          position) stored next to the shards and CRC'd in the manifest.
+        """
         from ..framework import _to_saveable
+        sw = _obs.Stopwatch()
+        # ordering fence FIRST: a save must never land after a LATER one —
+        # and the default step number must see the in-flight commit, or
+        # back-to-back async saves with step=None would both read the same
+        # latest_step() and silently overwrite each other
+        self.fence()
         if step is None:
             latest = self.latest_step()
             step = 0 if latest is None else latest + 1
         step = int(step)
-        pay_path = self._payload(step)
-        sw = _obs.Stopwatch()
-        with atomic_open(pay_path) as f:   # streamed: no full blob in RAM
-            w = _Crc32Writer(f)
-            pickle.dump(_to_saveable(state), w, protocol=4)
-        # CRC/size accumulated while streaming — no read-back of a multi-GB
-        # payload inside the preemption grace window
-        manifest = {'format': _FMT, 'step': step, 'size': w.size,
-                    'crc32': w.crc, 'meta': dict(meta or {})}
-        atomic_write(self._manifest(step),
-                     json.dumps(manifest, sort_keys=True).encode())
-        self._rotate()
+        sharded = sharding is not None or world is not None \
+            or rank is not None
+        if extra is not None and not sharded:
+            # the side payload (RNG streams, loop position) only exists in
+            # the sharded manifest format — promote rather than drop it
+            sharded, world = True, 1
+        committer = rank is None or int(rank) == 0
+        meta = dict(meta or {})
+
+        if sharded:
+            from . import async_checkpoint as ac
+            if sharding is not None:
+                from ..distributed.strategy import resolve_sharding
+                sharding = resolve_sharding(sharding)
+            src = ac.secure_for_async(state) if async_ else state
+
+            def job(should_abort):
+                jsw = _obs.Stopwatch()
+                man = ac.save_sharded(
+                    self.path, src, step, meta=meta, config=sharding,
+                    world=world, rank=rank, tag=tag, extra=extra,
+                    should_abort=should_abort)
+                if man is not None:
+                    nbytes = sum(s['size'] for s in man['shards'].values())
+                    self._finish_commit(step, jsw, meta, nbytes,
+                                        async_=async_, sharded=True)
+                if committer:
+                    self._rotate()
+        else:
+            src = state
+            if async_:
+                from . import async_checkpoint as ac
+                src = ac.secure_for_async(state)
+
+            def job(should_abort):
+                from . import async_checkpoint as ac
+                jsw = _obs.Stopwatch()
+                pay_path = self._payload(step)
+                try:
+                    with atomic_open(pay_path) as f:
+                        if should_abort is not None:
+                            f = ac._AbortCheckingStream(f, should_abort)
+                        w = _Crc32Writer(f)
+                        # streamed: no full blob in RAM; CRC/size accumulate
+                        # while writing — no read-back of a multi-GB payload
+                        # inside the preemption grace window
+                        pickle.dump(_to_saveable(src), w, protocol=4)
+                except ac.AbandonedSave:
+                    if _obs.enabled():
+                        _obs.event('checkpoint.abandoned', step=step)
+                    return
+                manifest = {'format': _FMT, 'step': step, 'size': w.size,
+                            'crc32': w.crc, 'meta': meta}
+                atomic_write(self._manifest(step),
+                             json.dumps(manifest, sort_keys=True).encode())
+                self._finish_commit(step, jsw, meta, w.size,
+                                    async_=async_, sharded=False)
+                self._rotate()
+
+        if async_:
+            self._saver().submit(job)
+        else:
+            job(lambda: False)
         if _obs.enabled():
-            ms = sw.elapsed_ms()
-            _obs.histogram('checkpoint.save_ms').observe(ms)
-            _obs.counter('checkpoint.saves').inc()
-            _obs.event('checkpoint.save', step=step, bytes=w.size,
-                       duration_ms=round(ms, 3), meta=dict(meta or {}))
+            stall = sw.elapsed_ms()
+            _obs.histogram('checkpoint.save_stall_ms').observe(stall)
         return step
+
+    def _finish_commit(self, step, sw, meta, nbytes, async_, sharded):
+        """Telemetry at manifest-commit time (runs on the writer thread
+        for async saves)."""
+        if not _obs.enabled():
+            return
+        ms = sw.elapsed_ms()
+        _obs.histogram('checkpoint.commit_ms').observe(ms)
+        # legacy name: the pre-async save duration histogram
+        _obs.histogram('checkpoint.save_ms').observe(ms)
+        _obs.counter('checkpoint.saves').inc()
+        _obs.event('checkpoint.save', step=step, bytes=nbytes,
+                   duration_ms=round(ms, 3), async_=bool(async_),
+                   sharded=bool(sharded), meta=meta)
 
     def _rotate(self):
         if not self.max_keep:
             return
+        import shutil
         for s in self.steps()[:-self.max_keep]:
             for p in (self._payload(s), self._manifest(s)):
                 try:
                     os.unlink(p)
                 except OSError:
                     pass
+            shutil.rmtree(self._v2_dir(s), ignore_errors=True)
 
     # -- read ---------------------------------------------------------------
     def verify(self, step):
@@ -109,6 +251,9 @@ class CheckpointManager:
 
     def _check(self, step):
         """None when intact, else a human-readable defect description."""
+        if self._is_v2(step):
+            from . import async_checkpoint as ac
+            return ac.check_sharded(self._v2_dir(step))
         man_path, pay_path = self._manifest(step), self._payload(step)
         try:
             with open(man_path, 'rb') as f:
@@ -127,41 +272,120 @@ class CheckpointManager:
                 % (crc, man.get('crc32', 0))
         return None
 
-    def load(self, step=None, return_numpy=False):
-        """Return ``(state, meta)`` of checkpoint ``step`` (default: the
-        newest NON-CORRUPT one), or ``None`` when nothing loadable exists.
-        Corrupt checkpoints are skipped with a warning, never deleted —
-        an operator may still salvage them."""
+    def _read_step(self, s, v1_numpy, return_extra):
+        """(state, meta, extra) of an intact step, or a defect string."""
         from ..framework import _from_saveable
+        defect = self._check(s)
+        if defect is not None:
+            return defect
+        if self._is_v2(s):
+            from . import async_checkpoint as ac
+            try:
+                state, meta, extra = ac.load_sharded(self._v2_dir(s),
+                                                     return_extra=True)
+            except Exception as e:    # CRC passed but deserialize failed
+                return 'unreadable sharded payload (%s)' % e
+            return state, meta, extra
+        try:
+            with open(self._payload(s), 'rb') as f:
+                state = pickle.load(f)
+        except Exception as e:   # CRC passed but unpickle failed
+            return 'unpicklable payload (%s)' % e
+        with open(self._manifest(s), 'rb') as f:
+            meta = json.loads(f.read().decode()).get('meta', {})
+        return _from_saveable(state, v1_numpy), meta, None
+
+    def _load_any(self, step, v1_numpy, return_extra):
+        """Newest intact checkpoint (or ``step``), with corrupt-skip
+        fallback. Returns (state, meta, extra, step) or None."""
         candidates = [step] if step is not None else \
             list(reversed(self.steps()))
         sw = _obs.Stopwatch()
         for s in candidates:
-            defect = self._check(s)
-            if defect is None:
-                try:
-                    with open(self._payload(s), 'rb') as f:
-                        state = pickle.load(f)
-                except Exception as e:   # CRC passed but unpickle failed
-                    defect = 'unpicklable payload (%s)' % e
-                else:
-                    with open(self._manifest(s), 'rb') as f:
-                        meta = json.loads(f.read().decode()).get('meta', {})
-                    if _obs.enabled():
-                        ms = sw.elapsed_ms()
-                        _obs.histogram('checkpoint.restore_ms').observe(ms)
-                        _obs.counter('checkpoint.restores').inc()
-                        _obs.event('checkpoint.restore', step=s,
-                                   duration_ms=round(ms, 3))
-                    return _from_saveable(state, return_numpy), meta
+            got = self._read_step(s, v1_numpy, return_extra)
+            if not isinstance(got, str):
+                state, meta, extra = got
+                if _obs.enabled():
+                    ms = sw.elapsed_ms()
+                    _obs.histogram('checkpoint.restore_ms').observe(ms)
+                    _obs.counter('checkpoint.restores').inc()
+                    _obs.event('checkpoint.restore', step=s,
+                               duration_ms=round(ms, 3))
+                return state, meta, extra, s
             if _obs.enabled():
                 _obs.counter('checkpoint.corrupt_skips').inc()
-                _obs.event('checkpoint.corrupt', step=s, defect=str(defect))
+                _obs.event('checkpoint.corrupt', step=s, defect=str(got))
             warnings.warn(
                 "CheckpointManager: checkpoint step %d at %r is corrupt "
                 "(%s) — falling back to the previous good checkpoint"
-                % (s, self.path, defect))
+                % (s, self.path, got))
         return None
+
+    def load(self, step=None, return_numpy=False):
+        """Return ``(state, meta)`` of checkpoint ``step`` (default: the
+        newest NON-CORRUPT one), or ``None`` when nothing loadable exists.
+        Corrupt checkpoints are skipped with a warning, never deleted —
+        an operator may still salvage them. Sharded (format-2) checkpoints
+        come back as plain numpy leaves."""
+        got = self._load_any(step, return_numpy, return_extra=False)
+        if got is None:
+            return None
+        state, meta, _extra, _s = got
+        return state, meta
+
+    def restore(self, step=None, sharding=None, return_extra=False):
+        """``load()`` for training state, with resharding.
+
+        Leaves come back as raw arrays (numpy for host restore). With
+        ``sharding=`` (a ``ShardingConfig`` — or anything
+        ``resolve_sharding`` accepts), an engine-layout state is placed
+        straight onto the *target* mesh per its ``state_shardings`` — the
+        checkpoint may have been saved on ANY mesh shape (k→k/2,
+        sharded→replicated, ...); the reassembled bytes are identical, so
+        the restore is bitwise-equal to a same-mesh restore. Returns
+        ``(state, meta)`` (or ``(state, meta, extra)``), or None.
+        """
+        got = self._load_any(step, True, return_extra=return_extra)
+        if got is None:
+            return None
+        state, meta, extra, _s = got
+        if sharding is not None:
+            from ..distributed.strategy import resolve_sharding
+            from .async_checkpoint import place_with_config
+            state = place_with_config(state, resolve_sharding(sharding))
+        if return_extra:
+            return state, meta, extra
+        return state, meta
+
+    def load_extra(self, step=None):
+        """The pickled side payload (RNG streams, loop position) of a
+        committed sharded checkpoint, WITHOUT reassembling the arrays;
+        None when the step (default: newest) has no extra / is format 1."""
+        import pickle as _pickle
+        steps = [step] if step is not None else \
+            list(reversed(self.steps()))
+        for s in steps:
+            if not self._is_v2(s):
+                continue
+            from . import async_checkpoint as ac
+            try:
+                man = ac.read_manifest(self._v2_dir(s))
+                if not man.get('extra'):
+                    return None
+                with open(os.path.join(self._v2_dir(s),
+                                       man['extra']['file']), 'rb') as f:
+                    return _pickle.load(f)
+            except Exception:
+                return None
+        return None
+
+    def load_manifest(self, step):
+        """The raw manifest dict of a committed step (either format)."""
+        if self._is_v2(step):
+            from . import async_checkpoint as ac
+            return ac.read_manifest(self._v2_dir(step))
+        with open(self._manifest(step), 'rb') as f:
+            return json.loads(f.read().decode())
 
 
 class _Crc32Writer:

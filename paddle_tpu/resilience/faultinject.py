@@ -44,7 +44,8 @@ __all__ = ['FaultInjector', 'flaky', 'poison_loss', 'corrupt_file',
            'truncate_file', 'PreemptAtStep', 'InjectedWriteError',
            'poison_sample', 'kill_worker', 'hang_worker', 'slow_rank',
            'slow_model', 'slow_loader', 'slow_collective', 'retrace_bait',
-           'boot_fail', 'PoisonedSampleError']
+           'boot_fail', 'PoisonedSampleError', 'slow_fs', 'disk_full',
+           'sigterm_at_step', 'kill_rank_at_step']
 
 
 class InjectedWriteError(OSError):
@@ -60,7 +61,9 @@ class FaultInjector:
 
     def __init__(self):
         self._arms = []       # list of [stage, remaining, match]
+        self._stream_arms = []   # list of [kind, param, match, remaining]
         self._prev_hook = None
+        self._prev_stream = None
         self.triggered = 0
 
     def fail_writes(self, times=1, match=None, stage='write'):
@@ -69,6 +72,24 @@ class FaultInjector:
         ``stage`` ('write' = before any bytes, 'replace' = staged bytes
         written but commit rename never happens)."""
         self._arms.append([stage, times, match])
+        return self
+
+    def disk_full(self, after_bytes=0, match=None, times=1):
+        """Arm: the next ``times`` atomic writes whose destination contains
+        ``match`` hit ENOSPC once ``after_bytes`` staged bytes are down —
+        the disk-fills-mid-shard model. The commit never happens, the temp
+        is removed, and the destination (and every previously committed
+        checkpoint) stays intact."""
+        self._stream_arms.append(['enospc', int(after_bytes), match,
+                                  int(times)])
+        return self
+
+    def slow_fs(self, delay_s, match=None):
+        """Arm: every staged ``write()`` to a matching destination sleeps
+        ``delay_s`` first — the NFS-on-a-bad-day model that makes a
+        synchronous checkpoint save stall the training thread visibly (and
+        an async one provably not)."""
+        self._stream_arms.append(['slow', float(delay_s), match, None])
         return self
 
     def _hook(self, stage, path):
@@ -83,13 +104,37 @@ class FaultInjector:
             raise InjectedWriteError(
                 "fault injection: forced %s failure for %r" % (stage, path))
 
+    def _stream(self, path, so_far, chunk_len):
+        for arm in self._stream_arms:
+            kind, param, match, remaining = arm
+            if match is not None and match not in os.fspath(path):
+                continue
+            if kind == 'slow':
+                time.sleep(param)
+            elif kind == 'enospc':
+                if remaining <= 0 or so_far + chunk_len <= param:
+                    continue
+                arm[3] -= 1
+                self.triggered += 1
+                import errno
+                raise OSError(
+                    errno.ENOSPC,
+                    "fault injection: no space left on device after "
+                    "%d bytes of %r" % (so_far, path))
+
     def __enter__(self):
+        # both hooks install unconditionally: arming disk_full/slow_fs
+        # AFTER entering (like fail_writes allows) must work, not silently
+        # inject nothing
         self._prev_hook = atomic_io._fault_hook
         atomic_io._fault_hook = self._hook
+        self._prev_stream = atomic_io._stream_hook
+        atomic_io._stream_hook = self._stream
         return self
 
     def __exit__(self, *exc):
         atomic_io._fault_hook = self._prev_hook
+        atomic_io._stream_hook = self._prev_stream
         return False
 
 
@@ -325,6 +370,81 @@ def slow_collective(delay_s, ops=None):
         yield
     finally:
         _deadline._delay_hook[0] = prev
+
+
+@contextlib.contextmanager
+def slow_fs(delay_s, match=None):
+    """Context manager: every staged atomic write in this process sleeps
+    ``delay_s`` per ``write()`` call (optionally only destinations
+    containing ``match``) — the slow-filesystem model behind the
+    async-checkpoint save-stall comparison and the preemption fence
+    regression test."""
+    with FaultInjector().slow_fs(delay_s, match=match):
+        yield
+
+
+@contextlib.contextmanager
+def disk_full(after_bytes=0, match=None, times=1):
+    """Context manager: ENOSPC partway through the next ``times`` staged
+    writes (see :meth:`FaultInjector.disk_full`)."""
+    with FaultInjector().disk_full(after_bytes=after_bytes, match=match,
+                                   times=times) as fi:
+        yield fi
+
+
+def sigterm_at_step(data, at_step):
+    """Wrap a batch iterable: a real SIGTERM is raised in this process
+    just before item ``at_step`` (0-based, counted across the wrapper's
+    lifetime) is yielded — the preemption model for ``engine.fit`` loops
+    (the hapi sibling is :class:`PreemptAtStep`). The item itself is still
+    yielded, so the loop's PreemptionGuard sees the flag at the *next*
+    step boundary, exactly like a scheduler-delivered signal."""
+    return _SigtermIter(data, at_step)
+
+
+class _SigtermIter:
+    """Iterator behind :func:`sigterm_at_step`; exposes ``.state``
+    (``seen``/``fired``) so a test can assert the signal really fired."""
+
+    def __init__(self, data, at_step):
+        self._it = iter(data)
+        self._at = int(at_step)
+        self.state = {'seen': 0, 'fired': False}
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = next(self._it)
+        if self.state['seen'] == self._at and not self.state['fired']:
+            self.state['fired'] = True
+            signal.raise_signal(signal.SIGTERM)
+        self.state['seen'] += 1
+        return item
+
+
+def kill_rank_at_step(at_step, once_file, rank=None):
+    """The rank-death model for elastic training: returns ``maybe_die(step)``
+    — call it once per training step; at global step ``at_step`` it SIGKILLs
+    the CURRENT process (optionally only when ``PADDLE_TRAINER_ID == rank``),
+    once across restarts (``once_file`` marker: the relaunched generation
+    survives the same step)."""
+    at_step = int(at_step)
+    once_file = os.fspath(once_file)
+
+    def maybe_die(step):
+        if int(step) != at_step:
+            return
+        if rank is not None and \
+                int(os.environ.get('PADDLE_TRAINER_ID', '0')) != int(rank):
+            return
+        if os.path.exists(once_file):
+            return   # already fired once: the respawned rank survives
+        with open(once_file, 'w'):   # atomic-ok: chaos one-shot marker
+            pass
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    return maybe_die
 
 
 @contextlib.contextmanager

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""ckpt: inspect a paddle_tpu checkpoint directory (docs/RESILIENCE.md).
+
+Usage::
+
+    python tools/ckpt.py <ckpt_dir>                 # list committed steps
+    python tools/ckpt.py <ckpt_dir> --step 12       # one step in detail
+    python tools/ckpt.py <ckpt_dir> --verify        # per-shard CRC32 check
+    python tools/ckpt.py <ckpt_dir> --compat 2      # dry-run resharding
+    python tools/ckpt.py <ckpt_dir> --compat data=2,model=2
+    python tools/ckpt.py <ckpt_dir> --json
+
+Reads both checkpoint formats — the single-file ``ckpt-<step>.ckpt`` pairs
+and the sharded ``ckpt_<step>/`` directories (shards + merged manifest) —
+and prints, per step: format, meta, source mesh/world shape, leaf/byte
+counts, and (``--verify``) whether every payload matches its manifest's
+size + CRC32. ``--compat`` answers "could this checkpoint reshard onto a
+mesh of degree k?" from the manifest alone (global shapes + the
+first-divisible-dim policy): every leaf either splits evenly or falls back
+replicated, so the answer is per-leaf placement + bytes/rank, not a yes/no.
+
+Stdlib-only on purpose (doctor-by-path style): CRCs are computed over the
+shard FILES, exactly what the manifest stamps, so no numpy/jax is needed
+on the machine doing the audit.
+"""
+import argparse
+import json
+import os
+import sys
+import zlib
+
+V1_PREFIX, V1_MANIFEST_EXT, V1_PAYLOAD_EXT = 'ckpt-', '.manifest.json', \
+    '.ckpt'
+V2_PREFIX, V2_MANIFEST = 'ckpt_', 'manifest.json'
+
+
+def crc32_file(path, chunk=1 << 20):
+    crc = 0
+    with open(path, 'rb') as f:
+        for block in iter(lambda: f.read(chunk), b''):
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def discover(root):
+    """{step: {'format': 1|2, ...manifest...}} for every committed step."""
+    out = {}
+    for name in sorted(os.listdir(root)):
+        path = os.path.join(root, name)
+        if name.startswith(V1_PREFIX) and name.endswith(V1_MANIFEST_EXT):
+            digits = name[len(V1_PREFIX):-len(V1_MANIFEST_EXT)]
+            if digits.isdigit():
+                with open(path, 'rb') as f:
+                    man = json.loads(f.read().decode())
+                man['_dir'] = root
+                out[int(digits)] = man
+        elif name.startswith(V2_PREFIX) and os.path.isdir(path):
+            digits = name[len(V2_PREFIX):]
+            mpath = os.path.join(path, V2_MANIFEST)
+            if digits.isdigit() and os.path.isfile(mpath):
+                with open(mpath, 'rb') as f:
+                    man = json.loads(f.read().decode())
+                man['_dir'] = path
+                out[int(digits)] = man
+    return out
+
+
+def verify_step(step, man):
+    """[(file, ok, detail), ...] — size + CRC32 of every stamped payload."""
+    results = []
+    if man.get('format') == 2:
+        entries = [(e['file'], e) for e in man.get('shards', {}).values()]
+        if man.get('extra'):
+            entries.append((man['extra']['file'], man['extra']))
+        base = man['_dir']
+    else:
+        name = '%s%08d%s' % (V1_PREFIX, step, V1_PAYLOAD_EXT)
+        entries = [(name, man)]
+        base = man['_dir']
+    for fname, ent in entries:
+        p = os.path.join(base, fname)
+        if not os.path.isfile(p):
+            results.append((fname, False, 'missing'))
+            continue
+        size = os.path.getsize(p)
+        if size != ent.get('size'):
+            results.append((fname, False,
+                            'size %d != manifest %s' % (size,
+                                                        ent.get('size'))))
+            continue
+        crc = crc32_file(p)
+        if crc != ent.get('crc32'):
+            results.append((fname, False,
+                            'crc 0x%08x != manifest 0x%08x'
+                            % (crc, ent.get('crc32', 0))))
+            continue
+        results.append((fname, True, 'ok'))
+    return results
+
+
+def parse_mesh(spec):
+    """'4' -> {'data': 4}; 'data=2,model=2' -> {'data': 2, 'model': 2}."""
+    spec = spec.strip()
+    if spec.isdigit():
+        return {'data': int(spec)}
+    out = {}
+    for part in spec.split(','):
+        if '=' not in part:
+            raise ValueError(f'bad mesh spec component {part!r}')
+        k, v = part.split('=', 1)
+        out[k.strip()] = int(v)
+    return out
+
+
+def compat_report(man, mesh, min_size=1024):
+    """Dry-run resharding feasibility onto a mesh of product degree k:
+    per-leaf 'sharded on dim d' vs 'replicated fallback' under the same
+    first-divisible-dim + ``min_size`` policy the saver's world planner
+    (and ``ShardingConfig``'s default FSDP rule) applies, plus approximate
+    bytes per rank."""
+    if man.get('format') != 2:
+        return {'error': 'compat check needs a sharded (format-2) manifest '
+                         '(single-file checkpoints replicate everywhere '
+                         'by construction)'}
+    k = 1
+    for v in mesh.values():
+        k *= int(v)
+    leaves = man.get('leaves', [])
+    sharded, fallback = [], []
+    bytes_per_rank = 0
+    total_bytes = 0
+
+    def leaf_bytes(leaf):
+        n = 1
+        for d in leaf.get('shape', []):
+            n *= int(d)
+        # dtype itemsize without numpy: trailing digits are bits
+        dt = leaf.get('dtype', 'float32')
+        digits = ''.join(c for c in dt if c.isdigit()) or '32'
+        return n * max(int(digits) // 8, 1)
+
+    for leaf in leaves:
+        shape = [int(d) for d in leaf.get('shape', [])]
+        nbytes = leaf_bytes(leaf)
+        total_bytes += nbytes
+        size = 1
+        for d in shape:
+            size *= d
+        dim = None
+        if k > 1 and size >= min_size:
+            for d, extent in enumerate(shape):
+                if extent >= k and extent % k == 0:
+                    dim = d
+                    break
+        name = '/'.join(str(p) for p in leaf.get('path', []))
+        if dim is None:
+            fallback.append(name)
+            bytes_per_rank += nbytes
+        else:
+            sharded.append('%s [dim %d]' % (name, dim))
+            bytes_per_rank += nbytes // k
+    return {'target_mesh': mesh, 'degree': k, 'feasible': True,
+            'sharded_leaves': sharded, 'replicated_fallback': fallback,
+            'total_bytes': total_bytes,
+            'approx_bytes_per_rank': bytes_per_rank,
+            'source_mesh': man.get('mesh'), 'source_world': man.get('world')}
+
+
+def describe(step, man):
+    d = {'step': step, 'format': man.get('format', 1),
+         'meta': man.get('meta', {})}
+    if man.get('format') == 2:
+        leaves = man.get('leaves', [])
+        d.update({
+            'world': man.get('world'),
+            'mesh': man.get('mesh'),
+            'tag': man.get('tag'),
+            'shards': len(man.get('shards', {})),
+            'leaves': len(leaves),
+            'bytes': sum(int(s.get('size', 0))
+                         for s in man.get('shards', {}).values()),
+            'sharded_leaves': sum(1 for leaf in leaves
+                                  if len(leaf.get('pieces', [])) > 1),
+        })
+    else:
+        d['bytes'] = man.get('size', 0)
+    return d
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='ckpt',
+        description='inspect paddle_tpu checkpoint dirs: manifests, CRC '
+                    'verification, resharding dry-runs '
+                    '(docs/RESILIENCE.md, "Elastic training")')
+    p.add_argument('path', help='checkpoint directory')
+    p.add_argument('--step', type=int, default=None,
+                   help='inspect one step (default: all, newest last)')
+    p.add_argument('--verify', action='store_true',
+                   help='CRC32-verify every payload/shard against its '
+                        'manifest (exit 1 on any mismatch)')
+    p.add_argument('--compat', default=None, metavar='MESH',
+                   help="dry-run resharding feasibility onto a target mesh "
+                        "('4', or 'data=2,model=2') — reports per-leaf "
+                        "sharded-vs-replicated placement and bytes/rank")
+    p.add_argument('--json', action='store_true', dest='as_json')
+    args = p.parse_args(argv)
+
+    if not os.path.isdir(args.path):
+        print(f'ckpt: no such directory: {args.path}', file=sys.stderr)
+        return 2
+    found = discover(args.path)
+    if not found:
+        print(f'ckpt: no committed checkpoints under {args.path}',
+              file=sys.stderr)
+        return 2
+    steps = [args.step] if args.step is not None else sorted(found)
+    if args.step is not None and args.step not in found:
+        print(f'ckpt: step {args.step} not committed (have '
+              f'{sorted(found)})', file=sys.stderr)
+        return 2
+
+    report = []
+    bad = 0
+    for s in steps:
+        man = found[s]
+        entry = describe(s, man)
+        if args.verify:
+            checks = verify_step(s, man)
+            entry['verify'] = [{'file': f, 'ok': ok, 'detail': det}
+                               for f, ok, det in checks]
+            bad += sum(1 for _f, ok, _d in checks if not ok)
+        if args.compat:
+            entry['compat'] = compat_report(man, parse_mesh(args.compat))
+        report.append(entry)
+
+    if args.as_json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        for entry in report:
+            fmt = entry['format']
+            line = (f"step {entry['step']:>8d}  format {fmt}  "
+                    f"{entry.get('bytes', 0):>12,d} B")
+            if fmt == 2:
+                mesh = entry.get('mesh')
+                src = (f"mesh {mesh['axes']}" if mesh
+                       else f"world {entry.get('world')}")
+                line += (f"  shards {entry.get('shards')}  "
+                         f"leaves {entry.get('leaves')} "
+                         f"({entry.get('sharded_leaves')} sharded)  {src}")
+            if entry.get('meta'):
+                line += f"  meta {entry['meta']}"
+            print(line)
+            for chk in entry.get('verify', []):
+                mark = 'OK ' if chk['ok'] else 'BAD'
+                print(f"    [{mark}] {chk['file']}: {chk['detail']}")
+            comp = entry.get('compat')
+            if comp:
+                if comp.get('error'):
+                    print(f"    compat: {comp['error']}")
+                    continue
+                print(f"    compat with mesh {comp['target_mesh']} "
+                      f"(degree {comp['degree']}): feasible; "
+                      f"{len(comp['sharded_leaves'])} leaf(s) shard, "
+                      f"{len(comp['replicated_fallback'])} fall back "
+                      f"replicated; ~{comp['approx_bytes_per_rank']:,d} "
+                      f"B/rank of {comp['total_bytes']:,d} B total")
+                for name in comp['replicated_fallback']:
+                    print(f"      replicated: {name}")
+    return 1 if bad else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
