@@ -561,6 +561,195 @@ def bench_serving_generative(seed=0):
     return out
 
 
+def bench_fleet(duration_s=2.0, rate_mult=2.0, seed=0):
+    """Serving fleet fabric on CPU (ISSUE 16 acceptance numbers, measured
+    — ``extras.fleet``):
+
+    - **fleet vs single-replica QPS**: the same Poisson storm against one
+      replica and against a 3-replica ``FleetRouter``.
+    - **kill survival**: one replica is killed mid-storm
+      (``faultinject.kill_replica_at_request``) with a ``FleetSupervisor``
+      relaunching it — error rate during the kill window and
+      recovery-to-healthy ms.
+    - **tail hedging**: p99 with ``hedge_after_ms`` on vs off against a
+      fleet with one deliberately slow replica
+      (``faultinject.slow_replica``).
+    """
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu import serving
+    from paddle_tpu import observability as obs
+    from paddle_tpu.resilience import faultinject
+
+    rng = np.random.RandomState(seed)
+    was_static = paddle.in_static_mode()
+    paddle.enable_static()
+    try:
+        w1 = (rng.randn(128, 128) * 0.05).astype(np.float32)
+        w2 = (rng.randn(128, 32) * 0.05).astype(np.float32)
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data('x', shape=[-1, 128], dtype='float32')
+            h = paddle.nn.functional.relu(
+                paddle.matmul(x, paddle.to_tensor(w1)))
+            y = paddle.matmul(h, paddle.to_tensor(w2))
+        example = {'x': np.zeros((128,), np.float32)}
+
+        def mk_engine(name):
+            eng = serving.ServingEngine(queue_capacity=256)
+            eng.register('mlp', program=(main, ['x'], [y]),
+                         executor=static.Executor(), example=example,
+                         bucket_spec=serving.BucketSpec((1, 2, 4, 8)))
+            eng.warmup()
+            eng.start()
+            return eng
+
+        def one_input():
+            return {'x': rng.randn(128).astype(np.float32)}
+
+        def storm(router, duration, rate, kill=None):
+            """Poisson submits; returns (latencies, errors, err_times)."""
+            lat, errors, err_times = [], 0, []
+            pend = []
+            t0 = time.perf_counter()
+            next_t = t0
+            while time.perf_counter() - t0 < duration:
+                next_t += rng.exponential(1.0 / rate)
+                pause = next_t - time.perf_counter()
+                if pause > 0:
+                    time.sleep(pause)
+                if kill is not None:
+                    kill()
+                try:
+                    pend.append(router.submit('mlp', one_input(),
+                                              deadline_ms=10000))
+                except serving.FleetOverloadError:
+                    errors += 1
+            for p in pend:
+                t1 = time.perf_counter()
+                try:
+                    r = p.result(timeout=30)
+                    if r.ok:
+                        lat.append(r.latency_ms)
+                    else:
+                        errors += 1
+                        err_times.append(t1 - t0)
+                except Exception:
+                    errors += 1
+                    err_times.append(t1 - t0)
+            return lat, errors, err_times
+
+        def p99(vals):
+            return round(float(np.percentile(vals, 99)), 2) if vals else 0.0
+
+        # -- phase 1: single replica baseline -----------------------------
+        r_single = serving.FleetRouter(serving.RouterPolicy())
+        r_single.add_replica('r0', mk_engine('r0'))
+        rate = 150.0
+        lat1, _, _ = storm(r_single, duration_s / 2, rate)
+        single_wall = duration_s / 2
+        single_qps = len(lat1) / single_wall
+        r_single.replica('r0').engine.stop()
+
+        # -- phase 2: 3-replica fleet, one replica killed mid-storm -------
+        router = serving.FleetRouter(serving.RouterPolicy(
+            max_retries=2, on_replica_death='redispatch'))
+        for n in ('r0', 'r1', 'r2'):
+            router.add_replica(n, mk_engine(n))
+        # r1 dies right after admitting its 30th request — that request
+        # (plus anything queued behind it) strands and must fail over
+        faultinject.kill_replica_at_request(router.replica('r1').engine,
+                                            at_request=30)
+        sup = serving.FleetSupervisor(router, replica_factory=mk_engine,
+                                      check_interval_s=0.05, warmup=True)
+        sup.start()
+        kill_state = {'t': None}
+        t_start = time.perf_counter()
+
+        def note_kill():
+            if kill_state['t'] is None and \
+                    getattr(router.replica('r1').engine, 'killed', False):
+                kill_state['t'] = time.perf_counter()
+
+        lat2, errors2, err_times2 = storm(router, duration_s,
+                                          rate * 3, kill=note_kill)
+        fleet_qps = len(lat2) / duration_s
+        # recovery: wall time from the kill until r1 is admittable again
+        recovery_ms = None
+        if kill_state['t'] is not None:
+            t_wait = time.perf_counter()
+            while time.perf_counter() - t_wait < 10.0:
+                h = router.replica('r1')
+                if h.engine.dispatchable() and not h.engine.killed:
+                    recovery_ms = round(
+                        (time.perf_counter() - kill_state['t']) * 1000, 1)
+                    break
+                time.sleep(0.01)
+        sup.stop()
+        # errors inside the 500 ms window after the kill vs total offered
+        kill_t = (kill_state['t'] - t_start) if kill_state['t'] else None
+        win_errs = (sum(1 for t in err_times2
+                        if kill_t <= t <= kill_t + 0.5)
+                    if kill_t is not None else 0)
+        offered2 = len(lat2) + errors2
+        for n in ('r0', 'r1', 'r2'):
+            router.replica(n).engine.stop()
+
+        # -- phase 3: hedging on/off against a slow replica ---------------
+        # closed loop (submit -> result immediately): result() drives the
+        # hedge state machine on the caller thread, so the client must be
+        # waiting for the hedge to fire — exactly the serving pattern
+        def hedged_run(hedge_ms, n_requests=40):
+            rr = serving.FleetRouter(serving.RouterPolicy(
+                hedge_after_ms=hedge_ms, max_retries=1,
+                trip_after=1000))          # keep the slow replica in play
+            for n in ('s0', 's1'):
+                rr.add_replica(n, mk_engine(n))
+            faultinject.slow_replica(rr.replica('s1').engine, delay_s=0.15)
+            lat = []
+            for _ in range(n_requests):
+                t1 = time.perf_counter()
+                p = rr.submit('mlp', one_input(), deadline_ms=10000)
+                r = p.result(timeout=30)
+                if r.ok:
+                    lat.append((time.perf_counter() - t1) * 1000.0)
+            for n in ('s0', 's1'):
+                rr.replica(n).engine.stop()
+            return lat
+
+        lat_off = hedged_run(None)
+        lat_on = hedged_run(25.0)
+
+        sup_stats = obs.snapshot()['histograms'].get('fleet.recovery_ms',
+                                                     {})
+        return {
+            'single_replica_qps': round(single_qps, 2),
+            'fleet_qps': round(fleet_qps, 2),
+            'fleet_speedup': round(fleet_qps / single_qps, 3)
+            if single_qps else 0.0,
+            'offered': offered2,
+            'completed': len(lat2),
+            'errors': errors2,
+            'error_rate': round(errors2 / offered2, 4) if offered2 else 0.0,
+            'errors_in_kill_window': win_errs,
+            'recovery_to_healthy_ms': recovery_ms,
+            'supervisor_recovery_ms': sup_stats,
+            'p99_unhedged_ms': p99(lat_off),
+            'p99_hedged_ms': p99(lat_on),
+            'hedge_p99_ratio': round(p99(lat_on) / p99(lat_off), 3)
+            if lat_off and p99(lat_off) else 0.0,
+            'router': {n: {k: v for k, v in row.items()
+                           if k in ('dispatched', 'retried', 'hedged',
+                                    'hedge_wins', 'deaths', 'restarts')}
+                       for n, row in router.stats()['replicas'].items()},
+        }
+    finally:
+        if not was_static:
+            paddle.disable_static()
+
+
 def bench_engine(steps=24, warmup=4, microbatch=4, seed=0):
     """The unified train-step compiler on CPU: the ISSUE-9 acceptance
     numbers, measured (``extras.engine``).
@@ -1447,6 +1636,13 @@ def _child_main(mode, model):
             serving_extras['generative'] = bench_serving_generative()
         except Exception as e:       # must never sink smoke either
             serving_extras['generative'] = {'error': repr(e)}
+        try:
+            # fleet fabric (ISSUE 16): 3-replica Poisson storm with a
+            # mid-run replica kill — fleet vs single QPS, error rate in
+            # the kill window, recovery ms, p99 hedging on/off
+            fleet_extras = bench_fleet()
+        except Exception as e:       # fleet bench must never sink smoke
+            fleet_extras = {'error': repr(e)}
         telemetry = _telemetry_counters()
         # cost ledger BEFORE bench_engine for the same reason as the
         # counter capture: its prefetch section resets the registry (and
@@ -1486,6 +1682,9 @@ def _child_main(mode, model):
             "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
             "extras": {"telemetry": telemetry,
                        "serving": serving_extras,
+                       # fleet fabric (ISSUE 16): kill-survival error
+                       # rate, recovery ms, hedged-tail p99
+                       "fleet": fleet_extras,
                        "engine": engine_extras,
                        "sharding": sharding_extras,
                        # elastic training (ISSUE 14): save-stall p50s +

@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL018).
+"""The graftlint AST rule catalog (GL001–GL019).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -64,6 +64,17 @@ but destroys performance or correctness on real hardware:
   manual ``span()``/``timer()`` ``.__enter__()`` whose ``.__exit__`` is
   not exception-safe. Wrap the region in ``with observability.span(...)``
   (pairs enter/exit on every path) or stop in a ``finally``.
+
+- GL019: a broad ``except``/``except Exception`` inside a retry or
+  dispatch loop in library code that neither re-raises nor emits — the
+  silent-failover anti-pattern. A loop that eats every error and tries
+  again turns a dead replica into an infinite quiet spin: no counter
+  moves, no event lands, doctor sees nothing, and the operator learns
+  about the outage from users. Route the retry through
+  ``resilience.retry`` (bounded attempts + telemetry for free), narrow
+  the exception type, re-raise after bookkeeping, or at minimum emit the
+  failure (``observability.event()``/``counter().inc()``/logger) inside
+  the handler (tests/tools/bench harnesses exempt).
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -1374,3 +1385,110 @@ class UnpairedProfilerStartRule(Rule):
                         "lands in the registry or the trace); use `with "
                         "paddle_tpu.observability.span(name):` so the "
                         "exit runs on every path")
+
+
+# -- GL019: silent broad except inside a retry/dispatch loop ------------------
+
+_SWALLOW_EXEMPT_PREFIXES = ('tests/', 'tools/')
+
+# broad handler types: catch-everything spellings
+_BROAD_EXC_NAMES = {'Exception', 'BaseException'}
+
+# a handler body "accounts for" the error if it calls anything whose final
+# dotted segment looks like telemetry/logging/completion bookkeeping —
+# after that the swallow is a recorded decision, not a silent one
+_EMISSION_TAILS = {
+    'event', 'emit', 'counter', 'inc', 'add', 'record', 'observe',
+    'histogram', 'gauge', 'warn', 'warning', 'error', 'exception',
+    'critical', 'log', 'debug', 'info', 'print_exc', 'format_exc',
+    'finish_request', 'complete', '_count', 'dump', 'put', 'append',
+    'trip', 'record_failure', 'set_exception', 'callback',
+}
+
+
+def _handler_is_broad(handler):
+    """True for ``except:``, ``except Exception``, ``except BaseException``
+    (bare names or attribute tails, alone or anywhere in a tuple)."""
+    t = handler.type
+    if t is None:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        tail = _tail_name(e)
+        if tail in _BROAD_EXC_NAMES:
+            return True
+    return False
+
+
+def _handler_accounts(handler):
+    """True when the handler re-raises, escapes the loop, emits, or
+    assigns a fallback (converting the error into a recorded default is a
+    decision, not a swallow — ``except Exception: idx_map = {}``)."""
+    for n in ast.walk(handler):
+        if isinstance(n, (ast.Raise, ast.Return, ast.Break,
+                          ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            return True
+        if isinstance(n, ast.Call):
+            tail = _tail_name(n.func)
+            if tail in _EMISSION_TAILS:
+                return True
+    return False
+
+
+@register
+class SilentLoopSwallowRule(Rule):
+    """GL019: a broad ``except`` inside a retry/dispatch loop in library
+    code that neither re-raises, breaks out, nor emits anything — the
+    silent-failover anti-pattern. The loop eats every error and goes
+    around again, so a dead replica (or a poisoned request) becomes an
+    infinite quiet spin: no counter moves, no event lands, doctor's
+    detectors have nothing to correlate, and the outage is discovered by
+    users instead of telemetry. Fix-it: route the retry through
+    ``paddle_tpu.resilience.retry`` (bounded attempts, backoff, and
+    telemetry for free), narrow the exception type to what the loop can
+    actually recover from, re-raise after bookkeeping, or at minimum
+    emit the failure (``observability.event()``/``counter().inc()``/
+    logger call) inside the handler."""
+    id = 'GL019'
+    title = 'silent broad except inside a retry/dispatch loop'
+
+    def in_scope(self, rel):
+        if any(rel == p or rel.startswith(p)
+               for p in _SWALLOW_EXEMPT_PREFIXES):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        # collect every Try that sits syntactically inside a For/While
+        # (the handler runs per iteration: a swallow there repeats)
+        in_loop = set()
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for n in ast.walk(loop):
+                if isinstance(n, ast.Try) and n is not loop:
+                    in_loop.add(id(n))
+        for n in ast.walk(ctx.tree):
+            if not (isinstance(n, ast.Try) and id(n) in in_loop):
+                continue
+            for handler in n.handlers:
+                if not _handler_is_broad(handler):
+                    continue
+                if _handler_accounts(handler):
+                    continue
+                yield self.finding(
+                    ctx, handler,
+                    "broad `except%s` inside a loop swallows every error "
+                    "and iterates again — a dead dependency becomes a "
+                    "silent spin with no counter, event, or log to find "
+                    "it by; use paddle_tpu.resilience.retry (bounded "
+                    "attempts + telemetry), narrow the exception type, "
+                    "re-raise after bookkeeping, or emit the failure "
+                    "inside the handler"
+                    % ((' ' + (_tail_name(handler.type)
+                               if not isinstance(handler.type, ast.Tuple)
+                               else '(...)'))
+                       if handler.type is not None else ''))

@@ -45,6 +45,20 @@ def enable_compilation_cache(cache_dir):
     # cache every computation, however small/fast to compile
     jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
     jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)
+    # jax initializes the persistent cache AT MOST ONCE, on the first
+    # compile. importing paddle_tpu jit-compiles helpers before any user
+    # code runs, so by the time this function is called the cache was
+    # already initialized as DISABLED (no dir configured) and the config
+    # updates above are silently ignored — every entry "written" is
+    # dropped with "cache is disabled/not initialized". reset_cache()
+    # discards that verdict so the next compile re-initializes against
+    # cache_dir. Guarded: the private module moves between jax versions,
+    # and an older jax without it initializes lazily enough not to need it.
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except (ImportError, AttributeError):
+        pass
     return cache_dir
 
 

@@ -50,6 +50,17 @@ Detector catalog (docs/OBSERVABILITY.md has the operator version):
                       elastic supervisor resumed on the survivors (info —
                       the run survived, but capacity is reduced; names
                       the dead rank from the supervisor's heartbeats).
+- ``replica_flapping`` a serving replica's circuit breaker opened >=
+                      ``flap_opens`` times this window — the half-open
+                      gate keeps re-admitting a replica that is not
+                      better (cold rejoin without warmup, flaky host);
+                      the fix-it names the replica and the half-open
+                      warmup knobs.
+- ``retry_storm``     router failover retries >= 20% of offered load —
+                      retry amplification melting the surviving
+                      replicas; fix the failing replica, then bound
+                      max_retries / hedging and let the shed ladder
+                      engage first.
 
 Ranked output: ``critical`` > ``warning`` > ``info``. Standalone on
 purpose — stdlib-only, importable by path — so ``tools/doctor.py`` works
@@ -74,6 +85,9 @@ MEMORY_PRESSURE_RATIO = 0.8    # worst program peak_bytes / memory budget
 SLO_BURN_WARNING = 1.0         # error-budget burn rate thresholds
 SLO_BURN_CRITICAL = 5.0
 CHECKPOINT_STALL_RATIO = 0.25  # mean save stall / mean step time
+FLAP_OPENS = 4                 # circuit opens per window = flapping
+RETRY_STORM_RATIO = 0.2        # router retries / offered requests
+RETRY_STORM_MIN = 10           # offered requests before the ratio counts
 
 
 def _labeled(section, prefix, key='model'):
@@ -544,6 +558,109 @@ def detect_elastic_downsize(events=None, snapshot=None, cluster=None, **_):
             break
 
 
+def detect_replica_flapping(events=None, snapshot=None, cluster=None,
+                            flap_opens=FLAP_OPENS, **_):
+    """A serving replica's circuit breaker is oscillating: it opened
+    ``flap_opens``+ times this window (``serving.router.circuit``
+    events), usually with closes in between — the half-open probe window
+    keeps re-admitting a replica that is not actually better (cold
+    compile storm on rejoin, flaky host, undersized warmup), so live
+    traffic keeps paying the failure tax."""
+    opens, closes, last_reason = {}, {}, {}
+    for e in (events or []):
+        if e.get('ev') != 'serving.router.circuit':
+            continue
+        rep = str(e.get('replica', '?'))
+        if e.get('state') == 'open':
+            opens[rep] = opens.get(rep, 0) + 1
+            if e.get('reason'):
+                last_reason[rep] = str(e['reason'])
+        elif e.get('state') == 'closed':
+            closes[rep] = closes.get(rep, 0) + 1
+    if not opens:
+        # last-wins router_stats fallback (flight dumps with a short
+        # event window): lifetime trip counts, no close attribution
+        for e in reversed(events or []):
+            if e.get('ev') == 'serving.router_stats':
+                for rep, row in (e.get('replicas') or {}).items():
+                    if isinstance(row, dict) and row.get('trips'):
+                        opens[str(rep)] = int(row['trips'])
+                break
+    for rep, n in sorted(opens.items()):
+        if n < flap_opens:
+            continue
+        severity = 'critical' if n >= 2 * flap_opens else 'warning'
+        yield _diag(
+            'replica_flapping', severity,
+            f"replica {rep!r} circuit opened {n} time(s)"
+            + (f", closed {closes[rep]} time(s)" if closes.get(rep) else "")
+            + (f" (last trip: {last_reason[rep]})"
+               if last_reason.get(rep) else "")
+            + " — it keeps being re-admitted and keeps failing",
+            f"stop the flap at replica {rep!r}: lengthen its half-open "
+            "warmup (raise RouterPolicy.half_open_probes and "
+            "circuit_cooldown_s so a rejoining replica proves itself on "
+            "more probes before taking real traffic), make sure the "
+            "relaunch path calls warmup() so probes don't hit a cold "
+            "compile storm, and if it still trips, drain() it and "
+            "inspect the host instead of letting the breaker babysit it",
+            replica=rep, opens=n, closes=int(closes.get(rep, 0)),
+            **({'last_trip': last_reason[rep]}
+               if last_reason.get(rep) else {}))
+
+
+def detect_retry_storm(events=None, snapshot=None, cluster=None,
+                       retry_storm_ratio=RETRY_STORM_RATIO,
+                       retry_storm_min=RETRY_STORM_MIN, **_):
+    """Router failover retries are a large fraction of offered load —
+    retry amplification: every failed request multiplies into several
+    dispatched ones, which is exactly how a degraded fleet melts the
+    healthy replicas too. Offered = first-attempt dispatches (dispatched
+    minus retries minus hedges); fires at ``retries/offered >=``
+    ``retry_storm_ratio`` once at least ``retry_storm_min`` requests were
+    offered."""
+    dispatched = retries = hedges = 0
+    if snapshot is not None:
+        # per-replica labeled families (one label set per family): the
+        # fleet total is the sum over replica labels
+        ctrs = snapshot.get('counters')
+        dispatched = int(sum(_labeled(
+            ctrs, 'serving.router.dispatched', key='replica').values()))
+        retries = int(sum(_labeled(
+            ctrs, 'serving.router.retries', key='replica').values()))
+        hedges = int(sum(_labeled(
+            ctrs, 'serving.router.hedges', key='replica').values()))
+    if not dispatched:
+        for e in reversed(events or []):   # last-wins cumulative event
+            if e.get('ev') == 'serving.router_stats':
+                for row in (e.get('replicas') or {}).values():
+                    if isinstance(row, dict):
+                        dispatched += int(row.get('dispatched') or 0)
+                        retries += int(row.get('retried') or 0)
+                        hedges += int(row.get('hedged') or 0)
+                break
+    offered = dispatched - retries - hedges
+    if offered < retry_storm_min or retries <= 0:
+        return
+    ratio = retries / offered
+    if ratio < retry_storm_ratio:
+        return
+    severity = 'critical' if ratio >= 2 * retry_storm_ratio else 'warning'
+    yield _diag(
+        'retry_storm', severity,
+        f"{retries} failover retries on {offered} offered request(s) = "
+        f"{100 * ratio:.0f}% amplification — the fleet is re-dispatching "
+        "a large share of its load onto the surviving replicas",
+        "find WHY requests fail over (serving.router.failover events and "
+        "the circuit log name the replica) and fix that replica; then "
+        "bound the blast radius — lower RouterPolicy.max_retries, keep "
+        "hedging for tail latency only (hedge_after_ms near p95, not "
+        "p50), and check the shed ladder thresholds engage before "
+        "retries do, so overload sheds instead of amplifying",
+        dispatched=dispatched, retries=retries, hedges=hedges,
+        offered=offered, ratio=round(ratio, 3))
+
+
 DETECTORS = {
     'straggler': detect_straggler,
     'retrace_storm': detect_retrace_storm,
@@ -555,6 +672,8 @@ DETECTORS = {
     'slo_burn': detect_slo_burn,
     'checkpoint_stall': detect_checkpoint_stall,
     'elastic_downsize': detect_elastic_downsize,
+    'replica_flapping': detect_replica_flapping,
+    'retry_storm': detect_retry_storm,
 }
 
 

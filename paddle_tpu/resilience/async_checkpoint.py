@@ -283,7 +283,9 @@ def _piece_arrays(leaf, plan, want_ranks):
         for sh in shards:
             try:
                 key = tuple(map(tuple, _norm_index(sh.index, shape)))
-            except Exception:
+            # a shard whose index cannot be normalized is simply not used
+            # as a fast path — the one-host-copy fallback below covers it
+            except Exception:   # graftlint: disable=GL019
                 continue
             if key not in by_index:
                 by_index[key] = sh.data

@@ -28,7 +28,12 @@ against, on CPU, deterministically:
 - ``slow_collective`` — context manager delaying named eager collectives in
   this process (DistributedTimeoutError model);
 - ``boot_fail`` — context manager arming rank bootstrap crashes (exit 43
-  before the started marker) for supervised-launch restart tests.
+  before the started marker) for supervised-launch restart tests;
+- ``kill_replica_at_request`` / ``hang_replica`` / ``slow_replica`` —
+  serving-replica chaos (siblings of ``kill_rank_at_step``/``slow_rank``):
+  abrupt engine death right after admitting the Nth request, a wedged
+  scheduler that stays "alive" while nothing progresses, and a per-pump
+  delay producing a deterministic p99 straggler for hedging tests.
 
 All injectors are context-managed or idempotent to deactivate, so a failing
 test cannot leak faults into the next one.
@@ -45,7 +50,8 @@ __all__ = ['FaultInjector', 'flaky', 'poison_loss', 'corrupt_file',
            'poison_sample', 'kill_worker', 'hang_worker', 'slow_rank',
            'slow_model', 'slow_loader', 'slow_collective', 'retrace_bait',
            'boot_fail', 'PoisonedSampleError', 'slow_fs', 'disk_full',
-           'sigterm_at_step', 'kill_rank_at_step']
+           'sigterm_at_step', 'kill_rank_at_step', 'kill_replica_at_request',
+           'hang_replica', 'slow_replica', 'ReplicaHang']
 
 
 class InjectedWriteError(OSError):
@@ -445,6 +451,83 @@ def kill_rank_at_step(at_step, once_file, rank=None):
         os.kill(os.getpid(), signal.SIGKILL)
 
     return maybe_die
+
+
+def kill_replica_at_request(engine, at_request):
+    """Serving sibling of ``kill_rank_at_step``: arm ``engine`` to die
+    abruptly (``ServingEngine.kill()``) immediately after ADMITTING its
+    ``at_request``-th request (1-indexed, counted across models; shed
+    submissions don't count). The just-admitted request and everything
+    already queued/resident is stranded exactly as a real crash strands
+    it — recovering the loss is the router's job, which is the point.
+    Returns ``engine``; no unwrap needed — a dead engine stays dead."""
+    at_request = int(at_request)
+    if at_request < 1:
+        raise ValueError("kill_replica_at_request: at_request is 1-indexed")
+    state = {'admitted': 0}
+    orig = engine.submit
+
+    def submit(model, inputs, **kw):
+        pending = orig(model, inputs, **kw)
+        state['admitted'] += 1
+        if state['admitted'] == at_request:
+            engine.kill()
+        return pending
+
+    engine.submit = submit
+    return engine
+
+
+class ReplicaHang:
+    """Handle from :func:`hang_replica` — ``release()`` un-wedges the
+    replica (restores the original pump)."""
+
+    def __init__(self, engine, orig_pump):
+        self._engine = engine
+        self._orig = orig_pump
+        self.released = False
+
+    def release(self):
+        self._engine.pump = self._orig
+        self.released = True
+
+
+def hang_replica(engine):
+    """Wedge ``engine``: every pump does NOTHING (the worker thread stays
+    alive, liveness checks pass, queues grow, no request progresses)
+    until the returned handle's ``release()`` — the hung-replica model (a
+    deadlocked device, a stuck host callback) that is invisible to
+    ``dispatchable()`` and only a router's attempt timeout or hedge can
+    route around. Returns a :class:`ReplicaHang`."""
+    orig = engine.pump
+    hang = ReplicaHang(engine, orig)
+
+    def pump():
+        if hang.released:
+            return orig()
+        # bounded no-op tick: the worker must stay responsive to stop()
+        time.sleep(0.005)
+        return False
+
+    engine.pump = pump
+    return hang
+
+
+def slow_replica(engine, delay_s):
+    """Every scheduler pump on ``engine`` sleeps ``delay_s`` first — the
+    degraded-replica model (overheating host, noisy neighbor) whose tail
+    latency makes hedged-request wins deterministic on CPU (the replica
+    sibling of ``slow_model``/``slow_rank``). Returns ``engine``; assign
+    ``engine.pump`` back (or just stop the engine) to deactivate."""
+    delay_s = float(delay_s)
+    orig = engine.pump
+
+    def pump():
+        time.sleep(delay_s)
+        return orig()
+
+    engine.pump = pump
+    return engine
 
 
 @contextlib.contextmanager

@@ -10,11 +10,26 @@ import time
 
 from .. import observability as _obs
 
-__all__ = ['retry', 'RetryError']
+__all__ = ['retry', 'RetryError', 'backoff_delay']
 
 # seam for tests/faultinject: patch to a recorder to assert backoff schedules
 # without real sleeping
 _sleep = time.sleep
+
+
+def backoff_delay(attempt, backoff=0.1, factor=2.0, max_backoff=30.0,
+                  jitter=0.5):
+    """Delay (seconds) before 1-indexed ``attempt`` under the same policy
+    the :func:`retry` decorator applies: ``backoff * factor**(attempt-1)``
+    capped at ``max_backoff``, jittered uniformly in ``[1-j, 1+j]``.
+
+    Public so other backoff consumers (the serving router's circuit-breaker
+    cooldown, supervisor relaunch pacing) share ONE backoff curve instead
+    of each growing a private exponential."""
+    delay = min(backoff * (factor ** (max(1, int(attempt)) - 1)), max_backoff)
+    if jitter:
+        delay *= 1.0 + random.uniform(-jitter, jitter)
+    return delay
 
 
 class RetryError(RuntimeError):
@@ -61,10 +76,10 @@ def retry(max_attempts=3, backoff=0.1, factor=2.0, max_backoff=30.0,
                     last = e
                     if attempt == max_attempts:
                         break
-                    delay = min(backoff * (factor ** (attempt - 1)),
-                                max_backoff)
-                    if jitter:
-                        delay *= 1.0 + random.uniform(-jitter, jitter)
+                    delay = backoff_delay(attempt, backoff=backoff,
+                                          factor=factor,
+                                          max_backoff=max_backoff,
+                                          jitter=jitter)
                     if timeout is not None and \
                             time.monotonic() - start + delay > timeout:
                         if reraise:

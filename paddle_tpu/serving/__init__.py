@@ -32,20 +32,28 @@ Quick start (docs/SERVING.md has the full guide)::
 """
 from .bucketing import (DEFAULT_BATCH_BUCKETS, BucketSpec, pad_to_bucket,
                         select_bucket, stack_examples)
-from .engine import Endpoint, ServingEngine
+from .engine import Endpoint, EngineDeadError, ServingEngine
+from .fleet_supervisor import FleetSupervisor
 from .kv_cache import GenerativeSpec, TinyCausalLM
 from .paged_kv import (PageAllocator, PagesExhaustedError, PrefixCache,
                        chain_hashes)
 from .paged_runner import PagedGenerativeRunner
+from .router import (CircuitBreaker, FleetOverloadError, FleetPending,
+                     FleetRouter, NoHealthyReplicaError, ReplicaError,
+                     ReplicaHandle, RouterPolicy)
 from .runners import BatchRunner, GenerativeRunner
 from .scheduler import (AdmissionQueue, PendingRequest, QueueFullError,
-                        Request, Response, STATUS_DEADLINE, STATUS_ERROR,
-                        STATUS_OK)
-from . import (bucketing, engine, kv_cache, paged_kv,  # noqa: F401
-               paged_runner, runners, scheduler)
+                        Request, Response, STATUS_CANCELLED,
+                        STATUS_DEADLINE, STATUS_ERROR, STATUS_OK)
+from . import (bucketing, engine, fleet_supervisor,  # noqa: F401
+               kv_cache, paged_kv, paged_runner, router, runners,
+               scheduler)
 
 __all__ = [
-    'ServingEngine', 'Endpoint',
+    'ServingEngine', 'Endpoint', 'EngineDeadError',
+    'FleetRouter', 'RouterPolicy', 'ReplicaHandle', 'CircuitBreaker',
+    'FleetPending', 'ReplicaError', 'NoHealthyReplicaError',
+    'FleetOverloadError', 'FleetSupervisor',
     'BucketSpec', 'DEFAULT_BATCH_BUCKETS', 'select_bucket', 'pad_to_bucket',
     'stack_examples',
     'GenerativeSpec', 'TinyCausalLM',
@@ -53,4 +61,5 @@ __all__ = [
     'PageAllocator', 'PagesExhaustedError', 'PrefixCache', 'chain_hashes',
     'AdmissionQueue', 'PendingRequest', 'QueueFullError', 'Request',
     'Response', 'STATUS_OK', 'STATUS_DEADLINE', 'STATUS_ERROR',
+    'STATUS_CANCELLED',
 ]

@@ -38,7 +38,13 @@ from .runners import BatchRunner, GenerativeRunner, _count
 from .scheduler import (AdmissionQueue, PendingRequest, QueueFullError,
                         Request)
 
-__all__ = ['ServingEngine', 'Endpoint']
+__all__ = ['ServingEngine', 'Endpoint', 'EngineDeadError']
+
+
+class EngineDeadError(RuntimeError):
+    """Submit/cancel on an engine that was ``kill()``-ed (or never
+    started). Distinguishable from model errors so a router can classify
+    it as replica death (fail over) rather than request failure."""
 
 # Idle backstop only: submit() and stop() notify the condition, so the
 # worker wakes immediately on new work — a long tick avoids 100 Hz busy
@@ -85,6 +91,7 @@ class ServingEngine:
         self._shed_page_exhaustion = 0  # memory pressure wearing a queue-
         self._submitted = 0             # full mask (doctor tells them apart)
         self._endpoint = None          # MetricsServer this engine owns
+        self._killed = False           # chaos: abrupt death, see kill()
 
     # -- registration ---------------------------------------------------
     def register(self, name, predict_fn=None, layer=None, program=None,
@@ -299,7 +306,27 @@ class ServingEngine:
                            f"(have {sorted(self._models)})")
         return Endpoint(self, name)
 
+    def has_model(self, name):
+        return name in self._models
+
+    def model_kind(self, name):
+        """'generative' or 'batch' for a registered model (KeyError else)."""
+        return self._models[name].kind
+
+    def page_starved(self, model):
+        """Is ``model``'s paged runner currently unable to allocate KV
+        pages? Always False for non-paged models — a router health gate,
+        mirrored in ``/healthz``."""
+        runner = self._models.get(model)
+        if runner is None:
+            return False
+        return bool(getattr(runner, 'page_starved', lambda: False)())
+
     def submit(self, model, inputs, deadline_ms=None, max_new_tokens=None):
+        if self._killed:
+            raise EngineDeadError(
+                f"serving: engine is dead (killed) — request for "
+                f"{model!r} refused")
         runner = self._models.get(model)
         if runner is None:
             raise KeyError(f"serving: no model {model!r} registered")
@@ -353,10 +380,51 @@ class ServingEngine:
             self._cond.notify_all()
         return PendingRequest(req, self.alive)
 
+    def cancel(self, pending):
+        """Withdraw a still-queued request: it is removed from the
+        admission queue and completed with status ``'cancelled'`` without
+        ever running. Returns True on success, False when the worker
+        already owns the request (it will run to completion; discard the
+        answer). The router's hedge path uses this to reap the losing
+        duplicate for free when it never reached a batch slot."""
+        req = pending._req if isinstance(pending, PendingRequest) else pending
+        queue = self._queues.get(req.model)
+        if queue is None or not queue.remove(req):
+            return False
+        from .scheduler import STATUS_CANCELLED
+        req.complete(STATUS_CANCELLED)
+        _count('serving.cancelled')
+        if _obs.enabled():
+            _obs.event('serving.cancelled', model=req.model, request=req.id)
+            _obs.async_end('request', req.id, cat='serving.request',
+                           status='cancelled')
+        return True
+
+    def queued_count(self, model=None):
+        """Requests admitted but not yet popped by a runner."""
+        with self._lock:
+            if model is not None:
+                q = self._queues.get(model)
+                return 0 if q is None else len(q)
+            return sum(len(q) for q in self._queues.values())
+
+    def resident_count(self, model=None):
+        """Generative requests currently resident in KV batch slots
+        (mid-decode). One-shot batches run synchronously inside a single
+        pump, so they are never observed resident between pumps."""
+        with self._lock:
+            runners = ([self._models[model]] if model in self._models
+                       else [] if model is not None
+                       else list(self._models.values()))
+        return sum(sum(1 for s in r.slots if s is not None)
+                   for r in runners if r.kind == 'generative')
+
     # -- scheduler loop -------------------------------------------------
     def pump(self):
         """One scheduler iteration over every model (round-robin order).
         Returns True when any runner did work."""
+        if self._killed:
+            return False               # a dead replica does no work
         # snapshot under the lock: register() may grow these dicts from
         # another thread and iterating a resizing dict raises
         with self._lock:
@@ -437,10 +505,14 @@ class ServingEngine:
         """The serving slice of ``/healthz``."""
         with self._lock:
             queues = {n: len(q) for n, q in self._queues.items()}
+        starved = {n: bool(getattr(r, 'page_starved', lambda: False)())
+                   for n, r in self._models.items()}
         out = {'serving': {
             'worker_alive': self.alive(),
             'models': sorted(queues),
             'queue_depth': queues,
+            'resident': self.resident_count(),
+            'page_starved': starved,
             'submitted': self._submitted,
             'shed': self._shed,
         }}
@@ -451,7 +523,46 @@ class ServingEngine:
         return out
 
     def alive(self):
+        if self._killed:
+            return False
         return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def killed(self):
+        return self._killed
+
+    def dispatchable(self):
+        """Can this engine accept work and eventually run it? False once
+        ``kill()``-ed, or once a started worker thread has died (crash).
+        A never-started engine IS dispatchable — manual ``pump()`` mode —
+        which is also why this is not ``alive()``: alive() answers "is the
+        background worker running", dispatchable() answers "is this
+        replica a valid dispatch target"."""
+        if self._killed:
+            return False
+        with self._lock:
+            t = self._thread
+        return t is None or t.is_alive()
+
+    def kill(self):
+        """Chaos surface: die abruptly, the in-process analogue of a
+        replica SIGKILL. Unlike ``stop()``, queued and resident requests
+        are NOT completed — they are stranded exactly as a real crash
+        strands them, so their clients' watchdog-bounded waits fire and a
+        router above can observe the loss and re-dispatch. The worker
+        thread (if any) exits on its next iteration; ``alive()`` is False
+        immediately. Idempotent."""
+        if self._killed:
+            return
+        self._killed = True
+        with self._cond:
+            self._stop.set()
+            self._cond.notify_all()
+        _count('serving.killed')
+        if _obs.enabled():
+            _obs.event('serving.killed',
+                       queued=sum(len(q) for q in self._queues.values()))
+        _obs.flight.record('serving.killed', models=sorted(self._models))
 
     def stop(self, timeout=10.0):
         """Stop the worker; queued AND in-flight (KV-slot-resident)

@@ -1,0 +1,159 @@
+"""FleetSupervisor: relaunch dead serving replicas behind the router.
+
+The serving sibling of ``distributed.launch._Supervisor`` (elastic
+training, PR 14): where that one watches rank *processes* and re-forms
+the world, this one watches replica *engines* behind a ``FleetRouter``
+and restores fleet capacity — same contract, different substrate:
+
+- a replica whose engine died (``kill()``-ed, or its worker thread
+  crashed) is detected on the next sweep; the corpse is **reaped**
+  (``stop()`` completes its stranded queued/resident requests as shaped
+  errors, so clients fail over in one tick instead of waiting out their
+  watchdogs);
+- a fresh engine from ``replica_factory(name)`` takes its slot, bounded
+  by ``max_restarts`` per replica (exhaustion leaves the replica out of
+  rotation and emits ``fleet.restarts_exhausted`` — capacity loss is a
+  fact, not a retry loop);
+- the relaunched replica **rejoins through the router's half-open gate**
+  (``router.readmit(..., warm=False)``): its compile warmup meets
+  bounded probe traffic, never the full request stream;
+- death→rejoin wall time lands on the ``fleet.recovery_ms`` histogram,
+  and every transition is a ``fleet.*`` event + flight-recorder entry.
+
+Drive it manually with ``check_once()`` (deterministic tests) or as a
+background thread via ``start()``/``stop()``.
+"""
+import threading
+
+from .. import observability as _obs
+from ..observability.timing import Stopwatch
+from ..resilience.retry import backoff_delay
+
+__all__ = ['FleetSupervisor']
+
+
+class FleetSupervisor:
+    """Watch a ``FleetRouter``'s replicas; reap + relaunch the dead.
+
+    ``replica_factory(name)`` must return a ready ``ServingEngine`` —
+    models registered, and ``start()``-ed if the fleet runs background
+    workers (the factory owns that choice; manual-drive fleets return
+    un-started engines). ``warmup=True`` pre-compiles the new engine's
+    shape set before it rejoins, so even the half-open probes never pay
+    an XLA compile. ``relaunch_backoff_s`` paces repeated restarts of the
+    same replica on the shared retry curve (0 keeps chaos tests fast)."""
+
+    def __init__(self, router, replica_factory, max_restarts=3,
+                 check_interval_s=0.2, warmup=True, relaunch_backoff_s=0.0,
+                 reap_timeout_s=5.0):
+        self.router = router
+        self.replica_factory = replica_factory
+        self.max_restarts = int(max_restarts)
+        self.check_interval_s = float(check_interval_s)
+        self.warmup = bool(warmup)
+        self.relaunch_backoff_s = float(relaunch_backoff_s)
+        self.reap_timeout_s = float(reap_timeout_s)
+        self._restarts = {}            # replica -> relaunch count
+        self._exhausted = set()        # emitted fleet.restarts_exhausted
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- one sweep (manual drive) ---------------------------------------
+    def check_once(self):
+        """One supervision sweep over the fleet. Returns the list of
+        replica names relaunched this sweep."""
+        relaunched = []
+        for h in self.router.replicas():
+            if h.draining or h.engine.dispatchable():
+                continue
+            name = h.name
+            used = self._restarts.get(name, 0)
+            if used >= self.max_restarts:
+                if name not in self._exhausted:
+                    self._exhausted.add(name)
+                    if _obs.enabled():
+                        _obs.counter('fleet.restarts_exhausted').inc()
+                        _obs.event('fleet.restarts_exhausted', replica=name,
+                                   restarts=used)
+                    _obs.flight.record('fleet.restarts_exhausted',
+                                       replica=name, restarts=used)
+                continue
+            sw = Stopwatch()
+            self._reap(h)
+            if self.relaunch_backoff_s:
+                self._stop.wait(backoff_delay(
+                    used + 1, backoff=self.relaunch_backoff_s, jitter=0.0))
+            if _obs.enabled():
+                _obs.counter('fleet.relaunches').inc()
+                _obs.event('fleet.replica_relaunch', replica=name,
+                           attempt=used + 1)
+            _obs.flight.record('fleet.replica_relaunch', replica=name,
+                               attempt=used + 1)
+            engine = self.replica_factory(name)
+            if self.warmup and hasattr(engine, 'warmup'):
+                engine.warmup()
+            self._restarts[name] = used + 1
+            self.router.readmit(name, engine=engine, warm=False)
+            recovery_ms = sw.elapsed_ms()
+            if _obs.enabled():
+                _obs.histogram('fleet.recovery_ms').observe(recovery_ms)
+                _obs.event('fleet.replica_rejoin', replica=name,
+                           restarts=used + 1,
+                           recovery_ms=round(recovery_ms, 3))
+            _obs.flight.record('fleet.replica_rejoin', replica=name,
+                               recovery_ms=round(recovery_ms, 3))
+            relaunched.append(name)
+        return relaunched
+
+    def _reap(self, handle):
+        """Complete the corpse's stranded requests as shaped errors —
+        ``stop()`` on a killed engine drains queues and evicts residents,
+        turning every client's would-be watchdog timeout into an
+        immediate, classifiable replica fault."""
+        try:
+            handle.engine.stop(timeout=self.reap_timeout_s)
+        except Exception as e:
+            # a corpse that will not even join its worker: clients fall
+            # back to their bounded waits; record it and move on
+            if _obs.enabled():
+                _obs.event('fleet.reap_failed', replica=handle.name,
+                           error=repr(e))
+            _obs.flight.record('fleet.reap_failed', replica=handle.name,
+                               error=repr(e))
+
+    def restarts(self):
+        """{replica: relaunch count} so far."""
+        return dict(self._restarts)
+
+    # -- background mode ------------------------------------------------
+    def start(self):
+        """Start the background sweep thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name='paddle-tpu-fleet-supervisor',
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            from ..resilience.watchdog import join_thread
+            join_thread(t, timeout=timeout)
+        self._thread = None
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.check_once()
+            except Exception as e:
+                # supervision must outlive a bad sweep (a replica factory
+                # raising, a race with drain) — but never silently
+                if _obs.enabled():
+                    _obs.counter('fleet.supervisor_errors').inc()
+                    _obs.event('fleet.supervisor_error', error=repr(e))
+                _obs.flight.record('fleet.supervisor_error', error=repr(e))
+            self._stop.wait(self.check_interval_s)

@@ -26,11 +26,13 @@ from ..observability.timing import Stopwatch
 from ..resilience.watchdog import WatchdogTimeout
 
 __all__ = ['QueueFullError', 'Request', 'Response', 'PendingRequest',
-           'AdmissionQueue', 'STATUS_OK', 'STATUS_DEADLINE', 'STATUS_ERROR']
+           'AdmissionQueue', 'STATUS_OK', 'STATUS_DEADLINE', 'STATUS_ERROR',
+           'STATUS_CANCELLED']
 
 STATUS_OK = 'ok'
 STATUS_DEADLINE = 'deadline'
 STATUS_ERROR = 'error'
+STATUS_CANCELLED = 'cancelled'   # caller withdrew it (hedge loser, drain)
 
 _WAIT_TICK = 0.05
 _ids = itertools.count(1)
@@ -254,6 +256,20 @@ class AdmissionQueue:
         for r in ready + expired:
             r.queue_ms = r.sw.elapsed_ms()
         return ready, expired
+
+    def remove(self, req):
+        """Withdraw ``req`` if it is still queued. Returns True when it was
+        removed (never popped by the worker), False when the worker already
+        owns it — the caller must then let it run to completion. The
+        router's hedge path uses this: a hedge loser still waiting for a
+        batch slot is cancelled for free; one already resident finishes
+        and its answer is discarded."""
+        with self._lock:
+            try:
+                self._dq.remove(req)
+            except ValueError:
+                return False
+        return True
 
     def reap_expired(self):
         """Remove and return every expired request anywhere in the queue

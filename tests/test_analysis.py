@@ -113,6 +113,12 @@ AST_FIXTURES = {
               "    jax.profiler.start_trace('/tmp/x')\n"
               "    fn()\n"
               "    jax.profiler.stop_trace()\n", "start_trace"),
+    'GL019': ("def dispatch_all(replicas, req):\n"
+              "    for r in replicas:\n"
+              "        try:\n"
+              "            return r.submit(req)\n"
+              "        except Exception:\n"
+              "            pass\n", "except Exception"),
 }
 
 
@@ -796,6 +802,96 @@ def test_gl018_inline_waiver(tmp_path):
     p.write_text(src)
     findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
     hits = [f for f in findings if f.rule == 'GL018']
+    assert len(hits) == 1 and hits[0].waived
+    from paddle_tpu.analysis.finding import active
+    assert active(hits) == []
+
+
+_SWALLOW_SRC = (
+    "from paddle_tpu import observability as obs\n"
+    "def silent_failover(replicas, req):\n"
+    "    for r in replicas:\n"
+    "        try:\n"
+    "            return r.submit(req)\n"
+    "        except Exception:\n"                # flagged: nothing recorded
+    "            pass\n"
+    "def silent_bare(queue):\n"
+    "    while True:\n"
+    "        try:\n"
+    "            queue.drain()\n"
+    "        except:\n"                          # flagged: bare + continue
+    "            continue\n"
+    "def counted_failover(replicas, req):\n"
+    "    for r in replicas:\n"
+    "        try:\n"
+    "            return r.submit(req)\n"
+    "        except Exception:\n"                # sanctioned: emits a counter
+    "            obs.counter('dispatch.failed').inc()\n"
+    "def narrow_failover(replicas, req):\n"
+    "    for r in replicas:\n"
+    "        try:\n"
+    "            return r.submit(req)\n"
+    "        except ConnectionError:\n"          # sanctioned: narrow type
+    "            pass\n"
+    "def fallback_loop(items):\n"
+    "    out = []\n"
+    "    for it in items:\n"
+    "        try:\n"
+    "            v = it.decode()\n"
+    "        except Exception:\n"                # sanctioned: fallback assign
+    "            v = None\n"
+    "        out.append(v)\n"
+    "    return out\n"
+    "def reraise_last(replicas, req):\n"
+    "    for r in replicas:\n"
+    "        try:\n"
+    "            return r.submit(req)\n"
+    "        except Exception:\n"                # sanctioned: re-raises
+    "            raise\n"
+    "def outside_loop(r, req):\n"
+    "    try:\n"
+    "        return r.submit(req)\n"
+    "    except Exception:\n"                    # sanctioned: not in a loop
+    "        pass\n")
+
+
+def test_gl019_flags_silent_swallow_in_loops(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'disp.py').write_text(_SWALLOW_SRC)
+    findings, _ = lint_paths([str(lib / 'disp.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL019')
+    lines = _SWALLOW_SRC.splitlines()
+    assert len(hits) == 2, [(f.rule, f.line) for f in findings]
+    assert 'except Exception' in lines[hits[0] - 1]
+    assert 'except:' in lines[hits[1] - 1]
+    msg = [f for f in findings if f.rule == 'GL019'][0].message
+    # fix-it points at the sanctioned retry helper
+    assert 'resilience.retry' in msg
+
+
+def test_gl019_exempts_harnesses(tmp_path):
+    for rel in ('tests/mod.py', 'tools/mod.py', 'bench_x.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_SWALLOW_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL019'] == [], rel
+
+
+def test_gl019_inline_waiver(tmp_path):
+    src = ("def sweep(items):\n"
+           "    for it in items:\n"
+           "        try:\n"
+           "            it.close()\n"
+           "        # graftlint: disable=GL019 — best-effort cleanup\n"
+           "        except Exception:\n"
+           "            pass\n")
+    p = tmp_path / 'lib.py'
+    p.write_text(src)
+    findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+    hits = [f for f in findings if f.rule == 'GL019']
     assert len(hits) == 1 and hits[0].waived
     from paddle_tpu.analysis.finding import active
     assert active(hits) == []

@@ -1,0 +1,551 @@
+"""Fleet fabric: health-gated failover routing, hedged retries, graceful
+drain, supervisor relaunch, shed ladder, and the fleet telemetry/doctor
+surfaces (docs/SERVING.md, "Fleet fabric").
+
+The acceptance core is the chaos test: a 3-replica fleet under
+``kill_replica_at_request`` + ``slow_replica`` loses zero idempotent
+requests (every one completes or fails shaped with the replica id),
+stays compile-flat after warmup, and the killed replica rejoins through
+the supervisor's half-open gate. Everything runs on CPU; engines are
+manual-pump wherever determinism matters and background-started only
+where the chaos/hedge physics need a live worker.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import doctor as doc
+from paddle_tpu.resilience import faultinject as fi
+from paddle_tpu.resilience.watchdog import WatchdogTimeout
+from paddle_tpu.serving import (BucketSpec, CircuitBreaker, FleetOverloadError,
+                                FleetRouter, FleetSupervisor,
+                                NoHealthyReplicaError, ReplicaError,
+                                RouterPolicy, ServingEngine)
+from paddle_tpu.serving.router import (CIRCUIT_CLOSED, CIRCUIT_HALF_OPEN,
+                                       CIRCUIT_OPEN, SHED_DEGRADE, SHED_NONE,
+                                       SHED_PRIORITY, SHED_REJECT)
+from paddle_tpu.serving.scheduler import STATUS_DEADLINE
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp_fn(w):
+    def predict(feeds):
+        return feeds['x'] @ w
+    return predict
+
+
+def _example(n=8):
+    return {'x': np.zeros((n,), np.float32)}
+
+
+def _engine(jit=False, capacity=64):
+    w = np.eye(8, dtype=np.float32) * 2.0
+    eng = ServingEngine(queue_capacity=capacity)
+    eng.register('m', predict_fn=_mlp_fn(w), example=_example(),
+                 bucket_spec=BucketSpec((1, 2, 4)), jit_compile=jit)
+    return eng
+
+
+def _fleet(n=3, policy=None, jit=False):
+    router = FleetRouter(policy=policy)
+    engines = []
+    for i in range(n):
+        eng = _engine(jit=jit)
+        router.add_replica(f'r{i}', eng)
+        engines.append(eng)
+    return router, engines
+
+
+def _p99(lat):
+    return sorted(lat)[int(0.99 * (len(lat) - 1))]
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off():
+    yield
+    obs.disable()
+    obs.reset()
+
+
+class _FakeEngine:
+    """Duck-typed replica for placement/shed tests: records submit-time
+    knobs without paying an engine (never pumped, never completed)."""
+
+    def __init__(self, kind='generative'):
+        self.kind = kind
+        self.max_new_tokens_seen = []
+
+    def dispatchable(self):
+        return True
+
+    def has_model(self, model):
+        return True
+
+    def model_kind(self, model):
+        return self.kind
+
+    def page_starved(self, model):
+        return False
+
+    def queued_count(self, model=None):
+        return 0
+
+    def resident_count(self, model=None):
+        return 0
+
+    def alive(self):
+        return False
+
+    def submit(self, model, inputs, deadline_ms=None, max_new_tokens=None):
+        self.max_new_tokens_seen.append(max_new_tokens)
+
+        class _P:
+            request_id = 0
+
+            def done(self):
+                return False
+        return _P()
+
+    def cancel(self, pending):
+        return True
+
+
+# ---------------------------------------------------------------------------
+# routing basics
+# ---------------------------------------------------------------------------
+
+class TestRouting:
+    def test_round_trip_and_spread(self):
+        router, engines = _fleet(3)
+        pendings = [router.submit('m', {'x': np.full((8,), i, np.float32)})
+                    for i in range(6)]
+        for eng in engines:
+            eng.run_until_idle()
+        for i, p in enumerate(pendings):
+            r = p.result(timeout=10)
+            assert r.ok
+            assert np.allclose(r.outputs, 2.0 * i)
+        rows = router.stats()['replicas']
+        assert sum(row['dispatched'] for row in rows.values()) == 6
+        assert sum(row['completed'] for row in rows.values()) == 6
+        # the rotating tie-break spreads an idle fleet instead of piling
+        # every request onto one name
+        assert sum(1 for row in rows.values() if row['dispatched']) >= 2
+
+    def test_unknown_model_and_duplicate_replica(self):
+        router, _ = _fleet(2)
+        with pytest.raises(KeyError, match='no replica serves'):
+            router.submit('nope', _example())
+        with pytest.raises(ValueError, match='already in'):
+            router.add_replica('r0', _engine())
+        with pytest.raises(KeyError, match='no replica'):
+            router.replica('ghost')
+
+    def test_prefix_affinity_is_sticky(self):
+        # identical generative prompts rendezvous onto the same replica,
+        # so its prefix cache acts fleet-wide
+        router = FleetRouter()
+        for i in range(3):
+            router.add_replica(f'r{i}', _FakeEngine(kind='generative'))
+        toks = list(range(20))
+        tried = [router.submit('lm', {'tokens': toks}).replicas_tried[0]
+                 for _ in range(4)]
+        assert len(set(tried)) == 1
+        # a different prompt may land elsewhere, and non-generative work
+        # carries no affinity at all
+        other = router.submit('lm', {'tokens': [7] * 20}).replicas_tried[0]
+        assert other in {'r0', 'r1', 'r2'}
+
+    def test_deadline_answered_without_service(self):
+        # nobody pumps: the budget expires and the router answers
+        # 'deadline' instead of hanging the client
+        router, _ = _fleet(1)
+        p = router.submit('m', _example(), deadline_ms=30)
+        r = p.result(timeout=5)
+        assert r.status == STATUS_DEADLINE
+        # a settled outcome replays
+        assert p.result(timeout=1).status == STATUS_DEADLINE
+
+
+# ---------------------------------------------------------------------------
+# the acceptance chaos test: zero lost requests through a replica kill
+# ---------------------------------------------------------------------------
+
+class TestChaosFleet:
+    def test_kill_and_slow_replica_zero_lost(self):
+        obs.enable()
+        policy = RouterPolicy(max_retries=2, attempt_timeout_ms=5000,
+                              trip_after=3, circuit_cooldown_s=60.0)
+        router = FleetRouter(policy=policy)
+        engines = []
+        for i in range(3):
+            eng = _engine(jit=True)
+            eng.warmup()
+            eng.start()
+            router.add_replica(f'r{i}', eng)
+            engines.append(eng)
+        compiles_after_warmup = obs.snapshot()['counters'].get(
+            'jax.compiles', 0)
+        # chaos: r1 dies abruptly after admitting its 5th request
+        # (stranding it), r2 is a degraded straggler the whole time
+        fi.kill_replica_at_request(engines[1], at_request=5)
+        fi.slow_replica(engines[2], delay_s=0.01)
+        try:
+            ok, shaped = 0, 0
+            for i in range(40):
+                p = router.submit('m', {'x': np.full((8,), i, np.float32)},
+                                  deadline_ms=15000)
+                try:
+                    r = p.result(timeout=20)
+                except ReplicaError as e:
+                    # a loss must be shaped with the replica id(s) that
+                    # failed it — never a silent drop
+                    assert e.replica is not None and e.replicas
+                    shaped += 1
+                    continue
+                assert r.ok
+                assert np.allclose(r.outputs, 2.0 * i)
+                ok += 1
+            # zero LOST: every request completed or failed shaped; with
+            # budget for 2 failovers and 2 healthy replicas, all complete
+            assert ok + shaped == 40
+            assert ok == 40
+            rows = router.stats()['replicas']
+            assert rows['r1']['deaths'] == 1
+            assert rows['r1']['circuit'] == CIRCUIT_OPEN
+            # the stranded request was re-dispatched, not replayed from
+            # thin air: at least one failover landed on a survivor
+            assert sum(row['retried'] for row in rows.values()) >= 1
+            assert sum(row['completed'] for row in rows.values()) == 40
+            # compile-flat after warmup: chaos traffic hit only warmed
+            # shapes on every replica
+            assert obs.snapshot()['counters'].get(
+                'jax.compiles', 0) == compiles_after_warmup
+
+            # recovery: the supervisor reaps the corpse and a relaunched
+            # replica rejoins through the half-open gate
+            def factory(name):
+                eng = _engine(jit=True)
+                eng.start()
+                return eng
+
+            sup = FleetSupervisor(router, factory, max_restarts=3,
+                                  warmup=True)
+            assert sup.check_once() == ['r1']
+            h = router.replica('r1')
+            assert h.restarts == 1
+            assert h.breaker.state == CIRCUIT_HALF_OPEN
+            assert h.engine.dispatchable()
+            engines[1] = h.engine
+            for i in range(6):
+                r = router.predict('m', {'x': np.full((8,), i, np.float32)},
+                                   timeout=20)
+                assert r.ok
+        finally:
+            for eng in engines:
+                eng.kill()
+
+    def test_fail_fast_death_policy(self):
+        router, engines = _fleet(2, policy=RouterPolicy(
+            on_replica_death='fail_fast'))
+        p = router.submit('m', _example())
+        victim = p.replicas_tried[0]
+        router.replica(victim).engine.kill()
+        with pytest.raises(ReplicaError, match='replica_death') as ei:
+            p.result(timeout=5)
+        assert ei.value.replica == victim
+        # fail_fast means exactly one replica was ever tried
+        assert p.replicas_tried == (victim,)
+
+    def test_non_idempotent_never_replayed(self):
+        router, engines = _fleet(2)
+        p = router.submit('m', _example(), idempotent=False)
+        victim = p.replicas_tried[0]
+        router.replica(victim).engine.kill()
+        with pytest.raises(ReplicaError, match='non_idempotent') as ei:
+            p.result(timeout=5)
+        assert ei.value.replica == victim
+        assert p.replicas_tried == (victim,)
+        # the survivor never saw the pinned request
+        rows = router.stats()['replicas']
+        assert sum(row['dispatched'] for row in rows.values()) == 1
+
+
+# ---------------------------------------------------------------------------
+# tail-latency hedging
+# ---------------------------------------------------------------------------
+
+class TestHedging:
+    def test_hedged_p99_beats_unhedged_on_slow_tail(self):
+        policy = RouterPolicy(hedge_after_ms=None, trip_after=10 ** 6)
+        router = FleetRouter(policy=policy)
+        engines = [_engine(), _engine()]
+        router.add_replica('fast', engines[0])
+        router.add_replica('slow', engines[1])
+        for eng in engines:
+            eng.start()
+        fi.slow_replica(engines[1], delay_s=0.12)
+        try:
+            def run(n=25):
+                lat = []
+                for i in range(n):
+                    sw = time.monotonic()
+                    r = router.predict(
+                        'm', {'x': np.full((8,), i, np.float32)}, timeout=20)
+                    assert r.ok
+                    lat.append((time.monotonic() - sw) * 1000.0)
+                return lat
+
+            lat_off = run()
+            policy.hedge_after_ms = 20.0
+            lat_on = run()
+        finally:
+            for eng in engines:
+                eng.kill()
+        p99_off, p99_on = _p99(lat_off), _p99(lat_on)
+        # acceptance: hedging caps the straggler tail at <= 0.6x
+        assert p99_on <= 0.6 * p99_off, (p99_on, p99_off)
+        rows = router.stats()['replicas']
+        assert sum(row['hedge_wins'] for row in rows.values()) > 0
+        assert sum(row['deaths'] for row in rows.values()) == 0
+
+
+# ---------------------------------------------------------------------------
+# graceful drain / rejoin
+# ---------------------------------------------------------------------------
+
+class TestDrain:
+    def test_drain_finishes_residents_and_blocks_admits(self):
+        router, (eng,) = _fleet(1)
+        pendings = [router.submit('m', {'x': np.full((8,), i, np.float32)})
+                    for i in range(3)]
+        returned = router.drain('r0', timeout=10)
+        assert returned is eng
+        # zero aborted: every queued/resident request finished OK
+        for i, p in enumerate(pendings):
+            r = p.result(timeout=5)
+            assert r.ok and np.allclose(r.outputs, 2.0 * i)
+        h = router.replica('r0')
+        assert h.drained and h.drained_requests == 3
+        with pytest.raises(NoHealthyReplicaError):
+            router.submit('m', _example())
+        # rejoin through the half-open gate, then serve again
+        router.readmit('r0')
+        assert h.breaker.state == CIRCUIT_HALF_OPEN
+        p = router.submit('m', _example())
+        eng.run_until_idle()
+        assert p.result(timeout=5).ok
+
+    def test_drain_timeout_on_hung_replica(self):
+        router, (eng,) = _fleet(1)
+        p = router.submit('m', _example())
+        hang = fi.hang_replica(eng)
+        with pytest.raises(WatchdogTimeout, match='drain'):
+            router.drain('r0', timeout=0.3)
+        # still out of rotation; un-wedge and the drain completes clean
+        assert router.replica('r0').draining
+        hang.release()
+        router.drain('r0', timeout=10)
+        assert p.result(timeout=5).ok
+        assert router.replica('r0').drained_requests == 1
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker unit
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_trip_cooldown_halfopen_recovery(self):
+        cb = CircuitBreaker('x', trip_after=2, cooldown_s=0.05, factor=1.0,
+                            jitter=0.0, half_open_probes=2)
+        assert cb.allow() and cb.state == CIRCUIT_CLOSED
+        cb.record_failure('e')
+        assert cb.state == CIRCUIT_CLOSED      # below trip_after
+        cb.record_failure('e')
+        assert cb.state == CIRCUIT_OPEN and cb.trips == 1
+        assert not cb.allow()                  # cooling down
+        time.sleep(0.08)
+        assert cb.allow()                      # cooldown elapsed -> probe
+        assert cb.state == CIRCUIT_HALF_OPEN
+        cb.on_dispatch()
+        cb.record_success()
+        assert cb.state == CIRCUIT_HALF_OPEN   # one probe is not enough
+        assert cb.allow()
+        cb.on_dispatch()
+        cb.record_success()
+        assert cb.state == CIRCUIT_CLOSED and cb.closes == 1
+
+    def test_halfopen_failure_reopens_and_probes_bounded(self):
+        cb = CircuitBreaker('x', trip_after=1, cooldown_s=0.02, factor=1.0,
+                            jitter=0.0, half_open_probes=1)
+        cb.record_failure('e')
+        time.sleep(0.04)
+        assert cb.allow() and cb.state == CIRCUIT_HALF_OPEN
+        cb.on_dispatch()
+        assert not cb.allow()                  # probe budget spent
+        cb.record_failure('probe bad')
+        assert cb.state == CIRCUIT_OPEN and cb.trips == 2
+
+    def test_instant_trip_and_forced_rejoin(self):
+        cb = CircuitBreaker('x', trip_after=5)
+        cb.trip('replica_death')
+        cb.trip('replica_death')               # idempotent on a corpse
+        assert cb.state == CIRCUIT_OPEN and cb.trips == 1
+        cb.force_half_open()
+        assert cb.state == CIRCUIT_HALF_OPEN and cb.allow()
+
+
+# ---------------------------------------------------------------------------
+# supervisor relaunch
+# ---------------------------------------------------------------------------
+
+class TestSupervisor:
+    def _router_with_factory(self, max_restarts=3):
+        router, engines = _fleet(2)
+
+        def factory(name):
+            return _engine()
+
+        sup = FleetSupervisor(router, factory, max_restarts=max_restarts,
+                              warmup=False)
+        return router, engines, sup
+
+    def test_relaunch_rejoins_half_open(self):
+        router, engines, sup = self._router_with_factory()
+        assert sup.check_once() == []          # healthy fleet: no-op
+        engines[0].kill()
+        assert sup.check_once() == ['r0']
+        h = router.replica('r0')
+        assert h.restarts == 1 and sup.restarts() == {'r0': 1}
+        assert h.breaker.state == CIRCUIT_HALF_OPEN
+        assert h.engine is not engines[0] and h.engine.dispatchable()
+        p = router.submit('m', _example())
+        for rep in router.replicas():
+            rep.engine.run_until_idle()
+        assert p.result(timeout=5).ok
+
+    def test_restart_budget_exhausts(self):
+        router, engines, sup = self._router_with_factory(max_restarts=1)
+        engines[0].kill()
+        assert sup.check_once() == ['r0']
+        router.replica('r0').engine.kill()     # the relaunch dies too
+        assert sup.check_once() == []          # budget spent: stays down
+        assert sup.restarts() == {'r0': 1}
+        # the fleet keeps answering on the survivor
+        p = router.submit('m', _example())
+        router.replica('r1').engine.run_until_idle()
+        assert p.result(timeout=5).ok
+
+
+# ---------------------------------------------------------------------------
+# shed ladder
+# ---------------------------------------------------------------------------
+
+class TestShedLadder:
+    def _burn(self, monkeypatch, value):
+        import paddle_tpu.observability.slo as slo_mod
+        monkeypatch.setattr(slo_mod, 'burn_rates',
+                            lambda: {'m': value} if value else {})
+
+    def test_ladder_levels_from_burn(self, monkeypatch):
+        router, _ = _fleet(1)
+        for burn, level in ((0.0, SHED_NONE), (1.2, SHED_PRIORITY),
+                            (2.5, SHED_DEGRADE), (5.0, SHED_REJECT)):
+            self._burn(monkeypatch, burn)
+            assert router.shed_level() == level
+
+    def test_reject_all_and_priority_floor(self, monkeypatch):
+        router, (eng,) = _fleet(1)
+        self._burn(monkeypatch, 5.0)
+        with pytest.raises(FleetOverloadError) as ei:
+            router.submit('m', _example(), priority=10)
+        assert ei.value.level == SHED_REJECT
+        self._burn(monkeypatch, 1.2)
+        with pytest.raises(FleetOverloadError) as ei:
+            router.submit('m', _example(), priority=0)
+        assert ei.value.level == SHED_PRIORITY
+        # at-floor priority still admitted at level 1
+        p = router.submit('m', _example(), priority=1)
+        eng.run_until_idle()
+        assert p.result(timeout=5).ok
+
+    def test_degrade_caps_generative_budget(self, monkeypatch):
+        router = FleetRouter()
+        fake = _FakeEngine(kind='generative')
+        router.add_replica('r0', fake)
+        self._burn(monkeypatch, 2.5)
+        router.submit('lm', {'tokens': [1, 2, 3]}, max_new_tokens=64)
+        router.submit('lm', {'tokens': [1, 2, 3]})
+        cap = router.policy.shed_max_new_tokens
+        assert fake.max_new_tokens_seen == [cap, cap]
+        self._burn(monkeypatch, 0.0)
+        router.submit('lm', {'tokens': [1, 2, 3]}, max_new_tokens=64)
+        assert fake.max_new_tokens_seen[-1] == 64
+
+
+# ---------------------------------------------------------------------------
+# telemetry + doctor surfaces
+# ---------------------------------------------------------------------------
+
+class TestFleetTelemetry:
+    def test_telemetry_dump_serving_renders_fleet_table(self, tmp_path):
+        obs.enable()
+        router, engines = _fleet(2)
+        pendings = [router.submit('m', _example()) for _ in range(3)]
+        for eng in engines:
+            eng.run_until_idle()
+        for p in pendings:
+            assert p.result(timeout=5).ok
+        log = tmp_path / 'events.jsonl'
+        obs.dump_jsonl(str(log))
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, 'tools/telemetry_dump.py'),
+             str(log), '--serving'],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert 'fleet' in out.stdout
+        assert 'r0' in out.stdout and 'r1' in out.stdout
+
+    def test_doctor_replica_flapping(self):
+        evs = []
+        for i in range(4):
+            evs.append({'ev': 'serving.router.circuit', 'replica': 'r2',
+                        'state': 'open', 'reason': 'error'})
+            evs.append({'ev': 'serving.router.circuit', 'replica': 'r2',
+                        'state': 'closed'})
+        hits = list(doc.detect_replica_flapping(events=evs))
+        assert len(hits) == 1 and hits[0]['cause'] == 'replica_flapping'
+        assert hits[0]['evidence']['replica'] == 'r2'
+        assert hits[0]['evidence']['opens'] == 4
+        # below the flap threshold: quiet
+        assert not list(doc.detect_replica_flapping(events=evs[:5]))
+
+    def test_doctor_retry_storm_from_labeled_counters(self):
+        snap = {'counters': {
+            'serving.router.dispatched{replica=r0}': 30,
+            'serving.router.dispatched{replica=r1}': 10,
+            'serving.router.retries{replica=r1}': 12,
+            'serving.router.hedges{replica=r0}': 0,
+        }}
+        hits = list(doc.detect_retry_storm(snapshot=snap))
+        assert len(hits) == 1 and hits[0]['cause'] == 'retry_storm'
+        assert hits[0]['evidence']['offered'] == 28
+        assert hits[0]['evidence']['retries'] == 12
+        # a healthy retry fraction stays quiet
+        snap['counters']['serving.router.retries{replica=r1}'] = 1
+        assert not list(doc.detect_retry_storm(snapshot=snap))
+
+    def test_detectors_reachable_from_cli_gate(self):
+        # tools/doctor.py --fail-on validates names against DETECTORS
+        assert 'replica_flapping' in doc.DETECTORS
+        assert 'retry_storm' in doc.DETECTORS
+        assert doc.DETECTORS['replica_flapping'] is doc.detect_replica_flapping
+        assert doc.DETECTORS['retry_storm'] is doc.detect_retry_storm

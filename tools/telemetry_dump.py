@@ -82,9 +82,12 @@ def to_chrome_trace(events):
 def serving_summary(events):
     """Aggregate ``serving.*`` events into one operator-facing dict: request
     count, status mix, latency/queue-wait percentiles, shed count (split by
-    reason), join/leave tallies for the continuous-batching path, and the
+    reason), join/leave tallies for the continuous-batching path, the
     paged-KV columns — page utilization, prefix-hit rate, draft acceptance
-    (from the ``serving.kv_stats`` records the paged runner emits)."""
+    (from the ``serving.kv_stats`` records the paged runner emits) — and
+    the fleet-router table: per-replica dispatched / retried / hedged /
+    hedge-wins / drained / circuit-state from the cumulative
+    ``serving.router_stats`` records the FleetRouter emits."""
     reqs = [e for e in events if e.get('ev') == 'serving.request']
     sheds = [e for e in events if e.get('ev') == 'serving.shed']
     joins = [e for e in events if e.get('ev') == 'serving.join']
@@ -119,6 +122,21 @@ def serving_summary(events):
                 return round(float(e[key]), 4)
         return None
 
+    # fleet-router columns: serving.router_stats is cumulative per replica
+    # (last one wins), same contract as kv_stats
+    replicas = {}
+    shed_level = None
+    for e in reversed(events):
+        if e.get('ev') == 'serving.router_stats':
+            replicas = {str(k): v for k, v in (e.get('replicas') or {}).items()
+                        if isinstance(v, dict)}
+            if isinstance(e.get('shed_level'), int):
+                shed_level = e['shed_level']
+            break
+    fleet_reqs = [e for e in events if e.get('ev') == 'serving.router.request']
+    router_sheds = sum(1 for e in events
+                       if e.get('ev') == 'serving.router.shed')
+
     return {
         'requests': len(reqs),
         'by_status': by_status,
@@ -137,6 +155,10 @@ def serving_summary(events):
         'draft_acceptance': kv_last('draft_acceptance'),
         'preemptions': len(preempts),
         'page_exhausted_events': len(exhausted),
+        'fleet_replicas': replicas,
+        'fleet_requests': len(fleet_reqs),
+        'fleet_shed': router_sheds,
+        'fleet_shed_level': shed_level,
     }
 
 
@@ -172,6 +194,29 @@ def render_serving(summary):
             f"{summary['page_exhausted_events']} page-exhausted stall(s)")
     if kv_bits:
         lines.append("  paged kv: " + ', '.join(kv_bits))
+    reps = summary.get('fleet_replicas') or {}
+    if reps:
+        head = (f"  fleet: {summary.get('fleet_requests', 0)} routed "
+                f"request(s), {summary.get('fleet_shed', 0)} shed by the "
+                "ladder")
+        if summary.get('fleet_shed_level'):
+            head += f" (shed level {summary['fleet_shed_level']})"
+        lines.append(head)
+        width = max([len('replica')] + [len(n) for n in reps])
+        lines.append(
+            f"    {'replica':<{width}} {'dispatched':>10} {'retried':>8} "
+            f"{'hedged':>7} {'hedge-wins':>10} {'drained':>8} "
+            f"{'deaths':>7} {'circuit':>9}")
+        for name in sorted(reps):
+            r = reps[name]
+            lines.append(
+                f"    {name:<{width}} {int(r.get('dispatched', 0)):>10} "
+                f"{int(r.get('retried', 0)):>8} "
+                f"{int(r.get('hedged', 0)):>7} "
+                f"{int(r.get('hedge_wins', 0)):>10} "
+                f"{int(r.get('drained', 0)):>8} "
+                f"{int(r.get('deaths', 0)):>7} "
+                f"{str(r.get('circuit', '?')):>9}")
     return '\n'.join(lines)
 
 
