@@ -51,6 +51,10 @@ def load():
                 os.path.getmtime(_LIB_PATH)
                 for f in ('prefetch.cpp', 'tokenizer.cpp', 'multislot.cpp')
                 if os.path.exists(os.path.join(_CSRC, f)))
+        # graftlint: disable=GC003 — holding _lock through the g++ build
+        # is the point: concurrent first-callers must wait for the one
+        # shared artifact rather than race a second compile, and there is
+        # nothing useful to do after releasing early.
         if stale and not _build():
             return None
         try:

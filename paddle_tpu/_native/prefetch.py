@@ -228,6 +228,11 @@ class PyPrefetchRing:
                     return 'timeout'
 
     def close(self):
+        # graftlint: disable=GC001 — close() must stay lock-free: put()
+        # can hold the cv in a blocking enqueue (the FIFO turnstile), so
+        # taking the cv here could deadlock against a full queue. The
+        # latch's visibility is fenced by the cv acquire+notify_all just
+        # below, and waiters re-check on a 50ms tick regardless.
         self._closed = True
         with self._cv:
             self._cv.notify_all()

@@ -237,6 +237,11 @@ class ProcessWorkerPool:
             args=(self._ring, self._err_q, self._dataset, self._collate_fn,
                   stalled, indices),
             daemon=True, name='paddle-tpu-batch-rebuild')
+        # graftlint: disable=GC005 — deliberately fire-and-forget: the
+        # rebuild can wedge in a native slot acquire left claimed by the
+        # dead worker (docstring above); ring close unblocks it at
+        # shutdown and the outer watchdog owns the failure path, so no
+        # stop path ever joins this daemon.
         self._rebuild_t.start()
 
     def __iter__(self):

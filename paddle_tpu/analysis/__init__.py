@@ -2,13 +2,16 @@
 
 Two engines over one ``Finding`` type and one reporter pair:
 
-- **AST lint** (``graftlint``): rules GL001–GL017 catch host syncs in traced
+- **AST lint** (``graftlint``): rules GL001–GL019 catch host syncs in traced
   code, retrace triggers (incl. unbucketed dynamic shapes and
   shape-polymorphic boolean-mask indexing), nondeterminism, leftover debug
   artifacts, non-atomic checkpoint writes, ad-hoc wall-clock timing,
   unbounded waits, undonated train steps, and unsharded param placement
-  *before* they reach hardware. CLI:
-  ``python tools/graftlint.py`` or ``python -m paddle_tpu.analysis``.
+  *before* they reach hardware; the GC001–GC006 concurrency family
+  (``--select GC``) adds guarded-by inference, lock-order cycle detection,
+  blocking-under-lock, condition-predicate, unjoined-thread, and
+  callback-under-lock checks over the threaded serving/resilience surface.
+  CLI: ``python tools/graftlint.py`` or ``python -m paddle_tpu.analysis``.
 - **IR verifier**: checks GV001–GV008 validate a captured static-graph
   Program (dangling inputs, duplicate names, dtype/shape drift, dead ops,
   unfetchable targets). API: ``verify_program`` / ``Program.verify()`` /
@@ -21,6 +24,7 @@ from .rules import RULES, Rule, register, lint_paths, lint_source
 from .verify import (ProgramVerificationError, assert_verified,
                      set_always_verify, verify_enabled, verify_program)
 from . import ast_rules  # noqa: F401  (registers the GL rule catalog)
+from . import concurrency  # noqa: F401  (registers GC001..GC006)
 from .cli import main
 
 __all__ = [

@@ -13,10 +13,11 @@ import argparse
 import os
 import sys
 
-from . import ast_rules  # noqa: F401  (registers GL001..GL011)
+from . import ast_rules  # noqa: F401  (registers the GL rule catalog)
+from . import concurrency  # noqa: F401  (registers GC001..GC006)
 from .config import ConfigError, find_config, load_config
 from .finding import active, render_json, render_text
-from .rules import RULES, lint_paths
+from .rules import RULES, expand_select, lint_paths
 
 
 def _default_target():
@@ -37,7 +38,8 @@ def build_parser():
     p.add_argument('--list-rules', action='store_true',
                    help='print the rule catalog and exit')
     p.add_argument('--select', default='',
-                   help='comma-separated rule ids to run (default: all)')
+                   help='comma-separated rule ids or 2-letter family '
+                        'prefixes (GL, GC) to run (default: all)')
     p.add_argument('--config', default=None,
                    help='explicit graftlint.toml (default: nearest one '
                         'above the first path)')
@@ -84,8 +86,8 @@ def main(argv=None):
 
     select = None
     if args.select:
-        select = {s.strip() for s in args.select.split(',') if s.strip()}
-        unknown = select - set(RULES)
+        tokens = {s.strip() for s in args.select.split(',') if s.strip()}
+        select, unknown = expand_select(tokens)
         if unknown:
             print(f"graftlint: unknown rule id(s): {', '.join(sorted(unknown))}",
                   file=sys.stderr)

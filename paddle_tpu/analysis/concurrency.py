@@ -1,0 +1,982 @@
+"""graftlint Engine 3: static concurrency analysis (GC001–GC006).
+
+The repo's threaded surface — serving worker threads, the fleet router's
+client-driven state machine, async checkpoint savers, rank flushers,
+prefetchers — is synchronized by hand-rolled ``threading.Lock``/
+``Condition`` discipline that unit tests on CPU almost never stress. A
+single missed ``with self._lock`` survives tier-1 and detonates under
+fleet chaos. This engine checks the discipline statically, per module
+(same-module transitive through ``self.method()`` and bare-name calls,
+reusing the ``analysis/traced.py`` parent/by-name machinery):
+
+- GC001 *guarded-by inference*: in a class that spawns a
+  ``threading.Thread``/``Timer`` or registers one of its own methods as a
+  callback (health hooks, liveness probes), each lock's guarded set is
+  inferred from the attributes accessed inside ``with self._lock:``
+  blocks. A write (including compound read-modify-writes: ``+=``,
+  ``d[k] =``, ``.append``) to a guarded attribute without the guard held
+  fires; so does an unguarded compound write to an attribute shared
+  between the thread side and the public API even when NO site guards it
+  (the fully-unguarded counter race).
+- GC002 *lock-order cycles*: the acquired-while-holding graph across the
+  module (nested ``with`` blocks, including through same-module calls);
+  any cycle is a potential deadlock.
+- GC003 *blocking-under-lock*: ``Queue.get``/``Thread.join``/
+  ``Popen.wait``/``watchdog.wait_proc``-family/``subprocess`` waits /
+  ``time.sleep``/``os.fsync`` invoked while a lock is held. Sanctioned:
+  ``Condition.wait`` on the held lock's own condition (it RELEASES the
+  lock), and watchdog-style bounded ticks (a ``*_TICK`` name or a
+  numeric literal <= 1.0 as the wait bound).
+- GC004 *condition-wait without predicate loop*: ``Condition.wait()``
+  whose surrounding statement is not re-checked in a ``while`` — a
+  spurious or stolen wakeup proceeds on a false predicate
+  (``wait_for`` builds the loop in and never fires).
+- GC005 *unjoined thread*: ``Thread(...).start()`` whose object never
+  reaches a bounded join (``watchdog.join_thread``/``join_proc`` or
+  ``join(timeout=...)``) on any path in the module. Fix-it →
+  ``resilience.watchdog``; deliberately fire-and-forget daemons carry an
+  inline waiver naming who detects their death.
+- GC006 *callback-under-lock*: invoking a user-supplied callable
+  (``*_fn``/``*_cb``/``callback``/``hook``/``sink``/``handler``/``on_*``
+  parameters or attributes) while holding an engine/router lock — the
+  callback can block or re-enter and deadlock; snapshot under the lock,
+  call outside it.
+
+All six run under the shared waiver machinery (inline
+``# graftlint: disable=GCnnn`` + ``graftlint.toml``), report through the
+standard ``Finding`` pipeline, and are selectable as a family with
+``--select GC``. Exempt paths: ``tests/``, ``tools/``, bench harnesses.
+See docs/ANALYSIS.md ("Engine 3: concurrency") for the operator catalog.
+"""
+import ast
+import re
+
+from . import ast_rules
+from .ast_rules import _dotted
+from .rules import Rule, register
+
+_EXEMPT_PREFIXES = ('tests/', 'tools/')
+
+
+def _in_scope(rel):
+    if any(rel == p or rel.startswith(p) for p in _EXEMPT_PREFIXES):
+        return False
+    base = rel.rsplit('/', 1)[-1]
+    return not base.startswith('bench')
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+_LOCK_CTORS = {'Lock', 'RLock', 'Semaphore', 'BoundedSemaphore'}
+_COND_CTORS = {'Condition'}
+# objects that are themselves thread-safe: method calls on them are not
+# data races, and accesses to them never infer a guard
+_SAFE_CTORS = {'Event', 'Queue', 'SimpleQueue', 'LifoQueue', 'PriorityQueue',
+               'JoinableQueue', 'Barrier', 'local', 'deque'}
+_THREAD_CTORS = {'Thread', 'Timer'}
+
+# container/attribute mutations that are read-modify-write on the OBJECT
+_MUTATORS = {'append', 'extend', 'add', 'update', 'insert', 'remove',
+             'discard', 'pop', 'popleft', 'appendleft', 'clear',
+             'setdefault', 'sort'}
+
+# blocking-by-construction helpers from resilience.watchdog (tick-based,
+# but they still park the calling thread — under a lock that is a stall
+# for every other thread contending it)
+_WATCHDOG_BLOCKERS = {'bounded_get', 'join_thread', 'join_proc', 'wait_proc'}
+_SUBPROCESS_BLOCKERS = {'run', 'check_call', 'check_output', 'communicate'}
+
+_CALLBACK_RE = re.compile(r'(^on_[a-z0-9_]+$)|(^|_)(fn|func|cb|callback|'
+                          r'hook|sink|handler)s?$')
+
+
+def _ctor_tail(call):
+    d = _dotted(call.func)
+    return d.rsplit('.', 1)[-1] if d else None
+
+
+class _Module:
+    """One-pass concurrency model of a module, shared by every GC rule
+    (cached on the ModuleContext)."""
+
+    def __init__(self, ctx):
+        self.tree = ctx.tree
+        self.index = ctx.index
+        self.parents = ctx.index._parents
+        self.locks = {}      # key -> 'lock' | 'condition'
+        self.aliases = {}    # condition key -> the lock it wraps
+        self.safe = set()    # keys of thread-safe primitives
+        self.threads = set()  # keys holding Thread/Timer objects
+        self._collect()
+        # lock ATTR name -> class keys using it (for foreign-receiver
+        # resolution like `fr.lock` / `h.breaker._lock`)
+        self.lock_attr_owners = {}
+        for key in self.locks:
+            if '::self.' in key:
+                attr = key.split('::self.', 1)[1]
+                self.lock_attr_owners.setdefault(attr, []).append(key)
+        self._class_infos = None
+
+    # -- structure ------------------------------------------------------
+    def enclosing_class(self, node):
+        cur = self.parents.get(node)
+        while cur is not None and not isinstance(cur, ast.ClassDef):
+            cur = self.parents.get(cur)
+        return cur
+
+    def expr_key(self, node, cls):
+        """Canonical key for a lock-ish expression. ``self.x`` inside class
+        C -> ``C::self.x``; bare/dotted names keep their dotted spelling."""
+        d = _dotted(node)
+        if d is None:
+            return None
+        if cls and (d == 'self' or d.startswith('self.')):
+            return f'{cls}::{d}'
+        return d
+
+    def _collect(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            tail = _ctor_tail(node.value)
+            if tail is None:
+                continue
+            cls_node = self.enclosing_class(node)
+            cls = cls_node.name if cls_node is not None else None
+            for tgt in node.targets:
+                key = self.expr_key(tgt, cls)
+                if key is None:
+                    continue
+                if tail in _LOCK_CTORS:
+                    self.locks[key] = 'lock'
+                elif tail in _COND_CTORS:
+                    self.locks[key] = 'condition'
+                    if node.value.args:
+                        wrapped = self.expr_key(node.value.args[0], cls)
+                        if wrapped:
+                            self.aliases[key] = wrapped
+                elif tail in _SAFE_CTORS:
+                    self.safe.add(key)
+                elif tail in _THREAD_CTORS:
+                    self.threads.add(key)
+
+    def resolve_lock(self, expr, cls):
+        """Lock key for a with-item / receiver expression, or None.
+
+        Exact key first; then a foreign-receiver fallback: ``fr.lock``
+        resolves through the unique class that declares a lock attr named
+        ``lock``. An attr name declared by several classes resolves to a
+        shared wildcard key — good enough for held-ness (GC003/GC006) but
+        deliberately excluded from the GC002 order graph."""
+        key = self.expr_key(expr, cls)
+        if key in self.locks:
+            return key
+        if isinstance(expr, ast.Attribute):
+            root = expr.value
+            is_self = isinstance(root, ast.Name) and root.id == 'self'
+            owners = self.lock_attr_owners.get(expr.attr)
+            if owners and not is_self:
+                if len(owners) == 1:
+                    return owners[0]
+                return f'?::{expr.attr}'
+        return None
+
+    def lock_kind(self, key):
+        if key in self.locks:
+            return self.locks[key]
+        if key and key.startswith('?::'):
+            return 'lock'
+        return None
+
+    def acquired(self, withnode, cls):
+        out = set()
+        for item in withnode.items:
+            key = self.resolve_lock(item.context_expr, cls)
+            if key is not None:
+                out.add(key)
+                wrapped = self.aliases.get(key)
+                if wrapped:
+                    out.add(wrapped)
+        return out
+
+    def iter_held(self, fn, cls, base=frozenset()):
+        """Yield (node, held_lock_keys) for every node lexically inside
+        ``fn`` (nested defs excluded, like TracedIndex.walk_body)."""
+
+        def rec(node, held):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES):
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    yield child, held
+                    for item in child.items:
+                        yield item.context_expr, held
+                        yield from rec(item.context_expr, held)
+                    inner = held | self.acquired(child, cls)
+                    for stmt in child.body:
+                        # a def/lambda in the with body is a closure that
+                        # runs LATER, not under the lock
+                        if isinstance(stmt, _FUNC_NODES):
+                            continue
+                        yield stmt, inner
+                        yield from rec(stmt, inner)
+                else:
+                    yield child, held
+                    yield from rec(child, held)
+
+        yield from rec(fn, frozenset(base))
+
+    def class_infos(self):
+        if self._class_infos is None:
+            self._class_infos = [
+                _ClassInfo(self, node) for node in ast.walk(self.tree)
+                if isinstance(node, ast.ClassDef)]
+        return self._class_infos
+
+    def functions(self):
+        """(fn, class_name_or_None) for every def in the module."""
+        for fn in self.index._funcs:
+            if isinstance(fn, ast.Lambda):
+                continue
+            cls_node = self.enclosing_class(fn)
+            yield fn, (cls_node.name if cls_node is not None else None)
+
+
+class _Access:
+    __slots__ = ('attr', 'method', 'held', 'node', 'write', 'compound')
+
+    def __init__(self, attr, method, held, node, write, compound):
+        self.attr = attr
+        self.method = method
+        self.held = held
+        self.node = node
+        self.write = write
+        self.compound = compound
+
+
+class _ClassInfo:
+    """Per-class concurrency model: methods, spawn/callback entry points,
+    the self-call graph, min-held-at-entry, and every self-attr access
+    with the lock set held at it."""
+
+    def __init__(self, mod, node):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.methods = {
+            n.name: n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.lock_keys = {k for k in mod.locks
+                          if k.startswith(f'{self.name}::self.')}
+        self.lock_attrs = {k.split('::self.', 1)[1] for k in self.lock_keys}
+        self.spawn_targets = set()
+        self.callback_regs = set()
+        self.call_sites = []     # (caller, callee, held)
+        self.accesses = []       # _Access records
+        self._scan()
+        self.min_held = self._fix_min_held()
+        self.calls = {}
+        for caller, callee, _held in self.call_sites:
+            self.calls.setdefault(caller, set()).add(callee)
+        self.thread_side = self._closure(
+            self.spawn_targets | self.callback_regs)
+        self.public_side = self._closure(
+            {m for m in self.methods if not m.startswith('_')})
+
+    # -- scanning -------------------------------------------------------
+    def _self_attr(self, node):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == 'self':
+            return node.attr
+        return None
+
+    def _method_ref(self, node):
+        """Method name when ``node`` is ``self.m`` for a method m."""
+        attr = self._self_attr(node)
+        return attr if attr in self.methods else None
+
+    def _record_write(self, tgt, method, held, compound):
+        attr = self._self_attr(tgt)
+        if attr is not None:
+            self.accesses.append(
+                _Access(attr, method, held, tgt, True, compound))
+            return
+        if isinstance(tgt, ast.Subscript):
+            attr = self._self_attr(tgt.value)
+            if attr is not None:
+                self.accesses.append(
+                    _Access(attr, method, held, tgt, True, True))
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._record_write(elt, method, held, compound)
+
+    def _scan(self):
+        for mname, fn in self.methods.items():
+            for node, held in self.mod.iter_held(fn, self.name):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        self._record_write(tgt, mname, held, False)
+                elif isinstance(node, ast.AugAssign):
+                    self._record_write(node.target, mname, held, True)
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    self._record_write(node.target, mname, held, False)
+                elif isinstance(node, ast.Call):
+                    callee = self._method_ref(node.func)
+                    if callee is not None:
+                        self.call_sites.append((mname, callee, held))
+                    # self.attr.append(...)-style container mutation
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in _MUTATORS:
+                        attr = self._self_attr(node.func.value)
+                        if attr is not None:
+                            self.accesses.append(_Access(
+                                attr, mname, held, node, True, True))
+                    # thread spawn / callback registration
+                    tail = _ctor_tail(node)
+                    argvals = list(node.args) + \
+                        [kw.value for kw in node.keywords]
+                    if tail in _THREAD_CTORS:
+                        for kw in node.keywords:
+                            if kw.arg == 'target':
+                                m = self._method_ref(kw.value)
+                                if m:
+                                    self.spawn_targets.add(m)
+                        if node.args:
+                            m = self._method_ref(node.args[0])
+                            if m:
+                                self.spawn_targets.add(m)
+                    else:
+                        for v in argvals:
+                            m = self._method_ref(v)
+                            if m:
+                                self.callback_regs.add(m)
+                elif isinstance(node, ast.Attribute) and \
+                        isinstance(node.ctx, ast.Load):
+                    attr = self._self_attr(node)
+                    if attr is not None:
+                        self.accesses.append(_Access(
+                            attr, mname, held, node, False, False))
+
+    # -- interprocedural held-ness --------------------------------------
+    def _fix_min_held(self):
+        """Lock set provably held at ENTRY of each method: the intersection
+        over internal call sites of (site-held | caller's entry set).
+        Public methods, thread targets, and registered callbacks are
+        external entry points (empty set). A 'callers hold self._lock'
+        helper like CircuitBreaker._open resolves to {lock} and its body
+        is analyzed as guarded."""
+        entries = {m for m in self.methods if not m.startswith('_')}
+        entries |= self.spawn_targets | self.callback_regs
+        entries.add('__init__')
+        min_held = {m: (frozenset() if m in entries else None)
+                    for m in self.methods}
+        changed = True
+        while changed:
+            changed = False
+            incoming = {}
+            for caller, callee, held in self.call_sites:
+                base = min_held.get(caller)
+                if base is None:
+                    continue
+                eff = frozenset(held) | base
+                cur = incoming.get(callee)
+                incoming[callee] = eff if cur is None else (cur & eff)
+            for m in self.methods:
+                if m in entries:
+                    continue
+                new = incoming.get(m)
+                if new is not None and new != min_held[m]:
+                    # monotone-shrinking re-resolution is fine: start from
+                    # the freshly computed intersection each round
+                    min_held[m] = new
+                    changed = True
+        return {m: (h or frozenset()) for m, h in min_held.items()}
+
+    def effective_held(self, access_or_held, method):
+        held = access_or_held.held if isinstance(access_or_held, _Access) \
+            else access_or_held
+        return frozenset(held) | self.min_held.get(method, frozenset())
+
+    def _closure(self, seeds):
+        out = set(s for s in seeds if s in self.methods)
+        stack = list(out)
+        while stack:
+            m = stack.pop()
+            for callee in self.calls.get(m, ()):
+                if callee not in out:
+                    out.add(callee)
+                    stack.append(callee)
+        return out
+
+
+def _module(ctx):
+    mod = getattr(ctx, '_gc_module', None)
+    if mod is None:
+        mod = _Module(ctx)
+        ctx._gc_module = mod
+    return mod
+
+
+def _short(key):
+    """Human spelling of a lock key: 'ClassName::self._lock' -> 'self._lock'."""
+    if '::' in key:
+        cls, rest = key.split('::', 1)
+        return rest if cls != '?' else f'.{key.split("::", 1)[1]}'
+    return key
+
+
+# -- GC001: guarded-by inference --------------------------------------------
+
+@register
+class GuardedByRule(Rule):
+    """GC001: a write to lock-guarded (or thread-shared) instance state
+    without the guard held — the missed ``with self._lock`` that loses
+    updates or tears multi-field invariants under the worker thread."""
+    id = 'GC001'
+    title = 'unguarded write to shared state in a threaded class'
+
+    def check(self, ctx):
+        if not _in_scope(ctx.rel_path):
+            return
+        mod = _module(ctx)
+        for ci in mod.class_infos():
+            if not ci.lock_keys and not ci.spawn_targets:
+                continue
+            yield from self._check_class(ctx, mod, ci)
+
+    def _data_attr(self, mod, ci, attr):
+        """Is ``attr`` plain data (not a sync primitive or method)?"""
+        if attr in ci.lock_attrs or attr in ci.methods:
+            return False
+        key = f'{ci.name}::self.{attr}'
+        return key not in mod.safe and key not in mod.locks
+
+    def _check_class(self, ctx, mod, ci):
+        guards = {}      # attr -> set of lock keys observed guarding it
+        sides = {}       # attr -> {'thread': bool, 'public': bool}
+        written_in = {}  # attr -> set of sides with a write
+        for a in ci.accesses:
+            if not self._data_attr(mod, ci, a.attr):
+                continue
+            eff = ci.effective_held(a, a.method)
+            guards.setdefault(a.attr, set()).update(eff)
+            s = sides.setdefault(a.attr, set())
+            if a.method in ci.thread_side:
+                s.add('thread')
+            if a.method in ci.public_side:
+                s.add('public')
+            if a.write and a.method != '__init__':
+                w = written_in.setdefault(a.attr, set())
+                if a.method in ci.thread_side:
+                    w.add('thread')
+                if a.method in ci.public_side:
+                    w.add('public')
+        reported = set()
+        for a in ci.accesses:
+            if not a.write or a.method == '__init__':
+                continue
+            if not self._data_attr(mod, ci, a.attr):
+                continue
+            eff = ci.effective_held(a, a.method)
+            guard = guards.get(a.attr, set())
+            key = (a.node.lineno, a.node.col_offset, a.attr)
+            if key in reported:
+                continue
+            if guard and not (eff & guard):
+                lock = sorted(guard)[0]
+                reported.add(key)
+                yield self.finding(
+                    ctx, a.node,
+                    f"self.{a.attr} is written in {ci.name}.{a.method}() "
+                    f"without holding {_short(lock)}, which guards it at "
+                    "other site(s) in this class — a concurrent reader or "
+                    "the worker thread sees a torn/lost update; move the "
+                    "write under the lock")
+            elif not eff and (ci.spawn_targets or ci.callback_regs):
+                shared = sides.get(a.attr, set()) >= {'thread', 'public'}
+                both_written = written_in.get(a.attr, set()) >= \
+                    {'thread', 'public'}
+                if shared and (a.compound or both_written):
+                    reported.add(key)
+                    yield self.finding(
+                        ctx, a.node,
+                        f"self.{a.attr} is shared between {ci.name}'s "
+                        "worker thread/registered callback and its public "
+                        "API, but this write in "
+                        f"{ci.name}.{a.method}() holds no lock — "
+                        "concurrent read-modify-writes lose updates; "
+                        "guard every access with the class lock")
+
+
+# -- GC002: lock-order cycles ------------------------------------------------
+
+@register
+class LockOrderRule(Rule):
+    """GC002: the acquired-while-holding graph has a cycle — two call
+    paths taking the same locks in opposite orders can deadlock under
+    exactly the concurrency tier-1 never generates."""
+    id = 'GC002'
+    title = 'lock-order cycle (potential deadlock)'
+
+    def check(self, ctx):
+        if not _in_scope(ctx.rel_path):
+            return
+        mod = _module(ctx)
+        edges = {}   # (held, acquired) -> first site node
+        # per-function lock-acquisition summaries for call-through edges
+        fn_acquires = {}
+        infos = {ci.name: ci for ci in mod.class_infos()}
+        for fn, cls in mod.functions():
+            acq = set()
+            for node, held in mod.iter_held(fn, cls):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    acq |= mod.acquired(node, cls)
+            fn_acquires[fn] = acq
+        # transitive: a function's closure acquisitions through
+        # same-module bare calls and same-class self calls
+        by_name = mod.index._by_name
+        changed = True
+        while changed:
+            changed = False
+            for fn, cls in mod.functions():
+                for node in mod.index.walk_body(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callees = []
+                    if isinstance(node.func, ast.Name):
+                        callees = by_name.get(node.func.id, [])
+                    elif cls and isinstance(node.func, ast.Attribute) and \
+                            isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id == 'self':
+                        ci = infos.get(cls)
+                        m = ci.methods.get(node.func.attr) if ci else None
+                        callees = [m] if m is not None else []
+                    for callee in callees:
+                        extra = fn_acquires.get(callee, set())
+                        if extra - fn_acquires[fn]:
+                            fn_acquires[fn] |= extra
+                            changed = True
+        # edges: direct nesting + call-under-lock into acquiring callees
+        for fn, cls in mod.functions():
+            ci = infos.get(cls)
+            base = ci.min_held.get(fn.name, frozenset()) \
+                if ci and hasattr(fn, 'name') else frozenset()
+            for node, held in mod.iter_held(fn, cls, base=base):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    acq = mod.acquired(node, cls)
+                    for h in held:
+                        for a in acq:
+                            if h != a and not h.startswith('?::') and \
+                                    not a.startswith('?::'):
+                                edges.setdefault((h, a), node)
+                elif isinstance(node, ast.Call) and held:
+                    callees = []
+                    if isinstance(node.func, ast.Name):
+                        callees = by_name.get(node.func.id, [])
+                    elif cls and isinstance(node.func, ast.Attribute) and \
+                            isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id == 'self':
+                        m = ci.methods.get(node.func.attr) if ci else None
+                        callees = [m] if m is not None else []
+                    for callee in callees:
+                        for a in fn_acquires.get(callee, set()):
+                            for h in held:
+                                if h != a and not h.startswith('?::') and \
+                                        not a.startswith('?::'):
+                                    edges.setdefault((h, a), node)
+        yield from self._report_cycles(ctx, edges)
+
+    def _report_cycles(self, ctx, edges):
+        graph = {}
+        for (h, a) in edges:
+            graph.setdefault(h, set()).add(a)
+        # iterative DFS cycle detection; report each cycle once
+        seen_cycles = set()
+        for start in sorted(graph):
+            path, stack = [], [(start, iter(sorted(graph.get(start, ()))))]
+            on_path = {start}
+            path.append(start)
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt in on_path:
+                        i = path.index(nxt)
+                        cycle = tuple(sorted(path[i:]))
+                        if cycle not in seen_cycles:
+                            seen_cycles.add(cycle)
+                            site = edges.get((node, nxt)) or \
+                                edges[next(iter(
+                                    (e for e in edges
+                                     if e[0] in cycle and e[1] in cycle)))]
+                            order = ' -> '.join(
+                                _short(k) for k in path[i:] + [nxt])
+                            yield self.finding(
+                                ctx, site,
+                                f"lock-order cycle: {order} — two threads "
+                                "taking these locks in opposite orders "
+                                "deadlock; pick one global order (document "
+                                "it on the locks) and re-nest the with "
+                                "blocks, or collapse to a single lock")
+                        continue
+                    if nxt in graph and nxt not in on_path:
+                        stack.append(
+                            (nxt, iter(sorted(graph.get(nxt, ())))))
+                        on_path.add(nxt)
+                        path.append(nxt)
+                        advanced = True
+                        break
+                if not advanced:
+                    stack.pop()
+                    on_path.discard(node)
+                    if path and path[-1] == node:
+                        path.pop()
+
+
+# -- GC003: blocking call while holding a lock -------------------------------
+
+def _tickish(node):
+    """Is this wait bound a sanctioned short tick — a name containing
+    'tick' or a numeric literal <= 1.0?"""
+    if isinstance(node, ast.Constant) and \
+            isinstance(node.value, (int, float)):
+        return float(node.value) <= 1.0
+    d = _dotted(node)
+    if d and 'tick' in d.rsplit('.', 1)[-1].lower():
+        return True
+    if isinstance(node, ast.Name) and 'tick' in node.id.lower():
+        return True
+    return False
+
+
+def _wait_bound(call):
+    """The timeout-ish argument of a blocking call, if any."""
+    for kw in call.keywords:
+        if kw.arg == 'timeout':
+            return kw.value
+    if call.args:
+        return call.args[0]
+    return None
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    """GC003: a blocking wait (queue get, thread/process join, subprocess
+    wait, sleep, fsync) while holding a lock — every thread contending
+    the lock stalls behind one slow or dead counterparty; watchdog-style
+    short ticks and ``Condition.wait`` on the held lock are sanctioned."""
+    id = 'GC003'
+    title = 'blocking call while holding a lock'
+
+    def check(self, ctx):
+        if not _in_scope(ctx.rel_path):
+            return
+        mod = _module(ctx)
+        tracked = ast_rules.UnboundedWaitRule()._tracked_names(ctx.tree)
+        infos = {ci.name: ci for ci in mod.class_infos()}
+        by_name = mod.index._by_name
+        # per-function "blocks when called" summaries (blocking call at a
+        # point where the function itself holds no lock), to fixpoint
+        blockers = {}
+        for fn, cls in mod.functions():
+            desc = None
+            for node, held in mod.iter_held(fn, cls):
+                if held:
+                    continue
+                d = self._blocking(mod, tracked, node, held, cls)
+                if d:
+                    desc = d
+                    break
+            blockers[fn] = desc
+        changed = True
+        while changed:
+            changed = False
+            for fn, cls in mod.functions():
+                if blockers.get(fn):
+                    continue
+                for node, held in mod.iter_held(fn, cls):
+                    if held or not isinstance(node, ast.Call):
+                        continue
+                    for callee in self._callees(node, cls, infos, by_name):
+                        if blockers.get(callee):
+                            name = getattr(callee, 'name', '<lambda>')
+                            blockers[fn] = f"{name}() [which "\
+                                f"{blockers[callee]}]"
+                            changed = True
+                            break
+                    if blockers.get(fn):
+                        break
+        for fn, cls in mod.functions():
+            ci = infos.get(cls)
+            base = ci.min_held.get(fn.name, frozenset()) \
+                if ci and hasattr(fn, 'name') else frozenset()
+            for node, held in mod.iter_held(fn, cls, base=base):
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                desc = self._blocking(mod, tracked, node, held, cls)
+                if desc:
+                    locks = ', '.join(sorted(_short(k) for k in held))
+                    yield self.finding(
+                        ctx, node,
+                        f"{desc} while holding {locks} — every thread "
+                        "contending the lock stalls behind this wait "
+                        "(lock convoy; a dead counterparty wedges them "
+                        "all); move the wait outside the lock or snapshot "
+                        "under the lock and block after releasing it")
+                    continue
+                for callee in self._callees(node, cls, infos, by_name):
+                    d = blockers.get(callee)
+                    if d:
+                        locks = ', '.join(sorted(_short(k) for k in held))
+                        yield self.finding(
+                            ctx, node,
+                            f"call into {getattr(callee, 'name', '?')}() "
+                            f"— which {d} — while holding {locks}; the "
+                            "blocking wait runs with the lock held "
+                            "(lock convoy), release before calling")
+                        break
+
+    def _callees(self, call, cls, infos, by_name):
+        if isinstance(call.func, ast.Name):
+            return by_name.get(call.func.id, [])
+        if cls and isinstance(call.func, ast.Attribute) and \
+                isinstance(call.func.value, ast.Name) and \
+                call.func.value.id == 'self':
+            ci = infos.get(cls)
+            m = ci.methods.get(call.func.attr) if ci else None
+            return [m] if m is not None else []
+        return []
+
+    def _blocking(self, mod, tracked, node, held, cls):
+        """Description string when ``node`` is a blocking call (given the
+        held set, for the Condition.wait sanction), else None."""
+        if not isinstance(node, ast.Call):
+            return None
+        dotted = _dotted(node.func)
+        tail = dotted.rsplit('.', 1)[-1] if dotted else None
+        if dotted in ('time.sleep',):
+            bound = node.args[0] if node.args else None
+            if bound is not None and _tickish(bound):
+                return None
+            return 'time.sleep()'
+        if dotted in ('os.fsync', 'os.fdatasync'):
+            return f'{dotted}() (synchronous disk flush)'
+        if tail in _WATCHDOG_BLOCKERS:
+            bound = _wait_bound(node)
+            # join_thread(t, timeout) passes the thread first; look at
+            # the timeout kwarg only
+            kw = {k.arg: k.value for k in node.keywords}
+            bound = kw.get('timeout', None)
+            if len(node.args) > 1 and bound is None:
+                bound = node.args[1]
+            if bound is not None and _tickish(bound):
+                return None
+            return f'watchdog.{tail}() (a bounded but parked wait)'
+        if dotted and dotted.startswith('subprocess.') and \
+                tail in _SUBPROCESS_BLOCKERS:
+            return f'{dotted}()'
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        recv = _dotted(node.func.value)
+        method = node.func.attr
+        # Condition.wait on the HELD lock releases it — sanctioned; on a
+        # condition whose lock is NOT held it raises anyway.
+        if method in ('wait', 'wait_for'):
+            key = mod.resolve_lock(node.func.value, cls)
+            if key is not None and mod.lock_kind(key) == 'condition':
+                return None
+        kind = tracked.get(recv)
+        if kind and method in ast_rules._BLOCKING_KINDS.get(kind, ()):
+            bound = _wait_bound(node)
+            if bound is not None and _tickish(bound):
+                return None
+            return f'{recv}.{method}() on a {kind}'
+        return None
+
+
+# -- GC004: Condition.wait without a predicate loop --------------------------
+
+@register
+class ConditionPredicateRule(Rule):
+    """GC004: ``Condition.wait()`` not re-checked in a ``while`` — wakeups
+    are allowed to be spurious and notify_all races admit stolen wakeups,
+    so a woken waiter must re-test its predicate before proceeding."""
+    id = 'GC004'
+    title = 'Condition.wait() without a predicate re-check loop'
+
+    def check(self, ctx):
+        if not _in_scope(ctx.rel_path):
+            return
+        mod = _module(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr == 'wait'):
+                continue
+            cls_node = mod.enclosing_class(node)
+            cls = cls_node.name if cls_node is not None else None
+            key = mod.resolve_lock(node.func.value, cls)
+            if key is None or mod.lock_kind(key) != 'condition':
+                continue
+            cur = mod.parents.get(node)
+            in_while = False
+            while cur is not None and not isinstance(cur, _FUNC_NODES):
+                if isinstance(cur, ast.While):
+                    in_while = True
+                    break
+                cur = mod.parents.get(cur)
+            if not in_while:
+                recv = _dotted(node.func.value) or 'cond'
+                yield self.finding(
+                    ctx, node,
+                    f"{recv}.wait() is not inside a while loop re-checking "
+                    "its predicate — spurious/stolen wakeups proceed on a "
+                    "false condition; use `while not pred: cond.wait(...)` "
+                    "or cond.wait_for(pred, timeout=...)")
+
+
+# -- GC005: started thread never reaches a bounded join ----------------------
+
+@register
+class UnjoinedThreadRule(Rule):
+    """GC005: ``Thread(...).start()`` whose object never reaches a bounded
+    join anywhere in the module — shutdown cannot prove the thread exited,
+    so interpreter teardown races it (daemon) or hangs on it (non-daemon).
+    Route the join through ``resilience.watchdog.join_thread``."""
+    id = 'GC005'
+    title = 'started thread never reaches a bounded join'
+
+    def check(self, ctx):
+        if not _in_scope(ctx.rel_path):
+            return
+        tracked = ast_rules.UnboundedWaitRule()._tracked_names(ctx.tree)
+        threadish = {k for k, kind in tracked.items()
+                     if kind in ('Thread', 'Process')}
+        # alias groups: `t = self._thread` (including tuple-unpacking like
+        # `t, self._thread = self._thread, None`) joins them so a join on
+        # either spelling covers the start on the other
+        groups = {k: {k} for k in threadish}
+        pairs = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Tuple, ast.List)) and \
+                        isinstance(node.value, (ast.Tuple, ast.List)) and \
+                        len(tgt.elts) == len(node.value.elts):
+                    for te, ve in zip(tgt.elts, node.value.elts):
+                        pairs.append((_dotted(ve), _dotted(te)))
+                elif isinstance(node.value, (ast.Name, ast.Attribute)):
+                    pairs.append((_dotted(node.value), _dotted(tgt)))
+        changed = True
+        while changed:
+            changed = False
+            for src, dst in pairs:
+                if src not in groups or not dst:
+                    continue
+                if dst not in groups:
+                    groups[src].add(dst)
+                    groups[dst] = groups[src]
+                    changed = True
+                elif groups[src] is not groups[dst]:
+                    merged = groups[src] | groups[dst]
+                    for m in merged:
+                        groups[m] = merged
+                    changed = True
+        threadish = set(groups)
+        started, joined = {}, set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                recv = _dotted(node.func.value)
+                if node.func.attr == 'start':
+                    if recv in threadish:
+                        started.setdefault(recv, node)
+                    elif isinstance(node.func.value, ast.Call) and \
+                            _ctor_tail(node.func.value) in _THREAD_CTORS:
+                        # inline Thread(...).start(): nothing to ever join
+                        started.setdefault(
+                            f'<inline:{node.lineno}>', node)
+                elif node.func.attr == 'join' and recv in threadish:
+                    # any timeout-carrying join counts as bounded (a bare
+                    # join() is GL012's unbounded-wait finding)
+                    if node.args or any(kw.arg in ('timeout', None)
+                                        for kw in node.keywords):
+                        joined.add(recv)
+            tail = _ctor_tail(node)
+            if tail in ('join_thread', 'join_proc') and node.args:
+                first = _dotted(node.args[0])
+                if first in threadish:
+                    joined.add(first)
+        joined_closure = set()
+        for k in joined:
+            joined_closure |= groups.get(k, {k})
+        for key, node in sorted(started.items(),
+                                key=lambda kv: kv[1].lineno):
+            if key in joined_closure:
+                continue
+            what = 'an inline-constructed thread' if \
+                key.startswith('<inline:') else f'{key}'
+            yield self.finding(
+                ctx, node,
+                f"{what}.start() but the thread object never reaches a "
+                "bounded join in this module — shutdown cannot prove it "
+                "exited (interpreter teardown races a daemon, hangs on a "
+                "non-daemon); keep the Thread and join it with "
+                "paddle_tpu.resilience.watchdog.join_thread(t, timeout=...)"
+                " on the stop path")
+
+
+# -- GC006: user-supplied callback invoked under a lock ----------------------
+
+@register
+class CallbackUnderLockRule(Rule):
+    """GC006: calling a user-supplied callable (``*_fn``, ``*_cb``,
+    ``callback``, ``hook``, ``sink``, ``handler``, ``on_*``) while holding
+    a lock — arbitrary user code can block or re-enter the locked API and
+    deadlock; snapshot under the lock, invoke after releasing it."""
+    id = 'GC006'
+    title = 'user-supplied callback invoked while holding a lock'
+
+    def check(self, ctx):
+        if not _in_scope(ctx.rel_path):
+            return
+        mod = _module(ctx)
+        infos = {ci.name: ci for ci in mod.class_infos()}
+        by_name = mod.index._by_name
+        for fn, cls in mod.functions():
+            ci = infos.get(cls)
+            base = ci.min_held.get(fn.name, frozenset()) \
+                if ci and hasattr(fn, 'name') else frozenset()
+            for node, held in mod.iter_held(fn, cls, base=base):
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                name = self._callback_name(node.func, by_name, ci)
+                if name is None:
+                    continue
+                locks = ', '.join(sorted(_short(k) for k in held))
+                yield self.finding(
+                    ctx, node,
+                    f"user-supplied callable {name}(...) invoked while "
+                    f"holding {locks} — arbitrary callback code can block "
+                    "or re-enter this API and deadlock every contending "
+                    "thread; snapshot what it needs under the lock and "
+                    "call it after releasing")
+
+    def _callback_name(self, func, by_name, ci):
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and func.value.id == 'self':
+            name = func.attr
+            if ci is not None and name in ci.methods:
+                return None      # our own method, body visible to analysis
+        else:
+            return None
+        if not _CALLBACK_RE.search(name):
+            return None
+        if by_name.get(name):
+            return None          # a same-module def: not user-supplied
+        return name

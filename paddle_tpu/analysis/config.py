@@ -51,8 +51,10 @@ def _parse_value(raw, where):
         return [_parse_value(s, where) for s in items]
     if raw in ('true', 'false'):
         return raw == 'true'
+    if raw.lstrip('-').isdigit():
+        return int(raw)
     raise ConfigError(f"{where}: unsupported value {raw!r} "
-                      "(strings and string lists only)")
+                      "(strings, integers and string lists only)")
 
 
 def _strip_comment(line):

@@ -68,14 +68,36 @@ class ModuleContext:
                 yield fn, node
 
 
+def expand_select(select):
+    """Expand a selection set: exact rule ids pass through; a bare 2-letter
+    family prefix ('GL', 'GC') expands to every registered rule in that
+    family. Returns (expanded_set, unknown_tokens)."""
+    if not select:
+        return None, set()
+    expanded, unknown = set(), set()
+    for token in select:
+        if token in RULES:
+            expanded.add(token)
+            continue
+        family = {rid for rid in RULES if rid.startswith(token)} \
+            if len(token) == 2 else set()
+        if family:
+            expanded |= family
+        else:
+            unknown.add(token)
+    return expanded, unknown
+
+
 def lint_source(path, source, scan_root=None, select=None):
-    """Run every registered rule over one module's source."""
+    """Run every registered rule over one module's source. ``select``
+    accepts exact ids and 2-letter family prefixes (see expand_select)."""
     try:
         ctx = ModuleContext(path, source, scan_root=scan_root)
     except SyntaxError as e:
         return [Finding(rule='GL000', severity='error', source='ast',
                         path=path, line=e.lineno or 0,
                         message=f"unparseable module: {e.msg}")]
+    select, _ = expand_select(select)
     out = []
     for rule_id, rule in sorted(RULES.items()):
         if select and rule_id not in select:

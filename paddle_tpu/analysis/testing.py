@@ -139,3 +139,243 @@ def malform(kind, seed=0):
     elif kind == 'bad_fetch':
         return prog, [f"no_such_var_{seed}"], expect
     return prog, expect
+
+
+# -- Engine 3 fixtures: seeded concurrency anti-pattern sources --------------
+
+#: every concurrency kind -> the single GC rule its firing variant trips
+CONCURRENCY_KINDS = {
+    'unguarded_counter': 'GC001',
+    'lock_order_cycle': 'GC002',
+    'sleep_under_lock': 'GC003',
+    'wait_without_loop': 'GC004',
+    'unjoined_thread': 'GC005',
+    'callback_under_lock': 'GC006',
+}
+
+# Each template is (firing_source, sanctioned_source, fire_marker) where
+# fire_marker is a substring unique to the line the finding anchors to.
+# {s} is the seed, woven into names so parallel tests never collide.
+_CONC_TEMPLATES = {
+    'unguarded_counter': (
+        '''import threading
+
+class Engine{s}:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _worker(self):
+        with self._lock:
+            self._count += 1
+
+    def submit(self):
+        self._count += 1
+''',
+        '''import threading
+
+class Engine{s}:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _worker(self):
+        with self._lock:
+            self._count += 1
+
+    def submit(self):
+        with self._lock:
+            self._count += 1
+''',
+        'self._count += 1'),
+    'lock_order_cycle': (
+        '''import threading
+
+lock_a{s} = threading.Lock()
+lock_b{s} = threading.Lock()
+
+def forward{s}(x):
+    with lock_a{s}:
+        with lock_b{s}:
+            return x + 1
+
+def backward{s}(x):
+    with lock_b{s}:
+        with lock_a{s}:
+            return x - 1
+''',
+        '''import threading
+
+lock_a{s} = threading.Lock()
+lock_b{s} = threading.Lock()
+
+def forward{s}(x):
+    with lock_a{s}:
+        with lock_b{s}:
+            return x + 1
+
+def backward{s}(x):
+    with lock_a{s}:
+        with lock_b{s}:
+            return x - 1
+''',
+        None),
+    'sleep_under_lock': (
+        '''import threading
+import time
+
+class Pump{s}:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beats = 0
+
+    def flush(self):
+        with self._lock:
+            time.sleep(2.0)
+            self.beats += 1
+''',
+        '''import threading
+import time
+
+class Pump{s}:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beats = 0
+
+    def flush(self):
+        with self._lock:
+            self.beats += 1
+        time.sleep(2.0)
+''',
+        'time.sleep(2.0)'),
+    'wait_without_loop': (
+        '''import threading
+
+class Gate{s}:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.ready = False
+
+    def open(self):
+        with self._cond:
+            self.ready = True
+            self._cond.notify_all()
+
+    def wait_ready(self):
+        with self._cond:
+            if not self.ready:
+                self._cond.wait(1.0)
+            return self.ready
+''',
+        '''import threading
+
+class Gate{s}:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.ready = False
+
+    def open(self):
+        with self._cond:
+            self.ready = True
+            self._cond.notify_all()
+
+    def wait_ready(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait(1.0)
+            return self.ready
+''',
+        'self._cond.wait(1.0)'),
+    'unjoined_thread': (
+        '''import threading
+
+def spawn{s}(fn):
+    t{s} = threading.Thread(target=fn, daemon=True)
+    t{s}.start()
+    return t{s}
+''',
+        '''import threading
+
+def spawn{s}(fn):
+    t{s} = threading.Thread(target=fn, daemon=True)
+    t{s}.start()
+    t{s}.join(timeout=2.0)
+    return t{s}
+''',
+        '.start()'),
+    'callback_under_lock': (
+        '''import threading
+
+class Notifier{s}:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.seq = 0
+
+    def publish(self, payload, done_cb):
+        with self._lock:
+            self.seq += 1
+            done_cb(payload)
+''',
+        '''import threading
+
+class Notifier{s}:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.seq = 0
+
+    def publish(self, payload, done_cb):
+        with self._lock:
+            self.seq += 1
+        done_cb(payload)
+''',
+        'done_cb(payload)'),
+}
+
+
+def concurrency_fixture(kind, seed=0, sanctioned=False):
+    """Seeded source text tripping (or, sanctioned, just avoiding) exactly
+    one GC rule.
+
+    Returns ``(source, expected_rule, line)`` — ``line`` is the 1-based
+    line the firing finding anchors to (None for the sanctioned variant,
+    and for GC002 whose anchor is whichever acquisition closes the cycle).
+    Same philosophy as :func:`malform`: deterministic in ``seed`` (names
+    vary, structure does not), so a test can assert "this source yields
+    exactly GCxxx at file:line" and build waiver variants by appending an
+    inline ``# graftlint: disable=GCxxx`` on that line.
+    """
+    if kind not in CONCURRENCY_KINDS:
+        raise ValueError(f"unknown concurrency kind {kind!r}; "
+                         f"one of {sorted(CONCURRENCY_KINDS)}")
+    firing, clean, marker = _CONC_TEMPLATES[kind]
+    source = (clean if sanctioned else firing).format(s=seed)
+    line = None
+    if not sanctioned and marker is not None:
+        # last occurrence: the firing site sits below any guarded twin
+        # of the same statement (e.g. GC001's in-worker locked write)
+        for i, text in enumerate(source.splitlines(), 1):
+            if marker in text:
+                line = i
+    return source, CONCURRENCY_KINDS[kind], line

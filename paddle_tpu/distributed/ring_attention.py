@@ -40,9 +40,19 @@ def _block_attn(q, k, v, scale, causal, q_block_idx, kv_block_idx, n_blocks):
     return o, m, l
 
 
+def _axis_size(axis):
+    """Static size of a bound mesh axis. ``lax.axis_size`` only exists in
+    newer jax; ``psum(1, axis)`` is the portable spelling — the axis env
+    constant-folds it, so the result stays a Python int usable for the
+    permutation list and the fori_loop bound."""
+    if hasattr(lax, 'axis_size'):
+        return lax.axis_size(axis)
+    return int(lax.psum(1, axis))
+
+
 def _ring_attention_sharded(q, k, v, *, axis, causal, scale):
     """Runs on one shard: q/k/v local blocks (B, H, L/n, D)."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     my_idx = lax.axis_index(axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
 

@@ -118,6 +118,10 @@ class AsyncCheckpointer:
         # without it two callers could both observe no pending write and
         # orphan one writer thread, losing its error and its join.
         with self._submit:
+            # graftlint: disable=GC003 — serializing save() THROUGH the
+            # in-flight join is this lock's contract (comment above): a
+            # second saver must wait out the previous write anyway, and
+            # the join is the wait.
             self._wait_pending()
             self._raise_pending_error()
             snap = _snapshot(layer, optimizer, step)

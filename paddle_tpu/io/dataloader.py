@@ -178,6 +178,10 @@ class DevicePrefetcher:
                 yield batch
         finally:
             stop.set()
+            # bounded join: the worker exits within one 0.1s put tick of
+            # stop; a worker wedged inside _convert just times the join
+            # out (False) rather than hanging generator teardown
+            _watchdog.join_thread(t, timeout=2.0)
 
 
 def _env_prefetch_depth():

@@ -61,6 +61,11 @@ Detector catalog (docs/OBSERVABILITY.md has the operator version):
                       replicas; fix the failing replica, then bound
                       max_retries / hedging and let the shed ladder
                       engage first.
+- ``lint_debt``       the tree's justified graftlint waivers (inline
+                      ``graftlint: disable`` + ``[[graftlint.waiver]]``
+                      blocks) outgrew the ``lint_debt_threshold`` budget
+                      recorded in graftlint.toml (info — the lint gate
+                      still passes; this flags the creeping debt).
 
 Ranked output: ``critical`` > ``warning`` > ``info``. Standalone on
 purpose — stdlib-only, importable by path — so ``tools/doctor.py`` works
@@ -661,6 +666,67 @@ def detect_retry_storm(events=None, snapshot=None, cluster=None,
         offered=offered, ratio=round(ratio, 3))
 
 
+def detect_lint_debt(events=None, snapshot=None, cluster=None,
+                     lint_debt_threshold=None, repo_root=None, **_):
+    """The repo's justified-waiver count outgrew the budget recorded in
+    ``graftlint.toml`` (``lint_debt_threshold``). Every waiver is a rule
+    firing that somebody argued around; past the budget the arguing is
+    the norm and the linter has stopped steering. Info-only: the gate
+    (tier-1 lint) still passes — this names the creeping debt before a
+    waiver-heavy PR normalizes it. Quiet when no budget is recorded or
+    the tree is not checked out (installed package without sources)."""
+    import os
+    import re
+    root = repo_root
+    if root is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        root = os.path.dirname(os.path.dirname(here))
+    toml = os.path.join(root, 'graftlint.toml')
+    if not os.path.isfile(toml):
+        return
+    try:
+        with open(toml, 'r', encoding='utf-8') as f:
+            cfg_text = f.read()
+    except OSError:
+        return
+    if lint_debt_threshold is None:
+        m = re.search(r'^\s*lint_debt_threshold\s*=\s*(\d+)', cfg_text,
+                      re.MULTILINE)
+        if m is None:
+            return
+        lint_debt_threshold = int(m.group(1))
+    file_waivers = len(re.findall(r'\[\[graftlint\.waiver\]\]', cfg_text))
+    inline = 0
+    pkg = os.path.join(root, 'paddle_tpu')
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != '__pycache__']
+        for fn in filenames:
+            if not fn.endswith('.py'):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn), 'r',
+                          encoding='utf-8') as f:
+                    inline += len(re.findall(r'#\s*graftlint:\s*disable',
+                                             f.read()))
+            except OSError:
+                continue
+    total = file_waivers + inline
+    if total <= int(lint_debt_threshold):
+        return
+    yield _diag(
+        'lint_debt', 'info',
+        f"{total} graftlint waiver(s) in the tree ({inline} inline, "
+        f"{file_waivers} file-level) exceed the lint_debt_threshold="
+        f"{lint_debt_threshold} budget recorded in graftlint.toml",
+        "burn down the debt before adding to it: re-read the oldest "
+        "waivers (git log -S 'graftlint: disable'), fix the ones whose "
+        "justification no longer holds, and only then raise "
+        "lint_debt_threshold for the remainder that is genuinely "
+        "by-design",
+        waivers=total, inline=inline, file_level=file_waivers,
+        threshold=int(lint_debt_threshold))
+
+
 DETECTORS = {
     'straggler': detect_straggler,
     'retrace_storm': detect_retrace_storm,
@@ -674,6 +740,7 @@ DETECTORS = {
     'elastic_downsize': detect_elastic_downsize,
     'replica_flapping': detect_replica_flapping,
     'retry_storm': detect_retry_storm,
+    'lint_debt': detect_lint_debt,
 }
 
 

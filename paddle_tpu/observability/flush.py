@@ -50,6 +50,11 @@ class RankFlusher:
         self.host = socket.gethostname()
         self._stop = threading.Event()
         self._thread = None
+        # flush_now() is public and the flusher thread calls it too: the
+        # lock serializes whole flushes (two writers in one process would
+        # collide on the same pid-suffixed staging file) and guards the
+        # flushes counter
+        self._flush_lock = threading.Lock()
         self.flushes = 0
 
     # -- file layout (shared with aggregate.py) -------------------------
@@ -75,28 +80,30 @@ class RankFlusher:
 
     def flush_now(self):
         """Write all three per-rank files from the current buffers."""
-        os.makedirs(self.run_dir, exist_ok=True)
-        head = {
-            'rank': self.rank,
-            'pid': os.getpid(),
-            'host': self.host,
-            'ts': round(events.wall_ts(), 6),
-            'metrics': registry.snapshot(),
-            'counters': interpose.summary(),
-            'costs': costs.summary(),
-        }
-        try:
-            self._commit(self.metrics_path,
-                         json.dumps(head, sort_keys=True, default=repr))
-            evs = events.events()
-            self._commit(self.events_path, ''.join(
-                json.dumps(dict(rec, rank=self.rank), sort_keys=True,
-                           default=repr) + '\n' for rec in evs))
-            self._commit(self.trace_path, json.dumps(spans.trace_events()))
-        except OSError:
-            return False   # run dir vanished (supervisor cleanup): benign
-        self.flushes += 1
-        return True
+        with self._flush_lock:
+            os.makedirs(self.run_dir, exist_ok=True)
+            head = {
+                'rank': self.rank,
+                'pid': os.getpid(),
+                'host': self.host,
+                'ts': round(events.wall_ts(), 6),
+                'metrics': registry.snapshot(),
+                'counters': interpose.summary(),
+                'costs': costs.summary(),
+            }
+            try:
+                self._commit(self.metrics_path,
+                             json.dumps(head, sort_keys=True, default=repr))
+                evs = events.events()
+                self._commit(self.events_path, ''.join(
+                    json.dumps(dict(rec, rank=self.rank), sort_keys=True,
+                               default=repr) + '\n' for rec in evs))
+                self._commit(self.trace_path,
+                             json.dumps(spans.trace_events()))
+            except OSError:
+                return False  # run dir vanished (supervisor cleanup): benign
+            self.flushes += 1
+            return True
 
     def _run(self):
         while not self._stop.wait(self.interval):

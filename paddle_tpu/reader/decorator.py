@@ -9,7 +9,7 @@ import random
 import threading
 
 from .. import observability as _obs
-from ..resilience.watchdog import bounded_get
+from ..resilience.watchdog import bounded_get, join_thread
 
 __all__ = ['map_readers', 'shuffle', 'chain', 'buffered', 'compose',
            'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
@@ -104,40 +104,61 @@ def buffered(reader, size):
     def data_reader():
         q = queue.Queue(maxsize=size)
         err = []
+        stop = threading.Event()
+
+        def _post(item):
+            # timed put honoring stop: a consumer that abandons the
+            # generator mid-stream must not strand the producer in a
+            # blocking put on the bounded queue
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def produce():
             try:
                 for e in reader():
-                    q.put(e)
+                    if not _post(e):
+                        return
             except BaseException as ex:   # surface in the consumer
                 err.append(ex)
             finally:
-                q.put(end)
+                _post(end)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
-        while True:
-            # bounded wait (watchdog): the producer posts its sentinel
-            # from a finally block, and the liveness probe catches the one
-            # remaining hang mode (a producer that died uncleanly)
-            if _obs.enabled():
-                # consumer-side starvation signal: how long the training
-                # loop sat waiting on the producer, and how full the
-                # read-ahead buffer is when a sample is taken
-                sw = _obs.Stopwatch()
-                e = bounded_get(q, alive=t.is_alive,
-                                what='buffered reader sample')
-                _obs.histogram('reader.buffered.wait_ms').observe(
-                    sw.elapsed_ms())
-                _obs.gauge('reader.buffered.depth').set(q.qsize())
-            else:
-                e = bounded_get(q, alive=t.is_alive,
-                                what='buffered reader sample')
-            if e is end:
-                if err:
-                    raise err[0]
-                return
-            yield e
+        try:
+            while True:
+                # bounded wait (watchdog): the producer posts its sentinel
+                # from a finally block, and the liveness probe catches the
+                # one remaining hang mode (a producer that died uncleanly)
+                if _obs.enabled():
+                    # consumer-side starvation signal: how long the
+                    # training loop sat waiting on the producer, and how
+                    # full the read-ahead buffer is when a sample is taken
+                    sw = _obs.Stopwatch()
+                    e = bounded_get(q, alive=t.is_alive,
+                                    what='buffered reader sample')
+                    _obs.histogram('reader.buffered.wait_ms').observe(
+                        sw.elapsed_ms())
+                    _obs.gauge('reader.buffered.depth').set(q.qsize())
+                else:
+                    e = bounded_get(q, alive=t.is_alive,
+                                    what='buffered reader sample')
+                if e is end:
+                    if err:
+                        raise err[0]
+                    return
+                yield e
+        finally:
+            stop.set()
+            # the producer sees stop within one put tick; a reader wedged
+            # in user code just times the join out rather than hanging
+            # consumer teardown
+            join_thread(t, timeout=2.0)
 
     return data_reader
 

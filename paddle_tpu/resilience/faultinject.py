@@ -33,7 +33,13 @@ against, on CPU, deterministically:
   serving-replica chaos (siblings of ``kill_rank_at_step``/``slow_rank``):
   abrupt engine death right after admitting the Nth request, a wedged
   scheduler that stays "alive" while nothing progresses, and a per-pump
-  delay producing a deterministic p99 straggler for hedging tests.
+  delay producing a deterministic p99 straggler for hedging tests;
+- ``hold_lock`` / ``RacingCall`` — the forced-interleaving hooks for data-
+  race regression tests (graftlint GC001-class bugs): freeze a writer at
+  its guarded critical section by holding the guard from the test thread,
+  launch the racing call on a side thread with completion observability,
+  assert it blocks, release, assert it lands. Deterministic: the schedule
+  is pinned by the lock itself, not by sleeps.
 
 All injectors are context-managed or idempotent to deactivate, so a failing
 test cannot leak faults into the next one.
@@ -51,7 +57,8 @@ __all__ = ['FaultInjector', 'flaky', 'poison_loss', 'corrupt_file',
            'slow_model', 'slow_loader', 'slow_collective', 'retrace_bait',
            'boot_fail', 'PoisonedSampleError', 'slow_fs', 'disk_full',
            'sigterm_at_step', 'kill_rank_at_step', 'kill_replica_at_request',
-           'hang_replica', 'slow_replica', 'ReplicaHang']
+           'hang_replica', 'slow_replica', 'ReplicaHang', 'hold_lock',
+           'RacingCall']
 
 
 class InjectedWriteError(OSError):
@@ -574,3 +581,57 @@ class PreemptAtStep:
                 self.seen += 1
 
         return _Preempter(step)
+
+
+@contextlib.contextmanager
+def hold_lock(lock):
+    """Freeze every writer that must take ``lock`` — the deterministic
+    interleaving hook for data-race regression tests. Acquire the guard on
+    the test thread, launch the racing call (``RacingCall``), assert it has
+    NOT completed (it is parked at the exact formerly-racy critical
+    section), release, assert it lands. A reverted fix turns the "still
+    blocked" assertion false immediately — no timing luck involved."""
+    lock.acquire()
+    try:
+        yield lock
+    finally:
+        lock.release()
+
+
+class RacingCall:
+    """A call launched on a daemon side thread with completion
+    observability — the other half of ``hold_lock``.
+
+    ``done`` is set when the call finished (result or exception);
+    ``blocked(grace)`` waits ``grace`` seconds and reports True while the
+    call is still parked; ``join()`` waits (watchdog-bounded) and returns
+    the result, re-raising any error from the side thread."""
+
+    def __init__(self, fn, *args, **kwargs):
+        import threading
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+
+        def _run():
+            try:
+                self.result = fn(*args, **kwargs)
+            except BaseException as e:   # re-raised in join()
+                self.error = e
+            finally:
+                self.done.set()
+
+        self._thread = threading.Thread(
+            target=_run, name='paddle-tpu-racing-call', daemon=True)
+        self._thread.start()
+
+    def blocked(self, grace=0.15):
+        """True when the call is still parked after ``grace`` seconds."""
+        return not self.done.wait(grace)
+
+    def join(self, timeout=5.0):
+        from .watchdog import join_thread
+        join_thread(self._thread, timeout=timeout)
+        if self.error is not None:
+            raise self.error
+        return self.result

@@ -358,22 +358,27 @@ class ServingEngine:
             # the doctor must not prescribe replicas for an OOM
             starved = getattr(runner, 'page_starved', lambda: False)()
             e.reason = 'page_exhaustion' if starved else 'queue_full'
-            self._shed += 1
+            with self._lock:
+                # submit() runs on arbitrary client threads while the
+                # endpoint's health probe reads these; += is a racy
+                # read-modify-write without the lock
+                self._shed += 1
+                if e.reason == 'page_exhaustion':
+                    self._shed_page_exhaustion += 1
+                else:
+                    self._shed_queue_full += 1
             _count('serving.shed')
-            if e.reason == 'page_exhaustion':
-                self._shed_page_exhaustion += 1
-                _count('serving.shed.page_exhaustion')
-            else:
-                self._shed_queue_full += 1
-                _count('serving.shed.queue_full')
+            _count('serving.shed.page_exhaustion'
+                   if e.reason == 'page_exhaustion'
+                   else 'serving.shed.queue_full')
             if _obs.enabled():
                 _obs.event('serving.shed', model=model, request=req.id,
                            reason=e.reason)
                 _obs.async_end('request', req.id, cat='serving.request',
                                status='shed', reason=e.reason)
             raise
-        self._submitted += 1
         with self._cond:
+            self._submitted += 1
             if _obs.enabled():
                 _obs.gauge('serving.queue_depth').set(
                     sum(len(q) for q in self._queues.values()))

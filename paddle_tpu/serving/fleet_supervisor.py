@@ -53,6 +53,10 @@ class FleetSupervisor:
         self.warmup = bool(warmup)
         self.relaunch_backoff_s = float(relaunch_backoff_s)
         self.reap_timeout_s = float(reap_timeout_s)
+        # the budget lock makes claim-and-increment atomic: a manual
+        # check_once() racing the background sweep must not both observe
+        # the same count and double-relaunch one replica
+        self._budget_lock = threading.Lock()
         self._restarts = {}            # replica -> relaunch count
         self._exhausted = set()        # emitted fleet.restarts_exhausted
         self._thread = None
@@ -67,10 +71,19 @@ class FleetSupervisor:
             if h.draining or h.engine.dispatchable():
                 continue
             name = h.name
-            used = self._restarts.get(name, 0)
-            if used >= self.max_restarts:
-                if name not in self._exhausted:
+            with self._budget_lock:
+                used = self._restarts.get(name, 0)
+                exhausted = used >= self.max_restarts
+                first_exhaustion = exhausted and name not in self._exhausted
+                if first_exhaustion:
                     self._exhausted.add(name)
+                if not exhausted:
+                    # claim the relaunch slot before doing the (slow,
+                    # unlocked) reap+rebuild so no concurrent sweep
+                    # relaunches the same replica on the same budget
+                    self._restarts[name] = used + 1
+            if exhausted:
+                if first_exhaustion:
                     if _obs.enabled():
                         _obs.counter('fleet.restarts_exhausted').inc()
                         _obs.event('fleet.restarts_exhausted', replica=name,
@@ -92,7 +105,6 @@ class FleetSupervisor:
             engine = self.replica_factory(name)
             if self.warmup and hasattr(engine, 'warmup'):
                 engine.warmup()
-            self._restarts[name] = used + 1
             self.router.readmit(name, engine=engine, warm=False)
             recovery_ms = sw.elapsed_ms()
             if _obs.enabled():
@@ -123,7 +135,8 @@ class FleetSupervisor:
 
     def restarts(self):
         """{replica: relaunch count} so far."""
-        return dict(self._restarts)
+        with self._budget_lock:
+            return dict(self._restarts)
 
     # -- background mode ------------------------------------------------
     def start(self):

@@ -285,6 +285,10 @@ class ReplicaHandle:
             half_open_probes=policy.half_open_probes)
         self.draining = False
         self.drained = False
+        # the ledger lock serializes counter bumps: result() drives the
+        # retry/hedge machine on arbitrary client threads, so different
+        # requests' drivers race on this one handle's counters
+        self._ledger = threading.Lock()
         self.dispatched = 0
         self.completed = 0
         self.failed = 0
@@ -310,14 +314,22 @@ class ReplicaHandle:
             return False
         return self.breaker.allow()
 
+    def bump(self, counter, n=1):
+        """Atomically increment one dispatch-ledger counter."""
+        with self._ledger:
+            setattr(self, counter, getattr(self, counter) + n)
+
     def stats_row(self):
-        return {'dispatched': self.dispatched, 'completed': self.completed,
-                'failed': self.failed, 'retried': self.retried,
-                'hedged': self.hedged, 'hedge_wins': self.hedge_wins,
-                'drained': self.drained_requests,
-                'queue_full': self.queue_full, 'deaths': self.deaths,
-                'restarts': self.restarts, 'circuit': self.breaker.state,
-                'trips': self.breaker.trips, 'draining': self.draining}
+        with self._ledger:
+            return {'dispatched': self.dispatched,
+                    'completed': self.completed,
+                    'failed': self.failed, 'retried': self.retried,
+                    'hedged': self.hedged, 'hedge_wins': self.hedge_wins,
+                    'drained': self.drained_requests,
+                    'queue_full': self.queue_full, 'deaths': self.deaths,
+                    'restarts': self.restarts,
+                    'circuit': self.breaker.state,
+                    'trips': self.breaker.trips, 'draining': self.draining}
 
 
 class _FleetRequest:
@@ -530,7 +542,7 @@ class FleetRouter:
             except QueueFullError as e:
                 # backed-up replica: a health signal, not a breaker trip —
                 # the queue-depth gate handles persistent backlog
-                h.queue_full += 1
+                h.bump('queue_full')
                 exclude.add(h.name)
                 if _obs.enabled():
                     _obs.event('serving.router.queue_full', fleet=fr.id,
@@ -541,12 +553,12 @@ class FleetRouter:
                 exclude.add(h.name)
                 continue
             h.breaker.on_dispatch()
-            h.dispatched += 1
+            h.bump('dispatched')
             if kind == 'retry':
-                h.retried += 1
+                h.bump('retried')
                 fr.retries_used += 1
             elif kind == 'hedge':
-                h.hedged += 1
+                h.bump('hedged')
             fr.tried.append(h.name)
             attempt = _Attempt(h, pending, kind)
             fr.attempts.append(attempt)
@@ -640,7 +652,7 @@ class FleetRouter:
         first = h.breaker.state != CIRCUIT_OPEN
         h.breaker.trip('replica_death')
         if first:
-            h.deaths += 1
+            h.bump('deaths')
             if _obs.enabled():
                 _obs.counter('serving.router.replica_death').inc()
                 _obs.event('serving.router.replica_death', replica=h.name,
@@ -651,7 +663,7 @@ class FleetRouter:
         if attempt in fr.attempts:
             fr.attempts.remove(attempt)
         h = attempt.handle
-        h.failed += 1
+        h.bump('failed')
         if why == 'replica_death':
             self._replica_died(h, fleet=fr.id)
         else:
@@ -687,10 +699,10 @@ class FleetRouter:
                 _obs.counter('serving.router.hedge_cancelled' if cancelled
                              else 'serving.router.hedge_wasted').inc()
         fr.attempts.clear()
-        h.completed += 1
+        h.bump('completed')
         h.breaker.record_success()
         if winner.kind == 'hedge':
-            h.hedge_wins += 1
+            h.bump('hedge_wins')
             if _obs.enabled():
                 _obs.counter('serving.router.hedge_wins',
                              labels={'replica': h.name}).inc()
@@ -856,7 +868,7 @@ class FleetRouter:
             else:
                 time.sleep(_POLL_TICK)
         h.drained = True
-        h.drained_requests += pending
+        h.bump('drained_requests', pending)
         if _obs.enabled():
             _obs.counter('serving.router.drained',
                          labels={'replica': name}).inc()
@@ -876,7 +888,7 @@ class FleetRouter:
         h = self.replica(name)
         if engine is not None:
             h.engine = engine
-            h.restarts += 1
+            h.bump('restarts')
         h.draining = False
         h.drained = False
         if warm:

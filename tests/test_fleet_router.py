@@ -549,3 +549,53 @@ class TestFleetTelemetry:
         assert 'retry_storm' in doc.DETECTORS
         assert doc.DETECTORS['replica_flapping'] is doc.detect_replica_flapping
         assert doc.DETECTORS['retry_storm'] is doc.detect_retry_storm
+
+
+class TestFleetConcurrencyRegressions:
+    """Forced-interleaving regressions for the GC001 findings the
+    concurrency linter surfaced in the fleet fabric. Schedules are pinned
+    by faultinject.hold_lock / RacingCall, never by sleeps."""
+
+    def test_supervisor_claims_restart_budget_exactly_once(self):
+        # two sweeps race over one corpse: the budget claim is atomic, so
+        # exactly one sweep relaunches and the factory runs exactly once
+        import threading
+        router, engines = _fleet(1)
+        engines[0].kill()
+        release = threading.Event()
+        calls = []
+
+        def parked_factory(name):
+            calls.append(name)
+            release.wait(5)
+            return _engine()
+
+        sup = FleetSupervisor(router, parked_factory, max_restarts=1,
+                              relaunch_backoff_s=0.0)
+        racer = fi.RacingCall(sup.check_once)
+        assert racer.blocked(), "sweep did not park in the factory"
+        # the racing sweep already claimed the only budget slot: a
+        # concurrent sweep must see it spent, not relaunch again
+        assert sup.check_once() == []
+        assert calls == ['r0']
+        release.set()
+        assert racer.join() == ['r0']
+        assert calls == ['r0']
+        assert sup.restarts() == {'r0': 1}
+        assert router.replica('r0').engine.dispatchable()
+        router.replica('r0').engine.kill()
+
+    def test_replica_ledger_bump_serialized(self):
+        router, engines = _fleet(1)
+        try:
+            h = router.replica('r0')
+            with fi.hold_lock(h._ledger):
+                racer = fi.RacingCall(h.bump, 'dispatched')
+                assert racer.blocked(), "bump ran outside the ledger lock"
+                assert h.dispatched == 0
+            racer.join()
+            assert h.dispatched == 1
+            assert h.stats_row()['dispatched'] == 1
+        finally:
+            for eng in engines:
+                eng.kill()

@@ -593,3 +593,26 @@ def test_overhead_disabled_smoke():
     # and nothing was started or written
     assert obs.endpoint.active_server() is None
     assert obs.flush.active_flusher() is None
+
+
+def test_flush_now_serialized_and_counted_exactly(tmp_path):
+    """Regression for the GC001/GC003-adjacent race in RankFlusher: a
+    manual flush_now() racing the daemon flush collided on the same
+    pid-suffixed staging file and tore the flushes tally. Whole flushes
+    now serialize on _flush_lock; the interleaving is forced with
+    faultinject.hold_lock, not timed."""
+    fl = obs.flush.RankFlusher(str(tmp_path), rank=3, interval=60)
+    with fi.hold_lock(fl._flush_lock):
+        racer = fi.RacingCall(fl.flush_now)
+        assert racer.blocked(), "flush_now ran outside _flush_lock"
+        # nothing committed while the flush in 'flight' owns the lock
+        assert fl.flushes == 0
+        assert not (tmp_path / 'telemetry_rank3.json').exists()
+    assert racer.join() is True
+    assert fl.flushes == 1
+    assert (tmp_path / 'telemetry_rank3.json').exists()
+    # a second concurrent pair lands exactly once each, no lost update
+    a = fi.RacingCall(fl.flush_now)
+    b = fi.RacingCall(fl.flush_now)
+    assert a.join() is True and b.join() is True
+    assert fl.flushes == 3

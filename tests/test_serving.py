@@ -592,3 +592,44 @@ class TestServingTelemetry:
         s = eng.stats()
         assert s['submitted'] == 1
         assert s['models']['m']['completed'] == 1
+
+
+class TestShedCounterRace:
+    """Regression for the GC001 finding on ServingEngine's shed tallies:
+    submit() runs on arbitrary client threads while stats()/health probes
+    read the counters, so the += sites must sit under engine._lock. The
+    schedule is forced with faultinject.hold_lock — no sleep-and-hope."""
+
+    def test_shed_accounting_serialized_under_engine_lock(self):
+        eng = ServingEngine(queue_capacity=1)
+        ep = eng.register('s', predict_fn=_mlp_fn(
+            np.eye(8, dtype=np.float32)), example=_example(),
+            bucket_spec=BucketSpec((1,)))
+        ep.submit(_example())   # fill the admission queue
+        with fi.hold_lock(eng._lock):
+            # the racing submit sheds immediately (queue full) and must
+            # park at the counter critical section while we own the guard
+            racer = fi.RacingCall(ep.submit, _example())
+            assert racer.blocked(), \
+                "shed accounting ran outside engine._lock"
+        with pytest.raises(QueueFullError):
+            racer.join()
+        s = eng.stats()
+        assert s['shed'] == 1
+        assert s['shed_queue_full'] == 1
+        assert s['shed_page_exhaustion'] == 0
+        eng.run_until_idle()
+
+    def test_submitted_counter_serialized_under_engine_lock(self):
+        eng = ServingEngine(queue_capacity=4)
+        ep = eng.register('s', predict_fn=_mlp_fn(
+            np.eye(8, dtype=np.float32)), example=_example(),
+            bucket_spec=BucketSpec((1,)))
+        with fi.hold_lock(eng._lock):
+            # _cond wraps _lock, so the post-admission bookkeeping parks
+            racer = fi.RacingCall(ep.submit, _example())
+            assert racer.blocked(), \
+                "submitted bookkeeping ran outside engine._cond"
+        racer.join()
+        assert eng.stats()['submitted'] == 1
+        eng.run_until_idle()
