@@ -1082,6 +1082,12 @@ def _cluster_rank_worker():
         with obs.timer('hapi.step', step=i) as t:
             step()
         obs.event('step', step=i, step_ms=round(t.elapsed_ms, 3))
+        # tick the time-series ring per step: the launch-started sampler's
+        # wall-clock cadence (1s) would see at most one sample in a run
+        # this short, and the trend detectors need a real timeline
+        sm = obs.timeseries.active_sampler()
+        if sm is not None:
+            sm.sample_now()
     return int(os.environ.get('PADDLE_TRAINER_ID', '0'))
 
 
@@ -1106,6 +1112,7 @@ def bench_cluster_telemetry(nprocs=4):
         snap = obs.aggregate.cluster_snapshot(run_dir)
         diagnoses = obs.diagnose(
             events=obs.aggregate.merged_events(run_dir), cluster=snap)
+        ts = snap.get('timeseries') or {}
         return {
             'n_ranks': snap['n_ranks'],
             'step_ms_skew': snap['step_ms_skew'],
@@ -1114,6 +1121,16 @@ def bench_cluster_telemetry(nprocs=4):
                 for r, row in sorted(snap['per_rank'].items())},
             'diagnoses': [{'cause': d['cause'], 'severity': d['severity'],
                            'detail': d['detail']} for d in diagnoses],
+            # in-run time series (ISSUE 18): per-rank sample counts + the
+            # merged series inventory, proving the sampler rode the
+            # flusher on every rank
+            'timeseries': {
+                'n_series': len(ts.get('series') or {}),
+                'samples_per_rank': {
+                    r: row.get('n_samples', 0)
+                    for r, row in sorted((ts.get('per_rank')
+                                          or {}).items())},
+            },
         }
     finally:
         for k, v in saved.items():
@@ -1122,6 +1139,33 @@ def bench_cluster_telemetry(nprocs=4):
             else:
                 os.environ[k] = v
         shutil.rmtree(run_dir, ignore_errors=True)
+
+
+def _config_fingerprint():
+    """Config identity for the cross-run registry: a short hash over the
+    sorted ``PADDLE_TPU_*`` knobs, so perfwatch compares a run against
+    prior runs of the SAME config (a batch-size override is a config
+    change, not a regression). The registry-path knob itself is excluded
+    — pointing the registry elsewhere must not fork the baseline."""
+    import hashlib
+    knobs = sorted((k, v) for k, v in os.environ.items()
+                   if k.startswith('PADDLE_TPU_')
+                   and k != 'PADDLE_TPU_RUNS_REGISTRY')
+    return hashlib.sha1(repr(knobs).encode()).hexdigest()[:12]
+
+
+def _record_bench_run(kind, metrics):
+    """Append one summary record to the cross-run ``runs.jsonl`` registry
+    (ISSUE 18). Best-effort: the sentinel must never sink a bench."""
+    try:
+        from paddle_tpu.observability import baseline
+        return baseline.record_run({
+            'run': kind,
+            'fingerprint': _config_fingerprint(),
+            'metrics': metrics,
+        })
+    except Exception:
+        return None
 
 
 def _env_batch(var, default):
@@ -1675,24 +1719,39 @@ def _child_main(mode, model):
             elastic_extras = bench_elastic()
         except Exception as e:       # elastic bench must never sink smoke
             elastic_extras = {'error': repr(e)}
+        extras = {"telemetry": telemetry,
+                  "serving": serving_extras,
+                  # fleet fabric (ISSUE 16): kill-survival error
+                  # rate, recovery ms, hedged-tail p99
+                  "fleet": fleet_extras,
+                  "engine": engine_extras,
+                  "sharding": sharding_extras,
+                  # elastic training (ISSUE 14): save-stall p50s +
+                  # rank-death chaos soak with downsize + resume
+                  "elastic": elastic_extras,
+                  # cost explorer (ISSUE 13): every program the run
+                  # compiled, with FLOPs/bytes/peak + roofline bound
+                  "costs": costs_extras,
+                  # in-run time series (ISSUE 18): sampler coverage of
+                  # the 4-rank mission-control spawn above
+                  "timeseries": (telemetry.get('cluster') or {}).get(
+                      'timeseries', {})}
+        # cross-run sentinel (ISSUE 18): one summary record per smoke
+        # round into runs.jsonl — tools/perfwatch.py compares the next
+        # round against the rolling median of these
+        extras["runs_registry"] = _record_bench_run('smoke', {
+            'samples_per_sec': round(sps, 2),
+            'serving': serving_extras,
+            'fleet': fleet_extras,
+            'engine': engine_extras,
+            'elastic': elastic_extras,
+        })
         print(json.dumps({
             "metric": "bert_smoke_cpu_samples_per_sec",
             "value": round(sps, 2),
             "unit": "samples/sec",
             "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 4),
-            "extras": {"telemetry": telemetry,
-                       "serving": serving_extras,
-                       # fleet fabric (ISSUE 16): kill-survival error
-                       # rate, recovery ms, hedged-tail p99
-                       "fleet": fleet_extras,
-                       "engine": engine_extras,
-                       "sharding": sharding_extras,
-                       # elastic training (ISSUE 14): save-stall p50s +
-                       # rank-death chaos soak with downsize + resume
-                       "elastic": elastic_extras,
-                       # cost explorer (ISSUE 13): every program the run
-                       # compiled, with FLOPs/bytes/peak + roofline bound
-                       "costs": costs_extras},
+            "extras": extras,
             "complete": True,
         }))
 

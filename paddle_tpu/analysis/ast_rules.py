@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL019).
+"""The graftlint AST rule catalog (GL001–GL020).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -75,6 +75,17 @@ but destroys performance or correctness on real hardware:
   the exception type, re-raise after bookkeeping, or at minimum emit the
   failure (``observability.event()``/``counter().inc()``/logger) inside
   the handler (tests/tools/bench harnesses exempt).
+
+- GL020: unbounded in-memory accumulation in library code — a module-level
+  or instance container born as a bare ``[]``/``{}`` and grown by
+  ``.append``/``.setdefault`` inside a loop or callback with no bounding
+  spelling (``deque(maxlen=)``, ``pop``/``popleft``/``popitem``/
+  ``clear``, ``del X[...]``, slice rotation, or a ``len(X)`` guard)
+  anywhere in its scope. In a long-lived process (serving engine, rank
+  flusher, soak run) that container grows with UPTIME, not workload —
+  the slow-leak class the doctor's trend detectors catch at runtime,
+  caught here statically. Bounded rings like ``observability.timeseries``
+  are the sanctioned shape (tests/tools/bench harnesses exempt).
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -1492,3 +1503,224 @@ class SilentLoopSwallowRule(Rule):
                                if not isinstance(handler.type, ast.Tuple)
                                else '(...)'))
                        if handler.type is not None else ''))
+
+
+# -- GL020: unbounded in-memory accumulation in library code ------------------
+
+# growth spellings on a long-lived container
+_GROW_TAILS = {'append', 'setdefault'}
+# bounding spellings: any of these on the same container sanctions it
+_BOUND_TAILS = {'pop', 'popleft', 'popitem', 'clear'}
+
+
+def _container_key(expr):
+    """Identity of the container an ``.append``/``.setdefault`` grows:
+    ``('g', name)`` for a Name-rooted chain (module global or local),
+    ``('s', attr)`` for ``self.<attr>``; None otherwise. Unwraps chained
+    calls/subscripts so ``_REG.setdefault(k, []).append(x)`` and
+    ``_REG[k].append(x)`` both key on ``_REG``."""
+    while True:
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        elif isinstance(expr, ast.Subscript):
+            expr = expr.value
+        elif isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == 'self':
+                return ('s', expr.attr)
+            expr = expr.value
+        elif isinstance(expr, ast.Name):
+            return ('g', expr.id)
+        else:
+            return None
+
+
+def _is_bare_container(node):
+    """An empty ``[]`` / ``{}`` literal — the unbounded starting state
+    (``deque(maxlen=...)``, an LRU class, or a pre-sized ring never
+    match, so those spellings are sanctioned by construction)."""
+    return ((isinstance(node, ast.List) and not node.elts)
+            or (isinstance(node, ast.Dict) and not node.keys))
+
+
+def _has_bound(scope, key, init_nodes):
+    """True when ``scope`` shows ANY bounding/rotation spelling for the
+    container ``key``: an eviction call (``pop``/``popleft``/``popitem``/
+    ``clear``), ``del X[...]``, a slice rewrite (``X[:] = X[-k:]``), a
+    ``len(X)`` comparison guarding an ``if``/``while``, or a reassignment
+    of the name outside its init (rotation/reset — also triggered by a
+    shadowing local, which keeps the rule conservative)."""
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _BOUND_TAILS \
+                and _container_key(n.func.value) == key:
+            return True
+        if isinstance(n, ast.Delete):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript) \
+                        and _container_key(t.value) == key:
+                    return True
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.slice, ast.Slice) \
+                        and _container_key(t.value) == key:
+                    return True
+                if key[0] == 'g' and isinstance(t, ast.Name) \
+                        and t.id == key[1] and n not in init_nodes:
+                    return True
+                if key[0] == 's' and isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == 'self' and t.attr == key[1] \
+                        and n not in init_nodes:
+                    return True
+        if isinstance(n, (ast.If, ast.While)):
+            for m in ast.walk(n.test):
+                if isinstance(m, ast.Call) \
+                        and isinstance(m.func, ast.Name) \
+                        and m.func.id == 'len' and m.args \
+                        and _container_key(m.args[0]) == key:
+                    return True
+    return False
+
+
+@register
+class UnboundedAccumulationRule(Rule):
+    """GL020: unbounded in-memory accumulation in library code — a
+    module-level or instance container born as a bare ``[]``/``{}`` and
+    grown by ``.append``/``.setdefault`` inside a loop or callback with
+    no bounding spelling anywhere in its scope. In a long-lived process
+    (a serving engine, a rank flusher, a multi-day soak) that container
+    IS a memory leak: it grows with uptime, not workload, until the rank
+    OOMs — typically days after the PR that added it. Fix-it: make the
+    bound structural (``collections.deque(maxlen=...)``, a ring like
+    ``observability.timeseries``, an LRU) or evict explicitly
+    (``pop``/``del``/slice rotation) behind a ``len()`` check."""
+    id = 'GL020'
+    title = 'unbounded in-memory accumulation in library code'
+
+    def in_scope(self, rel):
+        if any(rel == p or rel.startswith(p)
+               for p in _SWALLOW_EXEMPT_PREFIXES):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        tree = ctx.tree
+        # growth is repeated when it sits in a loop or in a callback
+        # (an ``on_*`` hook runs once per step/event — a loop in time)
+        in_loop, in_while = set(), set()
+        for loop in ast.walk(tree):
+            if isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                for n in ast.walk(loop):
+                    if n is not loop:
+                        in_loop.add(id(n))
+                        if isinstance(loop, ast.While):
+                            in_while.add(id(n))
+        encl_fn = {}
+        for f in ast.walk(tree):
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for n in ast.walk(f):
+                    encl_fn[id(n)] = f.name   # BFS: innermost wins
+        grows = []
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _GROW_TAILS:
+                key = _container_key(n.func.value)
+                if key is not None:
+                    grows.append((n, key))
+
+        def repeated(node, instance=False):
+            # A module-level global outlives every call, so growth inside
+            # any loop accumulates across calls — time-proportional. An
+            # instance attribute grown in a plain ``for`` over given
+            # input is usually workload-proportional (a builder); only a
+            # ``while`` loop (uptime loop) or an ``on_*`` hook (runs once
+            # per step/event — a loop in time) marks it as a leak.
+            fname = encl_fn.get(id(node), '')
+            if fname.startswith('on_') or fname.startswith('_on_'):
+                return True
+            return id(node) in (in_while if instance else in_loop)
+
+        # one finding per (container, line): `d.setdefault(k, []).append(e)`
+        # is two grow tails on the same container, not two leaks
+        seen = set()
+
+        def fresh(key, node):
+            mark = (key, node.lineno)
+            if mark in seen:
+                return False
+            seen.add(mark)
+            return True
+
+        # module-level candidates: NAME = [] / {} at module top level
+        mod_cands = {}
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and _is_bare_container(stmt.value):
+                mod_cands.setdefault(('g', stmt.targets[0].id),
+                                     []).append(stmt)
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name) \
+                    and stmt.value is not None \
+                    and _is_bare_container(stmt.value):
+                mod_cands.setdefault(('g', stmt.target.id),
+                                     []).append(stmt)
+        for key, inits in mod_cands.items():
+            if _has_bound(tree, key, set(inits)):
+                continue
+            for n, k in grows:
+                if k == key and repeated(n) and fresh(key, n):
+                    yield self.finding(
+                        ctx, n,
+                        f"module-level `{key[1]}` starts as a bare "
+                        "container and grows in a loop/callback with no "
+                        "bound or rotation anywhere in the module — in a "
+                        "long-lived process this accumulates with uptime "
+                        "until the rank OOMs; use collections.deque("
+                        "maxlen=...), a ring (see observability."
+                        "timeseries), an LRU, or evict behind a len() "
+                        "check")
+        # instance candidates: self.x = [] / {} in __init__, grown in a
+        # loop or on_* callback method of the same class
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            init = next((f for f in cls.body
+                         if isinstance(f, ast.FunctionDef)
+                         and f.name == '__init__'), None)
+            if init is None:
+                continue
+            cls_nodes = {id(n) for n in ast.walk(cls)}
+            attr_cands = {}
+            for stmt in ast.walk(init):
+                if isinstance(stmt, ast.Assign) \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Attribute) \
+                        and isinstance(stmt.targets[0].value, ast.Name) \
+                        and stmt.targets[0].value.id == 'self' \
+                        and _is_bare_container(stmt.value):
+                    attr_cands.setdefault(('s', stmt.targets[0].attr),
+                                          []).append(stmt)
+            for key, inits in attr_cands.items():
+                if _has_bound(cls, key, set(inits)):
+                    continue
+                for n, k in grows:
+                    if k == key and id(n) in cls_nodes \
+                            and repeated(n, instance=True) \
+                            and fresh(key, n):
+                        yield self.finding(
+                            ctx, n,
+                            f"`self.{key[1]}` starts as a bare container "
+                            f"in {cls.name}.__init__ and grows in a "
+                            "loop/callback with no bound or rotation "
+                            "anywhere in the class — a long-lived "
+                            "instance (engine, flusher, sampler) "
+                            "accumulates with uptime until the process "
+                            "OOMs; use collections.deque(maxlen=...), a "
+                            "ring (see observability.timeseries), an "
+                            "LRU, or evict behind a len() check")

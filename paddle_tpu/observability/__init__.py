@@ -36,6 +36,7 @@ from . import events as _events
 from . import interpose, registry, spans, state, timing  # noqa: F401
 from . import aggregate, doctor, endpoint, flush  # noqa: F401  mission ctl
 from . import costs, flight, slo  # noqa: F401  cost explorer + black box
+from . import baseline, timeseries  # noqa: F401  time series + sentinel
 from .state import enable, disable, enabled, log_dir, sync_every
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry, counter, gauge, histogram, snapshot,
@@ -77,18 +78,21 @@ __all__ = [
     'diagnose', 'run_doctor',
     # cost explorer + SLO tracker + flight recorder
     'costs', 'slo', 'flight',
+    # time series + cross-run regression sentinel
+    'baseline', 'timeseries',
 ]
 
 
 def reset():
     """Clear every buffer (metrics, events, spans, cost ledger, SLO
-    tallies, flight ring) — test isolation hook."""
+    tallies, flight ring, time-series ring) — test isolation hook."""
     reset_metrics()
     _events.clear()
     spans.clear()
     costs.reset()
     slo.reset()
     flight.clear()
+    timeseries.clear()
 
 
 def __getattr__(name):

@@ -15,6 +15,11 @@ files) and merges them into:
   ONE LANE PER RANK (rank = pid row, named ``rank <R> (host:pid)``), so a
   slow collective or straggling rank is visible as skewed lanes instead of
   a hang.
+- ``merged_timeseries(run_dir)`` — per-series timelines merged from every
+  rank's ``timeseries_rank<R>.json`` ring-sampler export (also embedded in
+  the cluster snapshot under ``timeseries``): the trend evidence the
+  doctor's ``page_leak`` / ``latency_creep`` / ``qps_collapse`` /
+  ``compile_creep`` detectors read.
 - ``write_merged(run_dir)`` — commits all three artifacts
   (``cluster_snapshot.json`` / ``merged_events.jsonl`` /
   ``merged_trace.json``) back into the run dir.
@@ -30,10 +35,13 @@ import time
 
 __all__ = ['rank_files', 'load_rank_snapshots', 'heartbeat_ages',
            'cluster_snapshot', 'merged_events', 'merged_chrome_trace',
-           'flight_dumps', 'write_merged']
+           'merged_timeseries', 'flight_dumps', 'write_merged']
 
 _RANK_FILE_RE = re.compile(
-    r'^(telemetry|events|trace|flight)_rank(\d+)\.(json|jsonl)$')
+    r'^(telemetry|events|trace|flight|timeseries)_rank(\d+)\.(json|jsonl)$')
+
+#: histogram stats carried per time-series sample (mirrors timeseries.py)
+_TS_HIST_KEYS = ('p50', 'p99', 'count')
 
 
 def rank_files(run_dir):
@@ -153,6 +161,9 @@ def cluster_snapshot(run_dir):
         # flight_rank<R>.json a dying rank left behind — a rank may have a
         # dump and NO telemetry head (telemetry off, flight always-on)
         'flight_dumps': flights,
+        # per-series timelines from the ring sampler (empty series dict
+        # when no rank wrote a timeseries file — sampler off or old run)
+        'timeseries': merged_timeseries(run_dir),
     }
 
 
@@ -176,6 +187,63 @@ def flight_dumps(run_dir):
                                 'message': exc.get('message')}
         out[rank] = row
     return out
+
+
+def merged_timeseries(run_dir):
+    """Per-series timelines merged from every rank's
+    ``timeseries_rank<R>.json`` (the ring sampler's delta-encoded export).
+
+    Returns ``{'sample_every', 'per_rank': {rank: {'n_samples',
+    'span_s'}}, 'series': {'counter:<name>'|'gauge:<name>'|
+    'hist:<name>:<stat>': {rank: [[ts, value], ...]}}}`` — the shape the
+    doctor's trend detectors and ``telemetry_dump --timeline`` consume.
+    Counter timelines carry reconstructed cumulative totals
+    (``counters_base + cumsum(deltas)``) and are dense: a sample with no
+    delta still contributes its unchanged point, because a qps cliff IS
+    the run of flat points. (Logic duplicated from ``timeseries.to_series``
+    — this module stays standalone / importable by path.)"""
+    series, per_rank, sample_every = {}, {}, None
+    for rank, files in sorted(rank_files(run_dir).items()):
+        path = files.get('timeseries')
+        if not path:
+            continue
+        doc = _load_json(path)
+        if not isinstance(doc, dict):
+            continue
+        samples = [s for s in (doc.get('samples') or [])
+                   if isinstance(s, dict)]
+        if sample_every is None and doc.get('sample_every'):
+            sample_every = doc['sample_every']
+        ts_list = [s.get('ts', 0) for s in samples]
+        per_rank[rank] = {
+            'n_samples': len(samples),
+            'span_s': round(max(ts_list) - min(ts_list), 3)
+            if len(ts_list) > 1 else 0.0,
+        }
+        cum = {k: v for k, v in (doc.get('counters_base') or {}).items()
+               if isinstance(v, (int, float))}
+        for s in samples:
+            ts = s.get('ts', 0)
+            for name, d in (s.get('counters') or {}).items():
+                if isinstance(d, (int, float)):
+                    cum[name] = cum.get(name, 0) + d
+            for name, total in cum.items():
+                series.setdefault(f'counter:{name}', {}) \
+                    .setdefault(rank, []).append([ts, total])
+            for name, v in (s.get('gauges') or {}).items():
+                if isinstance(v, (int, float)):
+                    series.setdefault(f'gauge:{name}', {}) \
+                        .setdefault(rank, []).append([ts, v])
+            for name, st in (s.get('histograms') or {}).items():
+                if not isinstance(st, dict):
+                    continue
+                for k in _TS_HIST_KEYS:
+                    v = st.get(k)
+                    if isinstance(v, (int, float)):
+                        series.setdefault(f'hist:{name}:{k}', {}) \
+                            .setdefault(rank, []).append([ts, v])
+    return {'sample_every': sample_every, 'per_rank': per_rank,
+            'series': series}
 
 
 def merged_events(run_dir):

@@ -15,7 +15,7 @@ import os
 
 from ..hapi.callbacks import Callback
 from . import (doctor, endpoint, events, flight, flush, interpose, registry,
-               spans, state, timing)
+               spans, state, timeseries, timing)
 
 __all__ = ['TelemetryCallback']
 
@@ -35,6 +35,7 @@ class TelemetryCallback(Callback):
         self._train_sw = None
         self._steps_per_sec = None
         self._own_flusher = False
+        self._own_sampler = False
 
     def _dir(self):
         return self.log_dir or state.log_dir()
@@ -57,6 +58,12 @@ class TelemetryCallback(Callback):
         had = flush.active_flusher() is not None
         self._own_flusher = (flush.start_rank_flusher() is not None
                              and not had)
+        # the ring sampler runs for every telemetry-on fit (not just
+        # supervised cluster runs): live /timeseries and the doctor's
+        # trend detectors want timelines even single-process
+        had_sampler = timeseries.active_sampler() is not None
+        self._own_sampler = (timeseries.start_sampler() is not None
+                             and not had_sampler)
         endpoint.maybe_start_from_env()
         events.emit('train_begin', epochs=self.params.get('epochs'),
                     steps=self.params.get('steps'))
@@ -160,6 +167,12 @@ class TelemetryCallback(Callback):
         # final per-rank flush so the aggregator sees the whole fit; the
         # flusher is only torn down when this fit started it (a spawn
         # worker's flusher outlives the fit — launch._worker owns it)
+        sm = timeseries.active_sampler()
+        if sm is not None:
+            sm.sample_now()   # the run's tail lands in the ring
+            if self._own_sampler:
+                timeseries.stop_sampler()
+                self._own_sampler = False
         fl = flush.active_flusher()
         if fl is not None:
             if self._own_flusher:

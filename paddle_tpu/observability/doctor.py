@@ -67,6 +67,32 @@ Detector catalog (docs/OBSERVABILITY.md has the operator version):
                       recorded in graftlint.toml (info — the lint gate
                       still passes; this flags the creeping debt).
 
+Trend detectors (need the ring sampler's timelines — ``timeseries`` in the
+cluster snapshot, via ``aggregate.merged_timeseries``; quiet without them):
+
+- ``page_leak``       KV page utilization grows monotonically while
+                      occupancy (active slots) stays flat — pages are
+                      allocated and never freed; a point snapshot shows
+                      "high utilization", only the timeline shows it never
+                      coming back down.
+- ``latency_creep``   request p99 rises steadily over the run (last third
+                      vs first third) — degradation too slow for any
+                      single snapshot to flag.
+- ``qps_collapse``    throughput cliff: the trailing window's per-sample
+                      request rate collapsed vs the run median. The dense
+                      counter timelines make the cliff visible — a stall
+                      IS the run of flat cumulative points.
+- ``compile_creep``   ``jax.compiles`` starts growing again after the
+                      warmup plateau — the time-resolved upgrade of
+                      ``retrace_storm`` (which needs compiles/steps to
+                      already look bad in aggregate; this fires on the
+                      inflection).
+- ``perf_regression`` the latest run in the cross-run registry
+                      (``runs.jsonl``, see ``baseline.py`` /
+                      ``tools/perfwatch.py``) regressed vs the rolling
+                      median + MAD of prior runs, direction-aware (qps
+                      down = bad, latency/stall up = bad).
+
 Ranked output: ``critical`` > ``warning`` > ``info``. Standalone on
 purpose — stdlib-only, importable by path — so ``tools/doctor.py`` works
 with no jax installed. When imported as part of the package,
@@ -93,6 +119,18 @@ CHECKPOINT_STALL_RATIO = 0.25  # mean save stall / mean step time
 FLAP_OPENS = 4                 # circuit opens per window = flapping
 RETRY_STORM_RATIO = 0.2        # router retries / offered requests
 RETRY_STORM_MIN = 10           # offered requests before the ratio counts
+# trend-detector tunables (need the ring sampler's timelines)
+PAGE_LEAK_MIN_SAMPLES = 5      # utilization points before a leak can fire
+PAGE_LEAK_GROWTH = 0.1         # absolute utilization growth start -> end
+PAGE_LEAK_OCCUPANCY_RANGE = 0.25   # active-slots rel. range still "stable"
+PAGE_LEAK_CRITICAL_UTIL = 0.9  # last utilization point => critical
+LATENCY_CREEP_MIN_SAMPLES = 6
+LATENCY_CREEP_RATIO = 1.5      # last-third mean p99 / first-third mean
+QPS_COLLAPSE_MIN_SAMPLES = 6
+QPS_COLLAPSE_RATIO = 0.3       # trailing-window rate / run median rate
+QPS_COLLAPSE_WINDOW = 3        # samples in the trailing window
+COMPILE_CREEP_PLATEAU = 3      # consecutive zero-delta samples = warmed up
+COMPILE_CREEP_GRACE = 3        # post-plateau compiles tolerated
 
 
 def _labeled(section, prefix, key='model'):
@@ -727,6 +765,262 @@ def detect_lint_debt(events=None, snapshot=None, cluster=None,
         threshold=int(lint_debt_threshold))
 
 
+# -- trend detectors (ring-sampler timelines) -------------------------------
+
+def _series(snapshot=None, cluster=None):
+    """Per-series timelines (``aggregate.merged_timeseries`` shape) from
+    the cluster snapshot, falling back to any ``timeseries`` block on the
+    plain snapshot. Empty dict when the run has no sampler output — every
+    trend detector is quiet then."""
+    for doc in (cluster, snapshot):
+        ts = (doc or {}).get('timeseries')
+        if isinstance(ts, dict) and isinstance(ts.get('series'), dict):
+            return ts['series']
+    return {}
+
+
+def _timelines(entry):
+    """``(rank, [(ts, value), ...])`` per rank from one series entry —
+    ranks come back as strings after a JSON round trip, values must be
+    numeric pairs."""
+    for rank, tl in sorted((entry or {}).items(), key=lambda kv: str(kv[0])):
+        try:
+            rank = int(rank)
+        except (TypeError, ValueError):
+            pass
+        pts = [(p[0], p[1]) for p in (tl or [])
+               if isinstance(p, (list, tuple)) and len(p) == 2
+               and isinstance(p[1], (int, float))]
+        if pts:
+            yield rank, pts
+
+
+def detect_page_leak(events=None, snapshot=None, cluster=None,
+                     page_leak_min_samples=PAGE_LEAK_MIN_SAMPLES,
+                     page_leak_growth=PAGE_LEAK_GROWTH,
+                     page_leak_occupancy_range=PAGE_LEAK_OCCUPANCY_RANGE,
+                     **_):
+    """KV page utilization climbing monotonically while occupancy stays
+    flat: pages are allocated and never freed. A point snapshot only says
+    "utilization is high" — the timeline shows it never comes back down
+    even though the engine is serving the same number of sequences."""
+    series = _series(snapshot, cluster)
+    util = series.get('gauge:serving.kv.page_utilization') or {}
+    slots = dict(_timelines(series.get('gauge:serving.active_slots') or {}))
+    for rank, tl in _timelines(util):
+        vals = [v for _ts, v in tl]
+        if len(vals) < page_leak_min_samples:
+            continue
+        growth = vals[-1] - vals[0]
+        if growth < page_leak_growth:
+            continue
+        # a leak never gives pages back: any real dip means churn, not leak
+        if any(b < a - 1e-6 for a, b in zip(vals, vals[1:])):
+            continue
+        # stable occupancy separates a leak from genuine load growth
+        occ = [v for _ts, v in slots.get(rank, [])]
+        if occ:
+            lo, hi = min(occ), max(occ)
+            if hi > 0 and (hi - lo) / hi > page_leak_occupancy_range:
+                continue
+        severity = ('critical' if vals[-1] >= PAGE_LEAK_CRITICAL_UTIL
+                    else 'warning')
+        yield _diag(
+            'page_leak', severity,
+            f"rank {rank}: KV page utilization grew "
+            f"{vals[0]:.2f} -> {vals[-1]:.2f} monotonically over "
+            f"{len(vals)} sample(s) with stable occupancy — pages are "
+            "allocated and never freed",
+            "audit the page release paths: every PageAllocator.alloc() "
+            "needs a matching decref() on sequence finish AND on "
+            "preemption/cancel; utilization should fall whenever "
+            "active_slots does. tools/telemetry_dump.py --timeline "
+            "--series page_utilization shows the climb",
+            rank=rank, first_util=round(vals[0], 4),
+            last_util=round(vals[-1], 4), growth=round(growth, 4),
+            n_samples=len(vals))
+
+
+def detect_latency_creep(events=None, snapshot=None, cluster=None,
+                         latency_creep_min_samples=LATENCY_CREEP_MIN_SAMPLES,
+                         latency_creep_ratio=LATENCY_CREEP_RATIO,
+                         latency_series='hist:serving.latency_ms:p99', **_):
+    """Request p99 rising steadily over the run: last-third mean vs
+    first-third mean, and the timeline mostly rising — degradation too
+    slow for any single snapshot (or the SLO burn-rate window) to flag."""
+    series = _series(snapshot, cluster)
+    for rank, tl in _timelines(series.get(latency_series) or {}):
+        vals = [v for _ts, v in tl]
+        if len(vals) < latency_creep_min_samples:
+            continue
+        third = max(len(vals) // 3, 1)
+        head = sum(vals[:third]) / third
+        tail = sum(vals[-third:]) / third
+        if head <= 0 or tail < latency_creep_ratio * head:
+            continue
+        rising = sum(1 for a, b in zip(vals, vals[1:]) if b >= a - 1e-9)
+        if rising < 0.6 * (len(vals) - 1):
+            continue
+        ratio = tail / head
+        severity = ('critical' if ratio >= 2 * latency_creep_ratio
+                    else 'warning')
+        yield _diag(
+            'latency_creep', severity,
+            f"rank {rank}: {latency_series.split(':', 1)[1]} crept "
+            f"{head:.1f} -> {tail:.1f} ({ratio:.1f}x) over "
+            f"{len(vals)} sample(s)",
+            "slow accumulation, not a spike: look for resource growth in "
+            "the same window (page_leak, queue_depth, compile_creep) — "
+            "tools/telemetry_dump.py --timeline lines the series up; if "
+            "nothing grows, suspect host-side interference on that rank",
+            rank=rank, first_third_mean=round(head, 3),
+            last_third_mean=round(tail, 3), ratio=round(ratio, 3),
+            n_samples=len(vals), series=latency_series)
+
+
+def detect_qps_collapse(events=None, snapshot=None, cluster=None,
+                        qps_collapse_min_samples=QPS_COLLAPSE_MIN_SAMPLES,
+                        qps_collapse_ratio=QPS_COLLAPSE_RATIO,
+                        qps_collapse_window=QPS_COLLAPSE_WINDOW, **_):
+    """Throughput cliff: the trailing window's per-sample request rate
+    collapsed vs the run median. The cumulative counter timelines are
+    dense (a sample with no delta still contributes a flat point), so a
+    stall shows up as exactly this — flat tail, healthy median."""
+    series = _series(snapshot, cluster)
+    entry = None
+    for name in ('counter:serving.requests', 'counter:hapi.steps'):
+        entry = series.get(name)
+        if entry:
+            break
+    if not entry:
+        return
+    for rank, tl in _timelines(entry):
+        if len(tl) < qps_collapse_min_samples:
+            continue
+        deltas = [b[1] - a[1] for a, b in zip(tl, tl[1:])]
+        busy = sorted(d for d in deltas if d > 0)
+        if len(busy) < qps_collapse_window:
+            continue
+        run_med = busy[len(busy) // 2]
+        tail = sorted(deltas[-qps_collapse_window:])
+        tail_med = tail[len(tail) // 2]
+        if run_med <= 0 or tail_med > qps_collapse_ratio * run_med:
+            continue
+        yield _diag(
+            'qps_collapse', 'critical',
+            f"rank {rank}: {name.split(':', 1)[1]} rate collapsed to "
+            f"{tail_med:.1f}/sample in the last {qps_collapse_window} "
+            f"sample(s) vs run median {run_med:.1f}/sample",
+            "the engine is alive (samples keep landing) but work stopped "
+            "flowing: check admission (queue_depth / shed counters), the "
+            "paged-KV pool (kv_page_exhaustion / page_leak), and upstream "
+            "feed; merged_trace.json shows which stage went quiet",
+            rank=rank, tail_rate=round(tail_med, 3),
+            median_rate=round(run_med, 3),
+            ratio=round(tail_med / run_med, 3), series=name,
+            n_samples=len(tl))
+
+
+def detect_compile_creep(events=None, snapshot=None, cluster=None,
+                         compile_creep_plateau=COMPILE_CREEP_PLATEAU,
+                         compile_creep_grace=COMPILE_CREEP_GRACE, **_):
+    """``jax.compiles`` growing again AFTER the warmup plateau — the
+    time-resolved upgrade of ``retrace_storm``: that one needs the
+    aggregate compiles/steps ratio to already look bad; this fires on the
+    inflection, while the cumulative total still looks innocent."""
+    series = _series(snapshot, cluster)
+    for rank, tl in _timelines(series.get('counter:jax.compiles') or {}):
+        vals = [v for _ts, v in tl]
+        if len(vals) < compile_creep_plateau + 2:
+            continue
+        # the warmup plateau: the first run of >= plateau consecutive
+        # zero-delta samples (steady state reuses the cached program)
+        plateau_end, flat = None, 0
+        for i in range(1, len(vals)):
+            if vals[i] == vals[i - 1]:
+                flat += 1
+                if flat >= compile_creep_plateau and plateau_end is None:
+                    plateau_end = i
+            else:
+                flat = 0
+        if plateau_end is None:
+            continue
+        post = vals[-1] - vals[plateau_end]
+        if post < compile_creep_grace:
+            continue
+        yield _diag(
+            'compile_creep', 'warning',
+            f"rank {rank}: {post:.0f} new XLA compile(s) after the warmup "
+            f"plateau ({vals[plateau_end]:.0f} compiles held flat for "
+            f"{compile_creep_plateau}+ samples, now {vals[-1]:.0f})",
+            "something started retracing mid-run: a shape or static "
+            "argument changed after warmup (late dataset tail batch, "
+            "config flip, eval path with new shapes) — diff the traced "
+            "signatures around the inflection; graftlint GL005/GL006/"
+            "GL013 name the static culprits",
+            rank=rank, plateau_compiles=vals[plateau_end],
+            final_compiles=vals[-1], post_plateau=post,
+            n_samples=len(vals))
+
+
+def _load_baseline():
+    """The cross-run baseline module, package-relative or by path (this
+    module is loaded standalone by tools/doctor.py)."""
+    if __package__:
+        from . import baseline
+        return baseline
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'baseline.py')
+    try:
+        spec = importlib.util.spec_from_file_location(
+            'paddle_tpu_baseline_standalone', path)
+        if spec is None or spec.loader is None:
+            return None
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except (OSError, ImportError):
+        return None
+
+
+def detect_perf_regression(events=None, snapshot=None, cluster=None,
+                           runs_path=None, perf_min_samples=None, **_):
+    """The latest run in the cross-run registry regressed vs the rolling
+    median + MAD of prior runs (``baseline.detect_regressions`` — robust,
+    direction-aware). Points at the registry via ``runs_path`` or
+    ``PADDLE_TPU_RUNS_REGISTRY``; quiet without one."""
+    import os
+    path = runs_path or os.environ.get('PADDLE_TPU_RUNS_REGISTRY')
+    if not path or not os.path.isfile(path):
+        return
+    baseline = _load_baseline()
+    if baseline is None:
+        return
+    kw = {} if perf_min_samples is None else \
+        {'min_samples': int(perf_min_samples)}
+    runs = baseline.load_runs(path)
+    for reg in baseline.detect_regressions(runs, **kw):
+        severity = ('critical' if abs(reg.get('rel_change', 0)) >= 0.5
+                    else 'warning')
+        yield _diag(
+            'perf_regression', severity,
+            f"{reg['metric']}: last run {reg['value']:g} vs rolling median "
+            f"{reg['median']:g} of {reg['n_baseline']} prior run(s) "
+            f"({reg['direction']} {100 * abs(reg['rel_change']):.0f}%, "
+            f"bad direction: {reg['bad_direction']})",
+            "tools/perfwatch.py history --metric <name> shows the trend; "
+            "bisect the runs between the last healthy record and this one "
+            "(each record carries its config fingerprint) — if the change "
+            "is intentional, land a new baseline by letting healthy runs "
+            "accumulate past the window",
+            metric=reg['metric'], value=reg['value'],
+            median=reg['median'], mad=reg.get('mad', 0),
+            rel_change=reg['rel_change'], direction=reg['direction'],
+            n_baseline=reg['n_baseline'])
+
+
 DETECTORS = {
     'straggler': detect_straggler,
     'retrace_storm': detect_retrace_storm,
@@ -741,6 +1035,11 @@ DETECTORS = {
     'replica_flapping': detect_replica_flapping,
     'retry_storm': detect_retry_storm,
     'lint_debt': detect_lint_debt,
+    'page_leak': detect_page_leak,
+    'latency_creep': detect_latency_creep,
+    'qps_collapse': detect_qps_collapse,
+    'compile_creep': detect_compile_creep,
+    'perf_regression': detect_perf_regression,
 }
 
 

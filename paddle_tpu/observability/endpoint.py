@@ -19,6 +19,10 @@ instead of post-hoc:
 - ``GET /costs``      the cost explorer's ledger slice: per-program
                       FLOPs/bytes/peak memory + roofline estimates, the
                       summary aggregates, and the SLO burn rates.
+- ``GET /timeseries`` the ring sampler's timelines: this process's live
+                      export plus, with a run dir attached, the cluster
+                      merge (``?series=page_util`` substring-filters the
+                      series map).
 
 Security posture: binds 127.0.0.1 unless
 ``PADDLE_TPU_TELEMETRY_HTTP_HOST`` says otherwise — this is a diagnostics
@@ -84,11 +88,17 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == '/costs':
                 self._send(200, json.dumps(self.server.owner.costs(),
                                            sort_keys=True, default=repr))
+            elif route == '/timeseries':
+                q = parse_qs(url.query)
+                needle = q.get('series', [None])[0]
+                self._send(200, json.dumps(
+                    self.server.owner.timeseries(series=needle),
+                    sort_keys=True, default=repr))
             else:
                 self._send(404, json.dumps(
                     {'error': f'no route {route!r}',
                      'routes': ['/metrics', '/healthz', '/events',
-                                '/diagnosis', '/costs']}))
+                                '/diagnosis', '/costs', '/timeseries']}))
         except BrokenPipeError:
             pass
         except Exception as e:   # a scrape must never kill the server
@@ -199,6 +209,33 @@ class MetricsServer:
         from . import costs, slo
         return {'summary': costs.summary(), 'programs': costs.ledger(),
                 'slo_burn': slo.burn_rates()}
+
+    def timeseries(self, series=None):
+        """The ring sampler's timelines: this process's live export plus
+        the cluster merge when a run dir is attached. ``series``
+        substring-filters the series maps (the full cluster map can be
+        wide)."""
+        from . import timeseries as ts
+        live = ts.export_active()
+        payload = {
+            'live': live,
+            'series': ts.to_series(live) if live else {},
+        }
+        if self.run_dir:
+            from . import aggregate
+            merged = aggregate.merged_timeseries(self.run_dir)
+            if merged.get('series'):
+                payload['cluster'] = merged
+        if series:
+            payload['series'] = {k: v for k, v in payload['series'].items()
+                                 if series in k}
+            if 'cluster' in payload:
+                payload['cluster'] = dict(
+                    payload['cluster'],
+                    series={k: v
+                            for k, v in payload['cluster']['series'].items()
+                            if series in k})
+        return payload
 
     # -- lifecycle -------------------------------------------------------
     @property

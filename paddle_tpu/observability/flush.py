@@ -10,6 +10,8 @@ supervisor's run dir:
 - ``telemetry_rank<R>.json``   {rank, pid, host, ts, metrics, counters}
 - ``events_rank<R>.jsonl``     the JSONL event log (rank-stamped)
 - ``trace_rank<R>.json``       Chrome trace events for this rank
+- ``timeseries_rank<R>.json``  the ring sampler's delta-encoded time
+                               series (written only once samples exist)
 
 The supervisor-side ``aggregate`` module merges these into one cluster
 snapshot and a single Perfetto trace with one lane per rank. Files are
@@ -25,7 +27,7 @@ import os
 import socket
 import threading
 
-from . import costs, events, interpose, registry, spans, state
+from . import costs, events, interpose, registry, spans, state, timeseries
 from .state import rank_id
 
 __all__ = ['RankFlusher', 'start_rank_flusher', 'stop_rank_flusher',
@@ -70,6 +72,11 @@ class RankFlusher:
     def trace_path(self):
         return os.path.join(self.run_dir, f'trace_rank{self.rank}.json')
 
+    @property
+    def timeseries_path(self):
+        return os.path.join(self.run_dir,
+                            f'timeseries_rank{self.rank}.json')
+
     def _commit(self, path, text):
         """Whole-document write, committed by rename so the aggregator's
         concurrent read never sees a torn file."""
@@ -100,6 +107,11 @@ class RankFlusher:
                                default=repr) + '\n' for rec in evs))
                 self._commit(self.trace_path,
                              json.dumps(spans.trace_events()))
+                ts_doc = timeseries.export_active()
+                if ts_doc is not None:
+                    ts_doc['rank'] = self.rank
+                    self._commit(self.timeseries_path,
+                                 json.dumps(ts_doc, sort_keys=True))
             except OSError:
                 return False  # run dir vanished (supervisor cleanup): benign
             self.flushes += 1
@@ -148,13 +160,22 @@ def start_rank_flusher(run_dir=None, rank=None):
             fl.stop(final_flush=False)
         fl = RankFlusher(run_dir, rank=rank).start()
         _active[0] = fl
-        return fl
+    # the time-series ring rides the flusher: every supervised rank samples
+    # at cadence so the aggregator gets timelines, not just the last frame
+    timeseries.start_sampler()
+    return fl
 
 
 def stop_rank_flusher(final_flush=True):
     with _lock:
         fl, _active[0] = _active[0], None
     if fl is not None:
+        # take one last sample so the final flush carries the run's tail,
+        # then park the cadence thread (the ring keeps its samples)
+        sm = timeseries.active_sampler()
+        if sm is not None and final_flush:
+            sm.sample_now()
+        timeseries.stop_sampler()
         fl.stop(final_flush=final_flush)
 
 

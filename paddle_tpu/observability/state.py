@@ -32,6 +32,22 @@ Mission-control knobs (docs/OBSERVABILITY.md, "Mission control"):
                                    files (default: the supervisor's run
                                    dir, passed via heartbeat env)
 
+Time-series knobs (owned by ``timeseries.py``, docs/OBSERVABILITY.md,
+"Time series + regression sentinel"):
+
+- ``PADDLE_TPU_TELEMETRY_SAMPLE_EVERY``
+                                   ring-sampler cadence in seconds for the
+                                   in-run counter/gauge/histogram time
+                                   series (default 1.0; 0 disables the
+                                   sampler; off with telemetry off)
+- ``PADDLE_TPU_TELEMETRY_TIMESERIES_CAP``
+                                   ring capacity in samples (default 512 —
+                                   ~8.5 min at the default cadence; memory
+                                   stays O(cap) over arbitrarily long runs)
+- ``PADDLE_TPU_RUNS_REGISTRY``     cross-run baseline registry path
+                                   (``runs.jsonl``; see ``baseline.py`` /
+                                   ``tools/perfwatch.py``)
+
 Cost explorer / SLO / flight-recorder knobs (owned by ``costs.py`` /
 ``slo.py`` / ``flight.py``, catalogued here so one file documents the env
 surface):
@@ -129,6 +145,16 @@ def http_host():
 
 def flush_every():
     return _env_float('PADDLE_TPU_TELEMETRY_FLUSH_EVERY', 1.0)
+
+
+def sample_every():
+    """Time-series sampler cadence in seconds (0 disables the sampler)."""
+    return _env_float('PADDLE_TPU_TELEMETRY_SAMPLE_EVERY', 1.0)
+
+
+def timeseries_cap():
+    """Ring capacity (samples) for the in-run time series."""
+    return max(2, _env_int('PADDLE_TPU_TELEMETRY_TIMESERIES_CAP', 512))
 
 
 def run_dir():

@@ -20,6 +20,9 @@ against, on CPU, deterministically:
   (straggler model for collective deadlines);
 - ``slow_model`` — wrap a serving batch callable to sleep before every
   batch (overloaded-backend model for deadline expiry / load shedding);
+- ``latency_ramp`` — wrap a callable so each call sleeps a little longer
+  than the last (slow-degradation model: no call is an outlier, only the
+  trend is wrong — drives the doctor's ``latency_creep`` detector);
 - ``slow_loader`` — Dataset wrapper sleeping before EVERY sample (the
   input-bound model the anomaly doctor's dataloader-wait detector names);
 - ``retrace_bait`` — run n jitted calls with n distinct static shapes,
@@ -54,7 +57,8 @@ from . import atomic_io
 __all__ = ['FaultInjector', 'flaky', 'poison_loss', 'corrupt_file',
            'truncate_file', 'PreemptAtStep', 'InjectedWriteError',
            'poison_sample', 'kill_worker', 'hang_worker', 'slow_rank',
-           'slow_model', 'slow_loader', 'slow_collective', 'retrace_bait',
+           'slow_model', 'latency_ramp', 'slow_loader', 'slow_collective',
+           'retrace_bait',
            'boot_fail', 'PoisonedSampleError', 'slow_fs', 'disk_full',
            'sigterm_at_step', 'kill_rank_at_step', 'kill_replica_at_request',
            'hang_replica', 'slow_replica', 'ReplicaHang', 'hold_lock',
@@ -327,6 +331,25 @@ def slow_model(fn, delay_s):
     def slowed(*args, **kwargs):
         time.sleep(delay_s)
         return fn(*args, **kwargs)
+    return slowed
+
+
+def latency_ramp(fn, per_call_ms, start_ms=0.0):
+    """Wrap a callable so call ``k`` sleeps ``start_ms + k*per_call_ms``
+    milliseconds first — each call a little slower than the last. The
+    slow-degradation model (resource exhaustion, fragmentation, thermal
+    creep) behind the doctor's ``latency_creep`` detector: no single call
+    is an outlier, only the TREND is wrong, which is exactly what a
+    point-in-time snapshot cannot see. Deterministic: the ramp depends
+    only on the call count. ``slowed.calls`` exposes it."""
+    per_call_s = float(per_call_ms) / 1e3
+    start_s = float(start_ms) / 1e3
+
+    def slowed(*args, **kwargs):
+        time.sleep(start_s + slowed.calls * per_call_s)
+        slowed.calls += 1
+        return fn(*args, **kwargs)
+    slowed.calls = 0
     return slowed
 
 

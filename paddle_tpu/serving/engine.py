@@ -91,6 +91,7 @@ class ServingEngine:
         self._shed_page_exhaustion = 0  # memory pressure wearing a queue-
         self._submitted = 0             # full mask (doctor tells them apart)
         self._endpoint = None          # MetricsServer this engine owns
+        self._own_sampler = False      # ring sampler this engine started
         self._killed = False           # chaos: abrupt death, see kill()
 
     # -- registration ---------------------------------------------------
@@ -486,6 +487,12 @@ class ServingEngine:
         if _obs.enabled():
             from ..observability import endpoint as _endpoint
             _endpoint.maybe_start_from_env(extra_health=self._health)
+            # ring sampler: the doctor's trend detectors (page_leak,
+            # latency_creep, qps_collapse) need timelines of this
+            # engine's gauges/histograms, not just the last frame
+            had = _obs.timeseries.active_sampler() is not None
+            self._own_sampler = (_obs.timeseries.start_sampler() is not None
+                                 and not had)
         with self._cond:
             if self._thread is not None and self._thread.is_alive():
                 return self
@@ -614,6 +621,12 @@ class ServingEngine:
             self._endpoint = None
         from ..observability import endpoint as _endpoint
         _endpoint.detach_health(self._health)
+        if self._own_sampler:
+            sm = _obs.timeseries.active_sampler()
+            if sm is not None:
+                sm.sample_now()   # the engine's tail lands in the ring
+            _obs.timeseries.stop_sampler()
+            self._own_sampler = False
 
     def _worker(self):
         try:

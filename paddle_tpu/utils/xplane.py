@@ -95,7 +95,10 @@ def parse_planes(path):
 def _is_op_line(plane_name, line_name):
     if line_name == 'XLA Ops':                  # TPU/GPU device planes
         return True
-    return line_name.startswith('tf_XLAPjRtCpuClient')  # CPU runtime
+    # CPU runtime thread lines: jax has spelled these tf_XLAPjRtCpuClient,
+    # tf_XLATfrtCpuClient, and tf_XLAEigen across releases — match the
+    # stable prefix, not one release's runtime name
+    return line_name.startswith('tf_XLA')
 
 
 def op_table(path):
@@ -121,6 +124,9 @@ def op_table(path):
                 op = ev_meta.get(mid, str(mid))
                 if op.startswith('end: '):      # CPU runtime end markers
                     continue
+                if '::' in op:                  # runtime bookkeeping rows
+                    continue                    # (ThunkExecutor::Execute,
+                                                # ThreadpoolListener::Record)
                 ms = dur / 1e9                  # ps -> ms
                 a = agg[op]
                 a['total_ms'] += ms

@@ -47,3 +47,28 @@ def _seed():
     paddle.seed(1234)
     np.random.seed(1234)
     yield
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _module_telemetry_isolation():
+    """Reset the observability spine between test MODULES.
+
+    Tier-1 runs alphabetically (-p no:randomly): a module that enables
+    telemetry, installs crash hooks, or leaves counters/cost-ledger
+    entries behind silently changes what the next module observes — e.g.
+    test_mission_control installing the flight recorder's excepthooks
+    made test_cost_flight's install_crash_hooks() a no-op, so its
+    monkeypatched threading.excepthook clobbered the live hook and
+    load_dump() returned None. Module scope keeps intra-module state
+    (many modules share setup within themselves) while giving every
+    module a pristine spine."""
+    yield
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import endpoint, flush, timeseries
+    flush.stop_rank_flusher(final_flush=False)
+    timeseries.clear()
+    endpoint.stop_active_server()
+    obs.flight.uninstall_crash_hooks()
+    obs.reset()
+    if os.environ.get("PADDLE_TPU_TELEMETRY") != "1":
+        obs.disable()

@@ -119,6 +119,10 @@ AST_FIXTURES = {
               "            return r.submit(req)\n"
               "        except Exception:\n"
               "            pass\n", "except Exception"),
+    'GL020': ("_LOG = []\n"
+              "def poll(events):\n"
+              "    for e in events:\n"
+              "        _LOG.append(e)\n", "_LOG.append(e)"),
 }
 
 
@@ -892,6 +896,95 @@ def test_gl019_inline_waiver(tmp_path):
     p.write_text(src)
     findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
     hits = [f for f in findings if f.rule == 'GL019']
+    assert len(hits) == 1 and hits[0].waived
+    from paddle_tpu.analysis.finding import active
+    assert active(hits) == []
+
+
+# ---------------------------------------------------------------------------
+# GL020: unbounded in-memory accumulation in library code
+# ---------------------------------------------------------------------------
+
+_ACCUM_SRC = (
+    "_LOG = []\n"                                  # firing: module global
+    "_REG = {}\n"                                  # firing: dict-of-lists
+    "def poll(events):\n"
+    "    for e in events:\n"
+    "        _LOG.append(e)\n"
+    "        _REG.setdefault(e, []).append(e)\n"
+    "class Hook:\n"
+    "    def __init__(self):\n"
+    "        self._hist = []\n"
+    "    def on_batch_end(self, logs):\n"          # firing: per-step hook
+    "        self._hist.append(logs)\n")
+
+
+def test_gl020_flags_unbounded_accumulation(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'acc.py').write_text(_ACCUM_SRC)
+    findings, _ = lint_paths([str(lib / 'acc.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL020')
+    lines = _ACCUM_SRC.splitlines()
+    assert len(hits) == 3, [(f.rule, f.line) for f in findings]
+    assert '_LOG.append' in lines[hits[0] - 1]
+    # setdefault(...).append(...) is two grow tails on one container —
+    # a single finding, not two
+    assert '_REG.setdefault' in lines[hits[1] - 1]
+    assert 'self._hist.append' in lines[hits[2] - 1]
+    msg = [f for f in findings if f.rule == 'GL020'][0].message
+    # fix-it points at the bounded spellings
+    assert 'deque(maxlen' in msg
+
+
+def test_gl020_sanctioned_bounded_spellings(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    src = (
+        "import collections\n"
+        "_RING = collections.deque(maxlen=10)\n"   # structural bound
+        "_CAP = []\n"
+        "class Hook:\n"
+        "    def __init__(self):\n"
+        "        self._hist = []\n"
+        "    def on_batch_end(self, logs):\n"
+        "        self._hist.append(logs)\n"
+        "        self._hist[:] = self._hist[-100:]\n"  # slice rotation
+        "class Builder:\n"
+        "    def __init__(self, items):\n"
+        "        self.rows = []\n"
+        "        for it in items:\n"               # workload-proportional
+        "            self.rows.append(it)\n"
+        "def poll(events):\n"
+        "    for e in events:\n"
+        "        _RING.append(e)\n"
+        "        if len(_CAP) < 100:\n"            # len() guard
+        "            _CAP.append(e)\n")
+    (lib / 'ok.py').write_text(src)
+    findings, _ = lint_paths([str(lib / 'ok.py')],
+                             scan_root=str(tmp_path))
+    assert [f for f in findings if f.rule == 'GL020'] == [], \
+        [(f.rule, f.line) for f in findings]
+
+
+def test_gl020_exempts_harnesses_and_waiver(tmp_path):
+    for rel in ('tests/mod.py', 'tools/mod.py', 'bench_x.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_ACCUM_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL020'] == [], rel
+    # inline waiver honored and excluded from the active set
+    p = tmp_path / 'lib.py'
+    p.write_text(
+        "_LOG = []\n"
+        "def poll(events):\n"
+        "    for e in events:\n"
+        "        _LOG.append(e)"
+        "  # graftlint: disable=GL020 — drained by caller each round\n")
+    findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+    hits = [f for f in findings if f.rule == 'GL020']
     assert len(hits) == 1 and hits[0].waived
     from paddle_tpu.analysis.finding import active
     assert active(hits) == []

@@ -206,7 +206,8 @@ def test_four_rank_spawn_merged_trace_and_straggler(tmp_path, monkeypatch,
     files = obs.aggregate.rank_files(str(run_dir))
     assert sorted(files) == [0, 1, 2, 3]
     for rank, kinds in files.items():
-        assert sorted(kinds) == ['events', 'telemetry', 'trace']
+        assert sorted(kinds) == ['events', 'telemetry', 'timeseries',
+                                 'trace']
 
     # the supervisor merged them at join: one Perfetto lane per rank
     trace = json.loads((run_dir / 'merged_trace.json').read_text())
@@ -254,6 +255,15 @@ def test_four_rank_spawn_merged_trace_and_straggler(tmp_path, monkeypatch,
     assert sorted({e['pid'] for e in merged}) == [0, 1, 2, 3]
     combined = (out_dir / 'merged_events.jsonl').read_text().splitlines()
     assert {json.loads(l)['rank'] for l in combined} == {0, 1, 2, 3}
+
+    # the ring sampler rode the flusher: every rank's timeseries export is
+    # in the snapshot merge, and --timeline renders sparklines from it
+    ts = snap['timeseries']
+    assert sorted(int(r) for r in ts['per_rank']) == [0, 1, 2, 3]
+    assert any(k.startswith('counter:') for k in ts['series'])
+    assert dump_cli.main(['--timeline', str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert 'timeline:' in out and 'r0' in out
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,243 @@
+"""Cross-run perf regression sentinel (ISSUE 18): the ``runs.jsonl``
+registry (``observability/baseline.py``), the ``tools/perfwatch.py`` CLI,
+the doctor's ``perf_regression`` detector, and the repo's own CI gate —
+``perfwatch compare --fail-on regression`` must exit non-zero on a seeded
+2x p99 regression and zero on a healthy registry. This module IS that
+gate: it runs in tier-1 beside the graftlint gates.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+from paddle_tpu.observability import baseline, doctor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.obs
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, 'tools', f'{name}.py')
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _seed_registry(path, n=6, fingerprint='cfg-a', p99=10.0, qps=3000.0):
+    """A healthy synthetic history: p99 and qps wiggling within noise."""
+    for i in range(n):
+        baseline.record_run({
+            'run': 'smoke', 'fingerprint': fingerprint,
+            'ts': 1000.0 + i,
+            'metrics': {'serving': {'latency_ms': {'p99': p99 + 0.2 * i},
+                                    'qps': qps + 10 * i},
+                        'samples_per_sec': 100.0 + i},
+        }, path=str(path))
+
+
+# ---------------------------------------------------------------------------
+# registry + detection unit behavior
+# ---------------------------------------------------------------------------
+
+def test_record_run_appends_and_loads_in_order(tmp_path):
+    path = tmp_path / 'runs.jsonl'
+    _seed_registry(path, n=3)
+    runs = baseline.load_runs(str(path))
+    assert len(runs) == 3
+    assert [r['ts'] for r in runs] == [1000.0, 1001.0, 1002.0]
+    # ts stamped when absent
+    baseline.record_run({'metrics': {}}, path=str(path))
+    assert baseline.load_runs(str(path))[-1]['ts'] > 0
+
+
+def test_load_runs_skips_torn_lines(tmp_path):
+    path = tmp_path / 'runs.jsonl'
+    _seed_registry(path, n=2)
+    with open(path, 'a', encoding='utf-8') as f:
+        f.write('{"truncated": \n')
+    assert len(baseline.load_runs(str(path))) == 2
+
+
+def test_flatten_and_direction():
+    rec = {'metrics': {'serving': {'latency_ms': {'p99': 12.5}, 'qps': 3000},
+                       'ok': True, 'label': 'x'}}
+    flat = baseline.flatten(rec)
+    assert flat == {'serving.latency_ms.p99': 12.5, 'serving.qps': 3000}
+    assert baseline.bad_direction('serving.latency_ms.p99') == 'up'
+    assert baseline.bad_direction('serving.qps') == 'down'
+    assert baseline.bad_direction('mystery_number') is None
+
+
+def test_regression_detection_direction_aware(tmp_path):
+    path = tmp_path / 'runs.jsonl'
+    _seed_registry(path, n=6)
+    # p99 doubled AND qps halved: both directions regress
+    baseline.record_run({
+        'run': 'smoke', 'fingerprint': 'cfg-a', 'ts': 2000.0,
+        'metrics': {'serving': {'latency_ms': {'p99': 21.0},
+                                'qps': 1500.0}}}, path=str(path))
+    regs = baseline.detect_regressions(baseline.load_runs(str(path)))
+    names = {r['metric']: r for r in regs}
+    assert 'serving.latency_ms.p99' in names
+    assert names['serving.latency_ms.p99']['direction'] == 'up'
+    assert 'serving.qps' in names
+    assert names['serving.qps']['direction'] == 'down'
+    # an IMPROVEMENT must not fire: p99 halved is the good direction
+    baseline.record_run({
+        'run': 'smoke', 'fingerprint': 'cfg-a', 'ts': 2001.0,
+        'metrics': {'serving': {'latency_ms': {'p99': 5.0}}}},
+        path=str(path))
+    regs2 = baseline.detect_regressions(baseline.load_runs(str(path)))
+    assert 'serving.latency_ms.p99' not in {r['metric'] for r in regs2}
+
+
+def test_min_sample_guard_keeps_thin_history_quiet(tmp_path):
+    path = tmp_path / 'runs.jsonl'
+    _seed_registry(path, n=2)           # two priors < min_samples=4
+    baseline.record_run({
+        'run': 'smoke', 'fingerprint': 'cfg-a', 'ts': 2000.0,
+        'metrics': {'serving': {'latency_ms': {'p99': 99.0}}}},
+        path=str(path))
+    assert baseline.detect_regressions(baseline.load_runs(str(path))) == []
+
+
+def test_fingerprint_filter_compares_same_config_only(tmp_path):
+    path = tmp_path / 'runs.jsonl'
+    # old config ran fast; new config is legitimately 2x slower
+    _seed_registry(path, n=6, fingerprint='cfg-old', p99=10.0)
+    _seed_registry(path, n=6, fingerprint='cfg-new', p99=20.0)
+    # a new-config run at its OWN baseline: not a regression
+    baseline.record_run({
+        'run': 'smoke', 'fingerprint': 'cfg-new', 'ts': 3000.0,
+        'metrics': {'serving': {'latency_ms': {'p99': 20.5}}}},
+        path=str(path))
+    assert baseline.detect_regressions(baseline.load_runs(str(path))) == []
+
+
+def test_noisy_single_outlier_does_not_drag_baseline(tmp_path):
+    path = tmp_path / 'runs.jsonl'
+    _seed_registry(path, n=6)
+    # one historical glitch (p99 spike) in the middle of the history
+    baseline.record_run({
+        'run': 'smoke', 'fingerprint': 'cfg-a', 'ts': 1500.0,
+        'metrics': {'serving': {'latency_ms': {'p99': 80.0}}}},
+        path=str(path))
+    # the latest run is healthy: the median ignores the outlier => quiet
+    baseline.record_run({
+        'run': 'smoke', 'fingerprint': 'cfg-a', 'ts': 2000.0,
+        'metrics': {'serving': {'latency_ms': {'p99': 10.6}}}},
+        path=str(path))
+    assert baseline.detect_regressions(baseline.load_runs(str(path))) == []
+
+
+# ---------------------------------------------------------------------------
+# the CLI + the repo's own CI gate (tier-1, beside the graftlint gates)
+# ---------------------------------------------------------------------------
+
+def test_perfwatch_ci_gate_fails_on_seeded_regression(tmp_path, capsys):
+    """THE gate: a synthetic registry with an injected 2x p99 regression
+    exits non-zero under ``--fail-on regression``; the healthy registry
+    exits 0."""
+    pw = _load_tool('perfwatch')
+    healthy = tmp_path / 'healthy.jsonl'
+    _seed_registry(healthy, n=6)
+    rc = pw.main(['compare', '--runs', str(healthy),
+                  '--fail-on', 'regression'])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert 'no regressions' in out
+
+    regressed = tmp_path / 'regressed.jsonl'
+    _seed_registry(regressed, n=6)
+    baseline.record_run({
+        'run': 'smoke', 'fingerprint': 'cfg-a', 'ts': 2000.0,
+        'metrics': {'serving': {'latency_ms': {'p99': 21.0},
+                                'qps': 3050.0}}}, path=str(regressed))
+    rc = pw.main(['compare', '--runs', str(regressed),
+                  '--fail-on', 'regression'])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert 'REGRESSION serving.latency_ms.p99' in out
+    # without the gate flag the same verdict reports but exits 0
+    assert pw.main(['compare', '--runs', str(regressed)]) == 0
+
+
+def test_perfwatch_compare_json_and_empty_registry(tmp_path, capsys):
+    pw = _load_tool('perfwatch')
+    path = tmp_path / 'runs.jsonl'
+    _seed_registry(path, n=6)
+    assert pw.main(['compare', '--runs', str(path), '--json']) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict['n_runs'] == 6 and verdict['regressions'] == []
+    # empty registry: report, don't crash, never gate
+    missing = tmp_path / 'nope.jsonl'
+    assert pw.main(['compare', '--runs', str(missing),
+                    '--fail-on', 'regression']) == 0
+    assert 'no runs' in capsys.readouterr().out
+
+
+def test_perfwatch_history_sparkline_and_listing(tmp_path, capsys):
+    pw = _load_tool('perfwatch')
+    path = tmp_path / 'runs.jsonl'
+    _seed_registry(path, n=6)
+    baseline.record_run({
+        'run': 'smoke', 'fingerprint': 'cfg-a', 'ts': 2000.0,
+        'metrics': {'serving': {'latency_ms': {'p99': 21.0}}}},
+        path=str(path))
+    rc = pw.main(['history', '--runs', str(path),
+                  '--metric', 'serving.latency_ms.p99'])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '7 run(s)' in out
+    assert '█' in out            # the 2x tail dominates the sparkline
+    # no metric: list what the registry carries
+    assert pw.main(['history', '--runs', str(path)]) == 0
+    assert 'serving.latency_ms.p99' in capsys.readouterr().out
+    # unknown metric: exit 2 so scripts can tell "absent" from "flat"
+    assert pw.main(['history', '--runs', str(path),
+                    '--metric', 'no.such']) == 2
+
+
+def test_perfwatch_is_stdlib_only_no_package_import(tmp_path):
+    """The tool must run where jax/paddle_tpu can't import: it loads
+    baseline.py by path and the registry code imports no package."""
+    import subprocess
+    import sys
+    path = tmp_path / 'runs.jsonl'
+    _seed_registry(path, n=6)
+    env = dict(os.environ, PYTHONPATH=str(tmp_path / 'empty'))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'perfwatch.py'),
+         'compare', '--runs', str(path)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr
+    assert 'no regressions' in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# doctor integration: the sentinel as a diagnosis
+# ---------------------------------------------------------------------------
+
+def test_doctor_perf_regression_detector(tmp_path, monkeypatch):
+    path = tmp_path / 'runs.jsonl'
+    _seed_registry(path, n=6)
+    baseline.record_run({
+        'run': 'smoke', 'fingerprint': 'cfg-a', 'ts': 2000.0,
+        'metrics': {'serving': {'latency_ms': {'p99': 21.0}}}},
+        path=str(path))
+    diags = doctor.diagnose(runs_path=str(path))
+    hits = [d for d in diags if d['cause'] == 'perf_regression']
+    assert hits and hits[0]['severity'] == 'critical'   # 2x = 100% > 50%
+    assert hits[0]['evidence']['metric'] == 'serving.latency_ms.p99'
+    # the env knob wires the same path without explicit cfg
+    monkeypatch.setenv('PADDLE_TPU_RUNS_REGISTRY', str(path))
+    assert any(d['cause'] == 'perf_regression'
+               for d in doctor.diagnose())
+    # healthy registry: quiet
+    healthy = tmp_path / 'healthy.jsonl'
+    _seed_registry(healthy, n=6)
+    assert not [d for d in doctor.diagnose(runs_path=str(healthy))
+                if d['cause'] == 'perf_regression']

@@ -1,0 +1,376 @@
+"""In-run telemetry time series (ISSUE 18): the bounded ring sampler, the
+flusher/aggregate transport, the doctor's trend detectors — each driven by
+its deterministic faultinject repro — and the sampler overhead discipline.
+
+The point of the time dimension: a page leak, a latency creep, a qps
+cliff, or post-warmup compile growth are all INVISIBLE in any single
+``registry.snapshot()`` frame; every test here builds a real metric
+pipeline (no hand-written timelines except where the shape itself is
+under test) and asserts the trend is what the doctor sees.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import (aggregate, doctor, flush, registry,
+                                      state, timeseries)
+from paddle_tpu.resilience import faultinject as fi
+
+pytestmark = pytest.mark.obs
+
+TREND_CAUSES = {'page_leak', 'latency_creep', 'qps_collapse',
+                'compile_creep'}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_spine():
+    obs.reset()
+    obs.enable()
+    yield
+    flush.stop_rank_flusher(final_flush=False)
+    timeseries.clear()
+    obs.reset()
+    obs.disable()
+
+
+def _cluster_from(sampler, rank=0):
+    """Doctor-ready cluster doc from one sampler's export (the same
+    ``timeseries.series`` shape ``aggregate.merged_timeseries`` builds)."""
+    doc = sampler.export()
+    return {'timeseries': {'series': timeseries.to_series(doc, rank=rank)}}
+
+
+def _causes(diagnoses):
+    return [d['cause'] for d in diagnoses]
+
+
+# ---------------------------------------------------------------------------
+# ring sampler: delta encoding, eviction fold, dense timelines
+# ---------------------------------------------------------------------------
+
+def test_sampler_delta_encoding_and_eviction_fold():
+    sm = timeseries.TimeSeriesSampler(interval=3600, capacity=4)
+    c = registry.counter('t.steps')
+    for inc in (1, 2, 3, 4, 5, 3):
+        c.inc(inc)
+        assert sm.sample_now()
+    doc = sm.export()
+    # ring stayed bounded: 6 samples taken, 4 kept
+    assert len(doc['samples']) == 4
+    assert doc['capacity'] == 4
+    # the two evicted deltas (1, 2) folded into the base, so
+    # base + cumsum(kept deltas) still reconstructs the true total
+    assert doc['counters_base']['t.steps'] == 3
+    series = timeseries.to_series(doc)
+    tl = series['counter:t.steps'][0]
+    assert tl[-1][1] == 18  # 1+2+3+4+5+3
+    # deltas are increments, not totals
+    assert [s['counters'].get('t.steps') for s in doc['samples']] == \
+        [3, 4, 5, 3]
+
+
+def test_counter_timelines_are_dense_through_flat_samples():
+    # a qps cliff IS the run of flat points — zero-delta samples must
+    # still contribute their (unchanged) cumulative point
+    sm = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    c = registry.counter('t.req')
+    c.inc(10)
+    sm.sample_now()
+    for _ in range(3):           # engine alive, work stopped
+        sm.sample_now()
+    tl = timeseries.to_series(sm.export())['counter:t.req'][0]
+    assert [v for _ts, v in tl] == [10, 10, 10, 10]
+
+
+def test_sampler_carries_gauges_and_histogram_quantiles():
+    sm = timeseries.TimeSeriesSampler(interval=3600, capacity=8)
+    registry.gauge('t.depth').set(7)
+    h = registry.histogram('t.lat_ms')
+    for v in (1.0, 2.0, 100.0):
+        h.observe(v)
+    sm.sample_now()
+    series = timeseries.to_series(sm.export())
+    assert series['gauge:t.depth'][0][0][1] == 7
+    assert 'hist:t.lat_ms:p99' in series
+    assert 'hist:t.lat_ms:p50' in series
+    assert series['hist:t.lat_ms:count'][0][0][1] == 3
+
+
+def test_sample_now_is_one_flag_check_when_disabled(monkeypatch):
+    """The PR 3 overhead discipline: telemetry off => the ONLY work per
+    sample site is a single ``state.enabled()`` check — the registry is
+    never even touched."""
+    obs.disable()
+    sm = timeseries.TimeSeriesSampler(interval=3600, capacity=8)
+    calls = {'enabled': 0}
+    real_enabled = state.enabled
+
+    def counting_enabled():
+        calls['enabled'] += 1
+        return real_enabled()
+
+    def exploding_snapshot(*a, **kw):
+        raise AssertionError('registry touched with telemetry off')
+
+    monkeypatch.setattr(timeseries.state, 'enabled', counting_enabled)
+    monkeypatch.setattr(timeseries.registry, 'snapshot', exploding_snapshot)
+    for _ in range(5):
+        assert sm.sample_now() is False
+    assert calls['enabled'] == 5       # exactly one check per sample site
+    assert sm.n_samples == 0
+    assert sm.export() is None
+
+
+def test_sampler_overhead_enabled_within_budget():
+    """Acceptance: cadenced sampling costs <= 5% step time. The sampler
+    thread runs at its own cadence OFF the step path, so the step loop
+    pays nothing but scheduler noise; allow an absolute grace so CI
+    jitter cannot flake the ratio on a fast loop."""
+    def step():
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 0.002:
+            pass
+
+    for _ in range(10):                # warm the loop
+        step()
+    t0 = time.perf_counter()
+    for _ in range(50):
+        step()
+    base = time.perf_counter() - t0
+
+    registry.counter('t.steps2')
+    registry.histogram('t.step_ms')
+    sm = timeseries.start_sampler(interval=0.01)
+    assert sm is not None
+    try:
+        t0 = time.perf_counter()
+        for i in range(50):
+            step()
+            registry.counter('t.steps2').inc()
+            registry.histogram('t.step_ms').observe(2.0)
+        sampled = time.perf_counter() - t0
+    finally:
+        timeseries.stop_sampler()
+    assert sm.n_samples >= 2           # the cadence thread actually ran
+    assert sampled <= base * 1.05 + 0.05, \
+        f'sampler overhead {sampled / base - 1:.1%} exceeds 5% budget'
+
+
+def test_start_sampler_disabled_or_zero_cadence(monkeypatch):
+    obs.disable()
+    assert timeseries.start_sampler() is None
+    obs.enable()
+    monkeypatch.setenv('PADDLE_TPU_TELEMETRY_SAMPLE_EVERY', '0')
+    assert timeseries.start_sampler() is None
+    monkeypatch.delenv('PADDLE_TPU_TELEMETRY_SAMPLE_EVERY')
+    sm = timeseries.start_sampler()
+    assert sm is not None
+    assert timeseries.start_sampler() is sm   # singleton
+
+
+# ---------------------------------------------------------------------------
+# transport: flusher -> timeseries_rank<R>.json -> merged_timeseries
+# ---------------------------------------------------------------------------
+
+def test_flusher_commits_and_aggregate_merges(tmp_path):
+    fl = flush.start_rank_flusher(run_dir=str(tmp_path), rank=0)
+    assert fl is not None
+    sm = timeseries.active_sampler()
+    assert sm is not None              # the ring rides the flusher
+    c = registry.counter('t.work')
+    for _ in range(4):
+        c.inc(5)
+        sm.sample_now()
+    assert fl.flush_now()
+    path = tmp_path / 'timeseries_rank0.json'
+    assert path.exists()
+    doc = json.loads(path.read_text())
+    assert doc['rank'] == 0 and doc['samples']
+    merged = aggregate.merged_timeseries(str(tmp_path))
+    assert merged['per_rank'][0]['n_samples'] >= 4
+    tl = merged['series']['counter:t.work'][0]
+    assert tl[-1][1] == 20
+    # and the cluster snapshot carries the block end to end
+    snap = aggregate.cluster_snapshot(str(tmp_path))
+    assert 'counter:t.work' in snap['timeseries']['series']
+
+
+# ---------------------------------------------------------------------------
+# trend detectors, each on its deterministic faultinject-style repro
+# ---------------------------------------------------------------------------
+
+def test_page_leak_fires_on_leaky_allocator_and_not_on_churn():
+    from paddle_tpu.serving.paged_kv import PageAllocator
+    sm = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    util = registry.gauge('serving.kv.page_utilization')
+    slots = registry.gauge('serving.active_slots')
+    # the leak: alloc every tick, never decref, occupancy flat
+    alloc = PageAllocator(num_pages=11)   # 10 usable (page 0 reserved)
+    slots.set(3)
+    for _ in range(10):
+        alloc.alloc()                  # no matching decref: the bug
+        util.set(alloc.utilization())
+        sm.sample_now()
+    diags = doctor.diagnose(cluster=_cluster_from(sm))
+    leak = [d for d in diags if d['cause'] == 'page_leak']
+    assert leak, _causes(diags)
+    assert leak[0]['severity'] == 'critical'   # ended above 0.9 util
+    assert leak[0]['evidence']['last_util'] > \
+        leak[0]['evidence']['first_util']
+
+    # churn (healthy): same alloc rate, pages given back => quiet
+    obs.reset()
+    obs.enable()
+    sm2 = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    util2 = registry.gauge('serving.kv.page_utilization')
+    registry.gauge('serving.active_slots').set(3)
+    alloc2 = PageAllocator(num_pages=16)
+    for _ in range(10):
+        page = alloc2.alloc()
+        util2.set(alloc2.utilization())
+        sm2.sample_now()
+        alloc2.decref(page)            # sequence finished: page returns
+    diags2 = doctor.diagnose(cluster=_cluster_from(sm2))
+    assert 'page_leak' not in _causes(diags2)
+
+
+def test_latency_creep_fires_on_latency_ramp_and_not_on_steady():
+    sm = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    h = registry.histogram('serving.latency_ms')
+    ramped = fi.latency_ramp(lambda: None, per_call_ms=0.0)
+    for _k in range(9):
+        t0 = time.perf_counter()
+        ramped()
+        # deterministic "measured" latency: the ramp's own schedule (call
+        # k sleeps k * per_call_ms); wall-clock sleep jitter must not
+        # decide the verdict, the call counter does
+        del t0
+        h.observe(1.0 + 2.0 * (ramped.calls - 1))
+        sm.sample_now()
+    diags = doctor.diagnose(cluster=_cluster_from(sm))
+    creep = [d for d in diags if d['cause'] == 'latency_creep']
+    assert creep, _causes(diags)
+    assert creep[0]['evidence']['ratio'] >= 1.5
+
+    obs.reset()
+    obs.enable()
+    sm2 = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    h2 = registry.histogram('serving.latency_ms')
+    for _ in range(9):
+        h2.observe(5.0)                # steady: no trend
+        sm2.sample_now()
+    assert 'latency_creep' not in _causes(
+        doctor.diagnose(cluster=_cluster_from(sm2)))
+
+
+def test_qps_collapse_fires_on_stalled_tail_and_not_on_steady():
+    sm = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    c = registry.counter('serving.requests')
+    for _ in range(6):                 # healthy head: 20 requests/sample
+        c.inc(20)
+        sm.sample_now()
+    for _ in range(3):                 # the cliff: engine alive, no work
+        sm.sample_now()
+    diags = doctor.diagnose(cluster=_cluster_from(sm))
+    cliff = [d for d in diags if d['cause'] == 'qps_collapse']
+    assert cliff, _causes(diags)
+    assert cliff[0]['severity'] == 'critical'
+    assert cliff[0]['evidence']['tail_rate'] < \
+        cliff[0]['evidence']['median_rate']
+
+    obs.reset()
+    obs.enable()
+    sm2 = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    c2 = registry.counter('serving.requests')
+    for _ in range(9):
+        c2.inc(20)
+        sm2.sample_now()
+    assert 'qps_collapse' not in _causes(
+        doctor.diagnose(cluster=_cluster_from(sm2)))
+
+
+def test_qps_collapse_falls_back_to_train_steps():
+    sm = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    c = registry.counter('hapi.steps')   # training run: no serving counter
+    for _ in range(6):
+        c.inc(10)
+        sm.sample_now()
+    for _ in range(3):
+        sm.sample_now()
+    diags = doctor.diagnose(cluster=_cluster_from(sm))
+    cliff = [d for d in diags if d['cause'] == 'qps_collapse']
+    assert cliff and 'hapi.steps' in cliff[0]['evidence']['series']
+
+
+def test_compile_creep_fires_after_retrace_bait_breaks_plateau():
+    sm = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    fi.retrace_bait(n=4, base=4)       # warmup: 4 legitimate compiles
+    sm.sample_now()
+    for _ in range(4):                 # steady state: cached programs
+        sm.sample_now()
+    fi.retrace_bait(n=3, base=400)     # mid-run shape drift: 3 retraces
+    sm.sample_now()
+    diags = doctor.diagnose(cluster=_cluster_from(sm))
+    creep = [d for d in diags if d['cause'] == 'compile_creep']
+    assert creep, _causes(diags)
+    assert creep[0]['evidence']['post_plateau'] >= 3
+
+    # healthy: warmup then plateau to the end => quiet
+    obs.reset()
+    obs.enable()
+    sm2 = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    fi.retrace_bait(n=4, base=4)
+    sm2.sample_now()
+    for _ in range(6):
+        sm2.sample_now()
+    assert 'compile_creep' not in _causes(
+        doctor.diagnose(cluster=_cluster_from(sm2)))
+
+
+def test_trend_detectors_quiet_on_empty_and_healthy_runs():
+    # no sampler output at all: every trend detector stays quiet
+    assert TREND_CAUSES.isdisjoint(_causes(doctor.diagnose(cluster={})))
+    # a healthy mixed run: steady counters, flat gauges, flat latency
+    sm = timeseries.TimeSeriesSampler(interval=3600, capacity=64)
+    registry.gauge('serving.kv.page_utilization').set(0.4)
+    registry.gauge('serving.active_slots').set(4)
+    h = registry.histogram('serving.latency_ms')
+    c = registry.counter('serving.requests')
+    for _ in range(10):
+        c.inc(15)
+        h.observe(5.0)
+        sm.sample_now()
+    diags = doctor.diagnose(cluster=_cluster_from(sm))
+    assert TREND_CAUSES.isdisjoint(_causes(diags)), _causes(diags)
+
+
+# ---------------------------------------------------------------------------
+# /timeseries endpoint slice
+# ---------------------------------------------------------------------------
+
+def test_timeseries_endpoint_serves_live_ring(tmp_path):
+    sm = timeseries.start_sampler(interval=3600)
+    c = registry.counter('t.live')
+    for _ in range(3):
+        c.inc(2)
+        sm.sample_now()
+    srv = obs.MetricsServer(port=0, run_dir=str(tmp_path)).start()
+    try:
+        with urllib.request.urlopen(f'{srv.url}/timeseries',
+                                    timeout=10) as r:
+            assert r.status == 200
+            body = json.loads(r.read().decode('utf-8'))
+        assert body['live']['samples']
+        tl = body['series']['counter:t.live']
+        assert [v for _ts, v in list(tl.values())[0]] == [2, 4, 6]
+        # substring filter narrows the slice
+        with urllib.request.urlopen(
+                f'{srv.url}/timeseries?series=nope', timeout=10) as r:
+            filtered = json.loads(r.read().decode('utf-8'))
+        assert filtered['series'] == {}
+    finally:
+        srv.stop()

@@ -10,6 +10,8 @@ Usage::
     python tools/telemetry_dump.py <events.jsonl> --chrome out.json
     python tools/telemetry_dump.py <events.jsonl> --costs       # cost table
     python tools/telemetry_dump.py --merge <run_dir>            # cluster
+    python tools/telemetry_dump.py --timeline <run_dir>         # sparklines
+    python tools/telemetry_dump.py --timeline <run_dir> --series page_util
 
 The input is what ``observability.dump_jsonl`` / ``TelemetryCallback`` write
 (one JSON object per line with ``ev`` and ``ts`` keys). Conversion maps
@@ -269,6 +271,56 @@ def render_costs(rows):
     return '\n'.join(lines)
 
 
+_SPARK = '▁▂▃▄▅▆▇█'
+
+
+def _sparkline(values):
+    vals = [v for v in values if isinstance(v, (int, float))]
+    if not vals:
+        return ''
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return ''.join(
+        _SPARK[min(int((v - lo) / span * (len(_SPARK) - 1)),
+                   len(_SPARK) - 1)] for v in vals)
+
+
+def render_timeline(merged, needle=None, width=64):
+    """ASCII sparklines for a ``merged_timeseries`` document: one line per
+    (series, rank), min..max annotated — the terminal version of the
+    trend evidence the doctor's page_leak/latency_creep/qps_collapse/
+    compile_creep detectors consume."""
+    series = (merged or {}).get('series') or {}
+    if needle:
+        series = {k: v for k, v in series.items() if needle in k}
+    if not series:
+        return ('(no time-series samples — sampler off, or filter '
+                'matched nothing)')
+    per_rank = (merged or {}).get('per_rank') or {}
+    head = ', '.join(
+        f"rank {r}: {row.get('n_samples', 0)} sample(s)/"
+        f"{row.get('span_s', 0)}s"
+        for r, row in sorted(per_rank.items(), key=lambda kv: str(kv[0])))
+    lines = [f"timeline: {len(series)} series "
+             f"(cadence {merged.get('sample_every')}s; {head})"]
+    name_w = min(max(len(k) for k in series), 44)
+    for name in sorted(series):
+        for rank, tl in sorted(series[name].items(),
+                               key=lambda kv: str(kv[0])):
+            vals = [p[1] for p in tl
+                    if isinstance(p, (list, tuple)) and len(p) == 2
+                    and isinstance(p[1], (int, float))]
+            if not vals:
+                continue
+            spark = _sparkline(vals[-width:])
+            lines.append(f"{name:<{name_w}} r{rank} "
+                         f"[{min(vals):>10.3f} .. {max(vals):>10.3f}] "
+                         f"{spark}")
+    return '\n'.join(lines)
+
+
 def _load_aggregate():
     """Load the mission-control aggregator BY PATH (the module is written
     to be standalone) so this tool keeps its no-jax contract."""
@@ -349,7 +401,25 @@ def main(argv=None):
                    help='tabulate cost.program events (the cost explorer: '
                         'per-program FLOPs, bytes accessed, peak memory, '
                         'arithmetic intensity, roofline bound + estimate)')
+    p.add_argument('--timeline', action='store_true',
+                   help='treat the positional argument as a run dir of '
+                        'timeseries_rank<R>.json ring-sampler exports and '
+                        'render per-series ASCII sparklines (one line per '
+                        'series and rank)')
+    p.add_argument('--series', default=None, metavar='SUBSTR',
+                   help='with --timeline: only series whose name contains '
+                        'SUBSTR (e.g. page_utilization, jax.compiles)')
     args = p.parse_args(argv)
+
+    if args.timeline:
+        if not os.path.isdir(args.log):
+            print(f"telemetry_dump: --timeline expects a run dir, not "
+                  f"{args.log!r}", file=sys.stderr)
+            return 2
+        aggregate = _load_aggregate()
+        merged = aggregate.merged_timeseries(args.log)
+        print(render_timeline(merged, needle=args.series))
+        return 0 if merged.get('series') else 2
 
     if args.merge:
         if not os.path.isdir(args.log):
