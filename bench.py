@@ -561,6 +561,98 @@ def bench_serving_generative(seed=0):
     return out
 
 
+_COLD_START_CHILD = r"""
+import json, os, sys, time
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+t0 = time.perf_counter()
+import numpy as np
+from paddle_tpu import compilecache as cc
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+
+cache_dir = sys.argv[1]
+# model build happens BEFORE obs.enable(): it is the checkpoint-loading
+# analogue, not part of the compile story this bench isolates
+lm = serving.TinyCausalLM.random(vocab=64, embed=32, num_heads=4,
+                                 max_batch=8, max_seq=64,
+                                 prompt_buckets=(4, 8), seed=0)
+obs.enable()
+eng = serving.ServingEngine()
+ep = eng.register('lm', generative=lm, page_size=8, num_pages=17,
+                  artifact_dir=cache_dir)
+eng.warmup()
+warm_ms = (time.perf_counter() - t0) * 1000.0
+fut = ep.submit({'tokens': np.array([3, 1, 4], np.int32)},
+                max_new_tokens=4)
+eng.run_until_idle()
+resp = fut.result(timeout=60)
+first_token_ms = (time.perf_counter() - t0) * 1000.0
+snap = obs.snapshot()['counters']
+print(json.dumps({
+    'ok': bool(resp.ok),
+    'tokens': [int(t) for t in
+               np.asarray(resp.outputs['tokens']).ravel()],
+    'jax_compiles': snap.get('jax.compiles', 0),
+    'cache': cc.stats(),
+    'warmup_ms': round(warm_ms, 1),
+    'first_token_ms': round(first_token_ms, 1),
+}))
+"""
+
+
+def bench_cold_start(timeout_s=240.0):
+    """Fleet cold boot with the persistent compile cache (ISSUE 19
+    acceptance numbers, measured — ``extras.serving.cold_start``): the
+    SAME serving boot (register a paged generative model, warm, serve one
+    request) runs twice in fresh subprocesses against one shared cache
+    dir. Boot 1 compiles and populates; boot 2 must deserialize the whole
+    program set — ``jax.compiles == 0``, ``hit_rate == 1.0`` — and its
+    wall-ms to the first served token is the headline. Identical output
+    tokens across the boots double as the bitwise-handoff check."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix='paddle_tpu_cold_start_')
+    env = _clean_cpu_env()
+    try:
+        boots = []
+        for _ in range(2):
+            proc = subprocess.run(
+                [sys.executable, '-c', _COLD_START_CHILD, cache_dir],
+                env=env, capture_output=True, text=True,
+                timeout=timeout_s)
+            obj = None
+            for line in reversed(proc.stdout.strip().splitlines()):
+                if line.startswith('{'):
+                    obj = json.loads(line)
+                    break
+            if obj is None:
+                return {'error': f'cold-start child rc={proc.returncode}; '
+                                 f'stderr tail: {(proc.stderr or "")[-400:]}'}
+            boots.append(obj)
+        b1, b2 = boots
+        cache2 = b2.get('cache', {})
+        return {
+            'first_boot': {'jax_compiles': b1.get('jax_compiles'),
+                           'warmup_ms': b1.get('warmup_ms'),
+                           'first_token_ms': b1.get('first_token_ms')},
+            'second_boot': {'jax_compiles': b2.get('jax_compiles'),
+                            'warmup_ms': b2.get('warmup_ms'),
+                            'first_token_ms': b2.get('first_token_ms'),
+                            'cache_hit_rate': cache2.get('hit_rate')},
+            'speedup_first_token': round(
+                b1.get('first_token_ms', 0.0) /
+                max(b2.get('first_token_ms', 1.0), 1e-9), 2),
+            'zero_compile_boot': b2.get('jax_compiles') == 0,
+            'tokens_match': b1.get('tokens') == b2.get('tokens'),
+        }
+    except subprocess.TimeoutExpired:
+        return {'error': f'cold-start child timed out after {timeout_s:.0f}s'}
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def bench_fleet(duration_s=2.0, rate_mult=2.0, seed=0):
     """Serving fleet fabric on CPU (ISSUE 16 acceptance numbers, measured
     — ``extras.fleet``):
@@ -1680,6 +1772,13 @@ def _child_main(mode, model):
             serving_extras['generative'] = bench_serving_generative()
         except Exception as e:       # must never sink smoke either
             serving_extras['generative'] = {'error': repr(e)}
+        try:
+            # zero-compile fleet boot (ISSUE 19): two subprocess boots
+            # against one compile-cache dir — boot 2 must hit jax.compiles
+            # == 0 at hit_rate 1.0, with first-token wall-ms for both
+            serving_extras['cold_start'] = bench_cold_start()
+        except Exception as e:       # must never sink smoke either
+            serving_extras['cold_start'] = {'error': repr(e)}
         try:
             # fleet fabric (ISSUE 16): 3-replica Poisson storm with a
             # mid-run replica kill — fleet vs single QPS, error rate in

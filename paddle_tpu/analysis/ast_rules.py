@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL020).
+"""The graftlint AST rule catalog (GL001–GL021).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -86,6 +86,15 @@ but destroys performance or correctness on real hardware:
   the slow-leak class the doctor's trend detectors catch at runtime,
   caught here statically. Bounded rings like ``observability.timeseries``
   are the sanctioned shape (tests/tools/bench harnesses exempt).
+
+- GL021: a serving-registration-shaped ``jax.jit`` (a warmup-owning class
+  binding jitted prefill/decode/verify/propose/draft/batch program
+  attributes) in library code with no reference to the persistent compile
+  tier — every replica boot/relaunch recompiles the whole program set,
+  the cold-start storm ``paddle_tpu.compilecache`` exists to remove; wrap
+  the program in ``compilecache.CachedJit`` (warm by label) or route it
+  through ``compilecache.fetch_or_compile`` so a populated artifact dir
+  deserializes instead of compiling (tests/tools/bench exempt).
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -1724,3 +1733,115 @@ class UnboundedAccumulationRule(Rule):
                             "OOMs; use collections.deque(maxlen=...), a "
                             "ring (see observability.timeseries), an "
                             "LRU, or evict behind a len() check")
+
+
+# -- GL021: cache-blind serving warmup (raw jax.jit under a warmup class) -----
+
+# serving program names: the attribute tells — a runner's jitted prefill/
+# decode/verify/propose/draft/batch entrypoints are exactly the programs a
+# replica recompiles on every relaunch when they bypass the persistent
+# compile tier. '_fn'/'fn' covers the one-shot batch runner spelling.
+_WARMUP_PROGRAM_HINTS = ('prefill', 'decode', 'propose', 'verify', 'draft',
+                         'batch')
+_WARMUP_FN_ATTRS = {'fn', '_fn'}
+# any of these names appearing in the module marks it cache-aware: the
+# program set rides the persistent tier (module-level sanction — precision
+# over recall, like GL016's sharding-object check)
+_CACHE_SANCTION_NAMES = {'CachedJit', 'compilecache', 'fetch_or_compile'}
+# harnesses measure, they don't ship; the compilecache package is the
+# sanctioned wrapper itself
+_WARMUP_EXEMPT_PREFIXES = ('tests/', 'tools/', 'paddle_tpu/compilecache/',
+                           'compilecache/')
+
+
+def _module_cache_aware(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in _CACHE_SANCTION_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _CACHE_SANCTION_NAMES:
+            return True
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in node.names] + \
+                [a.asname or '' for a in node.names]
+            if isinstance(node, ast.ImportFrom):
+                names.append(node.module or '')
+            if any(n.split('.')[-1] in _CACHE_SANCTION_NAMES
+                   for n in names if n):
+                return True
+    return False
+
+
+def _serving_program_attr(attr):
+    low = attr.lower()
+    return attr in _WARMUP_FN_ATTRS or \
+        any(h in low for h in _WARMUP_PROGRAM_HINTS)
+
+
+@register
+class CacheBlindServingWarmupRule(Rule):
+    """GL021: a serving-registration-shaped ``jax.jit`` in library code
+    that ignores the persistent compile tier. A class that owns a
+    ``warmup()`` method and binds ``self._prefill = jax.jit(...)``-style
+    program attributes is a serving runner: its warmup recompiles the
+    whole program set on EVERY replica boot/relaunch — exactly the
+    cold-start compile storm ``paddle_tpu.compilecache`` removes. Wrap
+    the program in ``compilecache.CachedJit`` and warm it by label (or
+    route it through ``compilecache.fetch_or_compile``) so a boot
+    against a populated artifact dir deserializes instead of compiling.
+    A module that references the cache surface anywhere is sanctioned —
+    it already rides the tier."""
+    id = 'GL021'
+    title = 'cache-blind serving warmup (raw jax.jit under warmup class)'
+
+    def in_scope(self, rel):
+        if any(rel.startswith(p) for p in _WARMUP_EXEMPT_PREFIXES):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        if _module_cache_aware(ctx.tree):
+            return
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            has_warmup = any(
+                isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and f.name == 'warmup' for f in cls.body)
+            if not has_warmup:
+                continue
+            for node in ast.walk(cls):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                tgt = node.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == 'self'
+                        and _serving_program_attr(tgt.attr)):
+                    continue
+                val = node.value
+                # self._x = jax.jit(fn) and the conditional
+                # `jax.jit(fn) if compile else fn` spelling
+                cands = [val]
+                if isinstance(val, ast.IfExp):
+                    cands = [val.body, val.orelse]
+                jit_call = next(
+                    (c for c in cands if isinstance(c, ast.Call)
+                     and (_tail_name(c.func) == 'jit'
+                          or _is_partial_jit(c))), None)
+                if jit_call is None:
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"`self.{tgt.attr} = jax.jit(...)` in warmup-owning "
+                    f"class {cls.name} bypasses the persistent compile "
+                    "tier — every replica boot/relaunch recompiles this "
+                    "program from scratch (the cold-start storm "
+                    "compilecache removes); wrap it in paddle_tpu."
+                    "compilecache.CachedJit and warm by label (or use "
+                    "compilecache.fetch_or_compile) so a populated "
+                    "artifact_dir deserializes instead of compiling")

@@ -130,7 +130,7 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
         remat=None, donate='auto', matmul_precision='auto', sharding=None,
         checkpoint=None, checkpoint_every=0, async_save=True,
         resume_from=None, preempt_save=True, checkpoint_max_keep=3,
-        world=None, rank=None):
+        world=None, rank=None, serve_artifacts=None, serve_generative=None):
     """Train ``network`` over ``data`` through the unified compiled step.
 
     ``data``: a DataLoader or any iterable of ``(inputs, labels)`` batches
@@ -166,9 +166,27 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
       only its checkpoint shard; rank 0 commits the manifest after the
       shard barrier.
 
+    Train→serve warm handoff (docs/SERVING.md, "AOT registration"):
+
+    - ``serve_artifacts=``: a directory — after the final epoch, the loop
+      AOT-compiles + serializes the trained network's eval/infer program
+      at the training batch shapes into it (``paddle_tpu.compilecache``
+      format), so a serving replica registering against that dir boots
+      with zero compiles.
+    - ``serve_generative=``: a ``serving.GenerativeSpec`` (wrapping the
+      trained weights), or ``(name, spec)`` — additionally exports the
+      paged serving tier's whole closed program set (chunked-prefill
+      buckets, decode, and the speculative draft/verify set when the spec
+      carries one) into the same dir. Cache keys embed the model name:
+      the serving replica must ``register(name, ...)`` under the same one
+      (a bare spec exports as ``'model'``). A preempted run skips the
+      export (the artifact dir only ever holds programs a completed run
+      stands behind).
+
     Returns a report dict: floated losses at log cadence, step counts,
     steps/sec, and the final functional state (already written back into
-    ``network``/``optimizer``).
+    ``network``/``optimizer``); with ``serve_artifacts=`` also a
+    ``serve_artifacts`` entry naming the dir and exported program count.
     """
     from ..core import rng as _rng
     from ..nn.layer_base import buffer_values, param_values
@@ -238,6 +256,7 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
     sync_every = max(1, min(log_every, guard_cap))
     needs_sync = nan_guard is not None or step.scaler is not None
     sw = _obs.Stopwatch()
+    first_feed = None
     try:
         for epoch in range(int(start_epoch), int(epochs)):
             source = _grouped(data, k)
@@ -260,6 +279,15 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
                                           convert=convert)
             dispatch_in_epoch = skip_dispatches
             for bx, by in source:
+                if first_feed is None:
+                    # the serving export compiles at the training feed
+                    # shapes; microbatch groups carry a leading scan axis
+                    # the per-request program does not have
+                    first_feed = tuple(
+                        (tuple(np.shape(v))[1:] if k > 1
+                         else tuple(np.shape(v)),
+                         np.dtype(getattr(v, 'dtype', np.float32)))
+                        for v in bx)
                 if k == 1:
                     key = _rng.next_key()
                 else:
@@ -299,8 +327,13 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
                 save_now(epoch + 1, 0)
         if mgr is not None and checkpoint_every:
             save_now(int(epochs), 0)
-        return _finish(report, sw, step, state, network, optimizer,
-                       nan_guard, scaler, needs_sync, mgr, guard)
+        out = _finish(report, sw, step, state, network, optimizer,
+                      nan_guard, scaler, needs_sync, mgr, guard)
+        if serve_artifacts is not None:
+            out['serve_artifacts'] = _export_serve_artifacts(
+                serve_artifacts, network, state, first_feed,
+                serve_generative)
+        return out
     except BaseException:
         _cleanup(step, state, network, optimizer, nan_guard, scaler,
                  needs_sync, mgr, guard)
@@ -308,6 +341,73 @@ def fit(network, loss, optimizer, data, *, epochs=1, microbatch=1,
 
 
 _PREEMPT_FENCE_S = 5.0
+
+
+def _export_serve_artifacts(art_dir, network, state, first_feed,
+                            generative):
+    """Train→serve warm handoff: AOT-compile + serialize the programs the
+    serving tier will run, into ``art_dir`` (compilecache format).
+
+    Two program families: the trained network's eval/infer forward at the
+    training feed shapes (the programs ``ServingEngine.register(layer=)``
+    / batch serving dispatches), and — when ``generative`` carries a
+    ``GenerativeSpec`` over the trained weights — the paged runner's whole
+    closed set (chunked-prefill buckets, decode, draft/verify). Executable
+    bytes are weight-independent (params are runtime inputs), so the
+    artifacts stay valid as the checkpoint advances.
+    """
+    from .. import compilecache as _cc
+    from ..core.rng import key_scope, next_key
+    from ..core.tensor import Tensor
+    from ..nn.layer_base import functional_call
+    info = {'dir': str(art_dir), 'programs': 0}
+    with _cc.use(art_dir):
+        if first_feed:
+            was_training = getattr(network, 'training', False)
+            network.eval()
+            try:
+                def infer_fn(params_and_buffers, *feed):
+                    with key_scope(key0):
+                        out, _ = functional_call(
+                            network, params_and_buffers,
+                            *[Tensor(v) for v in feed])
+                    outs = out if isinstance(out, (list, tuple)) else [out]
+                    return tuple(o._value for o in outs)
+
+                key0 = next_key()
+                st = {**state['params'], **state['buffers']}
+                feed_zeros = tuple(jnp.asarray(np.zeros(s, d))
+                                   for s, d in first_feed)
+                cj = _cc.CachedJit(infer_fn)
+                cj.warm('engine.infer.%s' % type(network).__name__,
+                        st, *feed_zeros, kind='engine.infer',
+                        meta={'net': type(network).__name__})
+                info['programs'] += 1
+            finally:
+                if was_training:
+                    network.train()
+        if generative is not None:
+            # a throwaway paged runner's warmup IS the export: it walks
+            # the exact closed program set a serving replica will
+            # register. Cache keys embed the model name, so the replica
+            # must register under the same one — pass (name, spec) to
+            # pick it, bare spec exports as 'model'
+            from ..serving.paged_runner import PagedGenerativeRunner
+            from ..serving.scheduler import AdmissionQueue
+            if isinstance(generative, tuple):
+                serve_name, spec = generative
+            else:
+                serve_name, spec = 'model', generative
+            runner = PagedGenerativeRunner(serve_name,
+                                           AdmissionQueue(serve_name, 4),
+                                           spec)
+            info['programs'] += runner.warmup()
+            info['generative'] = serve_name
+    stats = _cc.stats()
+    info['stores'] = stats['stores']
+    if _obs.enabled():
+        _obs.event('engine.serve_export', **info)
+    return info
 
 
 def _to_manager(source, max_keep):

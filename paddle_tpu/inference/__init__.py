@@ -188,6 +188,19 @@ class Predictor:
                              meta['exported'].get(
                                  'feed_dtypes',
                                  ['float32'] * len(self._feed_names))]
+        # run through the persistent compile tier: in-memory jit caching
+        # per feed signature always; against a bound compilecache dir the
+        # executable is AOT-deserialized/committed per signature, so a
+        # fresh process (or a serving replica registering this predictor
+        # with artifact_dir=) replays it with zero compiles
+        from .. import compilecache as _cc
+        self._call = _cc.CachedJit(
+            lambda feed_vals, param_vals:
+                self._exported.call(feed_vals, param_vals),
+            auto_label='predictor.%s' % os.path.basename(
+                os.path.abspath(dirname)),
+            kind='predictor', meta={'dir': os.path.basename(
+                os.path.abspath(dirname))})
 
     @property
     def feed_names(self):
@@ -210,7 +223,7 @@ class Predictor:
         # which the export was not built for) — same as Executor.run
         feed_vals = [np.asarray(feed[n], dtype=dt)
                      for n, dt in zip(self._feed_names, self._feed_dtypes)]
-        outs = self._exported.call(feed_vals, self._param_vals)
+        outs = self._call(feed_vals, self._param_vals)
         fetched = [np.asarray(o) for o in outs]
         from .. import observability as _obs
         if _obs.enabled():

@@ -61,6 +61,15 @@ Detector catalog (docs/OBSERVABILITY.md has the operator version):
                       replicas; fix the failing replica, then bound
                       max_retries / hedging and let the shed ladder
                       engage first.
+- ``cold_compile_storm`` a persistent compile cache is bound yet the boot
+                      is compiling anyway: cached executables rejected at
+                      load (CRC mismatch / jax version skew —
+                      ``compilecache.incompat`` climbing), or the hit
+                      rate collapsed against a populated dir (wrong dir /
+                      stale program set). The fix-it names
+                      ``tools/compilecache.py --verify`` and the
+                      ``PADDLE_TPU_COMPILE_CACHE`` knob. Quiet when no
+                      cache is bound or on the first populate pass.
 - ``lint_debt``       the tree's justified graftlint waivers (inline
                       ``graftlint: disable`` + ``[[graftlint.waiver]]``
                       blocks) outgrew the ``lint_debt_threshold`` budget
@@ -131,6 +140,9 @@ QPS_COLLAPSE_RATIO = 0.3       # trailing-window rate / run median rate
 QPS_COLLAPSE_WINDOW = 3        # samples in the trailing window
 COMPILE_CREEP_PLATEAU = 3      # consecutive zero-delta samples = warmed up
 COMPILE_CREEP_GRACE = 3        # post-plateau compiles tolerated
+COLD_STORM_COMPILES = 5        # boot compiles despite a populated cache
+COLD_STORM_HIT_RATE = 0.5      # persistent-tier hit rate below = storm
+COLD_STORM_INCOMPAT = 1        # rejected cache entries tolerated - 1
 
 
 def _labeled(section, prefix, key='model'):
@@ -1021,6 +1033,72 @@ def detect_perf_regression(events=None, snapshot=None, cluster=None,
             n_baseline=reg['n_baseline'])
 
 
+def detect_cold_compile_storm(events=None, snapshot=None, cluster=None,
+                              cold_storm_compiles=COLD_STORM_COMPILES,
+                              cold_storm_hit_rate=COLD_STORM_HIT_RATE,
+                              cold_storm_incompat=COLD_STORM_INCOMPAT,
+                              **_):
+    """A persistent compile cache is bound and consulted, yet the process
+    is paying the boot compile storm anyway — the zero-compile-boot
+    contract is broken. Two firing shapes:
+
+    - ``compilecache.incompat`` >= ``cold_storm_incompat``: entries are
+      being REJECTED (CRC mismatch from torn/corrupted files, jax/backend
+      version skew, topology drift) — every rejection is a paid compile
+      that a healthy cache would have served (critical when rejections
+      dominate the lookups: the cache is effectively poisoned).
+    - hit rate below ``cold_storm_hit_rate`` while ``jax.compiles`` >=
+      ``cold_storm_compiles``: lookups mostly miss, i.e. the dir the
+      process was pointed at was populated by a different program set /
+      key anatomy (wrong dir, changed labels, changed shapes).
+
+    Quiet when no cache is bound (no ``compilecache.*`` lookups — a first
+    boot against an EMPTY dir is also quiet: misses with near-zero prior
+    inventory are the populate pass, not a storm)."""
+    if snapshot is None:
+        return
+    hits = int(_ctr(snapshot, 'compilecache.hits'))
+    misses = int(_ctr(snapshot, 'compilecache.misses'))
+    incompat = int(_ctr(snapshot, 'compilecache.incompat'))
+    lookups = hits + misses + incompat
+    if lookups <= 0:
+        return                      # no persistent tier in play: quiet
+    compiles = int(_ctr(snapshot, 'jax.compiles'))
+    entries = int((snapshot.get('gauges') or {})
+                  .get('compilecache.entries', 0))
+    fix = ("verify the cache dir: `python tools/compilecache.py <dir> "
+           "--verify` (CRC + version skew per entry), gc stale entries "
+           "(`--gc --keep-bytes N`), and check the process is pointed at "
+           "the dir the fleet populates (PADDLE_TPU_COMPILE_CACHE, or "
+           "artifact_dir= on register/fit/FleetSupervisor) — a first "
+           "boot populates, every later boot must hit")
+    if incompat >= int(cold_storm_incompat):
+        poisoned = incompat >= max(1, lookups // 2)
+        yield _diag(
+            'cold_compile_storm', 'critical' if poisoned else 'warning',
+            f"{incompat} cached executable(s) rejected at load "
+            f"(of {lookups} lookup(s)) — corrupt bytes, CRC mismatch, or "
+            "jax/backend version skew; each rejection re-paid a compile "
+            "the persistent cache exists to skip",
+            fix, incompat=incompat, hits=hits, misses=misses,
+            jax_compiles=compiles, cache_entries=entries)
+        return
+    hit_rate = hits / lookups
+    # misses against a near-empty inventory are the populate pass; the
+    # storm is missing against a POPULATED dir
+    populated = entries > misses
+    if populated and hit_rate < float(cold_storm_hit_rate) and \
+            compiles >= int(cold_storm_compiles):
+        yield _diag(
+            'cold_compile_storm', 'warning',
+            f"boot compiled {compiles} program(s) with a populated "
+            f"persistent cache bound ({entries} entries): hit rate "
+            f"{hit_rate:.0%} over {lookups} lookup(s) — the cached set "
+            "does not match what this process compiles",
+            fix, hit_rate=round(hit_rate, 4), hits=hits, misses=misses,
+            jax_compiles=compiles, cache_entries=entries)
+
+
 DETECTORS = {
     'straggler': detect_straggler,
     'retrace_storm': detect_retrace_storm,
@@ -1034,6 +1112,7 @@ DETECTORS = {
     'elastic_downsize': detect_elastic_downsize,
     'replica_flapping': detect_replica_flapping,
     'retry_storm': detect_retry_storm,
+    'cold_compile_storm': detect_cold_compile_storm,
     'lint_debt': detect_lint_debt,
     'page_leak': detect_page_leak,
     'latency_creep': detect_latency_creep,

@@ -55,6 +55,7 @@ import time
 from . import atomic_io
 
 __all__ = ['FaultInjector', 'flaky', 'poison_loss', 'corrupt_file',
+           'corrupt_compile_cache',
            'truncate_file', 'PreemptAtStep', 'InjectedWriteError',
            'poison_sample', 'kill_worker', 'hang_worker', 'slow_rank',
            'slow_model', 'latency_ramp', 'slow_loader', 'slow_collective',
@@ -205,6 +206,45 @@ def corrupt_file(path, offset=0, nbytes=1):
         f.seek(offset)
         f.write(bytes(b ^ 0xFF for b in block))
     return path
+
+
+def corrupt_compile_cache(cache_dir, n=None, mode='corrupt'):
+    """Damage committed persistent-compile-cache entries (deterministic
+    repro for the doctor's ``cold_compile_storm`` detector and the
+    compilecache incompat-fallback tests).
+
+    ``mode='corrupt'`` XOR-flips a byte mid-payload in the first ``n``
+    entry files (all when ``n`` is None) — the CRC manifest catches it at
+    load. ``mode='truncate'`` tears them instead. ``mode='skew'`` rewrites
+    the manifest's recorded jax version to a fake one — the version gate
+    rejects every entry with untouched bytes. Returns the list of damaged
+    paths (or the manifest path for ``skew``)."""
+    import json
+    manifest = os.path.join(cache_dir, 'manifest.json')
+    with open(manifest, 'rb') as f:
+        doc = json.loads(f.read().decode('utf-8'))
+    entries = doc.get('entries', {})
+    if mode == 'skew':
+        for ent in entries.values():
+            ent['jax'] = '0.0.faultinjected'
+        with open(manifest, 'w', encoding='utf-8') as f:
+            json.dump(doc, f)
+        return [manifest]
+    damaged = []
+    for key in sorted(entries):
+        if n is not None and len(damaged) >= int(n):
+            break
+        path = os.path.join(cache_dir, entries[key].get('file', ''))
+        if not os.path.exists(path):
+            continue
+        if mode == 'truncate':
+            truncate_file(path)
+        else:
+            # mid-payload: headers tearing too would fail unpickle before
+            # the CRC check — the CRC must be what catches it
+            corrupt_file(path, offset=os.path.getsize(path) // 2)
+        damaged.append(path)
+    return damaged
 
 
 def truncate_file(path, keep_bytes=None, drop_bytes=None):

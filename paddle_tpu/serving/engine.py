@@ -102,7 +102,8 @@ class ServingEngine:
                  queue_capacity=None, jit_compile=True,
                  kv_cache='paged', page_size=16, num_pages=None,
                  max_concurrency=None, draft=None, draft_k=4,
-                 prefix_cache=True, slo_ms=None, slo_objective=0.99):
+                 prefix_cache=True, slo_ms=None, slo_objective=0.99,
+                 artifact_dir=None):
         """Register one model under ``name``. Exactly one of
         ``predict_fn``/``layer``/``program``/``predictor``/``generative``
         must be given; one-shot kinds also need ``example`` (one request's
@@ -123,7 +124,19 @@ class ServingEngine:
         tracker: ``slo_objective`` (default 0.99) of requests must
         complete OK within ``slo_ms`` end-to-end. Violations burn the
         error budget; the doctor's ``slo_burn`` detector fires when the
-        burn rate crosses 1x (docs/OBSERVABILITY.md, "SLO tracking")."""
+        burn rate crosses 1x (docs/OBSERVABILITY.md, "SLO tracking").
+
+        ``artifact_dir=`` binds this model to a persistent compile-cache
+        directory (``paddle_tpu.compilecache``): ``warmup()`` deserializes
+        the model's AOT-serialized executables from it instead of
+        compiling — a replica booted against a populated dir serves its
+        first request with ``jax.compiles == 0`` — and a first boot
+        populates it for the next one. Applies to every kind (predict_fn/
+        layer models through the runner's jits, program= through the
+        Executor's persistent tier, predictor= through the export's
+        cached call path). Overrides the process-wide
+        ``PADDLE_TPU_COMPILE_CACHE`` binding for this model's warmup
+        (docs/SERVING.md, "AOT registration")."""
         given = [k for k, v in (('predict_fn', predict_fn), ('layer', layer),
                                 ('program', program),
                                 ('predictor', predictor),
@@ -214,6 +227,7 @@ class ServingEngine:
             runner = BatchRunner(name, queue, fn, example,
                                  bucket_spec=bucket_spec,
                                  jit_compile=jit_compile)
+        runner.artifact_dir = artifact_dir
         with self._cond:
             self._models[name] = runner
             self._queues[name] = queue
@@ -464,14 +478,20 @@ class ServingEngine:
         return steps
 
     def warmup(self):
-        """Compile every registered model's closed shape set now, so the
-        first real request never pays an XLA compile. Returns
-        {model: programs_compiled}."""
+        """Ready every registered model's closed shape set now, so the
+        first real request never pays an XLA compile. Models registered
+        with ``artifact_dir=`` (or a process-wide
+        ``PADDLE_TPU_COMPILE_CACHE`` binding) deserialize their
+        AOT-serialized executables instead of compiling them — and a
+        first boot commits what it compiled for the next one. Returns
+        {model: programs_readied}."""
+        from .. import compilecache as _cc
         out = {}
         with _obs.timer('serving.warmup'):
             for name, runner in self._models.items():
-                out[name] = runner.warmup() if hasattr(runner, 'warmup') \
-                    else 0
+                with _cc.use(getattr(runner, 'artifact_dir', None)):
+                    out[name] = runner.warmup() \
+                        if hasattr(runner, 'warmup') else 0
         return out
 
     def start(self):

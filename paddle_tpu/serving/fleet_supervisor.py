@@ -40,14 +40,21 @@ class FleetSupervisor:
     workers (the factory owns that choice; manual-drive fleets return
     un-started engines). ``warmup=True`` pre-compiles the new engine's
     shape set before it rejoins, so even the half-open probes never pay
-    an XLA compile. ``relaunch_backoff_s`` paces repeated restarts of the
-    same replica on the shared retry curve (0 keeps chaos tests fast)."""
+    an XLA compile. ``artifact_dir=`` removes even those warmup compiles
+    from the recovery path: the factory's registration and the warmup run
+    against a persistent compile cache (``paddle_tpu.compilecache``), so
+    a relaunch against a populated dir deserializes its whole program set
+    — death→rejoin without a compile storm (the first launch populates
+    the dir for every later one). ``relaunch_backoff_s`` paces repeated
+    restarts of the same replica on the shared retry curve (0 keeps chaos
+    tests fast)."""
 
     def __init__(self, router, replica_factory, max_restarts=3,
                  check_interval_s=0.2, warmup=True, relaunch_backoff_s=0.0,
-                 reap_timeout_s=5.0):
+                 reap_timeout_s=5.0, artifact_dir=None):
         self.router = router
         self.replica_factory = replica_factory
+        self.artifact_dir = artifact_dir
         self.max_restarts = int(max_restarts)
         self.check_interval_s = float(check_interval_s)
         self.warmup = bool(warmup)
@@ -102,9 +109,15 @@ class FleetSupervisor:
                            attempt=used + 1)
             _obs.flight.record('fleet.replica_relaunch', replica=name,
                                attempt=used + 1)
-            engine = self.replica_factory(name)
-            if self.warmup and hasattr(engine, 'warmup'):
-                engine.warmup()
+            # rebuild + warm against the persistent compile tier: with a
+            # populated artifact_dir the relaunch deserializes instead of
+            # recompiling (per-model artifact_dir= bindings still win
+            # inside engine.warmup)
+            from .. import compilecache as _cc
+            with _cc.use(self.artifact_dir):
+                engine = self.replica_factory(name)
+                if self.warmup and hasattr(engine, 'warmup'):
+                    engine.warmup()
             self.router.readmit(name, engine=engine, warm=False)
             recovery_ms = sw.elapsed_ms()
             if _obs.enabled():

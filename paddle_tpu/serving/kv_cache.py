@@ -34,7 +34,10 @@ def create_cache(num_layers, max_batch, max_seq, num_heads, head_dim,
     """Zeroed cache pytree: ``{'k','v'}`` of ``[L, B, S, H, D]``."""
     shape = (int(num_layers), int(max_batch), int(max_seq),
              int(num_heads), int(head_dim))
-    return {'k': jnp.zeros(shape, dtype), 'v': jnp.zeros(shape, dtype)}
+    # host-built zeros: device transfer only, no tiny fill-program compile
+    # (keeps an AOT cold boot at jax.compiles == 0 — see compilecache)
+    z = np.zeros(shape, np.dtype(dtype))
+    return {'k': jnp.asarray(z), 'v': jnp.asarray(z)}
 
 
 def write_prompt(cache, layer, slot, k, v):

@@ -60,7 +60,10 @@ def create_paged_cache(num_layers, num_pages, page_size, num_heads, head_dim,
     """Zeroed paged cache pytree: ``{'k','v'}`` of ``[L, P, ps, H, D]``."""
     shape = (int(num_layers), int(num_pages), int(page_size),
              int(num_heads), int(head_dim))
-    return {'k': jnp.zeros(shape, dtype), 'v': jnp.zeros(shape, dtype)}
+    # host-built zeros: device transfer only, no tiny fill-program compile
+    # (keeps an AOT cold boot at jax.compiles == 0 — see compilecache)
+    z = np.zeros(shape, np.dtype(dtype))
+    return {'k': jnp.asarray(z), 'v': jnp.asarray(z)}
 
 
 def write_chunk(cache, layer, block_row, k, v, start, nvalid):

@@ -32,6 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import compilecache as _cc
 from .. import observability as _obs
 from .bucketing import (BucketSpec, pad_to_bucket, select_bucket,
                         stack_examples)
@@ -137,7 +138,9 @@ class BatchRunner:
         self.spec = bucket_spec or BucketSpec()
         self.example = {k: np.asarray(v) for k, v in example.items()}
         self._jitted = bool(jit_compile)
-        self._fn = jax.jit(batch_fn) if jit_compile else batch_fn
+        # CachedJit = jax.jit + the persistent executable tier: warmup
+        # against a bound artifact dir deserializes instead of compiling
+        self._fn = _cc.CachedJit(batch_fn) if jit_compile else batch_fn
         self.stats = _Stats()
 
     def validate(self, req):
@@ -165,20 +168,22 @@ class BatchRunner:
         return []
 
     def warmup(self):
-        """Compile every bucket once with zero feeds (the only compiles a
-        well-bucketed model ever pays). With telemetry on, each bucket's
-        program is cost-ledgered here (Executor-backed models are ledgered
-        by the Executor itself at its cache miss)."""
-        from ..observability import costs as _costs
+        """Ready every bucket once with zero feeds: against a bound
+        compilecache artifact dir this deserializes the bucket's AOT
+        executable (zero compiles); otherwise it compiles once — the only
+        compiles a well-bucketed model ever pays. With telemetry on, each
+        bucket's program is cost-ledgered either way (Executor-backed
+        models are ledgered by the Executor itself at its cache miss)."""
         for b in self.spec.batch_buckets:
             feeds = {k: jnp.asarray(np.zeros((b,) + ex.shape, ex.dtype))
                      for k, ex in self.example.items()}
-            jax.tree_util.tree_map(
-                lambda x: np.asarray(x), self._fn(feeds))
-            if self._jitted and _obs.enabled():
-                _costs.capture(f'serving.{self.name}.b{b}', self._fn, feeds,
-                               kind='serving.batch',
-                               meta={'model': self.name, 'bucket': b})
+            if self._jitted:
+                out = self._fn.warm(f'serving.{self.name}.b{b}', feeds,
+                                    kind='serving.batch',
+                                    meta={'model': self.name, 'bucket': b})
+            else:
+                out = self._fn(feeds)
+            jax.tree_util.tree_map(lambda x: np.asarray(x), out)
         return len(self.spec.batch_buckets)
 
     def step(self):
@@ -257,8 +262,8 @@ class GenerativeRunner:
             cache, logits = spec.decode(cache, toks, pos)
             return cache, jnp.argmax(logits, axis=-1)
 
-        self._prefill = jax.jit(_prefill)
-        self._decode = jax.jit(_decode)
+        self._prefill = _cc.CachedJit(_prefill)
+        self._decode = _cc.CachedJit(_decode)
 
     def validate(self, req):
         toks = np.asarray(req.inputs.get('tokens', ()))
@@ -292,34 +297,30 @@ class GenerativeRunner:
         return out
 
     def warmup(self):
-        """Compile every prefill bucket + the decode step. Uses slot 0 with
-        dummy tokens; a real join later overwrites the slot's cache. With
-        telemetry on, each program lands in the cost ledger."""
-        from ..observability import costs as _costs
-        ledger = _obs.enabled()
+        """Ready every prefill bucket + the decode step: deserialize from
+        a bound compilecache artifact dir (zero compiles) or compile once.
+        Uses slot 0 with dummy tokens; a real join later overwrites the
+        slot's cache. With telemetry on, each program lands in the cost
+        ledger either way."""
         n = 0
         for lb in self.spec.prompt_buckets:
-            toks = jnp.zeros((lb,), jnp.int32)
+            toks = jnp.asarray(np.zeros((lb,), np.int32))
             # length/slot must be int32 ARRAYS exactly like the real calls:
             # a python int here traces a weak-typed variant and the first
             # real request would recompile the bucket
             args = (self.cache, toks, jnp.asarray(1, jnp.int32),
                     jnp.asarray(0, jnp.int32))
-            self.cache, _ = self._prefill(*args)
-            if ledger:
-                _costs.capture(f'serving.{self.name}.prefill{lb}',
-                               self._prefill, *args,
-                               kind='serving.prefill',
-                               meta={'model': self.name, 'bucket': lb})
+            self.cache, _ = self._prefill.warm(
+                f'serving.{self.name}.prefill{lb}', *args,
+                kind='serving.prefill',
+                meta={'model': self.name, 'bucket': lb})
             n += 1
         b = self.spec.max_batch
-        dargs = (self.cache, jnp.zeros((b,), jnp.int32),
-                 jnp.zeros((b,), jnp.int32))
-        self.cache, _ = self._decode(*dargs)
-        if ledger:
-            _costs.capture(f'serving.{self.name}.decode', self._decode,
-                           *dargs, kind='serving.decode',
-                           meta={'model': self.name, 'batch': b})
+        dargs = (self.cache, jnp.asarray(np.zeros((b,), np.int32)),
+                 jnp.asarray(np.zeros((b,), np.int32)))
+        self.cache, _ = self._decode.warm(
+            f'serving.{self.name}.decode', *dargs, kind='serving.decode',
+            meta={'model': self.name, 'batch': b})
         return n + 1
 
     # -- one scheduler iteration ---------------------------------------

@@ -161,10 +161,25 @@ class Executor:
                 self._cache[key] = self._compile(program, feed_names,
                                                  fetch_vars, param_names,
                                                  train_spec, dp=dp)
-            if telemetry:
+            # persistent tier (paddle_tpu.compilecache): a bound cache dir
+            # turns this in-memory miss into a deserialize instead of a
+            # compile (or an AOT compile-once + commit on true miss)
+            attach = getattr(self._cache[key], 'attach_disk_cache', None)
+            attached = bool(attach is not None
+                            and attach(feed_vals, param_vals))
+            if attach is None:
+                # donated train steps are not serialized: counted bypass
+                from .. import compilecache as _cc
+                _cc.note_bypass(
+                    getattr(self._cache[key], 'cost_label',
+                            f'executor.train.p{program._fingerprint}'),
+                    reason='donated_train_step')
+            if telemetry and not attached:
                 # cost explorer: ledger this program's FLOPs/bytes/peak
                 # memory once, at build time (train steps capture
-                # themselves at first dispatch — see TrainStep)
+                # themselves at first dispatch — see TrainStep; attached
+                # entries are ledgered by the persistent tier without the
+                # extra capture compile)
                 cap = getattr(self._cache[key], 'capture_costs', None)
                 if cap is not None:
                     cap(feed_vals, param_vals)
@@ -388,8 +403,23 @@ class Executor:
                 return _fetch_outs(fetch_vars, env), None
 
             fp = program._fingerprint
+            state = {}          # persistent-tier executable, if attached
             if sharded_feed is None:
                 def run(feed_vals, param_vals):
+                    exe = state.get('exe')
+                    if exe is not None:
+                        comp, from_cache = exe
+                        try:
+                            return comp(feed_vals, param_vals)
+                        except Exception as e:
+                            # a deserialized executable the runtime rejects
+                            # at dispatch: evict + count, recover live
+                            state.pop('exe', None)
+                            if from_cache:
+                                from .. import compilecache as _cc
+                                _cc.note_incompat(
+                                    getattr(run, 'cost_label', f'p{fp}'),
+                                    reason=repr(e)[:200])
                     return run_jit(feed_vals, param_vals)
             else:
                 def run(feed_vals, param_vals):
@@ -413,6 +443,36 @@ class Executor:
                                kind='executor.infer',
                                meta={'fingerprint': fp, 'dp': dp})
             run.capture_costs = capture_costs
+
+            def attach_disk_cache(feed_vals, param_vals):
+                """Install this entry's executable from the persistent
+                compile tier (load-or-AOT-compile-once, see
+                ``paddle_tpu.compilecache``). True means the run path now
+                dispatches an AOT executable and the cost ledger is
+                already populated — skip capture_costs (and its extra
+                compile) for this entry."""
+                from .. import compilecache as _cc
+                if _cc.active() is None:
+                    return False
+                sig = ','.join(
+                    'x'.join(str(d) for d in np.shape(v)) or '()'
+                    for v in feed_vals)
+                run.cost_label = f'executor.p{fp}[{sig}]'
+                if dp:
+                    # sharded-feed programs carry mesh placements a
+                    # serialized executable cannot re-derive portably:
+                    # deliberate, counted bypass
+                    _cc.note_bypass(run.cost_label, reason='dp_sharded')
+                    return False
+                comp, src = _cc.fetch_or_compile(
+                    run.cost_label, run_jit, (feed_vals, param_vals),
+                    kind='executor.infer',
+                    meta={'fingerprint': fp, 'dp': dp})
+                if comp is None:
+                    return False
+                state['exe'] = (comp, src == 'hit')
+                return True
+            run.attach_disk_cache = attach_disk_cache
             return run
 
         # train path: ONE compiled step through the unified engine builder

@@ -30,7 +30,12 @@ def test_worker_death_raises_instead_of_hanging():
             time.sleep(0.05)
             return np.full((4,), i, np.float32)
 
-    dl = DataLoader(Slow(), batch_size=4, num_workers=2, shuffle=False)
+    # worker_max_restarts=0 disables the PR 5 pool self-healing: with the
+    # default budget the pool RESPAWNS the killed worker (by design) and
+    # iteration completes instead of raising, which is the healing
+    # contract's own test — this one pins the raise-don't-hang contract
+    dl = DataLoader(Slow(), batch_size=4, num_workers=2, shuffle=False,
+                    worker_max_restarts=0)
     it = iter(dl)
     next(it)   # pool is up and producing
     pools = [o for o in _live_pools()]

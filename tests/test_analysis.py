@@ -990,6 +990,95 @@ def test_gl020_exempts_harnesses_and_waiver(tmp_path):
     assert active(hits) == []
 
 
+# ---------------------------------------------------------------------------
+# GL021: cache-blind serving warmup (raw jax.jit under a warmup class)
+# ---------------------------------------------------------------------------
+
+_CACHE_BLIND_SRC = (
+    "import jax\n"
+    "class Runner:\n"
+    "    def __init__(self, spec, jit_compile=True):\n"
+    "        self._prefill = jax.jit(spec.prefill)\n"          # flagged
+    "        self._decode = jax.jit(spec.decode) if jit_compile \\\n"
+    "            else spec.decode\n"                           # flagged
+    "        self.helper = spec.helper\n"       # not a serving program
+    "    def warmup(self):\n"
+    "        return 0\n"
+    "class NotARunner:\n"                       # no warmup(): out of shape
+    "    def __init__(self, spec):\n"
+    "        self._prefill = jax.jit(spec.prefill)\n")
+
+
+def test_gl021_flags_cache_blind_warmup(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'runner.py').write_text(_CACHE_BLIND_SRC)
+    findings, _ = lint_paths([str(lib / 'runner.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL021')
+    lines = _CACHE_BLIND_SRC.splitlines()
+    assert len(hits) == 2, [(f.rule, f.line) for f in findings]
+    assert 'self._prefill' in lines[hits[0] - 1]
+    assert 'self._decode' in lines[hits[1] - 1]
+    msg = [f for f in findings if f.rule == 'GL021'][0].message
+    # fix-it points at the persistent compile tier surfaces
+    assert 'CachedJit' in msg and 'artifact_dir' in msg
+
+
+def test_gl021_cache_aware_module_is_sanctioned(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    src = (
+        "import jax\n"
+        "from paddle_tpu import compilecache as _cc\n"
+        "class Runner:\n"
+        "    def __init__(self, spec):\n"
+        "        self._prefill = _cc.CachedJit(spec.prefill)\n"
+        "        self._decode = jax.jit(spec.aux)\n"  # cache-aware module
+        "    def warmup(self):\n"
+        "        return self._prefill.warm('x')\n")
+    (lib / 'ok.py').write_text(src)
+    findings, _ = lint_paths([str(lib / 'ok.py')],
+                             scan_root=str(tmp_path))
+    assert [f for f in findings if f.rule == 'GL021'] == [], \
+        [(f.rule, f.line) for f in findings]
+
+
+def test_gl021_exempts_harnesses_and_waiver(tmp_path):
+    for rel in ('tests/mod.py', 'tools/mod.py', 'bench_x.py',
+                'paddle_tpu/compilecache/wrap.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_CACHE_BLIND_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL021'] == [], rel
+    # inline waiver honored and excluded from the active set
+    p = tmp_path / 'lib.py'
+    p.write_text(
+        "import jax\n"
+        "class R:\n"
+        "    def __init__(self, spec):\n"
+        "        self._decode = jax.jit(spec.d)"
+        "  # graftlint: disable=GL021 — one-off tool runner\n"
+        "    def warmup(self):\n"
+        "        return 0\n")
+    findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+    hits = [f for f in findings if f.rule == 'GL021']
+    assert len(hits) == 1 and hits[0].waived
+    from paddle_tpu.analysis.finding import active
+    assert active(hits) == []
+
+
+def test_gl021_repo_serving_runners_lint_clean():
+    """The real runners route through CachedJit — the rule must agree."""
+    targets = [os.path.join(REPO, 'paddle_tpu', 'serving', f)
+               for f in ('runners.py', 'paged_runner.py')]
+    findings, n = lint_paths(targets, scan_root=REPO)
+    assert n == 2
+    assert [f for f in findings if f.rule == 'GL021'] == [], \
+        [(f.path, f.line) for f in findings if f.rule == 'GL021']
+
+
 def test_ten_distinct_rule_ids_on_seeded_fixtures(tmp_path):
     """The acceptance criterion, asserted directly: >=5 AST + >=5 verifier
     rule IDs fire, each finding carrying a location, and the JSON reporter

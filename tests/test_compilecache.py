@@ -1,0 +1,430 @@
+"""Zero-compile fleet boot (ISSUE 19): the persistent compile cache.
+
+Acceptance anchors:
+
+- a fresh subprocess registering a model against a POPULATED cache dir
+  serves its first request with ``jax.compiles == 0`` and
+  ``compilecache.hit_rate == 1.0`` (the headline: second boot compiles
+  nothing);
+- corrupt / version-skewed entries fall back to live compilation —
+  counted as ``incompat``, request still succeeds, never fatal;
+- cache-loaded outputs are bitwise-equal to freshly compiled ones;
+- the doctor's ``cold_compile_storm`` detector fires on the
+  faultinject-reproduced poisoned-cache shape and stays quiet on
+  healthy boots;
+- ``tools/compilecache.py`` lists/verifies/GCs the cache from the
+  manifest alone (stdlib-only);
+- ``engine.fit(serve_artifacts=...)`` exports the serving program set a
+  replica then boots from, and ``FleetSupervisor(artifact_dir=...)``
+  relaunches without recompiling.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu import compilecache as cc
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import doctor as doc
+from paddle_tpu.resilience import faultinject
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _cc_isolation():
+    cc.reset_stats()
+    yield
+    cc.disable()
+    cc.reset_stats()
+
+
+def _warm_one(root, label='t.double', n=8):
+    """One CachedJit program warmed against ``root``; returns the output."""
+    cc.enable(root)
+    cj = cc.CachedJit(lambda x: x * 2.0 + 1.0)
+    return np.asarray(cj.warm(label, jnp.asarray(np.arange(n, dtype=np.float32))))
+
+
+# ---------------------------------------------------------------------------
+# round-trip + bitwise parity
+# ---------------------------------------------------------------------------
+
+class TestRoundTrip:
+    def test_second_bind_hits_and_is_bitwise_equal(self, tmp_path):
+        fresh = _warm_one(str(tmp_path))
+        assert cc.stats()['misses'] == 1 and cc.stats()['stores'] == 1
+        cc.reset_stats()
+        loaded = _warm_one(str(tmp_path))      # fresh CompileCache binding
+        st = cc.stats()
+        assert st['hits'] == 1 and st['misses'] == 0
+        assert cc.hit_rate() == 1.0
+        # bitwise, not allclose: the deserialized executable IS the
+        # compiled program, same bytes out
+        assert fresh.tobytes() == loaded.tobytes()
+
+    def test_no_cache_bound_is_bypassing_noop(self):
+        cc.disable()
+        cj = cc.CachedJit(lambda x: x + 1.0)
+        out = cj.warm('t.off', jnp.asarray(np.ones((4,), np.float32)))
+        assert np.allclose(np.asarray(out), 2.0)
+        st = cc.stats()
+        assert st['hits'] == st['misses'] == st['stores'] == 0
+
+    def test_signature_mismatch_is_a_distinct_key(self, tmp_path):
+        _warm_one(str(tmp_path), n=8)
+        cc.reset_stats()
+        _warm_one(str(tmp_path), n=16)         # same label, new shape
+        st = cc.stats()
+        assert st['hits'] == 0 and st['misses'] == 1
+
+
+# ---------------------------------------------------------------------------
+# fallback: corrupt bytes / version skew are counted, never fatal
+# ---------------------------------------------------------------------------
+
+class TestFallback:
+    def test_corrupt_entry_falls_back_to_live_compile(self, tmp_path):
+        want = _warm_one(str(tmp_path))
+        damaged = faultinject.corrupt_compile_cache(str(tmp_path))
+        assert damaged, 'fault injector found no entries to corrupt'
+        cc.reset_stats()
+        got = _warm_one(str(tmp_path))
+        st = cc.stats()
+        assert st['incompat'] >= 1, st      # CRC rejected the torn bytes
+        assert st['hits'] == 0
+        assert st['stores'] >= 1            # recompiled AND re-committed
+        assert got.tobytes() == want.tobytes()
+
+    def test_version_skew_falls_back_to_live_compile(self, tmp_path):
+        want = _warm_one(str(tmp_path))
+        faultinject.corrupt_compile_cache(str(tmp_path), mode='skew')
+        cc.reset_stats()
+        got = _warm_one(str(tmp_path))
+        st = cc.stats()
+        assert st['incompat'] >= 1 and st['hits'] == 0, st
+        assert got.tobytes() == want.tobytes()
+
+    def test_truncated_entry_falls_back(self, tmp_path):
+        _warm_one(str(tmp_path))
+        faultinject.corrupt_compile_cache(str(tmp_path), mode='truncate')
+        cc.reset_stats()
+        got = _warm_one(str(tmp_path))
+        assert cc.stats()['incompat'] >= 1
+        assert np.allclose(got, np.arange(8) * 2.0 + 1.0)
+
+    def test_unreadable_manifest_disables_hits_not_boot(self, tmp_path):
+        _warm_one(str(tmp_path))
+        with open(os.path.join(str(tmp_path), cc.MANIFEST_NAME), 'w') as f:
+            f.write('{not json')
+        cc.reset_stats()
+        got = _warm_one(str(tmp_path))
+        st = cc.stats()
+        assert st['hits'] == 0 and st['incompat'] >= 1
+        assert np.allclose(got, np.arange(8) * 2.0 + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# the headline: a second serving boot compiles ZERO programs
+# ---------------------------------------------------------------------------
+
+_BOOT_CHILD = r"""
+import json, os, sys
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+import numpy as np
+from paddle_tpu import compilecache as cc
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+
+lm = serving.TinyCausalLM.random(vocab=64, embed=32, num_heads=4,
+                                 max_batch=8, max_seq=64,
+                                 prompt_buckets=(4, 8), seed=0)
+obs.enable()   # weight build above is the checkpoint-load analogue
+eng = serving.ServingEngine()
+ep = eng.register('lm', generative=lm, page_size=8, num_pages=17,
+                  artifact_dir=sys.argv[1])
+eng.warmup()
+fut = ep.submit({'tokens': np.array([3, 1, 4], np.int32)},
+                max_new_tokens=4)
+eng.run_until_idle()
+resp = fut.result(timeout=60)
+print(json.dumps({
+    'ok': bool(resp.ok),
+    'tokens': [int(t) for t in np.asarray(resp.outputs['tokens']).ravel()],
+    'jax_compiles': obs.snapshot()['counters'].get('jax.compiles', 0),
+    'cache': cc.stats(),
+}))
+"""
+
+
+def _boot(cache_dir):
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO + os.pathsep + os.environ.get('PYTHONPATH', ''))
+    proc = subprocess.run([sys.executable, '-c', _BOOT_CHILD, cache_dir],
+                          capture_output=True, text=True, timeout=240,
+                          env=env, cwd=REPO)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith('{'):
+            return json.loads(line)
+    raise AssertionError(f'boot child rc={proc.returncode}: '
+                         f'{proc.stderr[-800:]}')
+
+
+class TestColdBoot:
+    def test_second_boot_compiles_zero_programs(self, tmp_path):
+        b1 = _boot(str(tmp_path))
+        assert b1['ok'] and b1['jax_compiles'] > 0     # populate pass paid
+        assert b1['cache']['stores'] == b1['cache']['misses'] > 0
+        b2 = _boot(str(tmp_path))
+        assert b2['ok']
+        # THE acceptance criterion: zero compiles, all hits
+        assert b2['jax_compiles'] == 0, b2
+        assert b2['cache']['hit_rate'] == 1.0, b2['cache']
+        assert b2['cache']['misses'] == 0
+        # and the cache-loaded program generates the same tokens
+        assert b2['tokens'] == b1['tokens']
+
+
+# ---------------------------------------------------------------------------
+# doctor: cold_compile_storm
+# ---------------------------------------------------------------------------
+
+class TestDoctor:
+    def test_registered_for_cli_gate(self):
+        # tools/doctor.py --fail-on validates names against DETECTORS
+        assert 'cold_compile_storm' in doc.DETECTORS
+        assert doc.DETECTORS['cold_compile_storm'] \
+            is doc.detect_cold_compile_storm
+
+    def test_fires_critical_on_poisoned_cache(self):
+        snap = {'counters': {'compilecache.hits': 0,
+                             'compilecache.misses': 1,
+                             'compilecache.incompat': 4,
+                             'jax.compiles': 5},
+                'gauges': {'compilecache.entries': 5}}
+        hits = list(doc.detect_cold_compile_storm(snapshot=snap))
+        assert len(hits) == 1 and hits[0]['severity'] == 'critical'
+        assert hits[0]['cause'] == 'cold_compile_storm'
+        # fix-it names the CLI and the env knob
+        assert 'tools/compilecache.py' in hits[0]['fix']
+        assert 'PADDLE_TPU_COMPILE_CACHE' in hits[0]['fix']
+
+    def test_fires_warning_on_missing_against_populated_dir(self):
+        snap = {'counters': {'compilecache.hits': 1,
+                             'compilecache.misses': 9,
+                             'jax.compiles': 9},
+                'gauges': {'compilecache.entries': 40}}
+        hits = list(doc.detect_cold_compile_storm(snapshot=snap))
+        assert len(hits) == 1 and hits[0]['severity'] == 'warning'
+
+    def test_quiet_on_healthy_and_first_boot(self):
+        # healthy: everything hit
+        snap = {'counters': {'compilecache.hits': 9, 'jax.compiles': 0},
+                'gauges': {'compilecache.entries': 9}}
+        assert not list(doc.detect_cold_compile_storm(snapshot=snap))
+        # first boot against an empty dir: misses ARE the populate pass
+        snap = {'counters': {'compilecache.misses': 9, 'jax.compiles': 9},
+                'gauges': {'compilecache.entries': 9}}
+        assert not list(doc.detect_cold_compile_storm(snapshot=snap))
+        # no cache bound at all: not this detector's business
+        assert not list(doc.detect_cold_compile_storm(
+            snapshot={'counters': {'jax.compiles': 50}}))
+
+    @pytest.mark.obs
+    def test_deterministic_repro_via_faultinject(self, tmp_path):
+        """The documented repro: populate, poison every entry, reboot —
+        the live counters drive the detector to critical."""
+        _warm_one(str(tmp_path), label='storm.a')
+        _warm_one(str(tmp_path), label='storm.b')
+        faultinject.corrupt_compile_cache(str(tmp_path))
+        obs.reset()
+        obs.enable()
+        try:
+            cc.reset_stats()
+            _warm_one(str(tmp_path), label='storm.a')
+            _warm_one(str(tmp_path), label='storm.b')
+            hits = list(doc.detect_cold_compile_storm(
+                snapshot=obs.snapshot()))
+        finally:
+            obs.disable()
+            obs.reset()
+        assert len(hits) == 1 and hits[0]['severity'] == 'critical'
+        assert hits[0]['evidence']['incompat'] >= 2
+
+    def test_doctor_cli_gates_on_run_dir(self, tmp_path):
+        """``tools/doctor.py <run_dir> --fail-on cold_compile_storm``
+        fires from a rank telemetry head: the head's ``metrics`` field
+        carries the full dotted-counter registry snapshot, and the CLI
+        must feed it to the snapshot-based detectors."""
+        head = {
+            'rank': 0, 'pid': 1, 'host': 'h', 'ts': 1.0,
+            'metrics': {
+                'counters': {'compilecache.hits': 0,
+                             'compilecache.misses': 1,
+                             'compilecache.incompat': 4,
+                             'jax.compiles': 5},
+                'gauges': {'compilecache.entries': 5},
+                'histograms': {},
+            },
+            'counters': {'jax_compiles': 5},
+        }
+        (tmp_path / 'telemetry_rank0.json').write_text(json.dumps(head))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, 'tools', 'doctor.py'),
+             str(tmp_path), '--fail-on', 'cold_compile_storm'],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert 'cold_compile_storm' in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# tools/compilecache.py (stdlib CLI)
+# ---------------------------------------------------------------------------
+
+def _cli(*args):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'compilecache.py')]
+        + list(args), capture_output=True, text=True, timeout=60)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+class TestCli:
+    def _populated(self, tmp_path):
+        for i, n in enumerate((4, 8, 16)):
+            _warm_one(str(tmp_path), label=f'cli.t{i}', n=n)
+        return str(tmp_path)
+
+    def test_list_and_json(self, tmp_path):
+        root = self._populated(tmp_path)
+        rc, out, _ = _cli(root)
+        assert rc == 0 and 'cli.t0' in out and '3 entries' in out
+        rc, out, _ = _cli(root, '--json')
+        assert rc == 0
+        doc_ = json.loads(out)
+        assert len(doc_['entries']) == 3
+        row = doc_['entries'][0]
+        for field in ('key', 'label', 'bytes', 'jax', 'backend', 'sig'):
+            assert field in row, row
+
+    def test_verify_catches_corruption(self, tmp_path):
+        root = self._populated(tmp_path)
+        rc, _, _ = _cli(root, '--verify')
+        assert rc == 0
+        faultinject.corrupt_compile_cache(root, n=1)
+        rc, out, _ = _cli(root, '--verify')
+        assert rc == 1 and 'BAD' in out
+
+    def test_gc_evicts_lru_down_to_budget(self, tmp_path):
+        root = self._populated(tmp_path)
+        # touch t2 so t0 (oldest mtime) is the LRU victim
+        man = json.load(open(os.path.join(root, 'manifest.json')))
+        by_label = {e['label']: e for e in man['entries'].values()}
+        os.utime(os.path.join(root, by_label['cli.t0']['file']),
+                 (1, 1))     # force-oldest
+        total = sum(e['bytes'] for e in man['entries'].values())
+        rc, out, _ = _cli(root, '--gc', '--keep-bytes',
+                          str(total - 1), '--json')
+        assert rc == 0
+        rep = json.loads(out)
+        assert rep['gc']['kept'] == 2
+        assert [r for r in rep['gc']['removed']
+                if r.get('label') == 'cli.t0'], rep['gc']
+        # the evicted entry is gone from BOTH manifest and disk
+        man2 = json.load(open(os.path.join(root, 'manifest.json')))
+        assert len(man2['entries']) == 2
+        assert not os.path.exists(
+            os.path.join(root, by_label['cli.t0']['file']))
+        # and the survivors still verify + still hit
+        rc, _, _ = _cli(root, '--verify')
+        assert rc == 0
+        cc.reset_stats()
+        _warm_one(root, label='cli.t1', n=8)
+        assert cc.stats()['hits'] == 1
+
+    def test_gc_requires_budget_and_bad_dir_errors(self, tmp_path):
+        rc, _, err = _cli(str(tmp_path), '--gc')
+        assert rc == 2 and 'keep-bytes' in err
+        rc, _, err = _cli(str(tmp_path / 'nope'))
+        assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# train→serve handoff + fleet relaunch
+# ---------------------------------------------------------------------------
+
+class TestWarmHandoff:
+    def test_fit_exports_and_replica_boots_on_hits(self, tmp_path):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu import engine, serving
+
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        data = [([rng.rand(4, 4).astype(np.float32)],
+                 [np.zeros((4, 2), np.float32)]) for _ in range(3)]
+        spec = serving.TinyCausalLM.random(
+            vocab=64, embed=32, num_heads=4, max_batch=8, max_seq=64,
+            prompt_buckets=(4, 8), seed=0)
+        report = engine.fit(net, nn.MSELoss(), opt, data, epochs=1,
+                            prefetch=0, serve_artifacts=str(tmp_path),
+                            serve_generative=('lm', spec))
+        art = report['serve_artifacts']
+        assert art['dir'] == str(tmp_path)
+        assert art['generative'] == 'lm'
+        # the infer forward + the paged runner's closed set all landed
+        assert art['programs'] >= 4
+        man = json.load(open(os.path.join(str(tmp_path), 'manifest.json')))
+        assert len(man['entries']) == art['programs']
+        labels = {e['label'] for e in man['entries'].values()}
+        assert any(lbl.startswith('engine.infer.') for lbl in labels)
+        assert any('serving.lm.prefill' in lbl for lbl in labels)
+
+        # a serving replica registering under the SAME name boots on hits
+        cc.reset_stats()
+        eng = serving.ServingEngine()
+        ep = eng.register('lm', generative=spec, artifact_dir=str(tmp_path))
+        eng.warmup()
+        st = cc.stats()
+        # every runner program hits (the leftover artifact is the
+        # engine.infer forward, which generative serving never asks for)
+        assert st['hits'] == art['programs'] - 1 and st['misses'] == 0, st
+        fut = ep.submit({'tokens': np.array([5, 2], np.int32)},
+                        max_new_tokens=3)
+        eng.run_until_idle()
+        assert fut.result(timeout=30).ok
+
+    def test_fleet_supervisor_relaunches_from_artifacts(self, tmp_path):
+        from paddle_tpu import serving
+
+        spec = serving.TinyCausalLM.random(
+            vocab=64, embed=32, num_heads=4, max_batch=8, max_seq=64,
+            prompt_buckets=(4,), seed=0)
+
+        def factory(name):
+            eng = serving.ServingEngine()
+            eng.register('lm', generative=spec)
+            return eng
+
+        # first boot populates the artifact dir
+        with cc.use(str(tmp_path)):
+            first = factory('r0')
+            first.warmup()
+        assert cc.stats()['stores'] > 0
+
+        router = serving.FleetRouter(serving.RouterPolicy())
+        router.add_replica('r0', first)
+        first.kill()
+        sup = serving.FleetSupervisor(router, factory, max_restarts=2,
+                                      artifact_dir=str(tmp_path))
+        cc.reset_stats()
+        assert sup.check_once() == ['r0']
+        st = cc.stats()
+        # the relaunch deserialized its whole program set: no compile storm
+        assert st['hits'] > 0 and st['misses'] == 0, st

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""compilecache: inspect a paddle_tpu persistent compile cache (docs/PERF.md).
+
+Usage::
+
+    python tools/compilecache.py <cache_dir>              # list entries
+    python tools/compilecache.py <cache_dir> --key ab12   # one entry (prefix)
+    python tools/compilecache.py <cache_dir> --verify     # CRC32 audit
+    python tools/compilecache.py <cache_dir> --gc --keep-bytes 50000000
+    python tools/compilecache.py <cache_dir> --json
+
+Reads the ``manifest.json`` that ``paddle_tpu.compilecache`` commits next
+to its ``<key>.exe`` payloads and prints, per entry: label, key, payload
+bytes, kind, input signature, and the jax/backend/device-count stamp that
+gates loads (a stamp that no longer matches this machine is a future
+``incompat`` fallback, not an error). ``--verify`` recomputes each
+payload's CRC32 against the manifest (exit 1 on any mismatch or missing
+file — the same check the loader applies before deserializing).
+``--gc --keep-bytes N`` evicts least-recently-USED entries until the
+cache fits: the runtime touches (``os.utime``) an entry file on every
+hit, so file mtime is the LRU clock, not ``created``. Orphan ``.exe``
+files (payload without a manifest row — a lost manifest race) are listed
+and reclaimed by ``--gc`` first.
+
+Stdlib-only on purpose (doctor-by-path style): CRCs are computed over the
+entry FILES, exactly what the manifest stamps, so no numpy/jax is needed
+on the machine doing the audit.
+"""
+import argparse
+import json
+import os
+import sys
+import zlib
+
+MANIFEST = 'manifest.json'
+ENTRY_SUFFIX = '.exe'
+
+
+def crc32_file(path, chunk=1 << 20):
+    crc = 0
+    with open(path, 'rb') as f:
+        for block in iter(lambda: f.read(chunk), b''):
+            crc = zlib.crc32(block, crc)
+    return crc & 0xFFFFFFFF
+
+
+def load_manifest(root):
+    path = os.path.join(root, MANIFEST)
+    if not os.path.isfile(path):
+        return None
+    with open(path, 'rb') as f:
+        doc = json.loads(f.read().decode())
+    return doc.get('entries', {})
+
+
+def orphans(root, entries):
+    """Payload files with no manifest row (lost manifest race / torn GC)."""
+    stamped = {e.get('file') for e in entries.values()}
+    out = []
+    for name in sorted(os.listdir(root)):
+        if name.endswith(ENTRY_SUFFIX) and name not in stamped:
+            out.append(name)
+    return out
+
+
+def describe(root, key, ent):
+    path = os.path.join(root, ent.get('file', ''))
+    d = {'key': key, 'label': ent.get('label'), 'kind': ent.get('kind'),
+         'sig': ent.get('sig'), 'bytes': ent.get('bytes'),
+         'jax': ent.get('jax'), 'backend': ent.get('backend'),
+         'n_devices': ent.get('n_devices'), 'created': ent.get('created'),
+         'file': ent.get('file')}
+    d['present'] = os.path.isfile(path)
+    if d['present']:
+        d['last_used'] = round(os.path.getmtime(path), 3)
+    return d
+
+
+def verify_entry(root, ent):
+    """(ok, detail) — size + CRC32 of the payload, loader-equivalent."""
+    path = os.path.join(root, ent.get('file', ''))
+    if not os.path.isfile(path):
+        return False, 'missing'
+    size = os.path.getsize(path)
+    if size != ent.get('bytes'):
+        return False, 'size %d != manifest %s' % (size, ent.get('bytes'))
+    crc = crc32_file(path)
+    if crc != ent.get('crc32'):
+        return False, ('crc 0x%08x != manifest 0x%08x'
+                       % (crc, ent.get('crc32', 0)))
+    return True, 'ok'
+
+
+def gc(root, entries, keep_bytes):
+    """Evict least-recently-used entries until <= keep_bytes remain.
+
+    Orphan payloads go first (they can never hit), then manifest entries
+    ordered by entry-file mtime — the runtime's os.utime-on-hit LRU
+    clock. Rewrites the manifest via tmp+rename (same commit discipline
+    as the runtime's atomic_write)."""
+    removed = []
+    freed = 0
+    for name in orphans(root, entries):
+        p = os.path.join(root, name)
+        freed += os.path.getsize(p)
+        os.remove(p)
+        removed.append({'file': name, 'reason': 'orphan'})
+    live = []
+    for key, ent in entries.items():
+        p = os.path.join(root, ent.get('file', ''))
+        if not os.path.isfile(p):
+            removed.append({'file': ent.get('file'), 'key': key,
+                            'reason': 'missing-payload'})
+            continue
+        live.append((os.path.getmtime(p), key, ent, p))
+    live.sort()                      # oldest mtime = least recently used
+    total = sum(ent.get('bytes', 0) for _m, _k, ent, _p in live)
+    kept = {}
+    for mtime, key, ent, p in live:
+        if total > keep_bytes:
+            total -= ent.get('bytes', 0)
+            freed += os.path.getsize(p)
+            os.remove(p)
+            removed.append({'file': ent.get('file'), 'key': key,
+                            'reason': 'lru', 'label': ent.get('label')})
+        else:
+            kept[key] = ent
+    if len(kept) != len(entries) or removed:
+        tmp = os.path.join(root, MANIFEST + '.tmp')
+        with open(tmp, 'wb') as f:
+            f.write(json.dumps({'version': 1, 'entries': kept},
+                               indent=1, sort_keys=True).encode())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(root, MANIFEST))
+    return {'removed': removed, 'freed_bytes': freed,
+            'kept': len(kept), 'kept_bytes': total}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog='compilecache',
+        description='inspect paddle_tpu persistent compile caches: '
+                    'entries, CRC verification, LRU eviction '
+                    '(docs/PERF.md, "Persistent compilation cache")')
+    p.add_argument('path', help='cache directory (manifest.json + *.exe)')
+    p.add_argument('--key', default=None,
+                   help='describe entries whose key starts with this prefix')
+    p.add_argument('--verify', action='store_true',
+                   help='CRC32-verify every payload against the manifest '
+                        '(exit 1 on any mismatch or missing file)')
+    p.add_argument('--gc', action='store_true',
+                   help='evict least-recently-used entries (requires '
+                        '--keep-bytes)')
+    p.add_argument('--keep-bytes', type=int, default=None, metavar='N',
+                   help='with --gc: shrink the cache to at most N payload '
+                        'bytes')
+    p.add_argument('--json', action='store_true', dest='as_json')
+    args = p.parse_args(argv)
+
+    if args.gc and args.keep_bytes is None:
+        print('compilecache: --gc requires --keep-bytes N', file=sys.stderr)
+        return 2
+    if not os.path.isdir(args.path):
+        print(f'compilecache: no such directory: {args.path}',
+              file=sys.stderr)
+        return 2
+    entries = load_manifest(args.path)
+    if entries is None:
+        print(f'compilecache: no {MANIFEST} under {args.path} '
+              f'(not a compile cache, or never populated)', file=sys.stderr)
+        return 2
+    if args.key is not None:
+        entries = {k: v for k, v in entries.items()
+                   if k.startswith(args.key)}
+        if not entries:
+            print(f'compilecache: no entry key matches {args.key!r}',
+                  file=sys.stderr)
+            return 2
+
+    report = {'dir': os.path.abspath(args.path),
+              'entries': [], 'orphans': orphans(args.path, entries),
+              'total_bytes': 0}
+    bad = 0
+    # newest-used last, same convention as tools/ckpt.py step listing
+    rows = sorted(entries.items(),
+                  key=lambda kv: describe(args.path, *kv).get('last_used', 0))
+    for key, ent in rows:
+        d = describe(args.path, key, ent)
+        if args.verify:
+            ok, detail = verify_entry(args.path, ent)
+            d['verify'] = {'ok': ok, 'detail': detail}
+            bad += 0 if ok else 1
+        report['entries'].append(d)
+        report['total_bytes'] += ent.get('bytes', 0)
+    if args.gc:
+        report['gc'] = gc(args.path, entries, args.keep_bytes)
+
+    if args.as_json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        for d in report['entries']:
+            line = (f"{d['key'][:12]}  {d.get('bytes', 0):>10,d} B  "
+                    f"{d.get('kind', '?'):<16} {d.get('label', '?')}")
+            line += (f"  [jax {d.get('jax')} {d.get('backend')}"
+                     f" x{d.get('n_devices')}]")
+            if not d['present']:
+                line += '  MISSING'
+            print(line)
+            if d.get('sig'):
+                print(f"    sig: {d['sig']}")
+            if 'verify' in d:
+                mark = 'OK ' if d['verify']['ok'] else 'BAD'
+                print(f"    [{mark}] {d['file']}: {d['verify']['detail']}")
+        for name in report['orphans']:
+            print(f"orphan: {name} (payload without manifest row)")
+        print(f"{len(report['entries'])} entr"
+              f"{'y' if len(report['entries']) == 1 else 'ies'}, "
+              f"{report['total_bytes']:,d} B")
+        if 'gc' in report:
+            g = report['gc']
+            print(f"gc: removed {len(g['removed'])}, freed "
+                  f"{g['freed_bytes']:,d} B; kept {g['kept']} "
+                  f"({g['kept_bytes']:,d} B)")
+            for r in g['removed']:
+                print(f"    evicted [{r['reason']}] {r['file']}")
+    return 1 if bad else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

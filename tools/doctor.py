@@ -60,16 +60,41 @@ def load_jsonl(path):
     return events
 
 
+def merged_snapshot(run_dir, aggregate):
+    """Cluster-merged registry snapshot from each rank's telemetry head.
+
+    The head's ``metrics`` field is a full ``registry.snapshot()`` — the
+    same ``{'counters': {dotted}, 'gauges': ...}`` shape the in-process
+    detectors consume (``compilecache.*``, ``serving.*``, ...), which the
+    curated flat ``counters`` summary does not carry. Counters sum across
+    ranks; gauges take the max (a gauge is a level, not a tally). Returns
+    ``None`` when no rank recorded either."""
+    counters, gauges = {}, {}
+    for _, head in sorted(aggregate.load_rank_snapshots(run_dir).items()):
+        snap = head.get('metrics') or {}
+        for k, v in (snap.get('counters') or {}).items():
+            if isinstance(v, (int, float)):
+                counters[k] = counters.get(k, 0) + v
+        for k, v in (snap.get('gauges') or {}).items():
+            if isinstance(v, (int, float)):
+                gauges[k] = max(gauges.get(k, v), v)
+    if not counters and not gauges:
+        return None
+    return {'counters': counters, 'gauges': gauges}
+
+
 def gather(path, aggregate):
-    """(events, cluster, describe-string) for a run dir / log dir / jsonl
-    file."""
+    """(events, snapshot, cluster, describe-string) for a run dir / log
+    dir / jsonl file."""
     if os.path.isfile(path):
-        return load_jsonl(path), None, f"event log {path}"
+        return load_jsonl(path), None, None, f"event log {path}"
     cluster = None
+    snapshot = None
     events = []
     parts = []
     if aggregate.rank_files(path):
         cluster = aggregate.cluster_snapshot(path)
+        snapshot = merged_snapshot(path, aggregate)
         events = aggregate.merged_events(path)
         parts.append(f"{cluster['n_ranks']} rank(s), "
                      f"step skew {cluster['step_ms_skew']}x")
@@ -86,7 +111,8 @@ def gather(path, aggregate):
             parts.append(name)
     if events and not any('event' in p for p in parts):
         parts.append(f"{len(events)} event(s)")
-    return events, cluster, f"run dir {path} ({', '.join(parts) or 'empty'})"
+    return (events, snapshot, cluster,
+            f"run dir {path} ({', '.join(parts) or 'empty'})")
 
 
 def from_url(url):
@@ -143,8 +169,9 @@ def main(argv=None):
             print(f"doctor: no such path: {args.path}", file=sys.stderr)
             return 2
         aggregate = load_obs_module('aggregate')
-        events, cluster, describe = gather(args.path, aggregate)
-        diagnoses = doctor.diagnose(events=events, cluster=cluster)
+        events, snapshot, cluster, describe = gather(args.path, aggregate)
+        diagnoses = doctor.diagnose(events=events, snapshot=snapshot,
+                                    cluster=cluster)
 
     if args.as_json:
         print(json.dumps(diagnoses, sort_keys=True, indent=1, default=repr))
