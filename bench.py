@@ -17,6 +17,7 @@ BASELINE.json; the phase-2 seq512 number is reported in "extras".
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -836,6 +837,193 @@ def bench_fleet(duration_s=2.0, rate_mult=2.0, seed=0):
                            if k in ('dispatched', 'retried', 'hedged',
                                     'hedge_wins', 'deaths', 'restarts')}
                        for n, row in router.stats()['replicas'].items()},
+        }
+    finally:
+        if not was_static:
+            paddle.disable_static()
+
+
+def bench_tenant_isolation(seed=0, ticks=12, storm_qps=12.0):
+    """Tenancy + elasticity (ISSUE 20 acceptance numbers, measured —
+    ``extras.fleet.tenants``):
+
+    - **victim-tenant isolation**: one victim request per tick while a
+      ``faultinject.tenant_storm`` floods the same engine — victim p99
+      with per-tenant quotas ON vs OFF, against a no-storm solo baseline.
+      Quotas on, the storm sheds as ``quota`` at the front door and the
+      victim's tail barely moves; off, the victim queues behind the whole
+      backlog.
+    - **per-tenant shed attribution**: the admission ledger's
+      shed-by-reason split for both rounds.
+    - **autoscale cycle**: sustained ``faultinject.burn_ramp`` grows the
+      fleet (warm via a populated compile-cache artifact dir — the hits
+      are reported), calm shrinks it back through ``drain()`` with
+      in-flight requests submitted mid-cycle: completed vs lost (must be
+      zero) and grow/shrink wall ms.
+
+    Manual-drive engines throughout: every queue interleaving is pinned
+    by the pump cadence, not wall-clock races.
+    """
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu import compilecache as _cc
+    from paddle_tpu import serving
+    from paddle_tpu.observability import slo
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.serving import admission
+
+    rng = np.random.RandomState(seed)
+    was_static = paddle.in_static_mode()
+    paddle.enable_static()
+    try:
+        w1 = (rng.randn(128, 128) * 0.05).astype(np.float32)
+        w2 = (rng.randn(128, 32) * 0.05).astype(np.float32)
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data('x', shape=[-1, 128], dtype='float32')
+            h = paddle.nn.functional.relu(
+                paddle.matmul(x, paddle.to_tensor(w1)))
+            y = paddle.matmul(h, paddle.to_tensor(w2))
+        example = {'x': np.zeros((128,), np.float32)}
+
+        def mk_engine(name, tenants=None):
+            eng = serving.ServingEngine(queue_capacity=64, tenants=tenants)
+            eng.register('mlp', program=(main, ['x'], [y]),
+                         executor=static.Executor(), example=example,
+                         bucket_spec=serving.BucketSpec((1, 2, 4, 8)))
+            eng.warmup()
+            return eng   # manual drive: pump cadence IS the clock
+
+        def one_input():
+            return {'x': rng.randn(128).astype(np.float32)}
+
+        def p99(vals):
+            return round(float(np.percentile(vals, 99)), 2) if vals else 0.0
+
+        def run_round(quotas, storm=True):
+            admission.reset_tenant_stats()
+            clock = [0.0]
+            arb = None
+            if quotas:
+                arb = serving.TenantArbiter(clock=lambda: clock[0])
+                arb.set_policy(serving.TenantPolicy(
+                    'storm', weight=1.0, rate=1.0, burst=2))
+                arb.set_policy(serving.TenantPolicy('victim', weight=2.0,
+                                                    rate=1000.0))
+            eng = mk_engine('iso', tenants=arb)
+            victim_pend, storm_shed = [], {}
+            for t in range(ticks):
+                clock[0] = float(t)
+                if storm:
+                    # one virtual-tick Poisson burst per pump tick,
+                    # deterministic off (seed, tick)
+                    burst = faultinject.tenant_storm(
+                        eng, 'mlp', one_input(), tenant='storm',
+                        qps=storm_qps, duration_ticks=1, seed=seed + t)
+                    for r, n in burst['shed'].items():
+                        storm_shed[r] = storm_shed.get(r, 0) + n
+                try:
+                    victim_pend.append(eng.submit('mlp', one_input(),
+                                                  tenant='victim'))
+                except serving.QueueFullError:
+                    pass
+                eng.pump()       # capacity: one bucket per tick — the
+            while eng.pump():    # storm offers more, the backlog is real
+                pass
+            lats = []
+            for p in victim_pend:
+                r = p.result(timeout=10)
+                if r.ok:
+                    lats.append(r.latency_ms)
+            ledger = admission.tenant_stats()
+            eng.stop()
+            return {'victim_p99_ms': p99(lats),
+                    'victim_completed': len(lats),
+                    'victim_offered': ticks,
+                    'storm_shed': storm_shed,
+                    'ledger': ledger}
+
+        solo = run_round(quotas=False, storm=False)
+        quotas_off = run_round(quotas=False)
+        quotas_on = run_round(quotas=True)
+
+        # -- autoscale grow -> shrink cycle, warm via the artifact tier --
+        artifact_dir = tempfile.mkdtemp(prefix='paddle_tpu_bench_cc_')
+        with _cc.use(artifact_dir):
+            eng0 = mk_engine('t0')           # populates the cache
+        router = serving.FleetRouter()
+        router.add_replica('t0', eng0)
+        slo.set_objective('mlp', 50.0, 0.9)
+        auto = serving.FleetAutoscaler(
+            router, replica_factory=lambda name: mk_engine(name),
+            min_replicas=1, max_replicas=2, burn_high=1.0, burn_low=0.2,
+            sustain_ticks=2, cooldown_ticks=1, artifact_dir=artifact_dir,
+            warmup=True, drain_timeout_s=15.0)
+        faultinject.burn_ramp('mlp', burn=3.0, requests=20)
+        cc_before = _cc.stats()
+        t0 = time.perf_counter()
+        grow_ticks = 0
+        while auto.tick() != 'grow' and grow_ticks < 10:
+            grow_ticks += 1
+        grow_ms = round((time.perf_counter() - t0) * 1000.0, 1)
+        cc_after = _cc.stats()
+        grew = len(router.replicas()) == 2
+        # in-flight work lands on BOTH replicas, then calm shrinks one
+        # out through drain() — nothing may be lost
+        inflight = [router.submit('mlp', one_input(), deadline_ms=20000)
+                    for _ in range(6)]
+        slo.reset()
+        slo.set_objective('mlp', 50.0, 0.9)   # calm: no traffic, burn 0
+        t0 = time.perf_counter()
+        shrink_ticks = 0
+        while auto.tick() != 'shrink' and shrink_ticks < 10:
+            shrink_ticks += 1
+        shrink_ms = round((time.perf_counter() - t0) * 1000.0, 1)
+        for h in router.replicas():        # settle the survivor
+            while h.engine.pump():
+                pass
+        completed = 0
+        for p in inflight:
+            try:
+                if p.result(timeout=10).ok:
+                    completed += 1
+            except Exception:
+                pass
+        shrink_events = [d for d in auto.decisions()
+                         if d['action'] == 'shrink']
+        for h in router.replicas():
+            h.engine.stop()
+        slo.clear_objective('mlp')
+        admission.reset_tenant_stats()
+
+        solo_p99 = solo['victim_p99_ms'] or 1e-9
+        return {
+            'victim_p99_solo_ms': solo['victim_p99_ms'],
+            'victim_p99_quota_on_ms': quotas_on['victim_p99_ms'],
+            'victim_p99_quota_off_ms': quotas_off['victim_p99_ms'],
+            'isolation_ratio_on': round(
+                quotas_on['victim_p99_ms'] / solo_p99, 3),
+            'degradation_ratio_off': round(
+                quotas_off['victim_p99_ms'] / solo_p99, 3),
+            'storm_shed_quota_on': quotas_on['storm_shed'],
+            'storm_shed_quota_off': quotas_off['storm_shed'],
+            'tenant_ledger_on': quotas_on['ledger'],
+            'autoscale': {
+                'grew': grew,
+                'grow_wall_ms': grow_ms,
+                'shrink_wall_ms': shrink_ms,
+                'replicas_after': len(router.replicas()),
+                'inflight_completed': completed,
+                'inflight_lost': len(inflight) - completed,
+                'aborted_in_drain': (shrink_events[0].get('aborted', 0)
+                                     if shrink_events else None),
+                'compilecache_hits_on_scale_up':
+                    cc_after['hits'] - cc_before['hits'],
+                'compilecache_misses_on_scale_up':
+                    cc_after['misses'] - cc_before['misses'],
+            },
         }
     finally:
         if not was_static:
@@ -1786,6 +1974,13 @@ def _child_main(mode, model):
             fleet_extras = bench_fleet()
         except Exception as e:       # fleet bench must never sink smoke
             fleet_extras = {'error': repr(e)}
+        try:
+            # tenancy + elasticity (ISSUE 20): victim p99 under a tenant
+            # storm quotas on/off, per-tenant shed attribution, autoscale
+            # grow->shrink cycle with zero lost in-flight
+            fleet_extras['tenants'] = bench_tenant_isolation()
+        except Exception as e:       # must never sink smoke either
+            fleet_extras['tenants'] = {'error': repr(e)}
         telemetry = _telemetry_counters()
         # cost ledger BEFORE bench_engine for the same reason as the
         # counter capture: its prefetch section resets the registry (and

@@ -1,4 +1,4 @@
-"""The graftlint AST rule catalog (GL001–GL021).
+"""The graftlint AST rule catalog (GL001–GL022).
 
 Each rule targets a TPU failure mode that is invisible in unit tests on CPU
 but destroys performance or correctness on real hardware:
@@ -95,6 +95,19 @@ but destroys performance or correctness on real hardware:
   the program in ``compilecache.CachedJit`` (warm by label) or route it
   through ``compilecache.fetch_or_compile`` so a populated artifact dir
   deserializes instead of compiling (tests/tools/bench exempt).
+
+- GL022: a bare ``time.sleep()`` retry/poll loop in library code with no
+  deadline, watchdog, or backoff in sight — the unbounded-spin sibling of
+  GL012: a loop that sleeps a fixed tick and re-checks forever turns a
+  condition that never comes true into a silent hang (and a fleet of them
+  into a thundering herd, all retrying in lockstep). Route the loop
+  through ``resilience.retry`` (bounded attempts + exponential backoff +
+  jitter + telemetry for free), or bound it with a deadline compare
+  (``Stopwatch``/``time.monotonic`` against a timeout) that raises
+  ``resilience.watchdog.WatchdogTimeout``. Backoff-shaped sleeps
+  (arithmetic/jittered delays) and deadline-bounded functions are
+  sanctioned; tests/tools/bench harnesses and the resilience package
+  itself (the sanctioned machinery) exempt.
 
 See docs/ANALYSIS.md for the full catalog with examples and waiver syntax.
 """
@@ -1845,3 +1858,144 @@ class CacheBlindServingWarmupRule(Rule):
                     "compilecache.CachedJit and warm by label (or use "
                     "compilecache.fetch_or_compile) so a populated "
                     "artifact_dir deserializes instead of compiling")
+
+
+# -- GL022: bare time.sleep retry/poll loop (no deadline/backoff/watchdog) ----
+
+# the resilience package IS the sanctioned machinery (retry backoff,
+# watchdog ticks, fault injectors whose sleeps are the injected fault);
+# harnesses measure, they don't ship
+_SLEEP_LOOP_EXEMPT_PREFIXES = ('tests/', 'tools/', 'paddle_tpu/resilience/',
+                               'resilience/')
+# any of these referenced in the module marks it retry-aware: the loop's
+# author knows the bounded machinery exists and routed something through it
+# (module-level sanction — precision over recall, like GL021's cache check)
+_RETRY_SANCTION_NAMES = {'retry', 'retry_call', 'bounded_get',
+                         'join_thread', 'wait_proc'}
+# a Compare touching one of these is a deadline check bounding the loop
+_DEADLINE_NAME_HINTS = ('deadline', 'timeout', 'budget', 'expires',
+                        'until')
+_CLOCK_CALL_TAILS = {'monotonic', 'perf_counter', 'time', 'elapsed',
+                     'elapsed_ms'}
+
+
+def _module_retry_aware(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in _RETRY_SANCTION_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _RETRY_SANCTION_NAMES:
+            return True
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in node.names] + \
+                [a.asname or '' for a in node.names]
+            if any(n.split('.')[-1] in _RETRY_SANCTION_NAMES
+                   for n in names if n):
+                return True
+    return False
+
+
+def _mentions_deadline(node):
+    """A node subtree that reads a clock or a deadline-named value."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and \
+                _tail_name(n.func) in _CLOCK_CALL_TAILS:
+            return True
+        if isinstance(n, ast.Name) and any(
+                h in n.id.lower() for h in _DEADLINE_NAME_HINTS):
+            return True
+        if isinstance(n, ast.Attribute) and any(
+                h in n.attr.lower() for h in _DEADLINE_NAME_HINTS):
+            return True
+    return False
+
+
+def _scope_deadline_bounded(scope):
+    """The enclosing function (or module) shows a time bound: a compare
+    against a clock/deadline value, or a raise of a *Timeout error."""
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Compare):
+            if _mentions_deadline(n):
+                return True
+        elif isinstance(n, ast.Raise) and n.exc is not None:
+            exc = n.exc.func if isinstance(n.exc, ast.Call) else n.exc
+            tail = _tail_name(exc)
+            if tail and 'timeout' in tail.lower():
+                return True
+    return False
+
+
+@register
+class BareSleepRetryLoopRule(Rule):
+    """GL022: ``time.sleep()`` inside a retry/poll loop in library code
+    with nothing bounding it. A loop that sleeps a fixed tick and
+    re-checks forever turns "the condition never comes true" into a
+    silent hang — no counter moves, no watchdog fires, and a fleet of
+    identical fixed-tick retriers hammers the recovering dependency in
+    lockstep (no jitter). Sanctioned shapes: a deadline compare or
+    ``*Timeout`` raise in the enclosing function (bounded poll), a
+    backoff-shaped delay (arithmetic or call-derived — it grows or
+    jitters), or a module that routes retries through
+    ``resilience.retry``/``watchdog`` machinery."""
+    id = 'GL022'
+    title = 'bare time.sleep retry/poll loop (unbounded, no backoff)'
+
+    def in_scope(self, rel):
+        if any(rel.startswith(p) for p in _SLEEP_LOOP_EXEMPT_PREFIXES):
+            return False
+        base = rel.rsplit('/', 1)[-1]
+        return not base.startswith('bench')
+
+    def check(self, ctx):
+        if not self.in_scope(ctx.rel_path):
+            return
+        if _module_retry_aware(ctx.tree):
+            return
+        parents = {}
+        for node in ast.walk(ctx.tree):
+            for ch in ast.iter_child_nodes(node):
+                parents[ch] = node
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and _tail_name(node.func) == 'sleep'
+                    and _root_name(node.func) in ('time', 'sleep')):
+                continue
+            # backoff-shaped delay: arithmetic or a call (jitter, a
+            # schedule) — it grows or varies, which is the fix's point
+            if node.args and isinstance(node.args[0],
+                                        (ast.BinOp, ast.Call)):
+                continue
+            # nearest enclosing loop, without crossing a def boundary (a
+            # sleep in a nested function defined inside a loop does not
+            # run per-iteration)
+            cur, loop = parents.get(node), None
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+                if isinstance(cur, (ast.While, ast.For, ast.AsyncFor)):
+                    loop = cur
+                    break
+                cur = parents.get(cur)
+            if loop is None:
+                continue
+            # evidence scope: the nearest enclosing function, else module
+            scope = loop
+            while scope in parents and not isinstance(
+                    scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = parents[scope]
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                scope = ctx.tree
+            if _scope_deadline_bounded(scope):
+                continue
+            yield self.finding(
+                ctx, node,
+                "bare `time.sleep()` in a retry/poll loop with no "
+                "deadline, watchdog, or backoff in the enclosing "
+                "function — if the condition never comes true this spins "
+                "silently forever, and a fleet of fixed-tick retriers "
+                "thunders in lockstep; route the loop through "
+                "resilience.retry (bounded attempts + exponential "
+                "backoff + jitter + telemetry) or bound it with a "
+                "deadline compare that raises resilience.watchdog."
+                "WatchdogTimeout")

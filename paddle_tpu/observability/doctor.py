@@ -61,6 +61,22 @@ Detector catalog (docs/OBSERVABILITY.md has the operator version):
                       replicas; fix the failing replica, then bound
                       max_retries / hedging and let the shed ladder
                       engage first.
+- ``noisy_neighbor``  one tenant owns >= ``noisy_share`` of the serving
+                      pressure (quota/capacity sheds + SLO violations)
+                      while other tenants share the same fleet — the
+                      multi-tenant fairness failure per-tenant quotas
+                      exist for. Reads the ``serving.tenant.*`` labeled
+                      counters (snapshot) or tenant-stamped
+                      ``serving.shed`` / ``serving.request`` events; the
+                      fix-it names the tenant and its ``TenantPolicy``
+                      rate/burst/weight knobs. Quiet with one tenant or a
+                      healthy (shed-free) fleet.
+- ``autoscale_flap``  the fleet autoscaler (or whatever is driving
+                      replica count) reversed direction grow<->shrink
+                      within a few cooldown windows, repeatedly — the
+                      oscillation the hysteresis band + cooldown are
+                      meant to make impossible; firing means a degenerate
+                      band, cooldown 0, or two controllers fighting.
 - ``cold_compile_storm`` a persistent compile cache is bound yet the boot
                       is compiling anyway: cached executables rejected at
                       load (CRC mismatch / jax version skew —
@@ -143,6 +159,10 @@ COMPILE_CREEP_GRACE = 3        # post-plateau compiles tolerated
 COLD_STORM_COMPILES = 5        # boot compiles despite a populated cache
 COLD_STORM_HIT_RATE = 0.5      # persistent-tier hit rate below = storm
 COLD_STORM_INCOMPAT = 1        # rejected cache entries tolerated - 1
+NOISY_SHARE = 0.6              # one tenant's share of sheds + violations
+NOISY_MIN_PRESSURE = 5         # sheds + violations before a share counts
+FLAP_REVERSALS = 2             # grow<->shrink direction flips = flapping
+FLAP_WINDOW_COOLDOWNS = 3      # reversal counts within N cooldown spans
 
 
 def _labeled(section, prefix, key='model'):
@@ -1099,6 +1119,154 @@ def detect_cold_compile_storm(events=None, snapshot=None, cluster=None,
             jax_compiles=compiles, cache_entries=entries)
 
 
+def detect_noisy_neighbor(events=None, snapshot=None, cluster=None,
+                          noisy_share=NOISY_SHARE,
+                          noisy_min_pressure=NOISY_MIN_PRESSURE, **_):
+    """One tenant dominates the serving pressure on a shared fleet.
+
+    Pressure = that tenant's sheds (every reason — quota, queue_full,
+    page_exhaustion) + SLO violations. Sources, snapshot first (labeled
+    ``serving.tenant.shed{tenant=}`` / ``serving.tenant.violations``
+    counters), tenant-stamped ``serving.shed`` / ``serving.request``
+    events filling what the snapshot lacks — max of the two per tenant,
+    never the sum. Needs >= 2 tenants with traffic (a single-tenant
+    engine owning 100% of its own sheds is ``serving_overload``'s
+    business, not a neighbor problem). Victim evidence (the worst other
+    tenant's violations / event-path p99) rides along when present."""
+    sheds, violations, requests = {}, {}, {}
+    if snapshot is not None:
+        ctr = snapshot.get('counters')
+        sheds.update(_labeled(ctr, 'serving.tenant.shed', key='tenant'))
+        violations.update(_labeled(ctr, 'serving.tenant.violations',
+                                   key='tenant'))
+        requests.update(_labeled(ctr, 'serving.tenant.requests',
+                                 key='tenant'))
+    ev_sheds, ev_viol, ev_reqs, ev_lat = {}, {}, {}, {}
+    for e in (events or []):
+        ten = e.get('tenant')
+        if ten is None:
+            continue
+        ten = str(ten)
+        if e.get('ev') == 'serving.shed':
+            ev_sheds[ten] = ev_sheds.get(ten, 0) + 1
+        elif e.get('ev') == 'serving.request':
+            ev_reqs[ten] = ev_reqs.get(ten, 0) + 1
+            if e.get('status') not in (None, 'ok'):
+                ev_viol[ten] = ev_viol.get(ten, 0) + 1
+            if isinstance(e.get('latency_ms'), (int, float)):
+                ev_lat.setdefault(ten, []).append(float(e['latency_ms']))
+    for src, dst in ((ev_sheds, sheds), (ev_viol, violations),
+                     (ev_reqs, requests)):
+        for ten, n in src.items():
+            dst[ten] = max(dst.get(ten, 0), n)
+    tenants = set(requests) | set(sheds) | set(violations)
+    if len(tenants) < 2:
+        return
+    pressure = {t: sheds.get(t, 0) + violations.get(t, 0) for t in tenants}
+    total = sum(pressure.values())
+    if total < noisy_min_pressure:
+        return
+    noisy, p = max(pressure.items(), key=lambda kv: (kv[1], kv[0]))
+    share = p / total
+    if share < noisy_share:
+        return
+    victims = {t: v for t, v in pressure.items() if t != noisy}
+    victim = max(victims, key=lambda t: (victims[t],
+                                         len(ev_lat.get(t, [])))) \
+        if victims else None
+    evidence = {'tenant': noisy, 'share': round(share, 3),
+                'sheds': int(sheds.get(noisy, 0)),
+                'violations': int(violations.get(noisy, 0)),
+                'pressure_total': int(total),
+                'per_tenant_pressure': {t: int(v) for t, v
+                                        in sorted(pressure.items())}}
+    detail = (f"tenant {noisy!r} accounts for {share:.0%} of the serving "
+              f"pressure ({int(p)} of {int(total)} sheds+violations) on a "
+              f"fleet shared by {len(tenants)} tenants")
+    if victim is not None and ev_lat.get(victim):
+        lat = sorted(ev_lat[victim])
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+        detail += (f"; tenant {victim!r} is collateral "
+                   f"(p99 {p99:.1f}ms over {len(lat)} request(s))")
+        evidence['victim'] = victim
+        evidence['victim_p99_ms'] = round(p99, 3)
+    severity = 'critical' if share >= (1 + noisy_share) / 2 else 'warning'
+    yield _diag(
+        'noisy_neighbor', severity, detail,
+        f"cap tenant {noisy!r}: register a TenantPolicy with a tighter "
+        "token bucket (rate=/burst=) so its overflow sheds as 'quota' at "
+        "the front door instead of consuming shared queue/page capacity, "
+        "and drop its weight= so weighted-fair admission stops favoring "
+        "it; if the tenant is legitimately hot, scale the fleet "
+        "(FleetAutoscaler) instead of letting it starve its neighbors",
+        **evidence)
+
+
+def detect_autoscale_flap(events=None, snapshot=None, cluster=None,
+                          flap_reversals=FLAP_REVERSALS,
+                          flap_window_cooldowns=FLAP_WINDOW_COOLDOWNS,
+                          **_):
+    """The replica count is oscillating: ``fleet.autoscale`` grow/shrink
+    actions keep reversing direction within a few cooldown windows. A
+    correctly configured autoscaler cannot do this — the hysteresis band
+    means one signal value never justifies both directions, and the
+    cooldown + fresh-sustain window spaces opposing actions out — so
+    firing means the band is degenerate (burn_low ~ burn_high), cooldown
+    is ~0, the pressure signal itself whipsaws across both thresholds
+    slower than the window (undersized sustain_ticks), or two
+    controllers are fighting (e.g. an autoscaler shrinking replicas a
+    supervisor keeps resurrecting). Counter fallback: both
+    ``fleet.autoscale.grows`` and ``.shrinks`` high with no event
+    timeline still warns."""
+    acts = []
+    for e in (events or []):
+        if e.get('ev') == 'fleet.autoscale' and \
+                e.get('action') in ('grow', 'shrink'):
+            acts.append((e['action'], int(e.get('tick', 0)),
+                         int(e.get('cooldown_ticks', 0))))
+    reversals = 0
+    pairs = []
+    for (a1, t1, _c1), (a2, t2, c2) in zip(acts, acts[1:]):
+        window = max(1, c2) * flap_window_cooldowns
+        if a1 != a2 and (t2 - t1) <= window:
+            reversals += 1
+            pairs.append({'from': a1, 'to': a2, 'tick_gap': t2 - t1,
+                          'window': window})
+    if reversals >= flap_reversals:
+        severity = 'critical' if reversals >= 2 * flap_reversals \
+            else 'warning'
+        yield _diag(
+            'autoscale_flap', severity,
+            f"the fleet reversed scaling direction {reversals} time(s) "
+            f"within {flap_window_cooldowns} cooldown window(s) "
+            f"({len(acts)} grow/shrink action(s) total) — capacity is "
+            "oscillating, every cycle paying replica boot + drain for "
+            "nothing",
+            "widen the autoscaler's hysteresis band (burn_low well below "
+            "burn_high), raise cooldown_ticks and sustain_ticks so one "
+            "noisy burst cannot justify an action, and check nothing "
+            "else is mutating the same fleet (a FleetSupervisor "
+            "resurrecting replicas the autoscaler drains, or two "
+            "autoscalers on one router)",
+            reversals=reversals, actions=len(acts),
+            recent_reversals=pairs[-3:])
+        return
+    if not acts and snapshot is not None:
+        grows = _ctr(snapshot, 'fleet.autoscale.grows')
+        shrinks = _ctr(snapshot, 'fleet.autoscale.shrinks')
+        if min(grows, shrinks) >= flap_reversals:
+            yield _diag(
+                'autoscale_flap', 'warning',
+                f"{int(grows)} grow(s) and {int(shrinks)} shrink(s) in "
+                "one window with no event timeline to order them — the "
+                "fleet is likely oscillating",
+                "enable the event log for the timeline, then widen the "
+                "autoscaler's hysteresis band / raise cooldown_ticks "
+                "(see the fleet.autoscale events for which signal "
+                "crossings drove each action)",
+                grows=int(grows), shrinks=int(shrinks))
+
+
 DETECTORS = {
     'straggler': detect_straggler,
     'retrace_storm': detect_retrace_storm,
@@ -1112,6 +1280,8 @@ DETECTORS = {
     'elastic_downsize': detect_elastic_downsize,
     'replica_flapping': detect_replica_flapping,
     'retry_storm': detect_retry_storm,
+    'noisy_neighbor': detect_noisy_neighbor,
+    'autoscale_flap': detect_autoscale_flap,
     'cold_compile_storm': detect_cold_compile_storm,
     'lint_debt': detect_lint_debt,
     'page_leak': detect_page_leak,

@@ -37,6 +37,14 @@ against, on CPU, deterministically:
   abrupt engine death right after admitting the Nth request, a wedged
   scheduler that stays "alive" while nothing progresses, and a per-pump
   delay producing a deterministic p99 straggler for hedging tests;
+- ``tenant_storm`` — deterministic Poisson request bursts from one tenant
+  through ``submit()`` (engine, endpoint, or router) over VIRTUAL ticks —
+  the noisy-neighbor model for per-tenant quotas, weighted-fair admission,
+  and the doctor's ``noisy_neighbor`` detector; no wall-clock sleeps;
+- ``burn_ramp`` — fabricate completed-request judgments straight into the
+  SLO tracker so a model's error-budget burn rate reaches a chosen level
+  deterministically (the sustained-burn model the fleet autoscaler's grow
+  path and the doctor's ``slo_burn`` detector key on) without real traffic;
 - ``hold_lock`` / ``RacingCall`` — the forced-interleaving hooks for data-
   race regression tests (graftlint GC001-class bugs): freeze a writer at
   its guarded critical section by holding the guard from the test thread,
@@ -63,7 +71,7 @@ __all__ = ['FaultInjector', 'flaky', 'poison_loss', 'corrupt_file',
            'boot_fail', 'PoisonedSampleError', 'slow_fs', 'disk_full',
            'sigterm_at_step', 'kill_rank_at_step', 'kill_replica_at_request',
            'hang_replica', 'slow_replica', 'ReplicaHang', 'hold_lock',
-           'RacingCall']
+           'RacingCall', 'tenant_storm', 'burn_ramp']
 
 
 class InjectedWriteError(OSError):
@@ -644,6 +652,98 @@ class PreemptAtStep:
                 self.seen += 1
 
         return _Preempter(step)
+
+
+def _poisson(rng, lam):
+    """Knuth's Poisson sampler off a seeded ``random.Random`` — the burst
+    sizes are a pure function of (seed, draw index)."""
+    import math
+    limit = math.exp(-float(lam))
+    k, p = 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return k
+        k += 1
+
+
+def tenant_storm(target, model, inputs, tenant='storm', qps=8.0,
+                 duration_ticks=10, seed=0, **submit_kw):
+    """Deterministic noisy-neighbor traffic: Poisson bursts from one tenant.
+
+    Each of ``duration_ticks`` VIRTUAL ticks draws a Poisson(``qps``)
+    burst size from a seeded RNG and fires that many ``target.submit(model,
+    inputs, tenant=tenant)`` calls back-to-back — ``target`` is anything
+    with the serving submit signature (``ServingEngine``, ``Endpoint.submit``
+    host object, ``FleetRouter``). No wall-clock sleeps anywhere: "qps" is
+    per virtual tick, so the same seed always produces the same burst
+    train and the same shed pattern, and the caller pumps/settles between
+    ticks however its harness drives the engine.
+
+    Over-quota and over-capacity submits (``QueueFullError`` and
+    subclasses, e.g. ``QuotaExceededError``) are absorbed and tallied by
+    their ``reason``. Returns::
+
+        {'attempts': int, 'submitted': int, 'shed': {reason: n},
+         'per_tick': [burst sizes], 'pending': [admitted handles]}
+
+    so a test can assert the storm really was shed as ``quota`` (not
+    ``queue_full``) and still settle the admitted remainder.
+    """
+    import random
+    from ..serving.scheduler import QueueFullError
+    rng = random.Random(int(seed))
+    out = {'attempts': 0, 'submitted': 0, 'shed': {},
+           'per_tick': [], 'pending': []}
+    for _ in range(int(duration_ticks)):
+        burst = _poisson(rng, qps)
+        out['per_tick'].append(burst)
+        for _ in range(burst):
+            out['attempts'] += 1
+            try:
+                pending = target.submit(model, inputs, tenant=tenant,
+                                        **submit_kw)
+            except QueueFullError as e:
+                reason = getattr(e, 'reason', 'queue_full')
+                out['shed'][reason] = out['shed'].get(reason, 0) + 1
+            else:
+                out['submitted'] += 1
+                out['pending'].append(pending)
+    return out
+
+
+def burn_ramp(model, burn=2.0, requests=20, target_ms=50.0,
+              objective=0.9):
+    """Drive ``model``'s SLO error-budget burn rate to ``burn``, now.
+
+    Feeds ``requests`` fabricated completed-request judgments straight
+    into the SLO tracker (``observability.slo.record``): the fraction
+    needed for the target burn is recorded as over-target latencies
+    (status ``'ok'`` but 2x the objective — exactly what a degrading
+    backend produces), the rest comfortably under it. Registers a
+    ``target_ms``/``objective`` objective when the model has none. Burn
+    is a cumulative ratio, so one call *sustains*: every subsequent
+    autoscaler/doctor observation sees the same rate until real traffic
+    or ``slo.reset()`` dilutes it — which is what makes "sustained burn
+    for N ticks" testable without wall-clock time. Returns the achieved
+    burn rate.
+    """
+    from ..observability import slo as _slo
+    obj = _slo.objective(model)
+    if obj is None:
+        obj = _slo.set_objective(model, target_ms, objective)
+    budget = max(1.0 - obj['objective'], 1e-9)
+    requests = max(1, int(requests))
+    # burn = (violations/requests)/budget  =>  violations to fabricate:
+    violations = min(requests, max(0, round(float(burn) * budget
+                                            * requests)))
+    achieved = None
+    for i in range(requests):
+        if i < violations:
+            achieved = _slo.record(model, 'ok', obj['target_ms'] * 2.0)
+        else:
+            achieved = _slo.record(model, 'ok', obj['target_ms'] * 0.5)
+    return achieved
 
 
 @contextlib.contextmanager

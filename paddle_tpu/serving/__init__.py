@@ -17,6 +17,12 @@ compiled-program caches and served under load with
 - **production edges** (``scheduler``) — bounded admission queues with
   429-style shedding, per-request deadlines (expired work is dropped, not
   run), watchdog-bounded client waits;
+- **tenancy + elasticity** (``admission`` / ``autoscaler``) — per-tenant
+  weighted-fair (deficit-round-robin) admission with token-bucket quotas
+  (over-quota submits shed with reason ``quota``), per-tenant SLO burn
+  isolation, and an autoscaler that grows the router fleet on sustained
+  SLO burn (warm, zero-compile via the artifact tier) and shrinks it
+  through ``drain()`` with zero aborted in-flight work;
 - **telemetry** on the PR 3 spine — ``serving.*`` counters, latency /
   queue-wait / batch-occupancy histograms, per-request events
   (``tools/telemetry_dump.py --serving`` summarizes them).
@@ -30,6 +36,9 @@ Quick start (docs/SERVING.md has the full guide)::
     engine.start()           # background worker thread
     resp = ep.predict({'x': features}, deadline_ms=50)
 """
+from .admission import (DEFAULT_TENANT, QuotaExceededError, TenantArbiter,
+                        TenantPolicy, WeightedFairQueue, tenant_stats)
+from .autoscaler import FleetAutoscaler
 from .bucketing import (DEFAULT_BATCH_BUCKETS, BucketSpec, pad_to_bucket,
                         select_bucket, stack_examples)
 from .engine import Endpoint, EngineDeadError, ServingEngine
@@ -45,15 +54,17 @@ from .runners import BatchRunner, GenerativeRunner
 from .scheduler import (AdmissionQueue, PendingRequest, QueueFullError,
                         Request, Response, STATUS_CANCELLED,
                         STATUS_DEADLINE, STATUS_ERROR, STATUS_OK)
-from . import (bucketing, engine, fleet_supervisor,  # noqa: F401
-               kv_cache, paged_kv, paged_runner, router, runners,
-               scheduler)
+from . import (admission, autoscaler, bucketing, engine,  # noqa: F401
+               fleet_supervisor, kv_cache, paged_kv, paged_runner, router,
+               runners, scheduler)
 
 __all__ = [
     'ServingEngine', 'Endpoint', 'EngineDeadError',
     'FleetRouter', 'RouterPolicy', 'ReplicaHandle', 'CircuitBreaker',
     'FleetPending', 'ReplicaError', 'NoHealthyReplicaError',
-    'FleetOverloadError', 'FleetSupervisor',
+    'FleetOverloadError', 'FleetSupervisor', 'FleetAutoscaler',
+    'TenantPolicy', 'TenantArbiter', 'WeightedFairQueue',
+    'QuotaExceededError', 'DEFAULT_TENANT', 'tenant_stats',
     'BucketSpec', 'DEFAULT_BATCH_BUCKETS', 'select_bucket', 'pad_to_bucket',
     'stack_examples',
     'GenerativeSpec', 'TinyCausalLM',

@@ -33,6 +33,7 @@ import numpy as np
 
 from .. import observability as _obs
 from ..resilience.watchdog import join_thread
+from .admission import WeightedFairQueue, record_shed
 from .paged_runner import PagedGenerativeRunner
 from .runners import BatchRunner, GenerativeRunner, _count
 from .scheduler import (AdmissionQueue, PendingRequest, QueueFullError,
@@ -59,26 +60,38 @@ class Endpoint:
         self._engine = engine
         self.model = model
 
-    def submit(self, inputs, deadline_ms=None, max_new_tokens=None):
+    def submit(self, inputs, deadline_ms=None, max_new_tokens=None,
+               tenant=None):
         """Enqueue one request -> ``PendingRequest``. Raises
-        ``QueueFullError`` when the admission queue sheds it (429-style),
+        ``QueueFullError`` when the admission queue sheds it (429-style,
+        including the tenant-quota flavor ``QuotaExceededError``),
         ``ValueError`` when inputs don't match the registered spec."""
         return self._engine.submit(self.model, inputs,
                                    deadline_ms=deadline_ms,
-                                   max_new_tokens=max_new_tokens)
+                                   max_new_tokens=max_new_tokens,
+                                   tenant=tenant)
 
     def predict(self, inputs, deadline_ms=None, max_new_tokens=None,
-                timeout=None):
+                timeout=None, tenant=None):
         """Blocking one-call convenience: submit + result."""
         return self.submit(inputs, deadline_ms=deadline_ms,
-                           max_new_tokens=max_new_tokens).result(
-                               timeout=timeout)
+                           max_new_tokens=max_new_tokens,
+                           tenant=tenant).result(timeout=timeout)
 
 
 class ServingEngine:
-    def __init__(self, queue_capacity=256, default_deadline_ms=None):
+    def __init__(self, queue_capacity=256, default_deadline_ms=None,
+                 tenants=None):
+        """``tenants=`` attaches a ``serving.admission.TenantArbiter``:
+        every model's queue becomes a ``WeightedFairQueue`` (deficit-
+        round-robin pop order by tenant weight) and ``submit`` charges the
+        tenant's token-bucket quota before the queue push — over-quota
+        submits shed as ``QuotaExceededError`` (reason ``'quota'``)
+        without ever touching the queue (docs/SERVING.md, "Tenancy +
+        autoscaling")."""
         self.queue_capacity = int(queue_capacity)
         self.default_deadline_ms = default_deadline_ms
+        self.tenants = tenants         # TenantArbiter or None
         self._models = {}              # name -> runner
         self._queues = {}              # name -> AdmissionQueue
         self._rr = []                  # round-robin order
@@ -89,7 +102,8 @@ class ServingEngine:
         self._shed = 0
         self._shed_queue_full = 0      # real overload: offered > drained
         self._shed_page_exhaustion = 0  # memory pressure wearing a queue-
-        self._submitted = 0             # full mask (doctor tells them apart)
+        self._shed_quota = 0           # full mask (doctor tells them apart)
+        self._submitted = 0
         self._endpoint = None          # MetricsServer this engine owns
         self._own_sampler = False      # ring sampler this engine started
         self._killed = False           # chaos: abrupt death, see kill()
@@ -190,9 +204,12 @@ class ServingEngine:
         if slo_ms is not None:
             from ..observability import slo as _slo
             _slo.set_objective(name, slo_ms, slo_objective)
-        queue = AdmissionQueue(name,
-                               self.queue_capacity if queue_capacity is None
-                               else queue_capacity)
+        capacity = (self.queue_capacity if queue_capacity is None
+                    else queue_capacity)
+        if self.tenants is not None:
+            queue = WeightedFairQueue(name, capacity, arbiter=self.tenants)
+        else:
+            queue = AdmissionQueue(name, capacity)
         if generative is not None:
             if kv_cache == 'paged':
                 runner = PagedGenerativeRunner(
@@ -337,7 +354,8 @@ class ServingEngine:
             return False
         return bool(getattr(runner, 'page_starved', lambda: False)())
 
-    def submit(self, model, inputs, deadline_ms=None, max_new_tokens=None):
+    def submit(self, model, inputs, deadline_ms=None, max_new_tokens=None,
+               tenant=None):
         if self._killed:
             raise EngineDeadError(
                 f"serving: engine is dead (killed) — request for "
@@ -352,8 +370,17 @@ class ServingEngine:
                 f"serving: max_new_tokens must be >= 1, got "
                 f"{max_new_tokens!r}")
         req = Request(model, inputs, deadline_ms=deadline_ms,
-                      max_new_tokens=max_new_tokens)
+                      max_new_tokens=max_new_tokens, tenant=tenant)
         runner.validate(req)
+        if self.tenants is not None:
+            # quota gate at the front door, BEFORE the queue push: a shed
+            # here never touches the queue, so the queue-full path below
+            # can keep stamping its own reasons without masking 'quota'
+            try:
+                self.tenants.check(req.tenant, model)
+            except QueueFullError as e:
+                self._record_shed(req, e.reason)
+                raise
         _count('serving.requests')
         if _obs.enabled():
             # open the request's async trace lane BEFORE the queue push:
@@ -364,7 +391,8 @@ class ServingEngine:
             # speculative verify) renders as ONE connected flow, closed
             # by finish_request's async_end (or the shed edge below).
             _obs.async_begin('request', req.id, cat='serving.request',
-                             model=model, deadline_ms=deadline_ms)
+                             model=model, deadline_ms=deadline_ms,
+                             tenant=req.tenant)
         try:
             self._queues[model].push(req)
         except QueueFullError as e:
@@ -373,24 +401,7 @@ class ServingEngine:
             # the doctor must not prescribe replicas for an OOM
             starved = getattr(runner, 'page_starved', lambda: False)()
             e.reason = 'page_exhaustion' if starved else 'queue_full'
-            with self._lock:
-                # submit() runs on arbitrary client threads while the
-                # endpoint's health probe reads these; += is a racy
-                # read-modify-write without the lock
-                self._shed += 1
-                if e.reason == 'page_exhaustion':
-                    self._shed_page_exhaustion += 1
-                else:
-                    self._shed_queue_full += 1
-            _count('serving.shed')
-            _count('serving.shed.page_exhaustion'
-                   if e.reason == 'page_exhaustion'
-                   else 'serving.shed.queue_full')
-            if _obs.enabled():
-                _obs.event('serving.shed', model=model, request=req.id,
-                           reason=e.reason)
-                _obs.async_end('request', req.id, cat='serving.request',
-                               status='shed', reason=e.reason)
+            self._record_shed(req, e.reason, lane_open=True)
             raise
         with self._cond:
             self._submitted += 1
@@ -399,6 +410,30 @@ class ServingEngine:
                     sum(len(q) for q in self._queues.values()))
             self._cond.notify_all()
         return PendingRequest(req, self.alive)
+
+    def _record_shed(self, req, reason, lane_open=False):
+        """Tally one shed (reason: queue_full / page_exhaustion / quota)
+        under the lock, mirror to telemetry, attribute to the tenant."""
+        with self._lock:
+            # submit() runs on arbitrary client threads while the
+            # endpoint's health probe reads these; += is a racy
+            # read-modify-write without the lock
+            self._shed += 1
+            if reason == 'page_exhaustion':
+                self._shed_page_exhaustion += 1
+            elif reason == 'quota':
+                self._shed_quota += 1
+            else:
+                self._shed_queue_full += 1
+        _count('serving.shed')
+        _count(f'serving.shed.{reason}')
+        record_shed(req.tenant, reason)
+        if _obs.enabled():
+            _obs.event('serving.shed', model=req.model, request=req.id,
+                       reason=reason, tenant=req.tenant)
+            if lane_open:
+                _obs.async_end('request', req.id, cat='serving.request',
+                               status='shed', reason=reason)
 
     def cancel(self, pending):
         """Withdraw a still-queued request: it is removed from the
@@ -672,13 +707,19 @@ class ServingEngine:
     # -- introspection --------------------------------------------------
     def stats(self):
         from ..observability import slo as _slo
-        return {
+        out = {
             'submitted': self._submitted,
             'shed': self._shed,
             'shed_queue_full': self._shed_queue_full,
             'shed_page_exhaustion': self._shed_page_exhaustion,
+            'shed_quota': self._shed_quota,
             'queue_depth': {n: len(q) for n, q in self._queues.items()},
             'models': {n: r.stats.as_dict()
                        for n, r in self._models.items()},
             'slo_burn': _slo.burn_rates(),
         }
+        if self.tenants is not None:
+            from .admission import tenant_stats
+            out['tenants'] = {'policies': self.tenants.stats(),
+                              'ledger': tenant_stats()}
+        return out

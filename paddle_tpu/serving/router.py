@@ -56,6 +56,7 @@ from .. import observability as _obs
 from ..observability.timing import Stopwatch
 from ..resilience.retry import backoff_delay
 from ..resilience.watchdog import WatchdogTimeout
+from .admission import DEFAULT_TENANT, QuotaExceededError, record_shed
 from .engine import EngineDeadError
 from .paged_kv import chain_hashes
 from .scheduler import (QueueFullError, Response, STATUS_CANCELLED,
@@ -338,15 +339,16 @@ class _FleetRequest:
     __slots__ = ('id', 'model', 'inputs', 'deadline_ms', 'max_new_tokens',
                  'priority', 'idempotent', 'generative', 'affinity', 'sw',
                  'attempts', 'tried', 'retries_used', 'hedged', 'fail_fast',
-                 'lock', 'settled')
+                 'lock', 'settled', 'tenant')
 
     def __init__(self, model, inputs, deadline_ms, max_new_tokens, priority,
-                 idempotent, generative, affinity):
+                 idempotent, generative, affinity, tenant=None):
         self.id = next(_fleet_ids)
         self.model = model
         self.inputs = inputs
         self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
         self.max_new_tokens = max_new_tokens
+        self.tenant = tenant
         self.priority = int(priority)
         self.idempotent = idempotent
         self.generative = generative
@@ -408,8 +410,9 @@ class FleetRouter:
     (``start()``) or manually pumped (the router never pumps for dispatch,
     but ``drain()`` will pump a manual-drive replica to completion)."""
 
-    def __init__(self, policy=None):
+    def __init__(self, policy=None, tenants=None):
         self.policy = policy or RouterPolicy()
+        self.tenants = tenants         # admission.TenantArbiter or None
         self._handles = {}
         self._lock = threading.Lock()
         self._rr = itertools.count()   # tie-break rotation for _pick
@@ -463,20 +466,29 @@ class FleetRouter:
             return SHED_PRIORITY
         return SHED_NONE
 
-    def _shed_gate(self, model, priority):
+    def _shed_gate(self, model, priority, tenant=None):
         level = self.shed_level()
+        # ladder level 1 is tenant-aware when an arbiter is attached:
+        # "reject below THIS tenant's priority_floor" — a premium tenant
+        # (floor 0) keeps flowing at level 1 while a batch tenant (high
+        # floor) sheds first; without tenancy the global policy floor
+        # applies as before
+        floor = (self.tenants.priority_floor(tenant)
+                 if self.tenants is not None
+                 else self.policy.shed_priority_floor)
         if level >= SHED_REJECT or (
-                level >= SHED_PRIORITY and
-                priority < self.policy.shed_priority_floor):
+                level >= SHED_PRIORITY and priority < floor):
             reason = _SHED_NAMES[level]
             if _obs.enabled():
                 _obs.counter('serving.router.shed').inc()
                 _obs.event('serving.router.shed', model=model,
-                           level=level, reason=reason, priority=priority)
+                           level=level, reason=reason, priority=priority,
+                           tenant=tenant)
             raise FleetOverloadError(
                 f"router: fleet shedding at level {level} ({reason}) — "
-                f"request for {model!r} (priority {priority}) rejected; "
-                "retry with backoff", level=level, reason=reason)
+                f"request for {model!r} (priority {priority}, floor "
+                f"{floor}) rejected; retry with backoff",
+                level=level, reason=reason)
         return level
 
     # -- placement ------------------------------------------------------
@@ -538,7 +550,12 @@ class FleetRouter:
             try:
                 pending = h.engine.submit(
                     fr.model, fr.inputs, deadline_ms=fr.remaining_ms(),
-                    max_new_tokens=fr.max_new_tokens)
+                    max_new_tokens=fr.max_new_tokens, tenant=fr.tenant)
+            except QuotaExceededError:
+                # tenant-global, not replica-local: every replica would
+                # answer the same, so burning failover candidates on it
+                # only hides the real shed reason — surface it
+                raise
             except QueueFullError as e:
                 # backed-up replica: a health signal, not a breaker trip —
                 # the queue-depth gate handles persistent backlog
@@ -579,7 +596,7 @@ class FleetRouter:
             return attempt
 
     def submit(self, model, inputs, deadline_ms=None, max_new_tokens=None,
-               priority=1, idempotent=None):
+               priority=1, idempotent=None, tenant=None):
         """Route one request into the fleet -> ``FleetPending``.
 
         ``priority`` feeds the shed ladder (higher survives longer;
@@ -588,14 +605,32 @@ class FleetRouter:
         infer — one-shot requests are idempotent, generative requests are
         retried only while no partial output exists; ``False`` pins the
         request to its first replica (a continuation whose replay would
-        double-generate). Raises ``FleetOverloadError`` when the shed
+        double-generate). ``tenant`` names the submitting tenant: with a
+        ``tenants=`` arbiter attached, the token-bucket quota is charged
+        here (over-quota raises ``QuotaExceededError``, reason
+        ``'quota'``) and ladder level 1 rejects below the *tenant's*
+        ``priority_floor``. Raises ``FleetOverloadError`` when the shed
         ladder rejects, ``NoHealthyReplicaError`` when no replica can
         take it, ``KeyError`` when no replica serves ``model``."""
         with self._lock:
             handles = list(self._handles.values())
         if not any(h.engine.has_model(model) for h in handles):
             raise KeyError(f"router: no replica serves model {model!r}")
-        level = self._shed_gate(model, priority)
+        if self.tenants is not None:
+            # fleet front door owns the quota charge — replica engines in
+            # this fleet must NOT share the same arbiter, or each request
+            # is double-charged
+            try:
+                self.tenants.check(tenant, model)
+            except QuotaExceededError as e:
+                record_shed(tenant, e.reason)
+                if _obs.enabled():
+                    _obs.counter('serving.shed').inc()
+                    _obs.counter('serving.shed.quota').inc()
+                    _obs.event('serving.shed', model=model, reason=e.reason,
+                               tenant=tenant or DEFAULT_TENANT)
+                raise
+        level = self._shed_gate(model, priority, tenant=tenant)
         generative = any(h.engine.has_model(model) and
                          h.engine.model_kind(model) == 'generative'
                          for h in handles)
@@ -608,10 +643,11 @@ class FleetRouter:
                            max_new_tokens=max_new_tokens)
         fr = _FleetRequest(model, inputs, deadline_ms, max_new_tokens,
                            priority, idempotent, generative,
-                           self._affinity_key(model, inputs, generative))
+                           self._affinity_key(model, inputs, generative),
+                           tenant=tenant)
         if _obs.enabled():
             _obs.async_begin('fleet', fr.id, cat='serving.fleet',
-                             model=model, priority=priority)
+                             model=model, priority=priority, tenant=tenant)
         try:
             self._dispatch(fr, kind='first')
         except NoHealthyReplicaError:
@@ -620,14 +656,20 @@ class FleetRouter:
                 _obs.async_end('fleet', fr.id, cat='serving.fleet',
                                status='no_replica')
             raise
+        except QuotaExceededError:
+            if _obs.enabled():
+                _obs.async_end('fleet', fr.id, cat='serving.fleet',
+                               status='shed', reason='quota')
+            raise
         return FleetPending(self, fr)
 
     def predict(self, model, inputs, deadline_ms=None, max_new_tokens=None,
-                priority=1, idempotent=None, timeout=None):
+                priority=1, idempotent=None, timeout=None, tenant=None):
         """Blocking one-call convenience: submit + result."""
         return self.submit(model, inputs, deadline_ms=deadline_ms,
                            max_new_tokens=max_new_tokens, priority=priority,
-                           idempotent=idempotent).result(timeout=timeout)
+                           idempotent=idempotent,
+                           tenant=tenant).result(timeout=timeout)
 
     # -- the retry/hedge state machine ----------------------------------
     @staticmethod

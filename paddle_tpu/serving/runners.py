@@ -62,10 +62,13 @@ def finish_request(req, status, outputs=None, error=None):
     _count(f'serving.status.{status}')
     from ..observability import slo as _slo
     _slo.record(req.model, status, resp.latency_ms)
+    from .admission import record_completion
+    record_completion(req, status, resp.latency_ms)
     if _obs.enabled():
         _obs.histogram('serving.latency_ms').observe(resp.latency_ms)
         _obs.histogram('serving.queue_wait_ms').observe(resp.queue_ms)
         _obs.event('serving.request', model=req.model, status=status,
+                   tenant=getattr(req, 'tenant', None) or 'default',
                    latency_ms=round(resp.latency_ms, 3),
                    queue_ms=round(resp.queue_ms, 3),
                    **{f'{k}_ms': round(v, 3)

@@ -106,14 +106,16 @@ class Request:
     """
 
     __slots__ = ('id', 'model', 'inputs', 'deadline_ms', 'max_new_tokens',
-                 'sw', 'queue_ms', 'phase_ms', '_event', 'response')
+                 'tenant', 'sw', 'queue_ms', 'phase_ms', '_event', 'response')
 
-    def __init__(self, model, inputs, deadline_ms=None, max_new_tokens=None):
+    def __init__(self, model, inputs, deadline_ms=None, max_new_tokens=None,
+                 tenant=None):
         self.id = next(_ids)
         self.model = model
         self.inputs = inputs
         self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
         self.max_new_tokens = max_new_tokens
+        self.tenant = tenant or 'default'   # admission.DEFAULT_TENANT
         self.sw = Stopwatch()          # lifetime clock, started at submit
         self.queue_ms = 0.0
         self.phase_ms = {}             # runner-attributed wall ms per phase

@@ -70,5 +70,7 @@ def _module_telemetry_isolation():
     endpoint.stop_active_server()
     obs.flight.uninstall_crash_hooks()
     obs.reset()
+    from paddle_tpu.serving import admission
+    admission.reset_tenant_stats()
     if os.environ.get("PADDLE_TPU_TELEMETRY") != "1":
         obs.disable()

@@ -123,6 +123,10 @@ AST_FIXTURES = {
               "def poll(events):\n"
               "    for e in events:\n"
               "        _LOG.append(e)\n", "_LOG.append(e)"),
+    'GL022': ("import time\n"
+              "def wait_ready(client):\n"
+              "    while not client.ready():\n"
+              "        time.sleep(0.5)\n", "time.sleep(0.5)"),
 }
 
 
@@ -1077,6 +1081,112 @@ def test_gl021_repo_serving_runners_lint_clean():
     assert n == 2
     assert [f for f in findings if f.rule == 'GL021'] == [], \
         [(f.path, f.line) for f in findings if f.rule == 'GL021']
+
+
+# ---------------------------------------------------------------------------
+# GL022: bare time.sleep retry/poll loop (unbounded, no backoff)
+# ---------------------------------------------------------------------------
+
+_BARE_SLEEP_SRC = (
+    "import time\n"
+    "def wait_ready(client):\n"
+    "    while not client.ready():\n"
+    "        time.sleep(0.5)\n"                          # flagged
+    "def poll_file(path, items):\n"
+    "    for _ in range(10):\n"
+    "        time.sleep(1.0)\n"                          # flagged too
+    "def once():\n"
+    "    time.sleep(0.5)\n")                 # not in a loop: out of shape
+
+
+def test_gl022_flags_bare_sleep_loops(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    (lib / 'mod.py').write_text(_BARE_SLEEP_SRC)
+    findings, _ = lint_paths([str(lib / 'mod.py')],
+                             scan_root=str(tmp_path))
+    hits = sorted(f.line for f in findings if f.rule == 'GL022')
+    assert len(hits) == 2, [(f.rule, f.line) for f in findings]
+    lines = _BARE_SLEEP_SRC.splitlines()
+    assert all('time.sleep' in lines[ln - 1] for ln in hits)
+    msg = [f for f in findings if f.rule == 'GL022'][0].message
+    # fix-it points at the bounded machinery
+    assert 'resilience.retry' in msg and 'WatchdogTimeout' in msg
+
+
+def test_gl022_deadline_bounded_loop_is_sanctioned(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    src = (
+        "import time\n"
+        "def wait_ready(client, timeout=5.0):\n"
+        "    deadline = time.monotonic() + timeout\n"
+        "    while not client.ready():\n"
+        "        if time.monotonic() >= deadline:\n"
+        "            raise TimeoutError('never became ready')\n"
+        "        time.sleep(0.1)\n")
+    (lib / 'ok.py').write_text(src)
+    findings, _ = lint_paths([str(lib / 'ok.py')],
+                             scan_root=str(tmp_path))
+    assert [f for f in findings if f.rule == 'GL022'] == [], \
+        [(f.rule, f.line) for f in findings]
+
+
+def test_gl022_backoff_and_retry_aware_are_sanctioned(tmp_path):
+    lib = tmp_path / 'paddle_tpu'
+    lib.mkdir(exist_ok=True)
+    # backoff-shaped delay: arithmetic — it grows, the fix's whole point
+    (lib / 'backoff.py').write_text(
+        "import time\n"
+        "def wait_ready(client):\n"
+        "    delay = 0.05\n"
+        "    while not client.ready():\n"
+        "        time.sleep(delay * 2)\n")
+    # module routes retries through the sanctioned machinery
+    (lib / 'aware.py').write_text(
+        "import time\n"
+        "from paddle_tpu.resilience import retry\n"
+        "def wait_ready(client):\n"
+        "    while not client.ready():\n"
+        "        time.sleep(0.5)\n")
+    for name in ('backoff.py', 'aware.py'):
+        findings, _ = lint_paths([str(lib / name)],
+                                 scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL022'] == [], name
+
+
+def test_gl022_exempts_harnesses_and_waiver(tmp_path):
+    for rel in ('tests/mod.py', 'tools/mod.py', 'bench_x.py',
+                'paddle_tpu/resilience/mod.py'):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_BARE_SLEEP_SRC)
+        findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+        assert [f for f in findings if f.rule == 'GL022'] == [], rel
+    # inline waiver honored and excluded from the active set
+    p = tmp_path / 'lib.py'
+    p.write_text(
+        "import time\n"
+        "def wait_ready(client):\n"
+        "    while not client.ready():\n"
+        "        time.sleep(0.5)"
+        "  # graftlint: disable=GL022 — caller holds the deadline\n")
+    findings, _ = lint_paths([str(p)], scan_root=str(tmp_path))
+    hits = [f for f in findings if f.rule == 'GL022']
+    assert len(hits) == 1 and hits[0].waived
+    from paddle_tpu.analysis.finding import active
+    assert active(hits) == []
+
+
+def test_gl022_repo_lints_clean():
+    """Every in-tree sleep loop is deadline-bounded (router drain/response
+    waits, launch joins, process-pool error drain) — the rule must agree."""
+    findings, _ = lint_paths([os.path.join(REPO, 'paddle_tpu')],
+                             scan_root=REPO)
+    active_hits = [f for f in findings
+                   if f.rule == 'GL022' and not f.waived]
+    assert active_hits == [], \
+        [(f.path, f.line) for f in active_hits]
 
 
 def test_ten_distinct_rule_ids_on_seeded_fixtures(tmp_path):

@@ -104,7 +104,8 @@ class _FakeEngine:
     def alive(self):
         return False
 
-    def submit(self, model, inputs, deadline_ms=None, max_new_tokens=None):
+    def submit(self, model, inputs, deadline_ms=None, max_new_tokens=None,
+               tenant=None):
         self.max_new_tokens_seen.append(max_new_tokens)
 
         class _P:

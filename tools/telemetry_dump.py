@@ -139,6 +139,56 @@ def serving_summary(events):
     router_sheds = sum(1 for e in events
                        if e.get('ev') == 'serving.router.shed')
 
+    # per-tenant table: the cumulative serving.tenant_stats ledger event is
+    # authoritative where present (last one wins, same contract as
+    # kv_stats/router_stats); tenant-stamped serving.request/serving.shed
+    # events fill latency percentiles and cover bare event-log runs
+    ledger = {}
+    for e in reversed(events):
+        if e.get('ev') == 'serving.tenant_stats' and \
+                isinstance(e.get('tenants'), dict):
+            ledger = {str(t): dict(row) for t, row in e['tenants'].items()
+                      if isinstance(row, dict)}
+            break
+    t_reqs, t_lats, t_sheds = {}, {}, {}
+    for e in reqs:
+        ten = e.get('tenant')
+        if ten is None:
+            continue
+        ten = str(ten)
+        t_reqs[ten] = t_reqs.get(ten, 0) + 1
+        if isinstance(e.get('latency_ms'), (int, float)):
+            t_lats.setdefault(ten, []).append(float(e['latency_ms']))
+    for e in sheds:
+        ten = e.get('tenant')
+        if ten is None:
+            continue
+        ten = str(ten)
+        reason = str(e.get('reason', '?'))
+        t_sheds.setdefault(ten, {})[reason] = \
+            t_sheds.get(ten, {}).get(reason, 0) + 1
+    tenants = {}
+    for ten in sorted(set(ledger) | set(t_reqs) | set(t_sheds)):
+        row = ledger.get(ten, {})
+        shed_by_reason = row.get('shed') if isinstance(row.get('shed'),
+                                                       dict) \
+            else t_sheds.get(ten, {})
+        tenants[ten] = {
+            'requests': int(row.get('requests', t_reqs.get(ten, 0))),
+            'violations': int(row.get('violations', 0)),
+            'shed': {str(k): int(v)
+                     for k, v in (shed_by_reason or {}).items()},
+            'p50_latency_ms': pct(t_lats.get(ten, []), 50),
+            'p99_latency_ms': pct(t_lats.get(ten, []), 99),
+            'burn': row.get('burn'),
+        }
+    # one implicit default-tenant row with nothing shed is just the
+    # single-tenant engine talking about itself — not a tenant table
+    if set(tenants) == {'default'} and \
+            not tenants['default']['shed'] and \
+            not tenants['default']['violations']:
+        tenants = {}
+
     return {
         'requests': len(reqs),
         'by_status': by_status,
@@ -161,6 +211,7 @@ def serving_summary(events):
         'fleet_requests': len(fleet_reqs),
         'fleet_shed': router_sheds,
         'fleet_shed_level': shed_level,
+        'tenants': tenants,
     }
 
 
@@ -219,6 +270,23 @@ def render_serving(summary):
                 f"{int(r.get('drained', 0)):>8} "
                 f"{int(r.get('deaths', 0)):>7} "
                 f"{str(r.get('circuit', '?')):>9}")
+    tenants = summary.get('tenants') or {}
+    if tenants:
+        lines.append(f"  tenants: {len(tenants)}")
+        width = max([len('tenant')] + [len(t) for t in tenants])
+        lines.append(
+            f"    {'tenant':<{width}} {'requests':>8} {'shed':>16} "
+            f"{'p50 ms':>8} {'p99 ms':>8} {'burn':>6}")
+        for name in sorted(tenants):
+            t = tenants[name]
+            shed = ', '.join(f"{k}: {v}"
+                             for k, v in sorted(t['shed'].items())) or '-'
+            burn = ('-' if t.get('burn') is None
+                    else f"{float(t['burn']):.2f}")
+            lines.append(
+                f"    {name:<{width}} {t['requests']:>8} {shed:>16} "
+                f"{t['p50_latency_ms']:>8} {t['p99_latency_ms']:>8} "
+                f"{burn:>6}")
     return '\n'.join(lines)
 
 
@@ -396,7 +464,8 @@ def main(argv=None):
     p.add_argument('--serving', action='store_true',
                    help='summarize serving.* events (request counts by '
                         'status/model, latency + queue percentiles, shed '
-                        'and join/leave tallies) instead of the table')
+                        'and join/leave tallies, per-tenant requests/'
+                        'shed-by-reason/p50/p99/burn) instead of the table')
     p.add_argument('--costs', action='store_true',
                    help='tabulate cost.program events (the cost explorer: '
                         'per-program FLOPs, bytes accessed, peak memory, '
